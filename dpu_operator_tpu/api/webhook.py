@@ -1,0 +1,62 @@
+"""Validating webhook logic for TpuOperatorConfig.
+
+Reference: api/v1/dpuoperatorconfig_webhook.go:50-61 — enforce the singleton
+name and a valid mode. The TPU build additionally validates sliceTopology
+against known accelerator generations. The HTTP admission wrapper lives in
+``dpu_operator_tpu.webhook``; this module is the pure logic so envtest-style
+unit tests (reference: dpuoperatorconfig_webhook_test.go) need no server.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..utils import vars as v
+from .types import MODES
+
+
+class ValidationError(ValueError):
+    pass
+
+
+_TOPOLOGY_RE = re.compile(r"^(v[2-6][ep]?)-(\d+)$")
+
+# chips-per-slice upper bounds by generation (public TPU podslice sizes)
+_MAX_CHIPS = {"v2": 512, "v3": 1024, "v4": 4096, "v5e": 256, "v5p": 8960,
+              "v6e": 256}
+
+
+def validate_slice_topology(topology: str) -> None:
+    if topology == "":
+        return
+    m = _TOPOLOGY_RE.match(topology)
+    if not m:
+        raise ValidationError(
+            f"invalid sliceTopology {topology!r}: want <gen>-<chips>, "
+            f"e.g. v5e-16")
+    gen, chips = m.group(1), int(m.group(2))
+    limit = _MAX_CHIPS.get(gen)
+    if limit is None:
+        raise ValidationError(f"unknown TPU generation {gen!r}")
+    if chips < 1 or chips > limit:
+        raise ValidationError(
+            f"sliceTopology {topology!r}: chip count out of range (1..{limit})")
+
+
+def validate_tpu_operator_config(obj: dict) -> None:
+    """Raise ValidationError on an invalid CR; mirror of
+    validateDpuOperatorConfig (dpuoperatorconfig_webhook.go:50-61)."""
+    name = obj.get("metadata", {}).get("name", "")
+    if name != v.CONFIG_NAME:
+        raise ValidationError(
+            f"invalid name {name!r}: TpuOperatorConfig is a singleton named "
+            f"{v.CONFIG_NAME!r}")
+    spec = obj.get("spec", {}) or {}
+    mode = spec.get("mode", "auto")
+    if mode not in MODES:
+        raise ValidationError(f"invalid mode {mode!r}: want one of {MODES}")
+    log_level = spec.get("logLevel", 0)
+    if (not isinstance(log_level, int) or isinstance(log_level, bool)
+            or log_level < 0):
+        raise ValidationError(f"invalid logLevel {log_level!r}")
+    validate_slice_topology(spec.get("sliceTopology", ""))

@@ -1,0 +1,128 @@
+"""Tiny controller-runtime analog: Manager + Reconciler + workqueue.
+
+Reference: cmd/main.go:45-133 builds a ctrl.Manager, registers reconcilers via
+SetupWithManager, then mgr.Start blocks. Here a Manager owns watch
+registrations and a single worker thread draining a deduplicating workqueue —
+the same level-triggered reconcile semantics controller-runtime provides.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Request:
+    api_version: str
+    kind: str
+    name: str
+    namespace: Optional[str] = None
+
+
+@dataclass
+class ReconcileResult:
+    requeue_after: Optional[float] = None
+
+
+class Reconciler(Protocol):
+    #: (api_version, kind) this reconciler watches
+    watches: tuple
+
+    def reconcile(self, client, req: Request) -> ReconcileResult: ...
+
+
+class Manager:
+    def __init__(self, client):
+        self.client = client
+        self._reconcilers: list[Reconciler] = []
+        self._queue: "queue.Queue[tuple[Reconciler, Request]]" = queue.Queue()
+        self._pending: set[tuple[int, Request]] = set()
+        self._lock = threading.Lock()
+        self._cancels = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._idle = threading.Event()
+        self._idle.set()
+        self._inflight_timers = 0
+
+    def add_reconciler(self, rec: Reconciler):
+        self._reconcilers.append(rec)
+
+    def _enqueue(self, rec: Reconciler, req: Request):
+        key = (id(rec), req)
+        with self._lock:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+        self._idle.clear()
+        self._queue.put((rec, req))
+
+    def start(self):
+        for rec in self._reconcilers:
+            api_version, kind = rec.watches
+
+            def cb(event, obj, rec=rec, api_version=api_version, kind=kind):
+                md = obj.get("metadata", {})
+                self._enqueue(rec, Request(api_version, kind, md.get("name"),
+                                           md.get("namespace") or None))
+            self._cancels.append(self.client.watch(api_version, kind, cb))
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="manager-worker")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        for c in self._cancels:
+            c()
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Test helper: block until the workqueue drains."""
+        return self._idle.wait(timeout)
+
+    def _schedule_retry(self, delay: float, rec, req,
+                        timers: list) -> None:
+        with self._lock:
+            self._inflight_timers += 1
+
+        def fire():
+            self._enqueue(rec, req)
+            with self._lock:
+                self._inflight_timers -= 1
+
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        t.start()
+        timers.append(t)
+
+    def _run(self):
+        timers: list[threading.Timer] = []
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                break
+            rec, req = item
+            with self._lock:
+                self._pending.discard((id(rec), req))
+            try:
+                result = rec.reconcile(self.client, req) or ReconcileResult()
+            except Exception:
+                log.exception("reconcile failed for %s", req)
+                self._schedule_retry(0.5, rec, req, timers)
+                result = ReconcileResult()
+            if result.requeue_after:
+                self._schedule_retry(result.requeue_after, rec, req, timers)
+            with self._lock:
+                if (not self._pending and self._queue.empty()
+                        and self._inflight_timers == 0):
+                    self._idle.set()
+        for t in timers:
+            t.cancel()

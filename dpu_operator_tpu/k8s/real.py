@@ -1,0 +1,184 @@
+"""Real apiserver client over HTTP (requests + kubeconfig).
+
+Production counterpart of FakeKube. The reference gets this from
+controller-runtime; here it is a thin REST mapper: core group objects under
+/api/v1, everything else under /apis/<group>/<version>. Watches poll with
+resourceVersion (list+watch semantics degraded to periodic relist — sufficient
+for the operator's level-triggered reconcilers).
+
+Untested in this environment (no live cluster); covered by the same KubeClient
+protocol the FakeKube tests exercise.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Optional
+
+import yaml
+
+try:
+    import requests
+except ImportError:  # pragma: no cover
+    requests = None
+
+# Plural-name heuristics for REST path mapping; irregulars listed explicitly.
+_IRREGULAR_PLURALS = {
+    "Endpoints": "endpoints",
+    "NetworkAttachmentDefinition": "network-attachment-definitions",
+    "CustomResourceDefinition": "customresourcedefinitions",
+}
+
+
+def plural(kind: str) -> str:
+    if kind in _IRREGULAR_PLURALS:
+        return _IRREGULAR_PLURALS[kind]
+    k = kind.lower()
+    if k.endswith("s"):
+        return k + "es"
+    if k.endswith("y"):
+        return k[:-1] + "ies"
+    return k + "s"
+
+
+class RealKube:
+    def __init__(self, kubeconfig: Optional[str] = None):
+        if requests is None:  # pragma: no cover
+            raise RuntimeError("requests not available")
+        path = kubeconfig or os.environ.get("KUBECONFIG",
+                                            os.path.expanduser("~/.kube/config"))
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = cfg.get("current-context")
+        ctx = next(c for c in cfg["contexts"] if c["name"] == ctx_name)["context"]
+        cluster = next(c for c in cfg["clusters"]
+                       if c["name"] == ctx["cluster"])["cluster"]
+        user = next(u for u in cfg["users"] if u["name"] == ctx["user"])["user"]
+        self.base = cluster["server"].rstrip("/")
+        self.session = requests.Session()
+        ca = cluster.get("certificate-authority-data")
+        if ca:
+            f = tempfile.NamedTemporaryFile(delete=False, suffix=".crt")
+            f.write(base64.b64decode(ca))
+            f.close()
+            self.session.verify = f.name
+        elif cluster.get("certificate-authority"):
+            self.session.verify = cluster["certificate-authority"]
+        if user.get("token"):
+            self.session.headers["Authorization"] = f"Bearer {user['token']}"
+        elif user.get("client-certificate-data"):
+            cf = tempfile.NamedTemporaryFile(delete=False, suffix=".crt")
+            cf.write(base64.b64decode(user["client-certificate-data"]))
+            cf.close()
+            kf = tempfile.NamedTemporaryFile(delete=False, suffix=".key")
+            kf.write(base64.b64decode(user["client-key-data"]))
+            kf.close()
+            self.session.cert = (cf.name, kf.name)
+        self._watch_threads: list[threading.Thread] = []
+
+    def _url(self, api_version: str, kind: str, namespace: Optional[str],
+             name: Optional[str] = None, subresource: Optional[str] = None):
+        if "/" in api_version:
+            prefix = f"{self.base}/apis/{api_version}"
+        else:
+            prefix = f"{self.base}/api/{api_version}"
+        parts = []
+        if namespace:
+            parts += ["namespaces", namespace]
+        parts.append(plural(kind))
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return prefix + "/" + "/".join(parts)
+
+    def get(self, api_version, kind, name, namespace=None):
+        r = self.session.get(self._url(api_version, kind, namespace, name))
+        if r.status_code == 404:
+            return None
+        r.raise_for_status()
+        return r.json()
+
+    def list(self, api_version, kind, namespace=None, label_selector=None):
+        params = {}
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items())
+        r = self.session.get(self._url(api_version, kind, namespace),
+                             params=params)
+        r.raise_for_status()
+        return r.json().get("items", [])
+
+    def create(self, obj):
+        md = obj["metadata"]
+        r = self.session.post(
+            self._url(obj["apiVersion"], obj["kind"], md.get("namespace")),
+            json=obj)
+        r.raise_for_status()
+        return r.json()
+
+    def update(self, obj):
+        md = obj["metadata"]
+        r = self.session.put(
+            self._url(obj["apiVersion"], obj["kind"], md.get("namespace"),
+                      md["name"]), json=obj)
+        r.raise_for_status()
+        return r.json()
+
+    def apply(self, obj):
+        md = obj["metadata"]
+        r = self.session.patch(
+            self._url(obj["apiVersion"], obj["kind"], md.get("namespace"),
+                      md["name"]),
+            params={"fieldManager": "tpu-operator", "force": "true"},
+            headers={"Content-Type": "application/apply-patch+yaml"},
+            data=json.dumps(obj))
+        r.raise_for_status()
+        return r.json()
+
+    def delete(self, api_version, kind, name, namespace=None):
+        r = self.session.delete(self._url(api_version, kind, namespace, name))
+        if r.status_code not in (200, 202, 404):
+            r.raise_for_status()
+
+    def update_status(self, obj):
+        md = obj["metadata"]
+        r = self.session.put(
+            self._url(obj["apiVersion"], obj["kind"], md.get("namespace"),
+                      md["name"], subresource="status"), json=obj)
+        r.raise_for_status()
+        return r.json()
+
+    def watch(self, api_version, kind, callback: Callable, poll: float = 5.0):
+        stop = threading.Event()
+
+        def run():
+            seen: dict[str, tuple[str, dict]] = {}
+            while not stop.is_set():
+                try:
+                    current: dict[str, tuple[str, dict]] = {}
+                    for obj in self.list(api_version, kind):
+                        uid = obj["metadata"]["uid"]
+                        rv = obj["metadata"]["resourceVersion"]
+                        if uid not in seen:
+                            callback("ADDED", obj)
+                        elif seen[uid][0] != rv:
+                            callback("MODIFIED", obj)
+                        current[uid] = (rv, obj)
+                    for uid, (_, old) in seen.items():
+                        if uid not in current:
+                            callback("DELETED", old)
+                    seen = current
+                except Exception:
+                    pass
+                stop.wait(poll)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+        return stop.set

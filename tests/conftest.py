@@ -1,0 +1,40 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX loads.
+
+Multi-chip hardware is unavailable in CI; sharded code is validated on
+XLA's host-platform virtual devices (the reference's analog trick is
+FakePlatform + MockVsp + Kind, SURVEY.md §4).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+from dpu_operator_tpu.images import DummyImageManager  # noqa: E402
+from dpu_operator_tpu.k8s import FakeKube, FakeNodeAgent  # noqa: E402
+
+
+@pytest.fixture
+def kube():
+    return FakeKube()
+
+
+@pytest.fixture
+def node_agent(kube):
+    agent = FakeNodeAgent(kube)
+    agent.start()
+    yield agent
+    agent.stop()
+
+
+@pytest.fixture
+def images():
+    return DummyImageManager()

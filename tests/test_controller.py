@@ -1,0 +1,97 @@
+"""Operator controller integration tests against FakeKube.
+
+Reference analog: internal/controller/dpuoperatorconfig_controller_test.go
+(:116-170) — asserting that applying the CR materializes the daemon DaemonSet,
+the NAD, and the injector deployment, for host and tpu modes, and that the
+DaemonSet lands only on labelled nodes.
+"""
+
+import pytest
+
+from dpu_operator_tpu.api import TpuOperatorConfig, TpuOperatorConfigSpec
+from dpu_operator_tpu.controller import (
+    ServiceFunctionChainClusterReconciler,
+    TpuOperatorConfigReconciler,
+)
+from dpu_operator_tpu.k8s import Manager
+from dpu_operator_tpu.utils import DEFAULT_NAD_NAME, NAMESPACE
+
+
+@pytest.fixture
+def manager(kube, images, tmp_path):
+    from dpu_operator_tpu.utils.filesystem_mode_detector import (
+        FilesystemModeDetector,
+    )
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    mgr = Manager(kube)
+    mgr.add_reconciler(TpuOperatorConfigReconciler(
+        images,
+        path_manager=PathManager(str(tmp_path)),
+        fs_detector=FilesystemModeDetector(str(tmp_path))))
+    mgr.add_reconciler(ServiceFunctionChainClusterReconciler())
+    mgr.start()
+    yield mgr
+    mgr.stop()
+
+
+def _apply_cfg(kube, mode="host"):
+    cfg = TpuOperatorConfig(spec=TpuOperatorConfigSpec(mode=mode))
+    return kube.create(cfg.to_obj())
+
+
+def test_reconcile_creates_daemonset(kube, manager):
+    _apply_cfg(kube, mode="host")
+    assert manager.wait_idle()
+    ds = kube.get("apps/v1", "DaemonSet", "tpu-daemon", namespace=NAMESPACE)
+    assert ds is not None
+    tmpl = ds["spec"]["template"]["spec"]
+    assert tmpl["nodeSelector"] == {"tpu": "true"}
+    env = {e["name"]: e.get("value") for e in
+           tmpl["containers"][0]["env"] if "value" in e}
+    assert env["TPU_VSP_IMAGE"] == "TpuVspImage-mock-image"
+
+
+@pytest.mark.parametrize("mode,cni_mode", [("host", "chip"),
+                                           ("tpu", "network-function")])
+def test_reconcile_creates_mode_switched_nad(kube, manager, mode, cni_mode):
+    _apply_cfg(kube, mode=mode)
+    assert manager.wait_idle()
+    nad = kube.get("k8s.cni.cncf.io/v1", "NetworkAttachmentDefinition",
+                   DEFAULT_NAD_NAME, namespace="default")
+    assert nad is not None
+    assert f'"mode": "{cni_mode}"' in nad["spec"]["config"]
+
+
+def test_reconcile_creates_injector_deployment(kube, manager):
+    _apply_cfg(kube)
+    assert manager.wait_idle()
+    dep = kube.get("apps/v1", "Deployment", "network-resources-injector",
+                   namespace=NAMESPACE)
+    assert dep is not None
+
+
+def test_daemonset_lands_on_labelled_nodes_only(kube, node_agent, manager):
+    node_agent.register_node("worker-0", labels={"tpu": "true"})
+    node_agent.register_node("worker-1", labels={})
+    _apply_cfg(kube)
+    assert manager.wait_idle()
+    pods = kube.list("v1", "Pod", namespace=NAMESPACE,
+                     label_selector={"app": "tpu-daemon"})
+    assert [p["spec"]["nodeName"] for p in pods] == ["worker-0"]
+
+
+def test_cr_delete_garbage_collects(kube, manager):
+    _apply_cfg(kube)
+    assert manager.wait_idle()
+    kube.delete("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                "tpu-operator-config")
+    assert kube.get("apps/v1", "DaemonSet", "tpu-daemon",
+                    namespace=NAMESPACE) is None
+
+
+def test_status_reports_flavour(kube, manager):
+    _apply_cfg(kube)
+    assert manager.wait_idle()
+    obj = kube.get("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                   "tpu-operator-config")
+    assert obj["status"]["flavour"] == "kind"

@@ -1,0 +1,81 @@
+"""FakeKube behavior tests — the envtest/Kind-analog foundation."""
+
+import pytest
+
+from dpu_operator_tpu.k8s import FakeKube, FakeNodeAgent
+from dpu_operator_tpu.k8s.fake import AlreadyExists, Conflict
+
+
+def _cm(name, ns="default", data=None):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": ns},
+            "data": data or {}}
+
+
+def test_create_get_roundtrip(kube):
+    kube.create(_cm("a", data={"k": "v"}))
+    got = kube.get("v1", "ConfigMap", "a", namespace="default")
+    assert got["data"] == {"k": "v"}
+    assert got["metadata"]["uid"]
+
+
+def test_create_duplicate_raises(kube):
+    kube.create(_cm("a"))
+    with pytest.raises(AlreadyExists):
+        kube.create(_cm("a"))
+
+
+def test_update_conflict_on_stale_rv(kube):
+    kube.create(_cm("a"))
+    fresh = kube.get("v1", "ConfigMap", "a", namespace="default")
+    kube.update(fresh)
+    stale = dict(fresh)
+    with pytest.raises(Conflict):
+        kube.update(stale)
+
+
+def test_apply_merges(kube):
+    kube.create(_cm("a", data={"k1": "v1"}))
+    kube.apply(_cm("a", data={"k2": "v2"}))
+    got = kube.get("v1", "ConfigMap", "a", namespace="default")
+    assert got["data"] == {"k1": "v1", "k2": "v2"}
+
+
+def test_watch_sees_existing_and_new(kube):
+    kube.create(_cm("a"))
+    events = []
+    cancel = kube.watch("v1", "ConfigMap", lambda e, o: events.append((e, o["metadata"]["name"])))
+    kube.create(_cm("b"))
+    assert ("ADDED", "a") in events and ("ADDED", "b") in events
+    cancel()
+    kube.create(_cm("c"))
+    assert all(n != "c" for _, n in events)
+
+
+def test_pod_scheduling_respects_allocatable(kube):
+    agent = FakeNodeAgent(kube)
+    agent.start()
+    agent.register_node("n0", allocatable={"google.com/tpu": "4"})
+
+    def tpu_pod(name, n):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"containers": [{
+                    "name": "c", "image": "x",
+                    "resources": {"requests": {"google.com/tpu": str(n)}}}]},
+                "status": {"phase": "Pending"}}
+
+    kube.create(tpu_pod("p1", 4))
+    agent.sync()
+    assert kube.get("v1", "Pod", "p1", namespace="default")["status"]["phase"] == "Running"
+
+    # second pod exceeds capacity → Pending (e2e_test.go:525-593 analog)
+    kube.create(tpu_pod("p2", 1))
+    agent.sync()
+    assert kube.get("v1", "Pod", "p2", namespace="default")["status"]["phase"] == "Pending"
+
+    # free capacity → p2 schedules
+    kube.delete("v1", "Pod", "p1", namespace="default")
+    agent.sync()
+    assert kube.get("v1", "Pod", "p2", namespace="default")["status"]["phase"] == "Running"
+    agent.stop()

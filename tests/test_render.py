@@ -1,0 +1,61 @@
+"""Render engine tests (reference analog: render.go behaviors — lexical
+ordering, missingkey=error, owner refs, apply tolerance)."""
+
+import os
+
+import pytest
+
+from dpu_operator_tpu.k8s import FakeKube
+from dpu_operator_tpu.render import (
+    RenderError,
+    apply_all_from_bindata,
+    render_dir,
+    render_template,
+)
+
+
+def test_render_template_substitutes():
+    assert render_template("name: {{Name}}-x", {"Name": "a"}) == "name: a-x"
+
+
+def test_render_template_missing_key_errors():
+    with pytest.raises(RenderError, match="Nope"):
+        render_template("{{Nope}}", {})
+
+
+def test_render_dir_lexical_order(tmp_path):
+    (tmp_path / "02.b.yaml").write_text(
+        "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: b\n")
+    (tmp_path / "01.a.yaml").write_text(
+        "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: a\n")
+    objs = render_dir(str(tmp_path), {})
+    assert [o["metadata"]["name"] for o in objs] == ["a", "b"]
+
+
+def test_apply_all_sets_owner_refs(tmp_path):
+    (tmp_path / "01.cm.yaml").write_text(
+        "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: {{Name}}\n"
+        "  namespace: default\n")
+    kube = FakeKube()
+    owner = kube.create({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "owner", "namespace": "default"}})
+    applied = apply_all_from_bindata(kube, str(tmp_path), {"Name": "child"},
+                                     owner=owner)
+    assert applied[0]["metadata"]["ownerReferences"][0]["name"] == "owner"
+    # apply twice tolerated (AlreadyExists parity, render.go:84-92)
+    apply_all_from_bindata(kube, str(tmp_path), {"Name": "child"}, owner=owner)
+
+
+def test_owner_gc_cascades(tmp_path):
+    (tmp_path / "01.cm.yaml").write_text(
+        "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: child\n"
+        "  namespace: default\n")
+    kube = FakeKube()
+    owner = kube.create({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "owner", "namespace": "default"}})
+    apply_all_from_bindata(kube, str(tmp_path), {}, owner=owner)
+    assert kube.get("v1", "ConfigMap", "child", namespace="default")
+    kube.delete("v1", "ConfigMap", "owner", namespace="default")
+    assert kube.get("v1", "ConfigMap", "child", namespace="default") is None

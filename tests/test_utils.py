@@ -1,0 +1,69 @@
+"""Utils tests.
+
+Reference analog: internal/utils/filesystem_mode_detector_test.go (afero
+MemMapFs probe of /run/ostree-booted incl. permission-denied) and
+path_manager flavour-dependent CNI dirs.
+"""
+
+import os
+
+import pytest
+
+from dpu_operator_tpu.utils import FilesystemModeDetector, FsMode, PathManager
+from dpu_operator_tpu.utils.cluster_environment import (
+    ClusterEnvironment,
+    Flavour,
+)
+
+
+def test_fs_mode_rpm_when_absent(tmp_path):
+    assert FilesystemModeDetector(str(tmp_path)).detect_mode() == FsMode.RPM
+
+
+def test_fs_mode_ostree_when_present(tmp_path):
+    os.makedirs(tmp_path / "run", exist_ok=True)
+    (tmp_path / "run/ostree-booted").write_text("")
+    assert FilesystemModeDetector(str(tmp_path)).detect_mode() == FsMode.OSTREE
+
+
+@pytest.mark.skipif(os.geteuid() == 0, reason="root bypasses permissions")
+def test_fs_mode_permission_denied(tmp_path):
+    os.makedirs(tmp_path / "run", exist_ok=True)
+    probe = tmp_path / "run/ostree-booted"
+    probe.write_text("")
+    probe.chmod(0o000)
+    with pytest.raises(PermissionError):
+        FilesystemModeDetector(str(tmp_path)).detect_mode()
+
+
+def test_path_manager_flavour_dirs(tmp_path):
+    pm = PathManager(str(tmp_path))
+    assert pm.cni_host_dir("openshift").endswith("var/lib/cni/bin")
+    assert pm.cni_host_dir("microshift").endswith("opt/cni/bin")
+    assert pm.vendor_plugin_socket().startswith(str(tmp_path))
+
+
+def test_ensure_socket_dir(tmp_path):
+    pm = PathManager(str(tmp_path))
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    assert os.path.isdir(os.path.dirname(sock))
+
+
+def test_flavour_microshift(kube):
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "microshift-version",
+                              "namespace": "kube-public"}})
+    assert ClusterEnvironment(kube).flavour() == Flavour.MICROSHIFT
+
+
+def test_flavour_openshift(kube):
+    kube.create({"apiVersion": "apiextensions.k8s.io/v1",
+                 "kind": "CustomResourceDefinition",
+                 "metadata": {
+                     "name": "clusterversions.config.openshift.io"}})
+    assert ClusterEnvironment(kube).flavour() == Flavour.OPENSHIFT
+
+
+def test_flavour_kind_fallback(kube):
+    assert ClusterEnvironment(kube).flavour() == Flavour.KIND

@@ -1,0 +1,60 @@
+"""Validating webhook unit tests.
+
+Reference analog: api/v1/dpuoperatorconfig_webhook_test.go — singleton name and
+mode enforcement, extended here with sliceTopology validation.
+"""
+
+import pytest
+
+from dpu_operator_tpu.api import (
+    TpuOperatorConfig,
+    TpuOperatorConfigSpec,
+    ValidationError,
+    validate_tpu_operator_config,
+)
+
+
+def _cfg(name="tpu-operator-config", mode="auto", topology=""):
+    return TpuOperatorConfig(
+        name=name,
+        spec=TpuOperatorConfigSpec(mode=mode, slice_topology=topology),
+    ).to_obj()
+
+
+def test_valid_config_passes():
+    validate_tpu_operator_config(_cfg())
+
+
+@pytest.mark.parametrize("mode", ["host", "tpu", "auto"])
+def test_all_modes_valid(mode):
+    validate_tpu_operator_config(_cfg(mode=mode))
+
+
+def test_bad_name_rejected():
+    with pytest.raises(ValidationError, match="singleton"):
+        validate_tpu_operator_config(_cfg(name="other"))
+
+
+def test_bad_mode_rejected():
+    with pytest.raises(ValidationError, match="mode"):
+        validate_tpu_operator_config(_cfg(mode="dpu"))
+
+
+@pytest.mark.parametrize("topo", ["v5e-4", "v5e-16", "v5p-32", "v5p-256",
+                                  "v4-64", "v6e-8"])
+def test_good_topologies(topo):
+    validate_tpu_operator_config(_cfg(topology=topo))
+
+
+@pytest.mark.parametrize("topo", ["v5e16", "v9z-4", "v5e-0", "v5e-9999",
+                                  "banana"])
+def test_bad_topologies(topo):
+    with pytest.raises(ValidationError):
+        validate_tpu_operator_config(_cfg(topology=topo))
+
+
+def test_bad_log_level():
+    obj = _cfg()
+    obj["spec"]["logLevel"] = -1
+    with pytest.raises(ValidationError, match="logLevel"):
+        validate_tpu_operator_config(obj)
