@@ -46,12 +46,19 @@ def validate_slice_topology(topology: str) -> None:
 def validate_tpu_operator_config(obj: dict) -> None:
     """Raise ValidationError on an invalid CR; mirror of
     validateDpuOperatorConfig (dpuoperatorconfig_webhook.go:50-61)."""
-    name = obj.get("metadata", {}).get("name", "")
+    if not isinstance(obj, dict):
+        raise ValidationError(f"object must be a mapping, got {type(obj).__name__}")
+    metadata = obj.get("metadata") or {}
+    if not isinstance(metadata, dict):
+        raise ValidationError("metadata must be a mapping")
+    name = metadata.get("name", "")
     if name != v.CONFIG_NAME:
         raise ValidationError(
             f"invalid name {name!r}: TpuOperatorConfig is a singleton named "
             f"{v.CONFIG_NAME!r}")
-    spec = obj.get("spec", {}) or {}
+    spec = obj.get("spec") or {}
+    if not isinstance(spec, dict):
+        raise ValidationError("spec must be a mapping")
     mode = spec.get("mode", "auto")
     if mode not in MODES:
         raise ValidationError(f"invalid mode {mode!r}: want one of {MODES}")

@@ -88,35 +88,50 @@ class Manager:
         """Test helper: block until the workqueue drains."""
         return self._idle.wait(timeout)
 
+    #: error-retry backoff bounds (controller-runtime uses 5ms..16m;
+    #: scaled down since our base reconciles are cheap)
+    RETRY_BASE = 0.5
+    RETRY_MAX = 60.0
+
     def _schedule_retry(self, delay: float, rec, req,
-                        timers: list) -> None:
+                        timers: dict) -> None:
         with self._lock:
             self._inflight_timers += 1
+
+        key = object()
 
         def fire():
             self._enqueue(rec, req)
             with self._lock:
                 self._inflight_timers -= 1
+            timers.pop(key, None)
 
         t = threading.Timer(delay, fire)
         t.daemon = True
         t.start()
-        timers.append(t)
+        timers[key] = t
 
     def _run(self):
-        timers: list[threading.Timer] = []
+        timers: dict = {}
+        failures: dict[tuple, int] = {}
         while not self._stop.is_set():
             item = self._queue.get()
             if item is None:
                 break
             rec, req = item
+            fkey = (id(rec), req)
             with self._lock:
-                self._pending.discard((id(rec), req))
+                self._pending.discard(fkey)
             try:
                 result = rec.reconcile(self.client, req) or ReconcileResult()
+                failures.pop(fkey, None)
             except Exception:
-                log.exception("reconcile failed for %s", req)
-                self._schedule_retry(0.5, rec, req, timers)
+                n = failures.get(fkey, 0)
+                failures[fkey] = n + 1
+                delay = min(self.RETRY_BASE * (2 ** n), self.RETRY_MAX)
+                log.exception("reconcile failed for %s (retry in %.1fs)",
+                              req, delay)
+                self._schedule_retry(delay, rec, req, timers)
                 result = ReconcileResult()
             if result.requeue_after:
                 self._schedule_retry(result.requeue_after, rec, req, timers)
@@ -124,5 +139,5 @@ class Manager:
                 if (not self._pending and self._queue.empty()
                         and self._inflight_timers == 0):
                     self._idle.set()
-        for t in timers:
+        for t in list(timers.values()):
             t.cancel()

@@ -14,13 +14,15 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import os
 import tempfile
 import threading
-import time
 from typing import Callable, Optional
 
 import yaml
+
+log = logging.getLogger(__name__)
 
 try:
     import requests
@@ -72,13 +74,23 @@ class RealKube:
         if user.get("token"):
             self.session.headers["Authorization"] = f"Bearer {user['token']}"
         elif user.get("client-certificate-data"):
+            key_data = user.get("client-key-data")
+            if not key_data:
+                raise ValueError(
+                    "kubeconfig user has client-certificate-data but no "
+                    "client-key-data")
             cf = tempfile.NamedTemporaryFile(delete=False, suffix=".crt")
             cf.write(base64.b64decode(user["client-certificate-data"]))
             cf.close()
             kf = tempfile.NamedTemporaryFile(delete=False, suffix=".key")
-            kf.write(base64.b64decode(user["client-key-data"]))
+            kf.write(base64.b64decode(key_data))
             kf.close()
             self.session.cert = (cf.name, kf.name)
+        else:
+            raise ValueError(
+                f"unsupported kubeconfig auth for user {ctx['user']!r}: "
+                "need token or client certificate (exec plugins / "
+                "auth-providers are not supported)")
         self._watch_threads: list[threading.Thread] = []
 
     def _url(self, api_version: str, kind: str, namespace: Optional[str],
@@ -174,8 +186,9 @@ class RealKube:
                         if uid not in current:
                             callback("DELETED", old)
                     seen = current
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — keep polling
+                    log.warning("watch poll for %s/%s failed: %s",
+                                api_version, kind, e)
                 stop.wait(poll)
 
         t = threading.Thread(target=run, daemon=True)

@@ -1,0 +1,148 @@
+"""Hardware platform abstraction.
+
+Reference: internal/platform/platform.go:13-129 — a ``Platform`` interface
+(PciDevices / NetDevs / Product / ReadDeviceSerialNumber) with a
+``HardwarePlatform`` scanning sysfs via ghw and an injectable ``FakePlatform``
+for tests. The TPU build adds accel-device enumeration (/dev/accel*) and an
+accelerator-metadata probe (TPU VM environment), which are to TPUs what PCI
+config-space serial reads (platform.go:46-77) are to DPUs.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+
+@dataclass(frozen=True)
+class PciDevice:
+    address: str          # e.g. "0000:00:04.0"
+    vendor_id: str        # e.g. "1ae0" (Google)
+    device_id: str
+    class_name: str = ""
+    product_name: str = ""
+    serial: str = ""
+    is_vf: bool = False   # sysfs physfn presence (reference: ipu.go:34-57)
+
+
+class Platform(Protocol):
+    def pci_devices(self) -> list[PciDevice]: ...
+    def net_devs(self) -> list[str]: ...
+    def product_name(self) -> str: ...
+    def accel_devices(self) -> list[str]: ...
+    def accelerator_type(self) -> str: ...
+
+
+class HardwarePlatform:
+    """Scan real sysfs/dev. The ghw analog, plus TPU-VM specifics."""
+
+    def __init__(self, root: str = "/"):
+        self.root = root
+
+    def _sys(self, *p) -> str:
+        return os.path.join(self.root, "sys", *p)
+
+    def pci_devices(self) -> list[PciDevice]:
+        out = []
+        base = self._sys("bus/pci/devices")
+        if not os.path.isdir(base):
+            return out
+        for addr in sorted(os.listdir(base)):
+            dev = os.path.join(base, addr)
+
+            def read(name, default=""):
+                try:
+                    with open(os.path.join(dev, name)) as f:
+                        return f.read().strip()
+                except OSError:
+                    return default
+
+            out.append(PciDevice(
+                address=addr,
+                vendor_id=read("vendor").replace("0x", ""),
+                device_id=read("device").replace("0x", ""),
+                class_name=read("class"),
+                serial=read("serial"),
+                is_vf=os.path.exists(os.path.join(dev, "physfn")),
+            ))
+        return out
+
+    def net_devs(self) -> list[str]:
+        base = self._sys("class/net")
+        if not os.path.isdir(base):
+            return []
+        return sorted(os.listdir(base))
+
+    def product_name(self) -> str:
+        try:
+            with open(self._sys("devices/virtual/dmi/id/product_name")) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    def accel_devices(self) -> list[str]:
+        """TPU chip character devices: /dev/accel* (TPU VM runtime) or
+        /dev/vfio devices bound for the chips."""
+        pattern = os.path.join(self.root, "dev", "accel*")
+        return sorted(glob.glob(pattern))
+
+    def accelerator_type(self) -> str:
+        """TPU VM accelerator type, e.g. "v5litepod-4". Read from the GCE
+        metadata-derived env (set by the TPU VM image) or a well-known file;
+        empty when not a TPU VM."""
+        env = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        if env:
+            return env
+        try:
+            with open(os.path.join(self.root,
+                                   "run/tpu/accelerator_type")) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+
+class FakePlatform:
+    """Injectable platform (reference: platform.go:79-129, mutex-guarded)."""
+
+    def __init__(self, product: str = "", pci: Optional[list] = None,
+                 netdevs: Optional[list] = None,
+                 accel: Optional[list] = None,
+                 accelerator_type: str = ""):
+        self._lock = threading.Lock()
+        self._product = product
+        self._pci = list(pci or [])
+        self._netdevs = list(netdevs or [])
+        self._accel = list(accel or [])
+        self._accel_type = accelerator_type
+
+    def pci_devices(self):
+        with self._lock:
+            return list(self._pci)
+
+    def net_devs(self):
+        with self._lock:
+            return list(self._netdevs)
+
+    def product_name(self):
+        with self._lock:
+            return self._product
+
+    def accel_devices(self):
+        with self._lock:
+            return list(self._accel)
+
+    def accelerator_type(self):
+        with self._lock:
+            return self._accel_type
+
+    # test mutators
+    def set_accel_devices(self, devs):
+        with self._lock:
+            self._accel = list(devs)
+
+    def set_pci_devices(self, devs):
+        with self._lock:
+            self._pci = list(devs)

@@ -23,6 +23,16 @@ from dpu_operator_tpu.k8s import FakeKube, FakeNodeAgent  # noqa: E402
 
 
 @pytest.fixture
+def short_tmp():
+    """Short-prefix temp dir for unix-socket tests (107-char sun_path cap)."""
+    import shutil
+    import tempfile
+    d = tempfile.mkdtemp(prefix="tpuop-", dir="/tmp")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.fixture
 def kube():
     return FakeKube()
 

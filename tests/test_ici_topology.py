@@ -1,0 +1,65 @@
+"""ICI topology model tests: slice shapes, torus wiring, bandwidth bounds."""
+
+import pytest
+
+from dpu_operator_tpu.ici import MultiSliceGroup, SliceTopology, slice_shape
+
+
+@pytest.mark.parametrize("topo,shape", [
+    ("v5e-4", (2, 2)),
+    ("v5e-16", (4, 4)),
+    ("v5e-256", (16, 16)),
+    ("v5p-32", (2, 4, 4)),
+    ("v5p-64", (4, 4, 4)),
+])
+def test_slice_shapes(topo, shape):
+    assert slice_shape(topo) == shape
+
+
+def test_v5e_16_wiring():
+    s = SliceTopology("v5e-16")
+    assert s.num_chips == 16
+    # interior chip on a 4x4 torus has 4 outgoing links (x±, y±)
+    outs = s.links_from(5)
+    assert len(outs) == 4
+    assert {l.port for l in outs} == {"x+", "x-", "y+", "y-"}
+    # wraparound: chip at (0,0) connects to (3,0) and (0,3)
+    from_corner = {(l.dst) for l in s.links_from(0)}
+    coords = {s.chips[d].coords for d in from_corner}
+    assert (3, 0) in coords and (0, 3) in coords
+
+
+def test_v5p_32_hosts():
+    s = SliceTopology("v5p-32")
+    # v5p: 4 chips per host VM → 8 hosts
+    assert s.num_hosts == 8
+    assert len(s.chips_on_host(0)) == 4
+    assert all(len(s.links_from(c.index)) > 0 for c in s.chips)
+
+
+def test_extent2_dims_not_double_linked():
+    s = SliceTopology("v5e-4")  # 2x2
+    # each chip: one link per dimension pair, so 2 outgoing per chip
+    for c in s.chips:
+        assert len(s.links_from(c.index)) == 2
+
+
+def test_bandwidth_models():
+    s = SliceTopology("v5e-16")
+    assert s.bisection_bandwidth_gbps() > 0
+    algbw = s.allreduce_algbw_gbps(1 << 20)
+    # ring allreduce bound: per-link bw * n/(2(n-1)) — just over half link bw
+    assert 25.0 < algbw < 55.0
+
+
+def test_multislice_group():
+    g = MultiSliceGroup([SliceTopology("v5e-16"), SliceTopology("v5e-16")])
+    assert g.num_chips == 32
+    assert g.dcn_allreduce_algbw_gbps() > 0
+
+
+def test_ici_ports_on_host():
+    s = SliceTopology("v5e-16")
+    ports = s.ici_ports_on_host(0)
+    # 8 chips on host 0 (v5e: 8 chips/host), 4 ports each
+    assert len(ports) == 8 * 4

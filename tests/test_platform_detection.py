@@ -1,0 +1,77 @@
+"""Platform detection tests.
+
+Reference analog: detection scenarios from daemon_test.go:47 (fake product
+name → DPU mode) and netsec-accelerator.go:36-75 (host-side PCI scan with
+serial dedup; ambiguity errors, vendordetector.go:82-85).
+"""
+
+import pytest
+
+from dpu_operator_tpu.platform import (
+    DetectorManager,
+    FakePlatform,
+    FakeVendorDetector,
+    PciDevice,
+    TpuDetector,
+)
+
+
+def _tpu_pci(addr="0000:00:04.0", dev="0062", serial="GTPU01", vf=False):
+    return PciDevice(address=addr, vendor_id="1ae0", device_id=dev,
+                     serial=serial, is_vf=vf)
+
+
+def test_tpu_platform_detected_via_accelerator_type():
+    p = FakePlatform(accelerator_type="v5litepod-4")
+    res = DetectorManager([TpuDetector()]).detect(p)
+    assert res is not None and res.tpu_mode
+    assert res.identifier == "v5litepod-4"
+
+
+def test_tpu_platform_detected_via_accel_devices():
+    p = FakePlatform(accel=["/dev/accel0", "/dev/accel1"])
+    res = DetectorManager([TpuDetector()]).detect(p)
+    assert res.tpu_mode
+
+
+def test_host_side_detected_via_pci():
+    p = FakePlatform(pci=[_tpu_pci()])
+    res = DetectorManager([TpuDetector()]).detect(p)
+    assert res is not None and not res.tpu_mode
+    assert res.identifier == "GTPU01"
+
+
+def test_host_side_dedups_by_serial():
+    # dual-function device shares a serial → one identifier
+    p = FakePlatform(pci=[_tpu_pci(addr="0000:00:04.0"),
+                          _tpu_pci(addr="0000:00:05.0")])
+    res = DetectorManager([TpuDetector()]).detect(p)
+    assert res.identifier == "GTPU01"
+
+
+def test_vfs_ignored():
+    p = FakePlatform(pci=[_tpu_pci(vf=True)])
+    assert DetectorManager([TpuDetector()]).detect(p) is None
+
+
+def test_non_google_vendor_ignored():
+    p = FakePlatform(pci=[PciDevice(address="0000:00:04.0",
+                                    vendor_id="8086", device_id="0062")])
+    assert DetectorManager([TpuDetector()]).detect(p) is None
+
+
+def test_nothing_detected_returns_none():
+    assert DetectorManager([TpuDetector()]).detect(FakePlatform()) is None
+
+
+def test_ambiguous_platform_is_error():
+    p = FakePlatform(product="tpu-sim", accelerator_type="v5litepod-4")
+    mgr = DetectorManager([TpuDetector(), FakeVendorDetector()])
+    with pytest.raises(RuntimeError, match="ambiguous"):
+        mgr.detect(p)
+
+
+def test_fake_detector_product_match():
+    p = FakePlatform(product="tpu-sim v5e")
+    res = DetectorManager([FakeVendorDetector()]).detect(p)
+    assert res.tpu_mode and res.vendor == "fake-tpu"

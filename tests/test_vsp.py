@@ -1,0 +1,165 @@
+"""VSP seam tests: real gRPC over a real unix socket.
+
+Reference analog: MockVsp serving on the real socket path
+(mock-vsp/mockvsp.go:39-50) driven through the GrpcPlugin client with Init
+retry (vendorplugin.go:82-115), plus GoogleTpuVsp behavior on a fake platform.
+"""
+
+import threading
+
+import pytest
+
+from dpu_operator_tpu.platform import FakePlatform, TpuDetector
+from dpu_operator_tpu.utils.path_manager import PathManager
+from dpu_operator_tpu.vsp import (
+    DebugIciDataplane,
+    GoogleTpuVsp,
+    GrpcPlugin,
+    MockTpuVsp,
+    VspServer,
+)
+from dpu_operator_tpu.vsp.google import accelerator_type_to_topology
+
+
+@pytest.fixture
+def pm(short_tmp):
+    # unix socket paths are capped at ~107 chars; pytest's tmp_path nests too
+    # deep, so socket tests use a short /tmp dir (see conftest short_tmp)
+    return PathManager(short_tmp)
+
+
+def _serve(impl, pm):
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    server = VspServer(impl, sock)
+    server.start()
+    return server
+
+
+def _plugin(pm, tpu_mode=True):
+    det = TpuDetector().detection_result(tpu_mode=tpu_mode,
+                                         identifier="test-tpu")
+    return GrpcPlugin(det, path_manager=pm, init_timeout=5.0)
+
+
+def test_mock_vsp_init_and_devices(pm):
+    mock = MockTpuVsp()
+    server = _serve(mock, pm)
+    try:
+        plugin = _plugin(pm)
+        ip, port = plugin.start(tpu_mode=True)
+        assert (ip, port) == ("127.0.0.1", 50051)
+        assert mock.init_requests[0]["tpu_mode"] is True
+        devices = plugin.get_devices()
+        assert len(devices) == 4  # v5e-4 mock slice
+        assert devices["chip-0"]["healthy"]
+        plugin.set_num_chips(2)
+        assert len(plugin.get_devices()) == 2
+        plugin.close()
+    finally:
+        server.stop()
+
+
+def test_init_retries_until_server_up(pm):
+    """The daemon dials before the VSP container is up; Init must retry
+    (vendorplugin.go:82-115)."""
+    plugin = _plugin(pm)
+    result = {}
+
+    def connect():
+        result["ipport"] = plugin.start(tpu_mode=True)
+
+    t = threading.Thread(target=connect)
+    t.start()
+    # start the server ~after the first dial attempts failed
+    import time
+    time.sleep(0.5)
+    server = _serve(MockTpuVsp(), pm)
+    t.join(timeout=10)
+    try:
+        assert result["ipport"] == ("127.0.0.1", 50051)
+    finally:
+        plugin.close()
+        server.stop()
+
+
+def test_init_timeout_when_no_server(pm):
+    plugin = _plugin(pm)
+    plugin.init_timeout = 0.5
+    with pytest.raises(TimeoutError):
+        plugin.start(tpu_mode=True)
+    plugin.close()
+
+
+def test_slice_attachment_roundtrip(pm):
+    mock = MockTpuVsp()
+    server = _serve(mock, pm)
+    try:
+        plugin = _plugin(pm)
+        plugin.start(tpu_mode=True)
+        att = plugin.create_slice_attachment(
+            {"name": "host0-1", "chip_index": 1, "topology": "v5e-4"})
+        assert att["name"] == "host0-1"
+        assert "host0-1" in mock.slice_attachments
+        plugin.delete_slice_attachment("host0-1")
+        assert "host0-1" not in mock.slice_attachments
+        plugin.create_network_function("att-a", "att-b")
+        assert mock.network_functions == [("att-a", "att-b")]
+        plugin.close()
+    finally:
+        server.stop()
+
+
+# -- GoogleTpuVsp (in-process, no gRPC needed) --------------------------------
+
+def test_accelerator_type_mapping():
+    assert accelerator_type_to_topology("v5litepod-16") == "v5e-16"
+    assert accelerator_type_to_topology("v5p-32") == "v5p-32"
+    assert accelerator_type_to_topology("v4-8") == "v4-8"
+    with pytest.raises(ValueError):
+        accelerator_type_to_topology("gpu-8")
+
+
+def test_google_vsp_tpu_mode_devices():
+    platform = FakePlatform(accel=[f"/dev/accel{i}" for i in range(4)],
+                            accelerator_type="v5litepod-4")
+    dp = DebugIciDataplane()
+    vsp = GoogleTpuVsp(platform, dataplane=dp)
+    resp = vsp.init({"tpu_mode": True, "tpu_identifier": "x"})
+    assert resp["port"] == 50151
+    assert dp.events[0] == ("init", "v5e-4")
+    devs = vsp.get_devices({})["devices"]
+    assert set(devs) == {f"chip-{i}" for i in range(4)}
+    # fake /dev/accel* paths are not real chardevs → unhealthy
+    assert devs["chip-0"]["healthy"] is False
+    assert devs["chip-3"]["coords"] == [1, 1]  # 2x2 slice corner
+
+
+def test_google_vsp_host_mode_devices():
+    from dpu_operator_tpu.platform import PciDevice
+    platform = FakePlatform(pci=[
+        PciDevice(address="0000:00:04.0", vendor_id="1ae0",
+                  device_id="0062")])
+    vsp = GoogleTpuVsp(platform)
+    vsp.init({"tpu_mode": False})
+    devs = vsp.get_devices({})["devices"]
+    assert list(devs) == ["0000:00:04.0"]
+
+
+def test_google_vsp_slice_attachment_programs_dataplane():
+    platform = FakePlatform(accel=["/dev/accel0", "/dev/accel1"],
+                            accelerator_type="v5litepod-4")
+    dp = DebugIciDataplane()
+    vsp = GoogleTpuVsp(platform, dataplane=dp)
+    vsp.init({"tpu_mode": True})
+    att = vsp.create_slice_attachment({"name": "host0-1", "chip_index": 1})
+    assert att["ici_ports"]  # derived from topology when not given
+    assert ("attach", 1, tuple(att["ici_ports"])) in dp.events
+    vsp.delete_slice_attachment({"name": "host0-1"})
+    assert ("detach", 1) in dp.events
+
+
+def test_google_vsp_rejects_bad_attachment_name():
+    vsp = GoogleTpuVsp(FakePlatform())
+    with pytest.raises(ValueError, match="attachment name"):
+        vsp.create_slice_attachment({"name": "bogus"})
