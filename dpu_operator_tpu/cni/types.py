@@ -1,0 +1,127 @@
+"""CNI request/response types.
+
+Reference: dpu-cni/pkgs/cnitypes/cnitypes.go — Request/Response/PodRequest
+structs (:113-135) and socket path constants (:13-16). The TPU ``NetConf``
+replaces VF knobs (vlan/rate/spoofchk/trust) with chip/slice knobs: which
+resource the attachment consumes, the slice topology, and the device id the
+device plugin allocated (passed via the runtime's deviceID like the
+reference's SR-IOV DeviceID).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: CNI request deadline — kubelet CRI op timeout parity (cniserver.go:226-227)
+CNI_TIMEOUT = 120.0
+
+CNI_VERSION = "0.4.0"
+
+
+@dataclass
+class NetConf:
+    """Parsed CNI network configuration (stdin JSON)."""
+    cni_version: str = CNI_VERSION
+    name: str = ""
+    type: str = "tpu-cni"
+    mode: str = "chip"              # "chip" (host side) | "network-function"
+    resource_name: str = ""
+    topology: str = ""
+    device_id: str = ""             # from runtimeConfig / CNI_ARGS deviceID
+    log_level: str = "info"         # per-invocation logging (cnitypes.go:133)
+    log_file: str = ""
+    ipam: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetConf":
+        return cls(
+            cni_version=d.get("cniVersion", CNI_VERSION),
+            name=d.get("name", ""),
+            type=d.get("type", "tpu-cni"),
+            mode=d.get("mode", "chip"),
+            resource_name=d.get("resourceName", ""),
+            topology=d.get("topology", ""),
+            device_id=d.get("deviceID", ""),
+            log_level=d.get("logLevel", "info"),
+            log_file=d.get("logFile", ""),
+            ipam=d.get("ipam", {}) or {},
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "cniVersion": self.cni_version,
+            "name": self.name,
+            "type": self.type,
+            "mode": self.mode,
+            "resourceName": self.resource_name,
+            "topology": self.topology,
+            "deviceID": self.device_id,
+            "logLevel": self.log_level,
+            "logFile": self.log_file,
+            "ipam": self.ipam,
+        }
+
+
+@dataclass
+class CniRequest:
+    """What the shim posts: CNI_* env + stdin config (cnishim.go:31-55)."""
+    env: dict
+    config: dict
+
+    def to_dict(self) -> dict:
+        return {"env": self.env, "config": self.config}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CniRequest":
+        return cls(env=d.get("env", {}), config=d.get("config", {}))
+
+
+@dataclass
+class PodRequest:
+    """Server-side parsed request (cniserver.go:141-231)."""
+    command: str                     # ADD | DEL | CHECK
+    pod_namespace: str
+    pod_name: str
+    sandbox_id: str
+    netns: str
+    ifname: str
+    device_id: str
+    netconf: NetConf
+
+    @classmethod
+    def from_cni_request(cls, req: CniRequest) -> "PodRequest":
+        env = req.env
+        args = {}
+        for kv in env.get("CNI_ARGS", "").split(";"):
+            if "=" in kv:
+                k, val = kv.split("=", 1)
+                args[k] = val
+        command = env.get("CNI_COMMAND", "")
+        if command not in ("ADD", "DEL", "CHECK"):
+            raise ValueError(f"unexpected CNI_COMMAND {command!r}")
+        netconf = NetConf.from_dict(req.config)
+        return cls(
+            command=command,
+            pod_namespace=args.get("K8S_POD_NAMESPACE", ""),
+            pod_name=args.get("K8S_POD_NAME", ""),
+            sandbox_id=env.get("CNI_CONTAINERID", ""),
+            netns=env.get("CNI_NETNS", ""),
+            ifname=env.get("CNI_IFNAME", ""),
+            device_id=netconf.device_id or args.get("deviceID", ""),
+            netconf=netconf,
+        )
+
+
+@dataclass
+class CniResponse:
+    """CNI result JSON the shim prints (types.PrintResult parity)."""
+    result: Optional[dict] = None
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {"result": self.result, "error": self.error}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CniResponse":
+        return cls(result=d.get("result"), error=d.get("error", ""))

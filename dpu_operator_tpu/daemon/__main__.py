@@ -1,0 +1,45 @@
+"""Daemon entrypoint (reference: cmd/daemon/daemon.go:18-40)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+from ..images import EnvImageManager
+from ..platform import HardwarePlatform
+from ..utils.path_manager import PathManager
+from .daemon import Daemon
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("tpu-daemon")
+    parser.add_argument("--mode", default="auto",
+                        choices=["host", "tpu", "auto"])
+    parser.add_argument("--root", default="/")
+    parser.add_argument("--flavour", default="kind")
+    parser.add_argument("--kubeconfig", default="")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG)
+
+    client = None
+    try:
+        from ..k8s.real import RealKube
+        client = RealKube(args.kubeconfig or None)
+    except Exception as e:  # noqa: BLE001 — in-cluster-less dev mode
+        logging.warning("no apiserver client (%s); running standalone", e)
+
+    daemon = Daemon(
+        platform=HardwarePlatform(args.root),
+        mode=args.mode,
+        path_manager=PathManager(args.root),
+        client=client,
+        image_manager=EnvImageManager(),
+        node_name=os.environ.get("NODE_NAME", ""),
+        flavour=args.flavour,
+    )
+    daemon.prepare_and_serve()
+
+
+if __name__ == "__main__":
+    main()

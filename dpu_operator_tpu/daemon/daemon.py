@@ -1,0 +1,141 @@
+"""Daemon core: detection loop + side-manager lifecycle.
+
+Reference: internal/daemon/daemon.go — PrepareAndServe (:58): prepare copies
+the CNI shim into the host CNI bin dir (:195-209); Serve (:86-170) runs a
+1 Hz detection ticker, and on detection builds the Host- or Tpu-side manager
+and runs StartVsp → SetupDevices → Listen → Serve in a goroutine with error
+fan-in — any manager error tears the daemon down so k8s restarts the pod
+(:151-159).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Optional
+
+from ..platform.vendordetector import DetectorManager
+from ..utils.path_manager import PathManager
+from ..vsp.plugin import GrpcPlugin
+from .hostsidemanager import HostSideManager
+from .tpusidemanager import TpuSideManager
+
+log = logging.getLogger(__name__)
+
+_SHIM_SOURCE = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                            "cni", "shim.py")
+
+
+class Daemon:
+    def __init__(self, platform, mode: str = "auto",
+                 path_manager: Optional[PathManager] = None,
+                 client=None, image_manager=None,
+                 detector_manager: Optional[DetectorManager] = None,
+                 node_name: str = "", flavour: str = "kind",
+                 vsp_plugin_factory=None,
+                 detect_interval: float = 1.0):
+        self.platform = platform
+        self.mode = mode
+        self.path_manager = path_manager or PathManager()
+        self.client = client
+        self.image_manager = image_manager
+        self.detector_manager = detector_manager or DetectorManager()
+        self.node_name = node_name
+        self.flavour = flavour
+        self.vsp_plugin_factory = vsp_plugin_factory or self._default_vsp
+        self.detect_interval = detect_interval
+        self.manager = None
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # -- prepare (daemon.go:69, :195-209) -------------------------------------
+    def prepare(self):
+        cni_dir = self.path_manager.cni_host_dir(self.flavour)
+        os.makedirs(cni_dir, exist_ok=True)
+        target = os.path.join(cni_dir, "tpu-cni")
+        shutil.copyfile(_SHIM_SOURCE, target)
+        os.chmod(target, 0o755)
+        log.info("installed CNI shim at %s", target)
+
+    def _default_vsp(self, detection):
+        return GrpcPlugin(detection, client=self.client,
+                          image_manager=self.image_manager,
+                          path_manager=self.path_manager,
+                          node_name=self.node_name)
+
+    # -- detection + lifecycle (daemon.go:86-193) -----------------------------
+    def detect_once(self):
+        result = self.detector_manager.detect(self.platform)
+        if result is None:
+            return None
+        if self.mode == "host" and result.tpu_mode:
+            return None  # operator pinned host mode; ignore tpu detection
+        if self.mode == "tpu" and not result.tpu_mode:
+            return None
+        return result
+
+    def _create_manager(self, detection):
+        vsp = self.vsp_plugin_factory(detection)
+        if detection.tpu_mode:
+            return TpuSideManager(vsp, self.path_manager, client=self.client)
+        return HostSideManager(vsp, self.path_manager, client=self.client)
+
+    def _run_manager(self, mgr):
+        try:
+            mgr.start_vsp()
+            mgr.setup_devices()
+            mgr.listen()
+            mgr.serve()
+        except BaseException as e:  # noqa: BLE001 — error fan-in (:151-159)
+            self._error = e
+            self._stop.set()
+
+    def serve(self, block: bool = True):
+        """1 Hz detect loop; returns when stopped or a manager errored."""
+        while not self._stop.is_set():
+            if self.manager is None:
+                detection = self.detect_once()
+                if detection is not None:
+                    log.info("detected %s (tpu_mode=%s, id=%s)",
+                             detection.vendor, detection.tpu_mode,
+                             detection.identifier)
+                    self.manager = self._create_manager(detection)
+                    self._serve_thread = threading.Thread(
+                        target=self._run_manager, args=(self.manager,),
+                        daemon=True, name="side-manager")
+                    self._serve_thread.start()
+                    if not block:
+                        return
+            if not block:
+                return
+            self._stop.wait(self.detect_interval)
+        if self._error is not None:
+            raise RuntimeError("side manager failed") from self._error
+
+    def prepare_and_serve(self, block: bool = True):
+        self.prepare()
+        self.serve(block=block)
+
+    def wait_ready(self, timeout: float = 10.0) -> bool:
+        """Test helper: wait until a side manager is up and serving."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.manager is not None and (
+                    self._serve_thread is not None
+                    and not self._serve_thread.is_alive()):
+                return self._error is None
+            if self._error is not None:
+                return False
+            time.sleep(0.05)
+        return False
+
+    def stop(self):
+        self._stop.set()
+        if self.manager is not None:
+            self.manager.stop()
+        if self._serve_thread:
+            self._serve_thread.join(timeout=5)

@@ -1,0 +1,163 @@
+"""TPU-side manager: the daemon personality on the TPU VM.
+
+Reference: internal/daemon/dpusidemanager.go — additionally serves the OPI
+BridgePort service on the addr:port the VSP Init returned, forwarding to the
+VSP (:141-165); CNI handlers accumulate two attachments per pod netns and
+then call CreateNetworkFunction (macStore, :45, :104-139); Serve runs four
+servers concurrently: cross-boundary gRPC, device plugin, CNI server, and the
+embedded controller manager with the SFC reconciler (:176-254).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..cni import CniServer
+from ..cni.types import PodRequest
+from ..deviceplugin import DevicePlugin
+from ..k8s.manager import Manager
+from ..utils import vars as v
+from ..utils.path_manager import PathManager
+from ..vsp.rpc import VspServer
+from .device_handler import IciPortDeviceHandler, TpuDeviceHandler
+from .sfc_reconciler import SfcReconciler
+
+log = logging.getLogger(__name__)
+
+
+class _SliceServiceForwarder:
+    """Implementation backing the cross-boundary TCP server: forwards
+    slice/NF calls into the VSP (dpusidemanager.go:51 pass-through)."""
+
+    def __init__(self, vsp):
+        self.vsp = vsp
+
+    def create_slice_attachment(self, req: dict) -> dict:
+        return self.vsp.create_slice_attachment(req)
+
+    def delete_slice_attachment(self, req: dict) -> dict:
+        self.vsp.delete_slice_attachment(req.get("name", ""))
+        return {}
+
+    def create_network_function(self, req: dict) -> dict:
+        self.vsp.create_network_function(req.get("input", ""),
+                                         req.get("output", ""))
+        return {}
+
+    def delete_network_function(self, req: dict) -> dict:
+        self.vsp.delete_network_function(req.get("input", ""),
+                                         req.get("output", ""))
+        return {}
+
+
+class TpuSideManager:
+    def __init__(self, vsp_plugin, path_manager: PathManager, client=None,
+                 workload_image: str = ""):
+        self.vsp = vsp_plugin
+        self.path_manager = path_manager
+        self.client = client
+        self.workload_image = workload_image
+        self.device_handler = TpuDeviceHandler(self.vsp, tpu_mode=True)
+        self.device_plugin = DevicePlugin(
+            self.device_handler, resource=v.TPU_RESOURCE_NAME,
+            path_manager=path_manager)
+        self.ici_device_plugin: Optional[DevicePlugin] = None
+        self.cni_server = CniServer(
+            path_manager.cni_server_socket(),
+            add_handler=self._cni_nf_add, del_handler=self._cni_nf_del)
+        self._slice_server: Optional[VspServer] = None
+        self._addr: Optional[tuple] = None
+        # attachment accumulator per pod sandbox (macStore analog, :45);
+        # value: {"atts": [unique ids in arrival order], "wired": bool}
+        self._attach_store: dict[str, dict] = {}
+        self._attach_lock = threading.Lock()
+        self._manager: Optional[Manager] = None
+
+    # -- SideManager lifecycle ------------------------------------------------
+    def start_vsp(self):
+        ip, port = self.vsp.start(tpu_mode=True)
+        self._addr = (ip, port)
+
+    def setup_devices(self):
+        self.device_handler.setup_devices()
+
+    def listen(self):
+        # cross-boundary server on the VSP-returned addr (:141-165)
+        ip, port = self._addr
+        self._slice_server = VspServer(
+            _SliceServiceForwarder(self.vsp), tcp_addr=(ip, port))
+        self._slice_server.start()
+        self.device_plugin.start()
+        self.cni_server.start()
+
+    def serve(self):
+        self.device_plugin.register_with_kubelet()
+        if self.client is not None:
+            self._manager = Manager(self.client)
+            self._manager.add_reconciler(
+                SfcReconciler(workload_image=self.workload_image))
+            self._manager.start()
+
+    def stop(self):
+        if self._manager:
+            self._manager.stop()
+        self.cni_server.stop()
+        self.device_plugin.stop()
+        if self.ici_device_plugin:
+            self.ici_device_plugin.stop()
+        if self._slice_server:
+            self._slice_server.stop()
+        self.vsp.close()
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        return self._slice_server.bound_port if self._slice_server else None
+
+    # -- CNI network-function handlers (dpusidemanager.go:104-139) ------------
+    def _cni_nf_add(self, req: PodRequest) -> dict:
+        """Each ADD contributes one slice attachment; once two distinct
+        attachments exist for the pod, wire the network function. Idempotent
+        under kubelet ADD retries: duplicate attachment ids are deduped, and
+        a failed wire is re-attempted on the next retry."""
+        if not req.device_id:
+            raise ValueError("NF CNI ADD without deviceID")
+        attachment_id = f"nf-{req.sandbox_id[:12]}-{req.device_id}"
+        with self._attach_lock:
+            entry = self._attach_store.setdefault(
+                req.sandbox_id, {"atts": [], "wired": False})
+            if attachment_id not in entry["atts"]:
+                entry["atts"].append(attachment_id)
+            if len(entry["atts"]) >= 2 and not entry["wired"]:
+                self.vsp.create_network_function(entry["atts"][0],
+                                                 entry["atts"][1])
+                entry["wired"] = True
+            wired = entry["wired"]
+        return {
+            "cniVersion": req.netconf.cni_version,
+            "interfaces": [{"name": req.ifname, "sandbox": req.netns}],
+            "tpu": {"attachment": attachment_id, "networkFunction": wired},
+        }
+
+    def _cni_nf_del(self, req: PodRequest) -> dict:
+        with self._attach_lock:
+            entry = self._attach_store.pop(req.sandbox_id, None)
+        if entry and entry["wired"]:
+            try:
+                self.vsp.delete_network_function(entry["atts"][0],
+                                                 entry["atts"][1])
+            except Exception:  # noqa: BLE001 — defensive DEL
+                log.warning("delete_network_function failed for %s",
+                            req.sandbox_id)
+        return {}
+
+    # -- ICI port advertisement ----------------------------------------------
+    def enable_ici_ports(self, topology_provider):
+        """Advertise google.com/ici-port as a second device plugin."""
+        self.ici_device_plugin = DevicePlugin(
+            IciPortDeviceHandler(topology_provider),
+            resource=v.ICI_RESOURCE_NAME,
+            path_manager=self.path_manager)
+        self.ici_device_plugin.start()
+        self.ici_device_plugin.register_with_kubelet()

@@ -83,6 +83,8 @@ class FakeKube:
         md = obj.get("metadata", {})
         key = self._key(obj.get("apiVersion"), obj.get("kind"),
                         md.get("namespace"), md.get("name"))
+        if obj.get("kind") == "Pod":
+            obj.setdefault("status", {}).setdefault("phase", "Pending")
         with self._lock:
             if key in self._store:
                 raise AlreadyExists(str(key))

@@ -67,15 +67,24 @@ class VspServer:
             "delete_network_function",
     }
 
-    def __init__(self, impl, socket_path: str):
+    def __init__(self, impl, socket_path: Optional[str] = None,
+                 tcp_addr: Optional[tuple] = None):
+        """Bind to a unix *socket_path* (daemon↔VSP seam) or a TCP
+        *(ip, port)* (the host↔tpu cross-boundary channel, the reference's
+        OPI server on the VSP-returned IpPort, dpusidemanager.go:141-165)."""
+        if (socket_path is None) == (tcp_addr is None):
+            raise ValueError("exactly one of socket_path/tcp_addr required")
         self.impl = impl
         self.socket_path = socket_path
+        self.tcp_addr = tcp_addr
         self._server: Optional[grpc.Server] = None
+        self.bound_port: Optional[int] = None
 
     def start(self):
-        os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
-        if os.path.exists(self.socket_path):
-            os.unlink(self.socket_path)
+        if self.socket_path:
+            os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
         methods = {}
         for (svc, rpc), attr in self._RPC_TO_ATTR.items():
             fn = getattr(self.impl, attr, None)
@@ -89,7 +98,13 @@ class VspServer:
             methods[f"/tpuvsp.{svc}/{rpc}"] = wrap()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers((_GenericHandler(methods),))
-        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        if self.socket_path:
+            self._server.add_insecure_port(f"unix://{self.socket_path}")
+        else:
+            ip, port = self.tcp_addr
+            self.bound_port = self._server.add_insecure_port(f"{ip}:{port}")
+            if self.bound_port == 0:
+                raise OSError(f"cannot bind VSP server to {ip}:{port}")
         self._server.start()
 
     def stop(self, grace: float = 0.5):

@@ -1,0 +1,137 @@
+"""CNI subsystem tests: shim → unix-HTTP server → handlers, cache, allocator.
+
+Reference analog: cniserver_test.go (request conversion), cnihelper_test.go
+(config parse), hostsidemanager_test.go:235-263 (end-to-end ADD through real
+shim + real server + stub backend).
+"""
+
+import json
+import os
+
+import pytest
+
+from dpu_operator_tpu.cni import (
+    ChipAllocator,
+    CniRequest,
+    CniServer,
+    CniShim,
+    NetConf,
+    NetConfCache,
+)
+from dpu_operator_tpu.cni.types import PodRequest
+
+
+def _env(command="ADD", container="abc123", netns="/var/run/netns/x",
+         ifname="net1", pod="mypod", ns="default"):
+    return {
+        "CNI_COMMAND": command,
+        "CNI_CONTAINERID": container,
+        "CNI_NETNS": netns,
+        "CNI_IFNAME": ifname,
+        "CNI_ARGS": f"K8S_POD_NAMESPACE={ns};K8S_POD_NAME={pod}",
+    }
+
+
+def _conf(mode="chip", device="chip-1"):
+    return {"cniVersion": "0.4.0", "name": "tpunfcni-conf",
+            "type": "tpu-cni", "mode": mode, "deviceID": device,
+            "resourceName": "google.com/tpu"}
+
+
+def test_pod_request_parsing():
+    req = CniRequest(env=_env(), config=_conf())
+    pr = PodRequest.from_cni_request(req)
+    assert pr.command == "ADD"
+    assert pr.pod_name == "mypod"
+    assert pr.pod_namespace == "default"
+    assert pr.device_id == "chip-1"
+    assert pr.netconf.mode == "chip"
+
+
+def test_pod_request_rejects_bad_command():
+    req = CniRequest(env=_env(command="FROB"), config=_conf())
+    with pytest.raises(ValueError, match="CNI_COMMAND"):
+        PodRequest.from_cni_request(req)
+
+
+def test_netconf_roundtrip():
+    nc = NetConf.from_dict(_conf())
+    assert NetConf.from_dict(nc.to_dict()).device_id == "chip-1"
+
+
+def test_server_shim_end_to_end(short_tmp):
+    """Full hop: shim client → unix socket HTTP → injected handler."""
+    seen = {}
+
+    def add(pr):
+        seen["add"] = pr
+        return {"cniVersion": "0.4.0", "tpu": {"chip": 1}}
+
+    def delete(pr):
+        seen["del"] = pr
+        return {}
+
+    sock = os.path.join(short_tmp, "cni.sock")
+    server = CniServer(sock, add_handler=add, del_handler=delete)
+    server.start()
+    try:
+        shim = CniShim(sock)
+        resp = shim.invoke(_env(), json.dumps(_conf()))
+        assert resp.error == ""
+        assert resp.result["tpu"]["chip"] == 1
+        assert seen["add"].pod_name == "mypod"
+
+        resp = shim.invoke(_env(command="DEL"), json.dumps(_conf()))
+        assert resp.error == ""
+        assert seen["del"].command == "DEL"
+
+        # CHECK is a client-side no-op
+        resp = shim.invoke(_env(command="CHECK"), json.dumps(_conf()))
+        assert resp.result == {}
+    finally:
+        server.stop()
+
+
+def test_server_handler_error_surfaces(short_tmp):
+    def add(pr):
+        raise RuntimeError("chip on fire")
+
+    sock = os.path.join(short_tmp, "cni2.sock")
+    server = CniServer(sock, add_handler=add)
+    server.start()
+    try:
+        resp = CniShim(sock).invoke(_env(), json.dumps(_conf()))
+        assert "chip on fire" in resp.error
+    finally:
+        server.stop()
+
+
+def test_server_socket_is_root_only(short_tmp):
+    sock = os.path.join(short_tmp, "cni3.sock")
+    server = CniServer(sock, add_handler=lambda pr: {})
+    server.start()
+    try:
+        assert oct(os.stat(sock).st_mode & 0o777) == "0o600"
+    finally:
+        server.stop()
+
+
+def test_netconf_cache_roundtrip(tmp_path):
+    cache = NetConfCache(str(tmp_path / "cache"))
+    cache.save("sandbox1", "net1", {"chip": 2})
+    assert cache.load("sandbox1", "net1") == {"chip": 2}
+    cache.delete("sandbox1", "net1")
+    assert cache.load("sandbox1", "net1") is None
+    # defensive: loading never-saved state is None, not an error
+    assert cache.load("ghost", "net9") is None
+
+
+def test_chip_allocator(tmp_path):
+    alloc = ChipAllocator(str(tmp_path / "alloc"))
+    assert alloc.allocate("chip-0", "sandboxA")
+    assert alloc.allocate("chip-0", "sandboxA")  # idempotent re-claim
+    assert not alloc.allocate("chip-0", "sandboxB")  # held by A
+    assert alloc.owner("chip-0") == "sandboxA"
+    assert not alloc.release("chip-0", "sandboxB")  # wrong owner
+    assert alloc.release("chip-0", "sandboxA")
+    assert alloc.allocate("chip-0", "sandboxB")  # free again

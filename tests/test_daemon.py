@@ -1,0 +1,192 @@
+"""Daemon + side-manager integration tests.
+
+Reference analog: daemon_test.go:24-88 (full detect→VSP→serve loop),
+hostsidemanager_test.go:235-263 (CNI ADD through real shim → real server →
+fake tpu-side daemon asserting attachment count),
+dpusidemanager_test.go:22-49 (node reports allocatable with mock devices).
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from dpu_operator_tpu.cni import CniShim
+from dpu_operator_tpu.daemon import Daemon, HostSideManager, TpuSideManager
+from dpu_operator_tpu.deviceplugin import FakeKubelet
+from dpu_operator_tpu.platform import (
+    DetectorManager,
+    FakePlatform,
+    TpuDetector,
+)
+from dpu_operator_tpu.utils.path_manager import PathManager
+from dpu_operator_tpu.vsp import GrpcPlugin, MockTpuVsp, VspServer
+
+
+@pytest.fixture
+def pm(short_tmp):
+    return PathManager(short_tmp)
+
+
+def _mock_vsp_on_socket(pm, **kw):
+    mock = MockTpuVsp(**kw)
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    server = VspServer(mock, socket_path=sock)
+    server.start()
+    return mock, server
+
+
+def _plugin(pm, tpu_mode):
+    det = TpuDetector().detection_result(tpu_mode=tpu_mode, identifier="t")
+    return GrpcPlugin(det, path_manager=pm, init_timeout=5.0)
+
+
+def _cni_env(command="ADD", container="sbx1", ifname="net1"):
+    return {
+        "CNI_COMMAND": command,
+        "CNI_CONTAINERID": container,
+        "CNI_NETNS": "/var/run/netns/test",
+        "CNI_IFNAME": ifname,
+        "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p",
+    }
+
+
+def _cni_conf(device, mode="chip"):
+    return json.dumps({"cniVersion": "0.4.0", "type": "tpu-cni",
+                       "mode": mode, "deviceID": device})
+
+
+def test_tpu_side_manager_full_stack(pm, kube, node_agent):
+    """TPU-side daemon: VSP + cross-boundary server + device plugin +
+    kubelet registration → node allocatable; NF CNI wires after 2 ADDs."""
+    node_agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+    kubelet = FakeKubelet(pm, node_agent=node_agent, node_name="tpu-vm-0")
+    kubelet.start()
+    mock, vsp_server = _mock_vsp_on_socket(pm, port=0)
+    mgr = TpuSideManager(_plugin(pm, True), pm, client=kube)
+    mgr.device_plugin.poll_interval = 0.1
+    try:
+        mgr.start_vsp()
+        mgr.setup_devices()
+        mgr.listen()
+        mgr.serve()
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        node = kube.get("v1", "Node", "tpu-vm-0")
+        assert node["status"]["allocatable"]["google.com/tpu"] == "4"
+
+        # cross-boundary TCP server forwards into the VSP
+        from dpu_operator_tpu.vsp.rpc import VspChannel
+        ch = VspChannel(f"127.0.0.1:{mgr.bound_port}")
+        ch.call("SliceService", "CreateSliceAttachment",
+                {"name": "host0-2", "chip_index": 2})
+        ch.close()
+        assert "host0-2" in mock.slice_attachments
+
+        # NF CNI: two ADDs for one sandbox wire a network function
+        shim = CniShim(pm.cni_server_socket())
+        r1 = shim.invoke(_cni_env(container="nfpod1", ifname="net1"),
+                         _cni_conf("chip-0", mode="network-function"))
+        assert r1.result["tpu"]["networkFunction"] is False
+        r2 = shim.invoke(_cni_env(container="nfpod1", ifname="net2"),
+                         _cni_conf("chip-1", mode="network-function"))
+        assert r2.result["tpu"]["networkFunction"] is True
+        assert len(mock.network_functions) == 1
+    finally:
+        mgr.stop()
+        vsp_server.stop()
+        kubelet.stop()
+
+
+def test_host_side_manager_cni_add_creates_slice_attachment(pm, short_tmp):
+    """Host-side CNI ADD → allocator + CreateSliceAttachment on the (fake)
+    tpu-side daemon — bridgePorts==1 assertion parity."""
+    # fake tpu-side daemon: a slice server on TCP backed by a recording mock
+    tpu_mock = MockTpuVsp()
+    tpu_server = VspServer(tpu_mock, tcp_addr=("127.0.0.1", 0))
+    tpu_server.start()
+
+    # host-side VSP returns the fake tpu daemon's addr from Init
+    host_mock = MockTpuVsp(port=tpu_server.bound_port)
+    # host-side devices must be PCI addresses
+    host_mock.get_devices = lambda req: {"devices": {
+        "0000:00:04.0": {"id": "0000:00:04.0", "healthy": True,
+                         "dev_path": "", "coords": []}}}
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    vsp_server = VspServer(host_mock, socket_path=sock)
+    vsp_server.start()
+
+    mgr = HostSideManager(_plugin(pm, False), pm)
+    try:
+        mgr.start_vsp()
+        mgr.setup_devices()
+        mgr.listen()
+        shim = CniShim(pm.cni_server_socket())
+        resp = shim.invoke(_cni_env(), _cni_conf("0000:00:04.0"))
+        assert resp.error == ""
+        assert resp.result["tpu"]["attachment"] == "host0-0"
+        assert len(tpu_mock.slice_attachments) == 1
+
+        # double-ADD for a different sandbox must fail (allocator)
+        resp2 = shim.invoke(_cni_env(container="other"),
+                            _cni_conf("0000:00:04.0"))
+        assert "already allocated" in resp2.error
+
+        # DEL releases and removes the attachment
+        resp3 = shim.invoke(_cni_env(command="DEL"),
+                            _cni_conf("0000:00:04.0"))
+        assert resp3.error == ""
+        assert len(tpu_mock.slice_attachments) == 0
+    finally:
+        mgr.stop()
+        vsp_server.stop()
+        tpu_server.stop()
+
+
+def test_daemon_detect_loop_builds_manager(pm, kube):
+    """Detection loop: nothing → (hotplug) → tpu side manager runs
+    (daemon_test.go:24-88 pattern)."""
+    platform = FakePlatform()  # nothing to detect yet
+    mock, vsp_server = _mock_vsp_on_socket(pm)
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    daemon = Daemon(
+        platform, mode="auto", path_manager=pm, client=None,
+        detector_manager=DetectorManager([TpuDetector()]),
+        vsp_plugin_factory=lambda det: _plugin(pm, det.tpu_mode),
+        detect_interval=0.05,
+        flavour="kind",
+    )
+    t = threading.Thread(target=daemon.serve, daemon=True)
+    t.start()
+    try:
+        import time
+        time.sleep(0.2)
+        assert daemon.manager is None
+        platform.set_accel_devices(["/dev/accel0"])  # hotplug
+        assert daemon.wait_ready(10)
+        assert isinstance(daemon.manager, TpuSideManager)
+    finally:
+        daemon.stop()
+        t.join(timeout=5)
+        vsp_server.stop()
+        kubelet.stop()
+
+
+def test_daemon_prepare_installs_shim(pm, short_tmp):
+    daemon = Daemon(FakePlatform(), path_manager=pm, flavour="kind")
+    daemon.prepare()
+    shim_path = os.path.join(pm.cni_host_dir("kind"), "tpu-cni")
+    assert os.path.exists(shim_path)
+    assert os.access(shim_path, os.X_OK)
+
+
+def test_daemon_mode_pinning(pm):
+    """mode=host must ignore tpu-platform detection (operator pins the side)."""
+    platform = FakePlatform(accel=["/dev/accel0"])
+    daemon = Daemon(platform, mode="host", path_manager=pm)
+    assert daemon.detect_once() is None
+    daemon_auto = Daemon(platform, mode="auto", path_manager=pm)
+    assert daemon_auto.detect_once().tpu_mode

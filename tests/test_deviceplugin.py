@@ -1,0 +1,157 @@
+"""Device plugin tests over real v1beta1 protobuf wire traffic.
+
+Reference analog: dpusidemanager_test.go:22-49 (node reports allocatable
+after real kubelet registration with mock devices) and deviceplugin.go
+Allocate semantics (health validation, env export).
+"""
+
+import time
+
+import pytest
+
+from dpu_operator_tpu.daemon.device_handler import TpuDeviceHandler
+from dpu_operator_tpu.deviceplugin import DevicePlugin, FakeKubelet
+from dpu_operator_tpu.utils.path_manager import PathManager
+
+
+class StaticHandler:
+    def __init__(self, devices):
+        self.devices = devices
+
+    def get_devices(self):
+        return self.devices
+
+
+@pytest.fixture
+def pm(short_tmp):
+    return PathManager(short_tmp)
+
+
+DEVS = {
+    f"chip-{i}": {"id": f"chip-{i}", "healthy": True,
+                  "dev_path": f"/dev/accel{i}", "coords": [i % 2, i // 2]}
+    for i in range(4)
+}
+
+
+def test_register_and_list_and_watch(pm, kube, node_agent):
+    node_agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+    kubelet = FakeKubelet(pm, node_agent=node_agent, node_name="tpu-vm-0")
+    kubelet.start()
+    plugin = DevicePlugin(StaticHandler(dict(DEVS)), path_manager=pm,
+                          poll_interval=0.1)
+    plugin.start()
+    try:
+        plugin.register_with_kubelet()
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        node = kube.get("v1", "Node", "tpu-vm-0")
+        assert node["status"]["allocatable"]["google.com/tpu"] == "4"
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_list_and_watch_sends_on_change_only(pm):
+    handler = StaticHandler(dict(DEVS))
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    plugin = DevicePlugin(handler, path_manager=pm, poll_interval=0.05)
+    plugin.start()
+    try:
+        plugin.register_with_kubelet()
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        # mutate: one chip goes unhealthy → a new list arrives
+        handler.devices = dict(DEVS)
+        handler.devices["chip-3"] = dict(DEVS["chip-3"], healthy=False)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            devs = kubelet.device_lists.get("google.com/tpu", [])
+            if any(d.health == "Unhealthy" for d in devs):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("unhealthy transition never streamed")
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_allocate_returns_devices_mounts_env(pm):
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    plugin = DevicePlugin(StaticHandler(dict(DEVS)), path_manager=pm,
+                          poll_interval=0.1)
+    plugin.start()
+    try:
+        plugin.register_with_kubelet()
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        resp = kubelet.allocate("google.com/tpu", ["chip-0", "chip-1"])
+        car = resp.container_responses[0]
+        assert car.envs["TPU_DEVICE_IDS"] == "chip-0,chip-1"
+        assert car.envs["TPU_CHIP_COORDS"] == "0,0;1,0"
+        assert [d.host_path for d in car.devices] == ["/dev/accel0",
+                                                      "/dev/accel1"]
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_allocate_rejects_unhealthy(pm):
+    import grpc
+    devs = dict(DEVS)
+    devs["chip-2"] = dict(DEVS["chip-2"], healthy=False)
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    plugin = DevicePlugin(StaticHandler(devs), path_manager=pm,
+                          poll_interval=0.1)
+    plugin.start()
+    try:
+        plugin.register_with_kubelet()
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        with pytest.raises(grpc.RpcError) as err:
+            kubelet.allocate("google.com/tpu", ["chip-2"])
+        assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_device_handler_blocks_until_setup():
+    class SlowVsp:
+        def __init__(self):
+            self.num = None
+
+        def set_num_chips(self, n):
+            self.num = n
+
+        def get_devices(self):
+            return {"0000:00:04.0": {"healthy": True}}
+
+    vsp = SlowVsp()
+    h = TpuDeviceHandler(vsp, tpu_mode=False, num_chips=8)
+    import threading
+    results = {}
+
+    t = threading.Thread(
+        target=lambda: results.update(devs=h.get_devices()))
+    t.start()
+    time.sleep(0.2)
+    assert "devs" not in results  # blocked on setup
+    h.setup_devices()
+    t.join(timeout=5)
+    assert vsp.num == 8  # SetNumVfs(8) parity
+    assert "0000:00:04.0" in results["devs"]
+
+
+def test_host_side_enforces_pci_ids():
+    class BadVsp:
+        def set_num_chips(self, n):
+            pass
+
+        def get_devices(self):
+            return {"chip-0": {"healthy": True}}
+
+    h = TpuDeviceHandler(BadVsp(), tpu_mode=False)
+    h.setup_devices()
+    with pytest.raises(ValueError, match="PCI"):
+        h.get_devices()

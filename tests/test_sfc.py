@@ -1,0 +1,76 @@
+"""Node-side SFC reconciler tests.
+
+Reference analog: sfc-reconciler tests + e2e_test.go:425-445 (NF pod created
+with image and resource assertions) and :525-593 (N+1 SFCs: pending until
+capacity frees).
+"""
+
+import pytest
+
+from dpu_operator_tpu.api import NetworkFunction, ServiceFunctionChain
+from dpu_operator_tpu.daemon import SfcReconciler
+from dpu_operator_tpu.k8s import Manager
+from dpu_operator_tpu.utils import DEFAULT_NAD_NAME
+
+
+@pytest.fixture
+def manager(kube):
+    mgr = Manager(kube)
+    mgr.add_reconciler(SfcReconciler(workload_image="default-nf-image"))
+    mgr.start()
+    yield mgr
+    mgr.stop()
+
+
+def _sfc(name="my-sfc", nfs=None):
+    return ServiceFunctionChain(
+        name=name,
+        network_functions=nfs or [NetworkFunction("nf-a", "quay.io/nf-a:1")],
+    ).to_obj()
+
+
+def test_sfc_creates_nf_pod(kube, manager):
+    kube.create(_sfc())
+    assert manager.wait_idle()
+    pod = kube.get("v1", "Pod", "my-sfc-nf-a", namespace="default")
+    assert pod is not None
+    c = pod["spec"]["containers"][0]
+    assert c["image"] == "quay.io/nf-a:1"
+    assert c["resources"]["requests"]["google.com/tpu"] == "2"
+    nets = pod["metadata"]["annotations"]["k8s.v1.cni.cncf.io/networks"]
+    assert nets == f"{DEFAULT_NAD_NAME}, {DEFAULT_NAD_NAME}"
+
+
+def test_sfc_delete_garbage_collects_pods(kube, manager):
+    kube.create(_sfc())
+    assert manager.wait_idle()
+    kube.delete("config.tpu.openshift.io/v1", "ServiceFunctionChain",
+                "my-sfc", namespace="default")
+    assert kube.get("v1", "Pod", "my-sfc-nf-a", namespace="default") is None
+
+
+def test_sfc_resource_exhaustion_then_unblock(kube, node_agent, manager):
+    """4 chips, 2 per NF: third NF stays Pending until an SFC is deleted
+    (e2e_test.go:525-593)."""
+    node_agent.register_node("tpu-vm-0", labels={"tpu": "true"},
+                             allocatable={"google.com/tpu": "4"})
+    for i in range(3):
+        kube.create(_sfc(name=f"sfc-{i}",
+                         nfs=[NetworkFunction("nf", f"img-{i}")]))
+    assert manager.wait_idle()
+    node_agent.sync()
+    phases = sorted(
+        p["status"]["phase"]
+        for p in kube.list("v1", "Pod", namespace="default",
+                           label_selector={"app": "tpu-network-function"}))
+    assert phases == ["Pending", "Running", "Running"]
+
+    # free one chain → pending pod schedules
+    kube.delete("config.tpu.openshift.io/v1", "ServiceFunctionChain",
+                "sfc-0", namespace="default")
+    node_agent.sync()
+    phases = [
+        p["status"]["phase"]
+        for p in kube.list("v1", "Pod", namespace="default",
+                           label_selector={"app": "tpu-network-function"})]
+    assert phases == ["Running", "Running"]
