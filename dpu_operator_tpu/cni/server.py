@@ -106,6 +106,26 @@ class CniServer:
         try:
             result = fut.result(timeout=self.timeout)
         except FutTimeout:
+            # The error response below makes kubelet tear the sandbox down,
+            # but the handler thread may still be running and commit its
+            # side effects afterwards. Cancel if still queued; if a late ADD
+            # succeeds anyway, undo it so allocator/cache state doesn't leak
+            # for a dead sandbox.
+            fut.cancel()
+            if pod_req.command == "ADD" and self.del_handler is not None:
+                rollback = self.del_handler
+
+                def _undo_late_add(f):
+                    if f.cancelled() or f.exception() is not None:
+                        return
+                    log.warning("late CNI ADD success after timeout; "
+                                "rolling back sandbox %s", pod_req.sandbox_id)
+                    try:
+                        rollback(pod_req)
+                    except Exception:  # noqa: BLE001
+                        log.exception("rollback of timed-out ADD failed")
+
+                fut.add_done_callback(_undo_late_add)
             return CniResponse(
                 error=f"CNI {pod_req.command} timed out after {self.timeout}s")
         return CniResponse(result=result or {"cniVersion":

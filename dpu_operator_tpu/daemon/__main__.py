@@ -22,12 +22,19 @@ def main(argv=None):
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG)
 
+    # Fail fast when an apiserver is expected (explicit kubeconfig or
+    # in-cluster env): silently downgrading to standalone would disable VSP
+    # deployment and the SFC reconciler in production. Standalone is only
+    # for dev machines with no cluster configured at all.
     client = None
-    try:
+    in_cluster = bool(os.environ.get("KUBERNETES_SERVICE_HOST"))
+    default_kubeconfig = os.path.expanduser("~/.kube/config")
+    if args.kubeconfig or in_cluster or os.path.exists(default_kubeconfig):
         from ..k8s.real import RealKube
         client = RealKube(args.kubeconfig or None)
-    except Exception as e:  # noqa: BLE001 — in-cluster-less dev mode
-        logging.warning("no apiserver client (%s); running standalone", e)
+    else:
+        logging.warning("no kubeconfig and not in-cluster; "
+                        "running standalone")
 
     daemon = Daemon(
         platform=HardwarePlatform(args.root),

@@ -16,6 +16,8 @@ import logging
 import time
 from typing import Optional
 
+import grpc
+
 from ..cni import ChipAllocator, CniServer, NetConfCache
 from ..cni.types import PodRequest
 from ..deviceplugin import DevicePlugin
@@ -78,6 +80,12 @@ class HostSideManager:
         self.vsp.close()
 
     # -- cross-boundary slice attachment (hostsidemanager.go:48-74) -----------
+    #: transport-level statuses worth retrying; anything else is the
+    #: tpu-side daemon *answering* with an application error — retrying
+    #: burns the CNI deadline and must surface as-is, not ConnectionError
+    _RETRYABLE = (grpc.StatusCode.UNAVAILABLE,
+                  grpc.StatusCode.DEADLINE_EXCEEDED)
+
     def _tpu_daemon_call(self, method: str, req: dict) -> dict:
         if self._tpu_daemon_addr is None:
             raise RuntimeError("VSP not started")
@@ -87,7 +95,11 @@ class HostSideManager:
             channel = VspChannel(f"{ip}:{port}")
             try:
                 return channel.call("SliceService", method, req, timeout=10.0)
-            except Exception as e:  # noqa: BLE001 — retry w/ backoff (:154-166)
+            except grpc.RpcError as e:  # retry w/ backoff (:154-166)
+                if e.code() not in self._RETRYABLE:
+                    raise RuntimeError(
+                        f"tpu-side daemon rejected {method}: "
+                        f"{e.details()}") from e
                 last = e
                 if attempt < self.dial_retries - 1:
                     time.sleep(self.dial_backoff * (2 ** min(attempt, 4)))
@@ -112,14 +124,16 @@ class HostSideManager:
     # -- CNI handlers (hostsidemanager.go:176-197) ----------------------------
     def _chip_index_for_device(self, device_id: str) -> int:
         """Stable chip index from the allocated device id (the reference
-        derives VF index from the PCI address; here the device-plugin id is
-        either chip-<n> or a PCI address whose function/devfn orders chips)."""
+        derives VF index from PCI-address math): chip-<n> ids carry it,
+        PCI-address ids carry a VSP-assigned append-only ``chip_index`` —
+        never list position, which shifts when the device set changes."""
         if device_id.startswith("chip-"):
             return int(device_id.split("-", 1)[1])
-        devs = sorted(self.device_handler.get_devices())
-        if device_id in devs:
-            return devs.index(device_id)
-        raise ValueError(f"unknown device id {device_id!r}")
+        info = self.device_handler.get_devices().get(device_id)
+        if info is not None and "chip_index" in info:
+            return int(info["chip_index"])
+        raise ValueError(
+            f"unknown device id {device_id!r} (no stable chip index)")
 
     def _cni_add(self, req: PodRequest) -> dict:
         if not req.device_id:

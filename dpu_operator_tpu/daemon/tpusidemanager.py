@@ -124,16 +124,35 @@ class TpuSideManager:
         if not req.device_id:
             raise ValueError("NF CNI ADD without deviceID")
         attachment_id = f"nf-{req.sandbox_id[:12]}-{req.device_id}"
+        pair = None
         with self._attach_lock:
             entry = self._attach_store.setdefault(
-                req.sandbox_id, {"atts": [], "wired": False})
+                req.sandbox_id, {"atts": [], "wired": False,
+                                 "wiring": False})
             if attachment_id not in entry["atts"]:
                 entry["atts"].append(attachment_id)
-            if len(entry["atts"]) >= 2 and not entry["wired"]:
-                self.vsp.create_network_function(entry["atts"][0],
-                                                 entry["atts"][1])
-                entry["wired"] = True
+            if (len(entry["atts"]) >= 2 and not entry["wired"]
+                    and not entry["wiring"]):
+                entry["wiring"] = True  # claim the wire; VSP call is slow
+                pair = (entry["atts"][0], entry["atts"][1])
             wired = entry["wired"]
+        if pair is not None:
+            # outside the lock: a stalled VSP must not serialize every
+            # other pod's NF ADD behind this one
+            try:
+                self.vsp.create_network_function(*pair)
+            except Exception:
+                with self._attach_lock:
+                    e2 = self._attach_store.get(req.sandbox_id)
+                    if e2:
+                        e2["wiring"] = False
+                raise
+            with self._attach_lock:
+                e2 = self._attach_store.get(req.sandbox_id)
+                if e2:
+                    e2["wiring"] = False
+                    e2["wired"] = True
+            wired = True
         return {
             "cniVersion": req.netconf.cni_version,
             "interfaces": [{"name": req.ifname, "sandbox": req.netns}],
@@ -141,12 +160,31 @@ class TpuSideManager:
         }
 
     def _cni_nf_del(self, req: PodRequest) -> dict:
+        """DEL for one interface removes only that interface's attachment
+        (a multus-style per-interface DEL+retry must not discard the other
+        interface's state); a DEL without deviceID tears the sandbox down."""
+        attachment_id = (f"nf-{req.sandbox_id[:12]}-{req.device_id}"
+                         if req.device_id else None)
+        unwire = None
         with self._attach_lock:
-            entry = self._attach_store.pop(req.sandbox_id, None)
-        if entry and entry["wired"]:
+            entry = self._attach_store.get(req.sandbox_id)
+            if entry is None:
+                return {}
+            if attachment_id is None:
+                if entry["wired"]:
+                    unwire = (entry["atts"][0], entry["atts"][1])
+                self._attach_store.pop(req.sandbox_id)
+            elif attachment_id in entry["atts"]:
+                if (entry["wired"]
+                        and entry["atts"].index(attachment_id) < 2):
+                    unwire = (entry["atts"][0], entry["atts"][1])
+                    entry["wired"] = False
+                entry["atts"].remove(attachment_id)
+                if not entry["atts"]:
+                    self._attach_store.pop(req.sandbox_id, None)
+        if unwire is not None:
             try:
-                self.vsp.delete_network_function(entry["atts"][0],
-                                                 entry["atts"][1])
+                self.vsp.delete_network_function(*unwire)
             except Exception:  # noqa: BLE001 — defensive DEL
                 log.warning("delete_network_function failed for %s",
                             req.sandbox_id)

@@ -88,6 +88,10 @@ class GoogleTpuVsp:
         self.topology: Optional[SliceTopology] = None
         self.num_chips: Optional[int] = None
         self.attachments: dict[str, dict] = {}
+        # stable host-side chip numbering: first-seen order, append-only,
+        # so indices survive device hot-add/remove (the reference gets this
+        # for free from PCI-address math, marvell/mrvl-utils Mapped_VF)
+        self._host_index: dict[str, int] = {}
 
     # -- LifeCycleService -----------------------------------------------------
     def init(self, req: dict) -> dict:
@@ -135,9 +139,11 @@ class GoogleTpuVsp:
         for dev in self.platform.pci_devices():
             if (dev.vendor_id == GOOGLE_VENDOR_ID
                     and dev.device_id in TPU_DEVICE_IDS and not dev.is_vf):
+                idx = self._host_index.setdefault(
+                    dev.address, len(self._host_index))
                 devs[dev.address] = {
                     "id": dev.address, "healthy": True,
-                    "dev_path": "", "coords": [],
+                    "dev_path": "", "coords": [], "chip_index": idx,
                 }
         return devs
 

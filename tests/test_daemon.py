@@ -112,7 +112,7 @@ def test_host_side_manager_cni_add_creates_slice_attachment(pm, short_tmp):
     # host-side devices must be PCI addresses
     host_mock.get_devices = lambda req: {"devices": {
         "0000:00:04.0": {"id": "0000:00:04.0", "healthy": True,
-                         "dev_path": "", "coords": []}}}
+                         "dev_path": "", "coords": [], "chip_index": 0}}}
     sock = pm.vendor_plugin_socket()
     pm.ensure_socket_dir(sock)
     vsp_server = VspServer(host_mock, socket_path=sock)

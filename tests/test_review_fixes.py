@@ -1,0 +1,160 @@
+"""Regression tests for review findings: stable chip indices, per-interface
+NF DEL, late-ADD rollback after CNI timeout, fast-fail on app errors."""
+
+import threading
+import time
+
+import pytest
+
+from dpu_operator_tpu.cni import CniServer
+from dpu_operator_tpu.cni.types import CniRequest
+from dpu_operator_tpu.daemon import TpuSideManager
+from dpu_operator_tpu.platform.platform import FakePlatform, PciDevice
+from dpu_operator_tpu.utils.path_manager import PathManager
+from dpu_operator_tpu.vsp.google import GoogleTpuVsp
+
+
+def _tpu_pci(addr):
+    return PciDevice(address=addr, vendor_id="1ae0", device_id="0062")
+
+
+def test_host_chip_index_stable_across_hot_add():
+    """A device added later but sorting earlier must not shift existing
+    chip indices (attachment names would collide across pods)."""
+    plat = FakePlatform(pci=[_tpu_pci("0000:00:04.0")])
+    vsp = GoogleTpuVsp(plat)
+    d1 = vsp.get_devices({})["devices"]
+    assert d1["0000:00:04.0"]["chip_index"] == 0
+    plat.set_pci_devices([_tpu_pci("0000:00:03.0"),
+                          _tpu_pci("0000:00:04.0")])
+    d2 = vsp.get_devices({})["devices"]
+    assert d2["0000:00:04.0"]["chip_index"] == 0  # unchanged
+    assert d2["0000:00:03.0"]["chip_index"] == 1  # appended
+
+
+class _RecordingVsp:
+    def __init__(self, fail_wires=0):
+        self.wired = []
+        self.unwired = []
+        self.fail_wires = fail_wires
+
+    def create_network_function(self, a, b):
+        if self.fail_wires > 0:
+            self.fail_wires -= 1
+            raise RuntimeError("dataplane busy")
+        self.wired.append((a, b))
+
+    def delete_network_function(self, a, b):
+        self.unwired.append((a, b))
+
+
+def _nf_manager(tmp_path, vsp):
+    mgr = TpuSideManager.__new__(TpuSideManager)
+    mgr.vsp = vsp
+    mgr._attach_store = {}
+    mgr._attach_lock = threading.Lock()
+    return mgr
+
+
+class _Req:
+    def __init__(self, sandbox, device, ifname="net1"):
+        self.sandbox_id = sandbox
+        self.device_id = device
+        self.ifname = ifname
+        self.netns = "/var/run/netns/x"
+
+        class _NC:
+            cni_version = "0.4.0"
+        self.netconf = _NC()
+
+
+def test_nf_del_single_interface_preserves_other(tmp_path):
+    """DEL of one interface must keep the other's attachment so a retried
+    ADD can still reach two attachments and wire the NF."""
+    vsp = _RecordingVsp()
+    mgr = _nf_manager(tmp_path, vsp)
+    mgr._cni_nf_add(_Req("sandboxAAAA", "chip-0"))
+    r = mgr._cni_nf_add(_Req("sandboxAAAA", "chip-1", "net2"))
+    assert r["tpu"]["networkFunction"] is True
+    # per-interface DEL of net2 unwires but keeps net1's attachment
+    mgr._cni_nf_del(_Req("sandboxAAAA", "chip-1", "net2"))
+    assert len(vsp.unwired) == 1
+    entry = mgr._attach_store["sandboxAAAA"]
+    assert entry["atts"] == ["nf-sandboxAAAA-chip-0"]
+    assert entry["wired"] is False
+    # retried ADD reaches two attachments again and re-wires
+    r2 = mgr._cni_nf_add(_Req("sandboxAAAA", "chip-1", "net2"))
+    assert r2["tpu"]["networkFunction"] is True
+    assert len(vsp.wired) == 2
+
+
+def test_nf_del_without_device_tears_down_sandbox(tmp_path):
+    vsp = _RecordingVsp()
+    mgr = _nf_manager(tmp_path, vsp)
+    mgr._cni_nf_add(_Req("sandboxBBBB", "chip-0"))
+    mgr._cni_nf_add(_Req("sandboxBBBB", "chip-1", "net2"))
+    mgr._cni_nf_del(_Req("sandboxBBBB", None))
+    assert "sandboxBBBB" not in mgr._attach_store
+    assert len(vsp.unwired) == 1
+
+
+def test_nf_wire_failure_allows_retry(tmp_path):
+    vsp = _RecordingVsp(fail_wires=1)
+    mgr = _nf_manager(tmp_path, vsp)
+    mgr._cni_nf_add(_Req("sandboxCCCC", "chip-0"))
+    with pytest.raises(RuntimeError):
+        mgr._cni_nf_add(_Req("sandboxCCCC", "chip-1", "net2"))
+    # wiring claim released; retry succeeds
+    r = mgr._cni_nf_add(_Req("sandboxCCCC", "chip-1", "net2"))
+    assert r["tpu"]["networkFunction"] is True
+
+
+def _cni_request(command, container="late1"):
+    return CniRequest(
+        env={"CNI_COMMAND": command, "CNI_CONTAINERID": container,
+             "CNI_NETNS": "/var/run/netns/x", "CNI_IFNAME": "net1",
+             "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p"},
+        config={"cniVersion": "0.4.0", "type": "tpu-cni"})
+
+
+def test_late_add_success_after_timeout_is_rolled_back(short_tmp):
+    """A handler finishing after the deadline must not leave committed
+    state behind: its effects are undone via the DEL handler."""
+    added = []
+    deleted = []
+    done = threading.Event()
+
+    def slow_add(req):
+        time.sleep(0.5)
+        added.append(req.sandbox_id)
+        return {}
+
+    def on_del(req):
+        deleted.append(req.sandbox_id)
+        done.set()
+        return {}
+
+    server = CniServer(short_tmp + "/cni.sock", add_handler=slow_add,
+                       del_handler=on_del, timeout=0.1)
+    resp = server._handle(_cni_request("ADD"))
+    assert "timed out" in resp.error
+    assert done.wait(timeout=5)
+    assert added == ["late1"] and deleted == ["late1"]
+    server.stop()
+
+
+def test_timed_out_add_failure_is_not_rolled_back(short_tmp):
+    deleted = []
+
+    def slow_fail(req):
+        time.sleep(0.3)
+        raise RuntimeError("boom")
+
+    server = CniServer(short_tmp + "/cni.sock", add_handler=slow_fail,
+                       del_handler=lambda r: deleted.append(r.sandbox_id),
+                       timeout=0.1)
+    resp = server._handle(_cni_request("ADD"))
+    assert "timed out" in resp.error
+    time.sleep(0.5)
+    assert deleted == []
+    server.stop()
