@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Benchmark: pod schedule-to-ready p50 through the full operator path.
+
+The reference publishes no numbers (SURVEY.md §6); its only implicit bound is
+that an NF pod must be Running within 2 minutes (e2e_test/e2e_test.go:43,439)
+with a 2-minute CNI deadline (cniserver.go:226-227). This bench measures our
+end-to-end equivalent per pod:
+
+  create pod -> scheduler places it -> kubelet device-plugin Allocate (real
+  gRPC) -> CNI ADD through the real shim + unix-socket server -> slice
+  attachment wired -> pod Ready,
+
+over the full daemon stack (device plugin, CNI server, VSP on real sockets),
+then runs one flagship sharded train step on the local accelerator (the real
+TPU chip when present) to include the compute handoff the allocation exists
+for. Prints ONE JSON line; vs_baseline is the reference's 120 s bound divided
+by our p50 (>1 means faster than the bound).
+"""
+
+import json
+import logging
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+logging.disable(logging.WARNING)
+os.environ.setdefault("TPU_BENCH_PODS", "20")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pod(name, chips=1):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {
+                         "k8s.v1.cni.cncf.io/networks": "tpunfcni-conf"}},
+        "spec": {"containers": [{
+            "name": "w", "image": "jax-workload",
+            "resources": {"requests": {"google.com/tpu": str(chips)},
+                          "limits": {"google.com/tpu": str(chips)}}}]},
+    }
+
+
+def bench_pod_ready(n_pods: int) -> list:
+    from dpu_operator_tpu.cni import CniShim
+    from dpu_operator_tpu.daemon import TpuSideManager
+    from dpu_operator_tpu.deviceplugin.fake_kubelet import FakeKubelet
+    from dpu_operator_tpu.k8s import FakeKube, FakeNodeAgent
+    from dpu_operator_tpu.platform.vendordetector import TpuDetector
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    from dpu_operator_tpu.vsp.mock import MockTpuVsp
+    from dpu_operator_tpu.vsp.plugin import GrpcPlugin
+    from dpu_operator_tpu.vsp.rpc import VspServer
+
+    tmp = tempfile.mkdtemp(prefix="tpubench-", dir="/tmp")
+    pm = PathManager(tmp)
+    kube = FakeKube()
+    agent = FakeNodeAgent(kube)
+    agent.start()
+    agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+    kubelet = FakeKubelet(pm, node_agent=agent, node_name="tpu-vm-0")
+    kubelet.start()
+
+    mock = MockTpuVsp(port=0)
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    vsp_server = VspServer(mock, socket_path=sock)
+    vsp_server.start()
+    det = TpuDetector().detection_result(tpu_mode=True, identifier="bench")
+    mgr = TpuSideManager(GrpcPlugin(det, path_manager=pm, init_timeout=5.0),
+                         pm, client=kube)
+    mgr.device_plugin.poll_interval = 0.1
+
+    latencies = []
+    try:
+        mgr.start_vsp()
+        mgr.setup_devices()
+        mgr.listen()
+        mgr.serve()
+        if not kubelet.wait_for_devices("google.com/tpu", 4):
+            raise RuntimeError("device plugin never reported 4 chips")
+
+        shim = CniShim(pm.cni_server_socket())
+        for i in range(n_pods):
+            name = f"bench-{i}"
+            chip = f"chip-{i % 4}"
+            t0 = time.perf_counter()
+            kube.create(_pod(name))
+            agent.sync()  # scheduler pass
+            pod = kube.get("v1", "Pod", name, namespace="default")
+            assert pod["status"]["phase"] == "Running", pod["status"]
+            kubelet.allocate("google.com/tpu", [chip])
+            resp = shim.invoke(
+                {"CNI_COMMAND": "ADD", "CNI_CONTAINERID": f"sbx-{name}",
+                 "CNI_NETNS": f"/var/run/netns/{name}",
+                 "CNI_IFNAME": "net1",
+                 "CNI_ARGS": ("K8S_POD_NAMESPACE=default;"
+                              f"K8S_POD_NAME={name}")},
+                json.dumps({"cniVersion": "0.4.0", "type": "tpu-cni",
+                            "mode": "network-function", "deviceID": chip}))
+            if resp.error:
+                raise RuntimeError(f"CNI ADD failed: {resp.error}")
+            latencies.append(time.perf_counter() - t0)
+            shim.invoke(
+                {"CNI_COMMAND": "DEL", "CNI_CONTAINERID": f"sbx-{name}",
+                 "CNI_NETNS": f"/var/run/netns/{name}",
+                 "CNI_IFNAME": "net1",
+                 "CNI_ARGS": ("K8S_POD_NAMESPACE=default;"
+                              f"K8S_POD_NAME={name}")},
+                json.dumps({"cniVersion": "0.4.0", "type": "tpu-cni",
+                            "mode": "network-function", "deviceID": chip}))
+            kube.delete("v1", "Pod", name, namespace="default")
+    finally:
+        mgr.stop()
+        vsp_server.stop()
+        kubelet.stop()
+        agent.stop()
+    return latencies
+
+
+def run_train_step():
+    """One flagship sharded train step on the local accelerator — the
+    compute handoff the allocation path exists to enable."""
+    import jax
+
+    from dpu_operator_tpu.workloads import (TransformerConfig,
+                                            make_example_batch, make_mesh,
+                                            make_train_step)
+    n = len(jax.devices())
+    axes = (1, n) if n > 1 else (1, 1)
+    mesh = make_mesh(("data", "model"), axis_sizes=axes)
+    cfg = TransformerConfig(n_layers=2, max_seq=128)
+    step, init_state, place = make_train_step(cfg, mesh)
+    params, opt = init_state(jax.random.key(0))
+    batch = place(make_example_batch(cfg, batch=8))
+    t0 = time.perf_counter()
+    params, opt, loss = step(params, opt, batch)
+    float(loss)
+    return time.perf_counter() - t0
+
+
+def main():
+    n_pods = int(os.environ["TPU_BENCH_PODS"])
+    latencies = bench_pod_ready(n_pods)
+    run_train_step()  # compile+run must succeed on the local accelerator
+    p50 = statistics.median(latencies)
+    baseline_bound = 120.0  # reference: NF pod Running <= 2 min
+    print(json.dumps({
+        "metric": "pod_schedule_to_ready_p50",
+        "value": round(p50, 4),
+        "unit": "s",
+        "vs_baseline": round(baseline_bound / p50, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
