@@ -1,0 +1,174 @@
+"""Injector webhook tests: mutation logic + HTTP server + control switches.
+
+Reference analog: the NRI behavior e2e_test.go relies on (pods requesting
+secondary networks get resources injected) plus webhook validation cases
+(e2e_test.go:188-330).
+"""
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from dpu_operator_tpu.webhook import (
+    CONTROL_SWITCHES_CONFIGMAP, NETWORKS_ANNOTATION,
+    RESOURCE_NAME_ANNOTATION, WebhookServer, mutate_pod, parse_network_refs)
+from dpu_operator_tpu.utils import vars as v
+
+
+def _nad_obj(name, resource="google.com/tpu", ns="default"):
+    return {
+        "apiVersion": "k8s.cni.cncf.io/v1",
+        "kind": "NetworkAttachmentDefinition",
+        "metadata": {"name": name, "namespace": ns,
+                     "annotations": {RESOURCE_NAME_ANNOTATION: resource}},
+        "spec": {"config": "{}"},
+    }
+
+
+def _pod(networks, requests=None):
+    c = {"name": "w", "image": "x"}
+    if requests is not None:
+        c["resources"] = {"requests": dict(requests),
+                          "limits": dict(requests)}
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "default",
+                     "annotations": {NETWORKS_ANNOTATION: networks}},
+        "spec": {"containers": [c]},
+    }
+
+
+def _apply_patches(pod, patches):
+    """Minimal JSON-Patch apply for add/replace on the paths we emit."""
+    for p in patches:
+        parts = [s for s in p["path"].split("/") if s]
+        target = pod
+        for part in parts[:-1]:
+            target = target[int(part)] if part.isdigit() else target[part]
+        target[parts[-1]] = p["value"]
+    return pod
+
+
+# -- parse_network_refs -------------------------------------------------------
+
+def test_parse_refs_short_and_namespaced():
+    refs = parse_network_refs("tpunfcni-conf, other-ns/nad2@net2", "default")
+    assert refs == [("default", "tpunfcni-conf"), ("other-ns", "nad2")]
+
+
+def test_parse_refs_duplicates_preserved():
+    refs = parse_network_refs("a, a", "ns1")
+    assert refs == [("ns1", "a"), ("ns1", "a")]
+
+
+def test_parse_refs_malformed_raises():
+    with pytest.raises(ValueError):
+        parse_network_refs("bad//ref", "default")
+
+
+# -- mutate_pod ---------------------------------------------------------------
+
+def _lookup(nads):
+    index = {(n["metadata"]["namespace"], n["metadata"]["name"]): n
+             for n in nads}
+
+    def fn(ns, name):
+        nad = index.get((ns, name))
+        if nad is None:
+            return None
+        return nad["metadata"]["annotations"].get(RESOURCE_NAME_ANNOTATION)
+    return fn
+
+
+def test_mutate_injects_resource_for_two_attachments():
+    pod = _pod("tpunfcni-conf, tpunfcni-conf")
+    patches = mutate_pod(pod, _lookup([_nad_obj("tpunfcni-conf")]))
+    mutated = _apply_patches(pod, patches)
+    res = mutated["spec"]["containers"][0]["resources"]
+    assert res["requests"]["google.com/tpu"] == "2"
+    assert res["limits"]["google.com/tpu"] == "2"
+
+
+def test_mutate_respects_existing_requests():
+    pod = _pod("tpunfcni-conf", requests={"google.com/tpu": "4"})
+    patches = mutate_pod(pod, _lookup([_nad_obj("tpunfcni-conf")]))
+    assert patches == []  # existing 4 >= wanted 1: nothing to do
+
+
+def test_mutate_no_annotation_is_noop():
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [{"name": "c"}]}}
+    assert mutate_pod(pod, _lookup([])) == []
+
+
+def test_mutate_nad_without_resource_is_noop():
+    nad = _nad_obj("plain")
+    del nad["metadata"]["annotations"][RESOURCE_NAME_ANNOTATION]
+    pod = _pod("plain")
+    assert mutate_pod(pod, _lookup([nad])) == []
+
+
+# -- server -------------------------------------------------------------------
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def _review(obj, op="CREATE"):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "u1", "operation": op, "object": obj}}
+
+
+@pytest.fixture
+def webhook(kube):
+    server = WebhookServer(kube, switch_poll_interval=0.1)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_server_mutates_pod(kube, webhook):
+    kube.create(_nad_obj("tpunfcni-conf"))
+    out = _post(webhook.port, "/mutate", _review(_pod("tpunfcni-conf")))
+    assert out["response"]["allowed"] is True
+    patches = json.loads(base64.b64decode(out["response"]["patch"]))
+    assert any(p["value"].get("google.com/tpu") == "1" for p in patches
+               if isinstance(p["value"], dict))
+
+
+def test_server_control_switch_disables_injection(kube, webhook):
+    kube.create(_nad_obj("tpunfcni-conf"))
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": CONTROL_SWITCHES_CONFIGMAP,
+                              "namespace": v.NAMESPACE},
+                 "data": {"config.json":
+                          '{"networkResourceInjection": false}'}})
+    webhook.refresh_switches()
+    out = _post(webhook.port, "/mutate", _review(_pod("tpunfcni-conf")))
+    assert out["response"]["allowed"] is True
+    assert "patch" not in out["response"]
+
+
+def test_server_validates_config_cr(webhook):
+    bad = {"apiVersion": "tpu.google.com/v1", "kind": "TpuOperatorConfig",
+           "metadata": {"name": "wrong-name"}, "spec": {"mode": "host"}}
+    out = _post(webhook.port, "/validate", _review(bad))
+    assert out["response"]["allowed"] is False
+    assert "singleton" in out["response"]["status"]["message"]
+    good = {"apiVersion": "tpu.google.com/v1", "kind": "TpuOperatorConfig",
+            "metadata": {"name": "tpu-operator-config"},
+            "spec": {"mode": "tpu", "sliceTopology": "v5e-16"}}
+    assert _post(webhook.port, "/validate",
+                 _review(good))["response"]["allowed"] is True
+
+
+def test_server_healthz(webhook):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{webhook.port}/healthz", timeout=5) as r:
+        assert json.loads(r.read())["ok"] is True
