@@ -22,14 +22,31 @@ def main(argv=None):
                         help="serve the mock VSP (tests/dev)")
     parser.add_argument("--root", default="/")
     parser.add_argument("--socket", default="")
+    parser.add_argument("--cp-agent", default="",
+                        help="path to the tpu_cp_agent binary; when set the "
+                             "VSP spawns it and uses the native ICI "
+                             "dataplane (cp-agent-run.go:9-73 analog)")
+    parser.add_argument("--cp-agent-state", default="/var/run/tpucp.state")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     pm = PathManager(args.root)
     sock = args.socket or pm.vendor_plugin_socket()
     pm.ensure_socket_dir(sock)
+
+    agent_proc = None
+    dataplane = None
+    if args.cp_agent and not args.mock:
+        from .native_dp import AgentClient, AgentProcess, NativeIciDataplane
+        agent_sock = sock + ".cp-agent"
+        agent_proc = AgentProcess(args.cp_agent, agent_sock,
+                                  state_file=args.cp_agent_state)
+        agent_proc.start()
+        dataplane = NativeIciDataplane(AgentClient(agent_sock))
+        logging.info("native cp-agent on %s", agent_sock)
+
     impl = MockTpuVsp() if args.mock else GoogleTpuVsp(
-        HardwarePlatform(args.root))
+        HardwarePlatform(args.root), dataplane=dataplane)
     server = VspServer(impl, sock)
     server.start()
     logging.info("VSP serving on %s", sock)
@@ -38,6 +55,8 @@ def main(argv=None):
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     server.stop()
+    if agent_proc:
+        agent_proc.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,326 @@
+// tpu_cp_agent — native TPU control-plane agent.
+//
+// The TPU analog of the reference's octep_cp_agent (marvell/vendor/
+// pcie_ep_octeon_target/target/apps/cp_agent): the lowest-level process that
+// owns the accelerator control interface. Where the octeon agent services a
+// PCIe mailbox over vfio mmaps, this agent services the framed unix-socket
+// mailbox (protocol.h) the GoogleTpuVSP's NativeIciDataplane speaks, and
+// backs it with:
+//   - chip enumeration from the accel chardev directory (--dev-dir),
+//   - the slice/ICI wiring database (chipdb.cc),
+//   - a crash-safe state file (--state-file) replayed at startup.
+//
+// Usage: tpu_cp_agent --socket /run/tpucp.sock [--state-file F] [--dev-dir D]
+
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "chipdb.h"
+#include "protocol.h"
+
+namespace tpucp {
+namespace {
+
+struct Agent {
+  ChipDb db;
+  std::mutex mu;
+  std::string state_file;
+  std::string dev_dir = "/dev";
+
+  bool ChipHealthy(int local_index) const {
+    if (dev_dir.empty()) return true;
+    std::string path = dev_dir + "/accel" + std::to_string(local_index);
+    struct stat st;
+    if (stat(path.c_str(), &st) != 0) return false;
+    return S_ISCHR(st.st_mode) || S_ISREG(st.st_mode);  // regular: test fake
+  }
+
+  void PersistLocked() {
+    if (state_file.empty()) return;
+    std::string tmp = state_file + ".tmp";
+    std::ofstream out(tmp, std::ios::trunc);
+    out << db.Serialize();
+    out.close();
+    ::rename(tmp.c_str(), state_file.c_str());
+  }
+
+  void Restore() {
+    if (state_file.empty()) return;
+    std::ifstream in(state_file);
+    if (!in.good()) return;
+    std::stringstream buf;
+    buf << in.rdbuf();
+    std::string error;
+    if (!db.Deserialize(buf.str(), &error)) {
+      fprintf(stderr, "tpu_cp_agent: state restore failed: %s\n",
+              error.c_str());
+      db = ChipDb();
+    } else if (db.initialized()) {
+      fprintf(stderr, "tpu_cp_agent: restored %s (%zu chips)\n",
+              db.topology().c_str(), db.num_chips());
+    }
+  }
+};
+
+bool ReadAll(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = read(fd, p, len);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool WriteAll(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = write(fd, p, len);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool SendResp(int fd, uint16_t req_type, uint32_t seq, const void* payload,
+              uint32_t len) {
+  Header h{kMagic, kVersion, static_cast<uint16_t>(req_type | MSG_RESP), seq,
+           len};
+  if (!WriteAll(fd, &h, sizeof(h))) return false;
+  return len == 0 || WriteAll(fd, payload, len);
+}
+
+void FillStatus(StatusResp* resp, int32_t status, const std::string& error) {
+  resp->status = status;
+  snprintf(resp->error, sizeof(resp->error), "%s", error.c_str());
+}
+
+// Dispatch one request; returns false when the connection should close.
+bool Handle(Agent& agent, int fd, const Header& h,
+            const std::vector<char>& payload) {
+  std::lock_guard<std::mutex> lock(agent.mu);
+  std::string error;
+  switch (h.type) {
+    case MSG_INIT: {
+      InitResp resp{};
+      if (payload.size() < sizeof(InitReq)) {
+        resp.status = ST_INVALID;
+        return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+      }
+      InitReq req;
+      memcpy(&req, payload.data(), sizeof(req));
+      req.topology[sizeof(req.topology) - 1] = '\0';
+      if (!agent.db.Init(req.topology, &error)) {
+        resp.status = ST_INVALID;
+      } else {
+        resp.status = ST_OK;
+        resp.num_chips = static_cast<uint32_t>(agent.db.num_chips());
+        for (int d = 0; d < 3; d++) resp.shape[d] = agent.db.shape()[d];
+        agent.PersistLocked();
+      }
+      return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+    }
+    case MSG_ENUM: {
+      const auto& chips = agent.db.chips();
+      EnumResp resp{ST_OK, static_cast<uint32_t>(chips.size())};
+      std::vector<char> out(sizeof(resp) + chips.size() * sizeof(ChipEntry));
+      memcpy(out.data(), &resp, sizeof(resp));
+      for (size_t i = 0; i < chips.size(); i++) {
+        ChipEntry e{};
+        e.index = static_cast<uint32_t>(chips[i].index);
+        for (int d = 0; d < 3; d++) e.coords[d] = chips[i].coords[d];
+        e.healthy = agent.ChipHealthy(static_cast<int>(i)) ? 1 : 0;
+        e.attached = chips[i].attached ? 1 : 0;
+        e.nports = static_cast<uint16_t>(chips[i].torus_ports.size());
+        memcpy(out.data() + sizeof(resp) + i * sizeof(e), &e, sizeof(e));
+      }
+      return SendResp(fd, h.type, h.seq, out.data(),
+                      static_cast<uint32_t>(out.size()));
+    }
+    case MSG_ATTACH: {
+      StatusResp resp{};
+      if (payload.size() < sizeof(AttachReq)) {
+        FillStatus(&resp, ST_INVALID, "short AttachReq");
+        return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+      }
+      AttachReq req;
+      memcpy(&req, payload.data(), sizeof(req));
+      std::vector<std::string> ports;
+      for (uint32_t i = 0; i < req.nports && i < kMaxPorts; i++) {
+        req.ports[i][3] = '\0';
+        ports.emplace_back(req.ports[i]);
+      }
+      if (!agent.db.initialized()) {
+        FillStatus(&resp, ST_INVALID, "no topology programmed");
+      } else if (!agent.db.Attach(req.chip, ports, &error)) {
+        FillStatus(&resp, ST_INVALID, error);
+      } else {
+        FillStatus(&resp, ST_OK, "");
+        agent.PersistLocked();
+      }
+      return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+    }
+    case MSG_DETACH: {
+      StatusResp resp{};
+      DetachReq req{};
+      if (payload.size() >= sizeof(req))
+        memcpy(&req, payload.data(), sizeof(req));
+      if (!agent.db.Detach(req.chip, &error)) {
+        FillStatus(&resp, ST_NOT_FOUND, error);
+      } else {
+        FillStatus(&resp, ST_OK, "");
+        agent.PersistLocked();
+      }
+      return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+    }
+    case MSG_WIRE_NF:
+    case MSG_UNWIRE_NF: {
+      StatusResp resp{};
+      if (payload.size() < sizeof(WireReq)) {
+        FillStatus(&resp, ST_INVALID, "short WireReq");
+        return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+      }
+      WireReq req;
+      memcpy(&req, payload.data(), sizeof(req));
+      req.input[sizeof(req.input) - 1] = '\0';
+      req.output[sizeof(req.output) - 1] = '\0';
+      bool ok = (h.type == MSG_WIRE_NF)
+                    ? agent.db.Wire(req.input, req.output, &error)
+                    : agent.db.Unwire(req.input, req.output, &error);
+      if (!ok) {
+        FillStatus(&resp,
+                   h.type == MSG_WIRE_NF ? ST_EXISTS : ST_NOT_FOUND, error);
+      } else {
+        FillStatus(&resp, ST_OK, "");
+        agent.PersistLocked();
+      }
+      return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+    }
+    case MSG_LINK_STATE: {
+      LinkStateResp resp{};
+      LinkStateReq req{};
+      if (payload.size() >= sizeof(req))
+        memcpy(&req, payload.data(), sizeof(req));
+      const auto& chips = agent.db.chips();
+      if (req.chip >= chips.size()) {
+        resp.status = ST_NOT_FOUND;
+        return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+      }
+      const ChipState& chip = chips[req.chip];
+      resp.status = ST_OK;
+      resp.nports = 0;
+      for (const auto& p : chip.torus_ports) {
+        if (resp.nports >= kMaxPorts) break;
+        PortState& ps = resp.ports[resp.nports++];
+        snprintf(ps.port, sizeof(ps.port), "%s", p.c_str());
+        ps.wired = chip.attached && chip.wired_ports.count(p) ? 1 : 0;
+        ps.up = ps.wired;  // link trains when both wired (model: instant)
+      }
+      return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+    }
+    case MSG_SHUTDOWN: {
+      StatusResp resp{};
+      FillStatus(&resp, ST_OK, "");
+      SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+      exit(0);
+    }
+    default: {
+      StatusResp resp{};
+      FillStatus(&resp, ST_INVALID, "unknown message type");
+      return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+    }
+  }
+}
+
+void ServeConn(Agent* agent, int fd) {
+  for (;;) {
+    Header h;
+    if (!ReadAll(fd, &h, sizeof(h))) break;
+    if (h.magic != kMagic || h.version != kVersion || h.len > (1u << 20)) {
+      fprintf(stderr, "tpu_cp_agent: bad frame, closing\n");
+      break;
+    }
+    std::vector<char> payload(h.len);
+    if (h.len && !ReadAll(fd, payload.data(), h.len)) break;
+    if (!Handle(*agent, fd, h, payload)) break;
+  }
+  close(fd);
+}
+
+}  // namespace
+}  // namespace tpucp
+
+int main(int argc, char** argv) {
+  std::string socket_path;
+  tpucp::Agent agent;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    auto next = [&]() -> const char* {
+      return (i + 1 < argc) ? argv[++i] : "";
+    };
+    if (arg == "--socket") socket_path = next();
+    else if (arg == "--state-file") agent.state_file = next();
+    else if (arg == "--dev-dir") agent.dev_dir = next();
+    else {
+      fprintf(stderr, "unknown flag %s\n", arg.c_str());
+      return 2;
+    }
+  }
+  if (socket_path.empty()) {
+    fprintf(stderr, "usage: tpu_cp_agent --socket PATH [--state-file F] "
+                    "[--dev-dir D]\n");
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  agent.Restore();
+
+  unlink(socket_path.c_str());
+  int listener = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (listener < 0) {
+    perror("socket");
+    return 1;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", socket_path.c_str());
+  if (bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(listener, 8) < 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  chmod(socket_path.c_str(), 0600);
+  fprintf(stderr, "tpu_cp_agent: listening on %s\n", socket_path.c_str());
+
+  for (;;) {
+    int fd = accept(listener, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      perror("accept");
+      break;
+    }
+    std::thread(tpucp::ServeConn, &agent, fd).detach();
+  }
+  return 0;
+}

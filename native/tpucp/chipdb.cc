@@ -1,0 +1,224 @@
+#include "chipdb.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+namespace tpucp {
+
+namespace {
+
+// ports per chip by generation — must match ici/topology.py PORTS_PER_CHIP
+int PortsForGen(const std::string& gen) {
+  if (gen == "v2" || gen == "v3" || gen == "v5e" || gen == "v6e") return 4;
+  if (gen == "v4" || gen == "v5p") return 6;
+  return 0;
+}
+
+// most-square 2D factorization (topology.py _factor_2d)
+void Factor2d(uint32_t n, uint32_t* a, uint32_t* b) {
+  *a = 1;
+  *b = n;
+  for (uint32_t x = 1; x * x <= n; x++) {
+    if (n % x == 0) {
+      *a = x;
+      *b = n / x;
+    }
+  }
+}
+
+// most-cubic 3D factorization (topology.py _factor_3d)
+void Factor3d(uint32_t n, uint32_t* a, uint32_t* b, uint32_t* c) {
+  *a = 1;
+  *b = 1;
+  *c = n;
+  uint32_t best = 3 * n;
+  uint32_t lim = static_cast<uint32_t>(std::round(std::cbrt(double(n)))) + 2;
+  for (uint32_t x = 1; x <= lim; x++) {
+    if (n % x) continue;
+    uint32_t m = n / x;
+    for (uint32_t y = x; y * y <= m; y++) {
+      if (m % y) continue;
+      uint32_t z = m / y;
+      if (x + y + z < best) {
+        best = x + y + z;
+        *a = x;
+        *b = y;
+        *c = z;
+      }
+    }
+  }
+}
+
+const char kAxes[3] = {'x', 'y', 'z'};
+
+}  // namespace
+
+bool ChipDb::Init(const std::string& topology, std::string* error) {
+  // format: <gen>-<chips>
+  auto dash = topology.rfind('-');
+  if (dash == std::string::npos) {
+    *error = "invalid topology '" + topology + "'";
+    return false;
+  }
+  std::string gen = topology.substr(0, dash);
+  int nports = PortsForGen(gen);
+  if (nports == 0) {
+    *error = "unknown TPU generation '" + gen + "'";
+    return false;
+  }
+  char* end = nullptr;
+  long n = strtol(topology.c_str() + dash + 1, &end, 10);
+  if (n <= 0 || (end && *end != '\0')) {
+    *error = "invalid chip count in '" + topology + "'";
+    return false;
+  }
+
+  shape_ = {1, 1, 1};
+  if (nports == 4) {
+    dims_ = 2;
+    Factor2d(static_cast<uint32_t>(n), &shape_[0], &shape_[1]);
+  } else {
+    dims_ = 3;
+    Factor3d(static_cast<uint32_t>(n), &shape_[0], &shape_[1], &shape_[2]);
+  }
+
+  chips_.clear();
+  wires_.clear();
+  chips_.resize(n);
+  for (long idx = 0; idx < n; idx++) {
+    ChipState& chip = chips_[idx];
+    chip.index = static_cast<int>(idx);
+    long rem = idx;
+    for (int d = dims_ - 1; d >= 0; d--) {
+      chip.coords[d] = static_cast<int>(rem % shape_[d]);
+      rem /= shape_[d];
+    }
+    // torus port ownership — matches SliceTopology._wire: extent-1 dims
+    // have no links; extent-2 dims carry one link pair owned "+"-side by
+    // coord 0 and "-"-side by coord 1; extent>=3 is a full torus.
+    for (int d = 0; d < dims_; d++) {
+      uint32_t extent = shape_[d];
+      if (extent < 2) continue;
+      bool plus = !(extent == 2 && chip.coords[d] == 1);
+      bool minus = !(extent == 2 && chip.coords[d] == 0);
+      if (plus) chip.torus_ports.push_back(std::string(1, kAxes[d]) + "+");
+      if (minus) chip.torus_ports.push_back(std::string(1, kAxes[d]) + "-");
+    }
+  }
+  topology_ = topology;
+  return true;
+}
+
+bool ChipDb::Attach(uint32_t chip, const std::vector<std::string>& ports,
+                    std::string* error) {
+  if (chip >= chips_.size()) {
+    *error = "chip index out of range";
+    return false;
+  }
+  ChipState& state = chips_[chip];
+  std::set<std::string> owned(state.torus_ports.begin(),
+                              state.torus_ports.end());
+  std::set<std::string> to_wire;
+  if (ports.empty()) {
+    to_wire = owned;
+  } else {
+    for (const auto& p : ports) {
+      if (!owned.count(p)) {
+        *error = "chip " + std::to_string(chip) + " has no port '" + p + "'";
+        return false;
+      }
+      to_wire.insert(p);
+    }
+  }
+  state.attached = true;
+  state.wired_ports = std::move(to_wire);
+  return true;
+}
+
+bool ChipDb::Detach(uint32_t chip, std::string* error) {
+  if (chip >= chips_.size()) {
+    *error = "chip index out of range";
+    return false;
+  }
+  chips_[chip].attached = false;
+  chips_[chip].wired_ports.clear();
+  return true;
+}
+
+bool ChipDb::Wire(const std::string& input, const std::string& output,
+                  std::string* error) {
+  if (input.empty() || output.empty()) {
+    *error = "empty endpoint id";
+    return false;
+  }
+  auto key = std::make_pair(input, output);
+  if (wires_.count(key)) {
+    *error = "wire already exists";
+    return false;
+  }
+  wires_.insert(key);
+  return true;
+}
+
+bool ChipDb::Unwire(const std::string& input, const std::string& output,
+                    std::string* error) {
+  if (!wires_.erase(std::make_pair(input, output))) {
+    *error = "wire not found";
+    return false;
+  }
+  return true;
+}
+
+std::string ChipDb::Serialize() const {
+  std::ostringstream out;
+  out << "topology " << topology_ << "\n";
+  for (const auto& chip : chips_) {
+    if (!chip.attached) continue;
+    out << "attach " << chip.index;
+    for (const auto& p : chip.wired_ports) out << " " << p;
+    out << "\n";
+  }
+  for (const auto& w : wires_) {
+    out << "wire " << w.first << " " << w.second << "\n";
+  }
+  return out.str();
+}
+
+bool ChipDb::Deserialize(const std::string& text, std::string* error) {
+  std::istringstream in(text);
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    std::istringstream ls(line);
+    std::string op;
+    ls >> op;
+    if (op == "topology") {
+      std::string topo;
+      ls >> topo;
+      if (!Init(topo, error)) return false;
+    } else if (op == "attach") {
+      if (!initialized()) {
+        *error = "attach before topology in state file";
+        return false;
+      }
+      uint32_t chip;
+      ls >> chip;
+      std::vector<std::string> ports;
+      std::string p;
+      while (ls >> p) ports.push_back(p);
+      if (!Attach(chip, ports, error)) return false;
+    } else if (op == "wire") {
+      std::string a, b;
+      ls >> a >> b;
+      if (!Wire(a, b, error)) return false;
+    } else {
+      *error = "unknown state op '" + op + "'";
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace tpucp

@@ -1,0 +1,66 @@
+// In-memory chip + ICI-port database: the agent's model of the slice.
+//
+// Mirrors the semantics of dpu_operator_tpu/ici/topology.py (2D mesh/torus
+// for 4-port generations, 3D torus for 6-port; extent-2 dimensions carry a
+// single non-duplicated link pair) so the Python operator and the native
+// agent agree on wiring. Native analog of the reference's SoC-specific
+// state in octep_cp_lib/soc/cnxk.c.
+
+#pragma once
+
+#include <array>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace tpucp {
+
+struct ChipState {
+  int index = 0;
+  std::array<int, 3> coords{0, 0, 0};
+  std::vector<std::string> torus_ports;  // ports this chip owns
+  bool attached = false;
+  std::set<std::string> wired_ports;     // subset of torus_ports when attached
+};
+
+class ChipDb {
+ public:
+  // Parse "v5e-16" style topology; returns false (with error set) on
+  // malformed or unknown generation.
+  bool Init(const std::string& topology, std::string* error);
+
+  bool initialized() const { return !chips_.empty(); }
+  const std::string& topology() const { return topology_; }
+  const std::array<uint32_t, 3>& shape() const { return shape_; }
+  size_t num_chips() const { return chips_.size(); }
+  const std::vector<ChipState>& chips() const { return chips_; }
+
+  // Wire ports (empty = all torus ports). Errors: bad chip, unknown port.
+  bool Attach(uint32_t chip, const std::vector<std::string>& ports,
+              std::string* error);
+  bool Detach(uint32_t chip, std::string* error);
+
+  // Network-function hops between opaque endpoint ids.
+  bool Wire(const std::string& input, const std::string& output,
+            std::string* error);
+  bool Unwire(const std::string& input, const std::string& output,
+              std::string* error);
+  const std::set<std::pair<std::string, std::string>>& wires() const {
+    return wires_;
+  }
+
+  // Text state image for crash/restart recovery (checkpoint analog of the
+  // reference's CNI disk cache, sriov.go:489-500).
+  std::string Serialize() const;
+  bool Deserialize(const std::string& text, std::string* error);
+
+ private:
+  std::string topology_;
+  std::array<uint32_t, 3> shape_{1, 1, 1};
+  int dims_ = 0;
+  std::vector<ChipState> chips_;
+  std::set<std::pair<std::string, std::string>> wires_;
+};
+
+}  // namespace tpucp

@@ -1,0 +1,115 @@
+// Wire protocol for the TPU control-plane agent (tpu_cp_agent).
+//
+// The native analog of the reference's octep control-plane mailbox
+// (marvell/vendor/pcie_ep_octeon_target/target/libs/octep_cp_lib — host and
+// DPU exchange fixed-format control messages over PEM/DPI hardware). Here
+// the mailbox is a unix seqpacket-style framed stream: every message is a
+// fixed little-endian header followed by a fixed-size payload struct.
+//
+// The Python VSP (dpu_operator_tpu/vsp/native_dp.py) is the peer; keep the
+// structs in sync with _STRUCTS there.
+
+#pragma once
+
+#include <cstdint>
+
+namespace tpucp {
+
+constexpr uint32_t kMagic = 0x54504355;  // "UCPT" on the wire (LE)
+constexpr uint16_t kVersion = 1;
+
+enum MsgType : uint16_t {
+  MSG_INIT = 1,       // program a slice topology
+  MSG_ENUM = 2,       // enumerate chips + attachment state
+  MSG_ATTACH = 3,     // wire a chip's ICI ports into the slice
+  MSG_DETACH = 4,     // unwire a chip
+  MSG_WIRE_NF = 5,    // connect two attachment endpoints (SFC hop)
+  MSG_UNWIRE_NF = 6,
+  MSG_LINK_STATE = 7, // per-port link state for one chip
+  MSG_SHUTDOWN = 8,
+  MSG_RESP = 0x80,    // response bit: resp type = req type | MSG_RESP
+};
+
+enum Status : int32_t {
+  ST_OK = 0,
+  ST_INVALID = 1,
+  ST_NOT_FOUND = 2,
+  ST_EXISTS = 3,
+  ST_INTERNAL = 4,
+};
+
+#pragma pack(push, 1)
+
+struct Header {
+  uint32_t magic;
+  uint16_t version;
+  uint16_t type;
+  uint32_t seq;    // echoed in the response
+  uint32_t len;    // payload bytes following the header
+};
+
+struct InitReq {
+  char topology[32];  // e.g. "v5e-16"
+};
+
+struct InitResp {
+  int32_t status;
+  uint32_t num_chips;
+  uint32_t shape[3];  // torus extents; unused dims = 1
+};
+
+struct ChipEntry {
+  uint32_t index;
+  int32_t coords[3];
+  uint8_t healthy;    // local /dev/accel<i> chardev present (or no dev dir)
+  uint8_t attached;
+  uint16_t nports;
+};
+
+struct EnumResp {
+  int32_t status;
+  uint32_t count;     // followed by count ChipEntry structs
+};
+
+constexpr uint32_t kMaxPorts = 8;
+
+struct AttachReq {
+  uint32_t chip;
+  uint32_t nports;            // 0 = all torus ports of the chip
+  char ports[kMaxPorts][4];   // "x+", "y-", ...
+};
+
+struct StatusResp {
+  int32_t status;
+  char error[64];
+};
+
+struct DetachReq {
+  uint32_t chip;
+};
+
+struct WireReq {
+  char input[64];
+  char output[64];
+};
+
+struct LinkStateReq {
+  uint32_t chip;
+};
+
+struct PortState {
+  char port[4];
+  uint8_t up;      // attached → links trained
+  uint8_t wired;
+  uint16_t pad;
+};
+
+struct LinkStateResp {
+  int32_t status;
+  uint32_t nports;
+  PortState ports[kMaxPorts];
+};
+
+#pragma pack(pop)
+
+}  // namespace tpucp

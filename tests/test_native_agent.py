@@ -1,0 +1,152 @@
+"""Native control-plane agent tests: build, mailbox protocol, wiring
+semantics parity with the Python topology model, crash/restart recovery,
+and GoogleTpuVsp over the NativeIciDataplane end to end."""
+
+import os
+import subprocess
+
+import pytest
+
+from dpu_operator_tpu.ici import SliceTopology
+from dpu_operator_tpu.platform.platform import FakePlatform
+from dpu_operator_tpu.vsp.google import GoogleTpuVsp
+from dpu_operator_tpu.vsp.native_dp import (AgentClient, AgentError,
+                                            AgentProcess, NativeIciDataplane)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AGENT_BIN = os.path.join(REPO, "native", "build", "tpu_cp_agent")
+
+
+@pytest.fixture(scope="session")
+def agent_binary():
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")], check=True,
+                   capture_output=True)
+    return AGENT_BIN
+
+
+@pytest.fixture
+def agent(agent_binary, short_tmp):
+    proc = AgentProcess(agent_binary, short_tmp + "/tpucp.sock",
+                        state_file=short_tmp + "/tpucp.state",
+                        dev_dir=short_tmp)
+    proc.start()
+    client = AgentClient(proc.socket_path)
+    yield proc, client
+    client.close()
+    proc.stop()
+
+
+def _fake_accel(tmp, n):
+    for i in range(n):
+        open(f"{tmp}/accel{i}", "w").close()
+
+
+def test_init_and_enum_match_python_topology(agent, short_tmp):
+    _, client = agent
+    info = client.init("v5e-16")
+    topo = SliceTopology("v5e-16")
+    assert info["num_chips"] == 16
+    assert info["shape"][:2] == tuple(topo.shape)
+    chips = client.enumerate()
+    assert len(chips) == 16
+    for c, pc in zip(chips, topo.chips):
+        assert c["coords"][:2] == tuple(pc.coords)
+        assert c["nports"] == len(topo.links_from(pc.index))
+
+
+def test_3d_topology_ports(agent):
+    _, client = agent
+    client.init("v5p-8")  # 2x2x2 cube: every dim extent 2 → 3 ports/chip
+    chips = client.enumerate()
+    assert all(c["nports"] == 3 for c in chips)
+    topo = SliceTopology("v5p-8")
+    assert all(c["nports"] == len(topo.links_from(c["index"]))
+               for c in chips)
+
+
+def test_attach_detach_and_link_state(agent):
+    _, client = agent
+    client.init("v5e-4")  # 2x2
+    client.attach(0)  # all torus ports
+    states = client.link_state(0)
+    assert states and all(s["wired"] and s["up"] for s in states)
+    client.detach(0)
+    assert all(not s["wired"] for s in client.link_state(0))
+
+
+def test_attach_invalid_port_rejected(agent):
+    _, client = agent
+    client.init("v5e-4")
+    with pytest.raises(AgentError):
+        client.attach(0, ["z+"])  # 2D slice has no z axis
+    with pytest.raises(AgentError):
+        client.attach(99)
+
+
+def test_attach_requires_topology(agent):
+    _, client = agent
+    with pytest.raises(AgentError):
+        client.attach(0)
+
+
+def test_wire_nf_duplicate_and_missing(agent):
+    _, client = agent
+    client.init("v5e-4")
+    client.wire_nf("nf-a", "nf-b")
+    with pytest.raises(AgentError):
+        client.wire_nf("nf-a", "nf-b")
+    client.unwire_nf("nf-a", "nf-b")
+    with pytest.raises(AgentError):
+        client.unwire_nf("nf-a", "nf-b")
+
+
+def test_health_from_dev_dir(agent, short_tmp):
+    _, client = agent
+    _fake_accel(short_tmp, 2)
+    client.init("v5e-4")
+    chips = client.enumerate()
+    assert [c["healthy"] for c in chips] == [True, True, False, False]
+
+
+def test_state_survives_restart(agent_binary, short_tmp):
+    sock = short_tmp + "/a.sock"
+    state = short_tmp + "/a.state"
+    proc = AgentProcess(agent_binary, sock, state_file=state)
+    proc.start()
+    client = AgentClient(sock)
+    client.init("v5e-8")
+    client.attach(3)
+    client.wire_nf("in0", "out0")
+    client.close()
+    proc.stop()
+
+    proc2 = AgentProcess(agent_binary, sock, state_file=state)
+    proc2.start()
+    client2 = AgentClient(sock)
+    chips = client2.enumerate()
+    assert len(chips) == 8
+    assert chips[3]["attached"] is True
+    with pytest.raises(AgentError):
+        client2.wire_nf("in0", "out0")  # wire persisted → duplicate
+    client2.close()
+    proc2.stop()
+
+
+def test_google_vsp_over_native_dataplane(agent, short_tmp):
+    """End to end: GoogleTpuVsp drives the native agent through the
+    IciDataplane seam (init → attach via slice attachment → NF wire)."""
+    _, client = agent
+    _fake_accel(short_tmp, 4)
+    plat = FakePlatform(accelerator_type="v5litepod-4",
+                        accel=[f"{short_tmp}/accel{i}" for i in range(4)])
+    vsp = GoogleTpuVsp(plat, dataplane=NativeIciDataplane(client))
+    vsp.init({"tpu_mode": True})
+    att = vsp.create_slice_attachment({"name": "host0-1", "chip_index": 1})
+    assert att["ici_ports"]  # ports filled from topology
+    states = client.link_state(1)
+    assert any(s["wired"] for s in states)
+    vsp.create_network_function({"input": "nf-i", "output": "nf-o"})
+    with pytest.raises(AgentError):
+        client.wire_nf("nf-i", "nf-o")  # already wired via the VSP
+    vsp.delete_slice_attachment({"name": "host0-1"})
+    assert all(not s["wired"] for s in client.link_state(1))
