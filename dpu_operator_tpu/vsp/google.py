@@ -148,11 +148,13 @@ class GoogleTpuVsp:
         return devs
 
     def _chip_healthy(self, dev_path: str) -> bool:
-        """Health = device node exists and is a chardev (the TPU analog of
-        the Marvell link-up check, marvell/main.go:219-236)."""
+        """Health = device node present (the TPU analog of the Marvell
+        link-up check, marvell/main.go:219-236). Chardev on real hosts;
+        regular files accepted so FakePlatform e2e runs need no mknod."""
         try:
             import stat
-            return stat.S_ISCHR(os.stat(dev_path).st_mode)
+            mode = os.stat(dev_path).st_mode
+            return stat.S_ISCHR(mode) or stat.S_ISREG(mode)
         except OSError:
             return False
 
