@@ -1,0 +1,50 @@
+# Build/test entrypoints (reference: Makefile + taskfile.yaml targets).
+PYTHON ?= python
+REGISTRY ?= localhost:5000
+TAG ?= latest
+
+.PHONY: test fast-test bench native traffic-flow images deploy undeploy \
+        graft-check clean
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+# reference `fast-test`: skip the slow e2e tier
+fast-test: native
+	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_e2e.py
+
+native:
+	$(MAKE) -C native
+
+bench: native
+	$(PYTHON) bench.py
+
+graft-check:
+	$(PYTHON) __graft_entry__.py
+
+traffic-flow:
+	$(PYTHON) hack/traffic_flow_tests.py --cpu
+
+# image matrix (reference: taskfiles/images.yaml, 9 images)
+IMAGES = operator daemon vsp cp-agent nri workload
+images:
+	for img in $(IMAGES); do \
+	  docker build -f Dockerfile.$$img -t $(REGISTRY)/tpu-$$img:$(TAG) . ; \
+	done
+
+push:
+	for img in $(IMAGES); do docker push $(REGISTRY)/tpu-$$img:$(TAG); done
+
+deploy:
+	kubectl apply -f config/crd/bases/
+	kubectl apply -f config/rbac/
+	kubectl apply -f config/manager/
+	kubectl apply -f config/webhook/
+
+undeploy:
+	kubectl delete -f config/manager/ --ignore-not-found
+	kubectl delete -f config/crd/bases/ --ignore-not-found
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf .pytest_cache **/__pycache__

@@ -17,6 +17,7 @@ from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutTimeout
 from http.server import BaseHTTPRequestHandler
 from typing import Callable, Optional
 
+from ..utils import metrics
 from .types import CNI_TIMEOUT, CniRequest, CniResponse, PodRequest
 
 log = logging.getLogger(__name__)
@@ -104,8 +105,12 @@ class CniServer:
             return CniResponse(error=f"no handler for {pod_req.command}")
         fut = self._pool.submit(handler, pod_req)
         try:
-            result = fut.result(timeout=self.timeout)
+            with metrics.CNI_SECONDS.time():
+                result = fut.result(timeout=self.timeout)
+            metrics.CNI_REQUESTS.inc(command=pod_req.command, result="ok")
         except FutTimeout:
+            metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                                     result="timeout")
             # The error response below makes kubelet tear the sandbox down,
             # but the handler thread may still be running and commit its
             # side effects afterwards. Cancel if still queued; if a late ADD
@@ -128,5 +133,8 @@ class CniServer:
                 fut.add_done_callback(_undo_late_add)
             return CniResponse(
                 error=f"CNI {pod_req.command} timed out after {self.timeout}s")
+        except Exception:
+            metrics.CNI_REQUESTS.inc(command=pod_req.command, result="error")
+            raise
         return CniResponse(result=result or {"cniVersion":
                                              pod_req.netconf.cni_version})

@@ -25,6 +25,7 @@ from typing import Optional
 
 import grpc
 
+from ..utils import metrics
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
 from . import kubelet_pb2 as pb
@@ -142,6 +143,9 @@ class DevicePlugin:
         devs = self.device_handler.get_devices()
         with self._devices_lock:
             self._devices = dict(devs)
+        metrics.DEVICES_ADVERTISED.set(
+            sum(1 for d in devs.values() if d.get("healthy")),
+            resource=self.resource)
         return devs
 
     def _to_pb_list(self, devs: dict) -> "pb.ListAndWatchResponse":

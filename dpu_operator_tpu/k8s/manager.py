@@ -14,6 +14,8 @@ import threading
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
+from ..utils import metrics
+
 log = logging.getLogger(__name__)
 
 
@@ -120,12 +122,17 @@ class Manager:
                 break
             rec, req = item
             fkey = (id(rec), req)
+            controller = type(rec).__name__
             with self._lock:
                 self._pending.discard(fkey)
             try:
-                result = rec.reconcile(self.client, req) or ReconcileResult()
+                metrics.RECONCILE_TOTAL.inc(controller=controller)
+                with metrics.RECONCILE_SECONDS.time():
+                    result = (rec.reconcile(self.client, req)
+                              or ReconcileResult())
                 failures.pop(fkey, None)
             except Exception:
+                metrics.RECONCILE_ERRORS.inc(controller=controller)
                 n = failures.get(fkey, 0)
                 failures[fkey] = n + 1
                 delay = min(self.RETRY_BASE * (2 ** n), self.RETRY_MAX)

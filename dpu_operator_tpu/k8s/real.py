@@ -18,6 +18,7 @@ import logging
 import os
 import tempfile
 import threading
+import time
 from typing import Callable, Optional
 
 import yaml
@@ -194,4 +195,77 @@ class RealKube:
         t = threading.Thread(target=run, daemon=True)
         t.start()
         self._watch_threads.append(t)
+        return stop.set
+
+    # -- leader election (cmd/main.go leader-elect analog) --------------------
+    def acquire_leader_lease(self, name: str, namespace: str = "kube-system",
+                             lease_seconds: int = 15,
+                             identity: str = "",
+                             poll: float = 2.0) -> Callable:
+        """Block until this process holds the coordination.k8s.io Lease,
+        then renew in the background. Returns a cancel function."""
+        import datetime
+        import os
+        import socket as _socket
+        identity = identity or f"{_socket.gethostname()}-{os.getpid()}"
+
+        def now():
+            return datetime.datetime.now(datetime.timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%S.%fZ")
+
+        def try_take() -> bool:
+            lease = self.get("coordination.k8s.io/v1", "Lease", name,
+                             namespace=namespace)
+            if lease is None:
+                try:
+                    self.create({
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {"name": name, "namespace": namespace},
+                        "spec": {"holderIdentity": identity,
+                                 "leaseDurationSeconds": lease_seconds,
+                                 "renewTime": now()}})
+                    return True
+                except Exception:  # noqa: BLE001 — lost the create race
+                    return False
+            spec = lease.get("spec", {})
+            holder = spec.get("holderIdentity")
+            renew = spec.get("renewTime", "")
+            expired = True
+            if renew:
+                try:
+                    then = datetime.datetime.strptime(
+                        renew, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
+                            tzinfo=datetime.timezone.utc)
+                    age = (datetime.datetime.now(datetime.timezone.utc)
+                           - then).total_seconds()
+                    expired = age > spec.get("leaseDurationSeconds",
+                                             lease_seconds)
+                except ValueError:
+                    pass
+            if holder not in (None, identity) and not expired:
+                return False
+            spec.update(holderIdentity=identity, renewTime=now(),
+                        leaseDurationSeconds=lease_seconds)
+            lease["spec"] = spec
+            try:
+                self.update(lease)
+                return True
+            except Exception:  # noqa: BLE001 — conflict: someone else won
+                return False
+
+        while not try_take():
+            time.sleep(poll)
+        log.info("acquired leader lease %s/%s as %s", namespace, name,
+                 identity)
+
+        stop = threading.Event()
+
+        def renew_loop():
+            while not stop.wait(lease_seconds / 3):
+                try_take()
+
+        t = threading.Thread(target=renew_loop, daemon=True,
+                             name="leader-lease")
+        t.start()
         return stop.set

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Traffic-flow test suite: allreduce bandwidth over programmed slices.
+
+Reference: hack/traffic_flow_tests.sh drives the kubernetes-traffic-flow-
+tests suite (iperf flows through OVS-programmed VF paths) against worker +
+accelerator nodes. The ICI analog measures the collectives the SFC path
+must sustain: psum and explicit ring allreduce across a set of slice
+topologies, reporting algorithmic and per-link bus bandwidth against the
+topology model's ideal bound.
+
+Runs on whatever devices are visible (one real TPU chip, or the virtual CPU
+mesh under XLA_FLAGS=--xla_force_host_platform_device_count=N); per-config
+results go to stdout as JSON lines and the summary to traffic_flow_report.json.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("traffic-flow-tests")
+    parser.add_argument("--topologies", default="v5e-4,v5e-8,v5e-16,v5p-8")
+    parser.add_argument("--mbytes", type=float, default=16.0)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--report", default="traffic_flow_report.json")
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the virtual CPU mesh")
+    args = parser.parse_args(argv)
+
+    if args.cpu:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from dpu_operator_tpu.ici import SliceTopology
+    from dpu_operator_tpu.workloads import (measure_allreduce_gbps,
+                                            mesh_for_topology)
+
+    n_devices = len(jax.devices())
+    results = []
+    for topo_name in args.topologies.split(","):
+        topo = SliceTopology(topo_name.strip())
+        mesh = mesh_for_topology(topo)
+        degraded = mesh.devices.size != topo.num_chips
+        for impl in ("psum", "ring"):
+            if mesh.shape["model"] == 1:
+                continue
+            r = measure_allreduce_gbps(mesh, "model", mbytes=args.mbytes,
+                                       iters=args.iters, impl=impl)
+            ideal = topo.allreduce_algbw_gbps(int(args.mbytes * 1e6))
+            row = {
+                "topology": topo.topology,
+                "impl": impl,
+                "devices": int(mesh.devices.size),
+                "degraded": degraded,
+                "algbw_gbps": round(r["algbw_gbps"], 3),
+                "busbw_gbps": round(r["busbw_gbps"], 3),
+                "ideal_ici_algbw_gbps": round(ideal, 1),
+                "sec_per_iter": round(r["sec_per_iter"], 6),
+            }
+            results.append(row)
+            print(json.dumps(row))
+
+    report = {"n_devices": n_devices,
+              "platform": jax.devices()[0].platform,
+              "results": results}
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"wrote {args.report} ({len(results)} rows)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

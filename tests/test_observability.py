@@ -1,0 +1,115 @@
+"""Metrics, health endpoints, and drain facade tests (SURVEY.md §5)."""
+
+import urllib.request
+
+import pytest
+
+from dpu_operator_tpu.utils.drain import Drainer
+from dpu_operator_tpu.utils.metrics import (Counter, Gauge, Histogram,
+                                            MetricsServer, Registry)
+
+
+def test_counter_labels_and_render():
+    reg = Registry()
+    c = reg.counter("test_total", "help text")
+    c.inc(controller="a")
+    c.inc(controller="a")
+    c.inc(controller="b")
+    text = reg.render()
+    assert 'test_total{controller="a"} 2' in text
+    assert 'test_total{controller="b"} 1' in text
+    assert "# TYPE test_total counter" in text
+
+
+def test_gauge_set():
+    reg = Registry()
+    g = reg.gauge("devs", "h")
+    g.set(4, resource="google.com/tpu")
+    g.set(2, resource="google.com/tpu")
+    assert 'devs{resource="google.com/tpu"} 2' in reg.render()
+
+
+def test_histogram_buckets():
+    reg = Registry()
+    h = reg.histogram("lat", "h", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+
+
+def test_metrics_server_endpoints():
+    reg = Registry()
+    reg.counter("up_total", "h").inc()
+    ready = {"ok": False}
+    server = MetricsServer(host="127.0.0.1", registry=reg,
+                           ready_check=lambda: ready["ok"])
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(base + "/metrics", timeout=5).read()
+        assert b"up_total 1" in body
+        assert urllib.request.urlopen(base + "/healthz",
+                                      timeout=5).status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/readyz", timeout=5)
+        assert exc.value.code == 503
+        ready["ok"] = True
+        assert urllib.request.urlopen(base + "/readyz",
+                                      timeout=5).status == 200
+    finally:
+        server.stop()
+
+
+def test_reconcile_metrics_emitted(kube):
+    from dpu_operator_tpu.k8s.manager import Manager
+    from dpu_operator_tpu.utils.metrics import RECONCILE_TOTAL
+
+    class Rec:
+        watches = ("v1", "ConfigMap")
+
+        def reconcile(self, client, req):
+            return None
+
+    before = RECONCILE_TOTAL.value(controller="Rec")
+    mgr = Manager(kube)
+    mgr.add_reconciler(Rec())
+    mgr.start()
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "x", "namespace": "default"}})
+    assert mgr.wait_idle(5)
+    mgr.stop()
+    assert RECONCILE_TOTAL.value(controller="Rec") == before + 1
+
+
+# -- drain --------------------------------------------------------------------
+
+def _pod(name, node, tpu=True):
+    res = ({"requests": {"google.com/tpu": "2"}} if tpu else {})
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"nodeName": node,
+                     "containers": [{"name": "c", "resources": res}]}}
+
+
+def test_drain_evicts_only_tpu_consumers(kube, node_agent):
+    node_agent.register_node("n1", allocatable={"google.com/tpu": "4"})
+    kube.create(_pod("tpu-pod", "n1", tpu=True))
+    kube.create(_pod("sys-pod", "n1", tpu=False))
+    d = Drainer(kube)
+    evicted = d.drain("n1")
+    assert evicted == ["tpu-pod"]
+    assert kube.get("v1", "Pod", "sys-pod", namespace="default") is not None
+    node = kube.get("v1", "Node", "n1")
+    assert node["spec"]["unschedulable"] is True
+    d.uncordon("n1")
+    assert kube.get("v1", "Node", "n1")["spec"]["unschedulable"] is False
+
+
+def test_drain_missing_node_raises(kube):
+    with pytest.raises(KeyError):
+        Drainer(kube).cordon("ghost")
