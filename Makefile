@@ -13,6 +13,10 @@ test: native
 fast-test: native
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_e2e.py
 
+# flake detector (reference: ginkgo --repeat 4 in `task test`)
+test-repeat: native
+	for i in 1 2 3 4; do $(PYTHON) -m pytest tests/ -q -x || exit 1; done
+
 native:
 	$(MAKE) -C native
 

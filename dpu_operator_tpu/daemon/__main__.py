@@ -20,7 +20,11 @@ def main(argv=None):
     parser.add_argument("--flavour", default="kind")
     parser.add_argument("--kubeconfig", default="")
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.DEBUG)
+    # CR spec.logLevel lands here via the DaemonSet env (0 = info,
+    # >=1 = debug — klog-verbosity style)
+    verbosity = int(os.environ.get("TPU_LOG_LEVEL", "0") or 0)
+    logging.basicConfig(
+        level=logging.DEBUG if verbosity >= 1 else logging.INFO)
 
     # Fail fast when an apiserver is expected (explicit kubeconfig or
     # in-cluster env): silently downgrading to standalone would disable VSP

@@ -11,6 +11,7 @@ embedded controller manager with the SFC reconciler (:176-254).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Optional
 
@@ -94,6 +95,15 @@ class TpuSideManager:
 
     def serve(self):
         self.device_plugin.register_with_kubelet()
+        # advertise google.com/ici-port once the VSP reported its slice
+        # topology (the BASELINE north-star: ICI links schedulable
+        # alongside chips); worker index from the TPU VM environment
+        topology = getattr(self.vsp, "topology", "")
+        if topology and self.ici_device_plugin is None:
+            from ..ici import SliceTopology
+            topo = SliceTopology(topology)
+            worker = int(os.environ.get("TPU_WORKER_ID", "0"))
+            self.enable_ici_ports(lambda: (topo, worker))
         if self.client is not None:
             self._manager = Manager(self.client)
             self._manager.add_reconciler(

@@ -103,8 +103,10 @@ class GoogleTpuVsp:
             self.topology = SliceTopology(topo)
             self.dataplane.init_dataplane(self.topology)
         # Return the comm channel endpoint — host side dials it, tpu side
-        # binds its slice-attachment server there (marvell/main.go:691-725).
-        return {"ip": self.comm_ip, "port": self.comm_port}
+        # binds its slice-attachment server there (marvell/main.go:691-725) —
+        # plus the programmed topology so the daemon can advertise ICI ports.
+        return {"ip": self.comm_ip, "port": self.comm_port,
+                "topology": self.topology.topology if self.topology else ""}
 
     def shutdown(self, req: dict) -> dict:
         return {}

@@ -31,7 +31,8 @@ class MockTpuVsp:
     def init(self, req: dict) -> dict:
         with self._lock:
             self.init_requests.append(req)
-        return {"ip": self.ip, "port": self.port}
+        return {"ip": self.ip, "port": self.port,
+                "topology": self._slice.topology}
 
     def shutdown(self, req: dict) -> dict:
         return {}

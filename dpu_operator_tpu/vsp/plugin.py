@@ -47,6 +47,7 @@ class GrpcPlugin:
         self.path_manager = path_manager or PathManager()
         self.node_name = node_name
         self.init_timeout = init_timeout
+        self.topology = ""  # programmed slice topology from Init (tpu mode)
         self._channel: Optional[VspChannel] = None
 
     # -- lifecycle ------------------------------------------------------------
@@ -67,7 +68,8 @@ class GrpcPlugin:
     def start(self, tpu_mode: bool) -> tuple[str, int]:
         """Deploy VSP, dial the unix socket, call Init with retry
         (vendorplugin.go:82-115). Returns the (ip, port) the tpu-side
-        slice-attachment server binds."""
+        slice-attachment server binds; the programmed slice topology (tpu
+        mode) lands on ``self.topology``."""
         self._deploy_vsp()
         sock = self.path_manager.vendor_plugin_socket()
         self._channel = VspChannel(unix_target(sock))
@@ -80,6 +82,7 @@ class GrpcPlugin:
                     {"tpu_mode": tpu_mode,
                      "tpu_identifier": self.detection.identifier},
                     timeout=2.0)
+                self.topology = resp.get("topology", "")
                 return resp.get("ip", ""), int(resp.get("port", 0))
             except Exception as e:  # noqa: BLE001 — retry any dial error
                 last_err = e

@@ -76,6 +76,11 @@ def test_tpu_side_manager_full_stack(pm, kube, node_agent):
         node = kube.get("v1", "Node", "tpu-vm-0")
         assert node["status"]["allocatable"]["google.com/tpu"] == "4"
 
+        # ICI ports auto-advertised from the VSP-reported topology
+        # (v5e-4 = 2x2: 4 chips x 2 ports, all on host 0)
+        assert mgr.ici_device_plugin is not None
+        assert kubelet.wait_for_devices("google.com/ici-port", 8)
+
         # cross-boundary TCP server forwards into the VSP
         from dpu_operator_tpu.vsp.rpc import VspChannel
         ch = VspChannel(f"127.0.0.1:{mgr.bound_port}")

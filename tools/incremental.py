@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Rewrite Dockerfile FROM lines for layer-cached incremental builds
+(reference: tools/incremental/incremental.go:11-40 — point FROM at the
+previously built local image so unchanged layers are reused)."""
+
+import argparse
+import re
+import sys
+
+_FROM_RE = re.compile(r"^(FROM\s+)(\S+)(\s+AS\s+\S+)?\s*$", re.I)
+
+
+def rewrite(text: str, registry: str, tag: str) -> str:
+    out = []
+    for line in text.splitlines():
+        m = _FROM_RE.match(line)
+        if m and "/" not in m.group(2) and not m.group(2).startswith(
+                ("python", "gcc", "debian", "ubuntu", "scratch")):
+            image = f"{registry}/{m.group(2)}:{tag}"
+            line = f"{m.group(1)}{image}{m.group(3) or ''}"
+        out.append(line)
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("incremental")
+    parser.add_argument("dockerfile")
+    parser.add_argument("--registry", required=True)
+    parser.add_argument("--tag", default="latest")
+    args = parser.parse_args(argv)
+    with open(args.dockerfile) as f:
+        sys.stdout.write(rewrite(f.read(), args.registry, args.tag))
+
+
+if __name__ == "__main__":
+    main()
