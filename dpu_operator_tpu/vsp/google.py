@@ -88,6 +88,10 @@ class GoogleTpuVsp:
         self.topology: Optional[SliceTopology] = None
         self.num_chips: Optional[int] = None
         self.attachments: dict[str, dict] = {}
+        # DCN peers for multi-slice groups: attachments carrying a
+        # peer_address join this slice to others over the datacenter
+        # network (SURVEY.md §2.7 item 2; MultiSliceGroup in ici/topology)
+        self.dcn_peers: set[str] = set()
         # stable host-side chip numbering: first-seen order, append-only,
         # so indices survive device hot-add/remove (the reference gets this
         # for free from PCI-address math, marvell/mrvl-utils Mapped_VF)
@@ -176,7 +180,11 @@ class GoogleTpuVsp:
         if not ports and self.topology:
             ports = [l.port for l in self.topology.links_from(chip_index)]
         self.dataplane.attach_chip(chip_index, ports)
-        req = dict(req, chip_index=chip_index, ici_ports=ports)
+        peer = req.get("peer_address", "")
+        if peer:
+            self.dcn_peers.add(peer)
+        req = dict(req, chip_index=chip_index, ici_ports=ports,
+                   dcn_peers=sorted(self.dcn_peers))
         self.attachments[name] = req
         return req
 
@@ -185,6 +193,10 @@ class GoogleTpuVsp:
         att = self.attachments.pop(name, None)
         if att is not None:
             self.dataplane.detach_chip(int(att.get("chip_index", 0)))
+            peer = att.get("peer_address", "")
+            if peer and not any(a.get("peer_address") == peer
+                                for a in self.attachments.values()):
+                self.dcn_peers.discard(peer)
         return {}
 
     # -- NetworkFunctionService ----------------------------------------------

@@ -1,0 +1,90 @@
+"""Ring attention + multi-slice collective tests (first-class long-context
+and distributed requirements)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.workloads.multislice import (
+    dcn_bytes_per_host, flat_allreduce, hierarchical_allreduce,
+    make_multislice_mesh)
+from dpu_operator_tpu.workloads.mesh import make_mesh
+from dpu_operator_tpu.workloads.ring_attention import (full_attention,
+                                                       ring_attention)
+
+
+def _qkv(b=2, s=64, h=4, d=16, dtype=jnp.float32, seed=0):
+    keys = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in keys)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(causal):
+    mesh = make_mesh(("data", "model"), axis_sizes=(1, 8))
+    q, k, v = _qkv()
+    ring = ring_attention(mesh, "model", causal=causal)(q, k, v)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_4way_axis():
+    mesh = make_mesh(("data", "model"), axis_sizes=(2, 4))
+    q, k, v = _qkv(s=32)
+    ring = ring_attention(mesh, "model")(q, k, v)
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_bf16():
+    mesh = make_mesh(("data", "model"), axis_sizes=(1, 8))
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    ring = ring_attention(mesh, "model")(q, k, v)
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(ring, jnp.float32), np.asarray(ref, jnp.float32),
+        atol=5e-2, rtol=5e-2)  # bf16 accumulation tolerance
+
+
+def test_multislice_mesh_shape():
+    mesh = make_multislice_mesh(2)
+    assert mesh.shape["dcn"] == 2
+    assert mesh.shape["dcn"] * mesh.shape["data"] * mesh.shape["model"] == 8
+
+
+def test_hierarchical_allreduce_matches_flat():
+    mesh = make_multislice_mesh(2)
+    x = jax.random.normal(jax.random.key(0), (256,), jnp.float32)
+    hier = hierarchical_allreduce(mesh)(x)
+    flat = flat_allreduce(mesh)(x)
+    np.testing.assert_allclose(np.asarray(hier), np.asarray(flat),
+                               rtol=1e-5)
+
+
+def test_dcn_traffic_model():
+    # hierarchical moves 1/n_ici of the flat schedule's DCN bytes
+    flat = dcn_bytes_per_host(1 << 20, n_ici=4, n_slices=2,
+                              hierarchical=False)
+    hier = dcn_bytes_per_host(1 << 20, n_ici=4, n_slices=2)
+    assert hier == flat / 4
+    assert dcn_bytes_per_host(1 << 20, 4, 1) == 0.0
+
+
+def test_vsp_multislice_peer_tracking():
+    from dpu_operator_tpu.platform.platform import FakePlatform
+    from dpu_operator_tpu.vsp.google import GoogleTpuVsp
+    vsp = GoogleTpuVsp(FakePlatform(accelerator_type="v5litepod-4"))
+    vsp.init({"tpu_mode": True})
+    att = vsp.create_slice_attachment(
+        {"name": "host0-0", "chip_index": 0,
+         "peer_address": "10.0.0.2:50151"})
+    assert att["dcn_peers"] == ["10.0.0.2:50151"]
+    vsp.create_slice_attachment(
+        {"name": "host0-1", "chip_index": 1,
+         "peer_address": "10.0.0.3:50151"})
+    assert vsp.dcn_peers == {"10.0.0.2:50151", "10.0.0.3:50151"}
+    vsp.delete_slice_attachment({"name": "host0-0"})
+    assert vsp.dcn_peers == {"10.0.0.3:50151"}
