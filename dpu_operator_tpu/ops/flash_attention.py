@@ -1,0 +1,104 @@
+"""Blocked flash-attention forward kernel (Pallas, TPU).
+
+One grid step per (batch*head, Q block): the Q block stays in VMEM while
+the kernel walks KV blocks with online softmax (running max/sum in fp32),
+so attention never materializes the (S, S) score matrix in HBM — the MXU
+sees (block_q, d) x (d, block_k) matmuls and HBM traffic is O(S*d) per
+row block instead of O(S^2). Forward-only (serving / NF inference path);
+training uses XLA's fused attention via workloads/model.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+            sm_scale: float):
+    # q_ref: (block_q, d); k_ref/v_ref: (S, d); o_ref: (block_q, d)
+    block_q, d = q_ref.shape
+    s = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
+        scores = jnp.dot(q, k_blk.astype(jnp.float32).T,
+                         preferred_element_type=jnp.float32)
+        if causal:
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            scores = jnp.where(qpos >= kpos, scores, _NEG_INF)
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(scores - new_m)
+        scale = jnp.exp(m - new_m)
+        new_l = l * scale + jnp.sum(p, axis=-1, keepdims=True)
+        new_acc = acc * scale + jnp.dot(
+            p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return new_m, new_l, new_acc
+
+    nk = s // block_k
+    if causal:
+        # KV blocks past this Q block's last row contribute nothing
+        last_row = (qi + 1) * block_q
+        nk_eff = jnp.clip((last_row + block_k - 1) // block_k, 1, nk)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """(B, S, H, D) attention via the Pallas kernel.
+
+    *interpret* defaults to True off-TPU so the CPU test mesh runs the
+    same kernel through the interpreter.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must divide blocks "
+                         f"({block_q}, {block_k})")
+    sm_scale = 1.0 / np.sqrt(d)
+
+    def reshaped(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qr, kr, vr = reshaped(q), reshaped(k), reshaped(v)
+    kernel = functools.partial(_kernel, block_k=block_k, causal=causal,
+                               sm_scale=sm_scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

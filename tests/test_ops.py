@@ -1,0 +1,55 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh; same kernels
+compile on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.ops import flash_attention, fused_rmsnorm
+from dpu_operator_tpu.workloads.ring_attention import full_attention
+
+
+def _qkv(b=2, s=64, h=2, d=16, dtype=jnp.float32):
+    keys = jax.random.split(jax.random.key(1), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in keys)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_matches_reference(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_uneven_blocks_rejected():
+    q, k, v = _qkv(s=48)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, block_q=32, block_k=32)
+
+
+def test_flash_attention_single_block():
+    q, k, v = _qkv(s=32)
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_rmsnorm_matches_reference():
+    x = jax.random.normal(jax.random.key(2), (4, 32, 128), jnp.float32)
+    scale = jax.random.normal(jax.random.key(3), (128,)) + 1.0
+    out = fused_rmsnorm(x, scale)
+    var = np.mean(np.square(np.asarray(x)), -1, keepdims=True)
+    ref = np.asarray(x) / np.sqrt(var + 1e-6) * np.asarray(scale)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_fused_rmsnorm_bf16():
+    x = jax.random.normal(jax.random.key(4), (8, 64), jnp.bfloat16)
+    scale = jnp.ones((64,), jnp.bfloat16)
+    out = fused_rmsnorm(x, scale)
+    assert out.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(out, np.float32)).all()
