@@ -18,6 +18,8 @@ from http.server import BaseHTTPRequestHandler
 from typing import Callable, Optional
 
 from ..utils import metrics
+from ..utils.tracing import span
+from .logging import request_logger
 from .types import CNI_TIMEOUT, CniRequest, CniResponse, PodRequest
 
 log = logging.getLogger(__name__)
@@ -103,6 +105,13 @@ class CniServer:
                    else self.del_handler)
         if handler is None:
             return CniResponse(error=f"no handler for {pod_req.command}")
+        request_logger(pod_req).debug("CNI %s device=%s", pod_req.command,
+                                      pod_req.device_id)
+        with span("cni." + pod_req.command.lower(),
+                  sandbox=pod_req.sandbox_id, ifname=pod_req.ifname):
+            return self._dispatch(handler, pod_req)
+
+    def _dispatch(self, handler, pod_req: PodRequest) -> CniResponse:
         fut = self._pool.submit(handler, pod_req)
         try:
             with metrics.CNI_SECONDS.time():

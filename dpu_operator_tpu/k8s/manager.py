@@ -14,7 +14,7 @@ import threading
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 log = logging.getLogger(__name__)
 
@@ -127,7 +127,9 @@ class Manager:
                 self._pending.discard(fkey)
             try:
                 metrics.RECONCILE_TOTAL.inc(controller=controller)
-                with metrics.RECONCILE_SECONDS.time():
+                with metrics.RECONCILE_SECONDS.time(), \
+                        tracing.span("reconcile", controller=controller,
+                                     request=req.name or ""):
                     result = (rec.reconcile(self.client, req)
                               or ReconcileResult())
                 failures.pop(fkey, None)
