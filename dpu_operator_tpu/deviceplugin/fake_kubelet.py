@@ -52,6 +52,7 @@ class FakeKubelet:
         self._stop = threading.Event()
         self.registrations: list[pb.RegisterRequest] = []
         self.device_lists: dict[str, list] = {}
+        self._alloc_channels: dict[str, grpc.Channel] = {}
         self._lock = threading.Lock()
         self._updated = threading.Condition(self._lock)
 
@@ -72,6 +73,10 @@ class FakeKubelet:
             self._server = None
         for t in self._watch_threads:
             t.join(timeout=2)
+        with self._lock:
+            for channel in self._alloc_channels.values():
+                channel.close()
+            self._alloc_channels.clear()
 
     # -- Registration service -------------------------------------------------
     def _register(self, request: pb.RegisterRequest, context):
@@ -130,17 +135,19 @@ class FakeKubelet:
 
     def allocate(self, resource: str, device_ids: list,
                  timeout: float = 10.0) -> pb.AllocateResponse:
-        """Drive the plugin's Allocate like kubelet would at pod admission."""
-        endpoint = self.path_manager.device_plugin_socket(resource)
-        channel = grpc.insecure_channel(f"unix://{endpoint}")
-        try:
-            grpc.channel_ready_future(channel).result(timeout=timeout)
-            allocate = channel.unary_unary(
-                "/v1beta1.DevicePlugin/Allocate",
-                request_serializer=lambda m: m.SerializeToString(),
-                response_deserializer=pb.AllocateResponse.FromString)
-            return allocate(pb.AllocateRequest(container_requests=[
-                pb.ContainerAllocateRequest(devicesIDs=device_ids)]),
-                timeout=timeout)
-        finally:
-            channel.close()
+        """Drive the plugin's Allocate like kubelet would at pod admission.
+        The channel is cached per resource — real kubelet holds the plugin
+        connection open, and channel_ready polling costs ~200 ms/call."""
+        with self._lock:
+            channel = self._alloc_channels.get(resource)
+            if channel is None:
+                endpoint = self.path_manager.device_plugin_socket(resource)
+                channel = grpc.insecure_channel(f"unix://{endpoint}")
+                self._alloc_channels[resource] = channel
+        allocate = channel.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.AllocateResponse.FromString)
+        return allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=device_ids)]),
+            timeout=timeout, wait_for_ready=True)

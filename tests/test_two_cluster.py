@@ -1,0 +1,158 @@
+"""Two-cluster e2e: host cluster + TPU-VM cluster joined over TCP.
+
+The reference's signature topology (SURVEY.md §0: x86 OpenShift cluster +
+MicroShift on the DPU ARM cores; e2e via cluster-deployment-automation).
+Here: two independent FakeKubes — the host side runs HostSideManager whose
+CNI ADDs cross the wire to the tpu side's slice service (the IPv6
+link-local OPI channel analog), which programs the VSP over the native
+agent. Asserts the cross-boundary path end to end, including teardown.
+"""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+from dpu_operator_tpu.cni import CniShim
+from dpu_operator_tpu.daemon import HostSideManager, TpuSideManager
+from dpu_operator_tpu.k8s import FakeKube, FakeNodeAgent
+from dpu_operator_tpu.platform.platform import FakePlatform
+from dpu_operator_tpu.platform.vendordetector import TpuDetector
+from dpu_operator_tpu.utils.path_manager import PathManager
+from dpu_operator_tpu.vsp.google import GoogleTpuVsp
+from dpu_operator_tpu.vsp.mock import MockTpuVsp
+from dpu_operator_tpu.vsp.native_dp import (AgentClient, AgentProcess,
+                                            NativeIciDataplane)
+from dpu_operator_tpu.vsp.plugin import GrpcPlugin
+from dpu_operator_tpu.vsp.rpc import VspServer
+
+from utils import assert_eventually
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="session")
+def agent_binary():
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")], check=True,
+                   capture_output=True)
+    return os.path.join(REPO, "native", "build", "tpu_cp_agent")
+
+
+def _tpu_pci(addr):
+    from dpu_operator_tpu.platform.platform import PciDevice
+    return PciDevice(address=addr, vendor_id="1ae0", device_id="0062")
+
+
+def test_two_cluster_slice_attachment_lifecycle(short_tmp, agent_binary):
+    """host CNI ADD → TCP to the tpu-side daemon → VSP → native agent;
+    DEL unwinds the attachment on both sides."""
+    host_dir = short_tmp + "/host"
+    tpu_dir = short_tmp + "/tpu"
+    os.makedirs(host_dir)
+    os.makedirs(tpu_dir)
+
+    # ---- TPU-VM cluster ----
+    tpu_kube = FakeKube()
+    tpu_agent = FakeNodeAgent(tpu_kube)
+    tpu_agent.start()
+    tpu_agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+    tpu_pm = PathManager(tpu_dir)
+    cp = AgentProcess(agent_binary, tpu_dir + "/cp.sock",
+                      state_file=tpu_dir + "/cp.state", dev_dir=tpu_dir)
+    cp.start()
+    for i in range(4):
+        open(f"{tpu_dir}/accel{i}", "w").close()
+    cp_client = AgentClient(cp.socket_path)
+    tpu_vsp = GoogleTpuVsp(
+        FakePlatform(accelerator_type="v5litepod-4",
+                     accel=[f"{tpu_dir}/accel{i}" for i in range(4)]),
+        dataplane=NativeIciDataplane(cp_client))
+    tpu_sock = tpu_pm.vendor_plugin_socket()
+    tpu_pm.ensure_socket_dir(tpu_sock)
+    tpu_vsp_server = VspServer(tpu_vsp, socket_path=tpu_sock)
+    tpu_vsp_server.start()
+    tpu_det = TpuDetector().detection_result(tpu_mode=True, identifier="t")
+    tpu_mgr = TpuSideManager(
+        GrpcPlugin(tpu_det, path_manager=tpu_pm, init_timeout=5.0), tpu_pm,
+        client=tpu_kube)
+    tpu_mgr.device_plugin.poll_interval = 0.1
+
+    # ---- host cluster ----
+    host_kube = FakeKube()
+    host_pm = PathManager(host_dir)
+    host_vsp = MockTpuVsp()  # host-side VSP: enumerates PCIe endpoints
+    host_vsp.get_devices = lambda req: {"devices": {
+        "0000:00:04.0": {"id": "0000:00:04.0", "healthy": True,
+                         "dev_path": "", "coords": [], "chip_index": 0},
+        "0000:00:05.0": {"id": "0000:00:05.0", "healthy": True,
+                         "dev_path": "", "coords": [], "chip_index": 1},
+    }}
+    host_sock = host_pm.vendor_plugin_socket()
+    host_pm.ensure_socket_dir(host_sock)
+    host_vsp_server = VspServer(host_vsp, socket_path=host_sock)
+    host_vsp_server.start()
+    host_det = TpuDetector().detection_result(tpu_mode=False, identifier="h")
+    host_mgr = HostSideManager(
+        GrpcPlugin(host_det, path_manager=host_pm, init_timeout=5.0),
+        host_pm, client=host_kube)
+
+    try:
+        # bring up the tpu side; its slice server binds the VSP-returned
+        # port
+        tpu_mgr.start_vsp()
+        tpu_mgr.setup_devices()
+        tpu_mgr.listen()
+        assert tpu_mgr.bound_port
+
+        # the host-side VSP's Init response points at the tpu-side daemon
+        # (the reference returns the IPv6 link-local IpPort the same way)
+        host_vsp.ip = "127.0.0.1"
+        host_vsp.port = tpu_mgr.bound_port
+        host_mgr.start_vsp()
+        host_mgr.setup_devices()
+        host_mgr.listen()
+
+        shim = CniShim(host_pm.cni_server_socket())
+
+        def cni(cmd, device):
+            return shim.invoke(
+                {"CNI_COMMAND": cmd, "CNI_CONTAINERID": "podA",
+                 "CNI_NETNS": "/var/run/netns/podA", "CNI_IFNAME": "net1",
+                 "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=a"},
+                json.dumps({"cniVersion": "0.4.0", "type": "tpu-cni",
+                            "mode": "chip", "deviceID": device}))
+
+        resp = cni("ADD", "0000:00:04.0")
+        assert resp.error == ""
+        assert resp.result["tpu"]["attachment"] == "host0-0"
+        # the attachment crossed clusters into the tpu-side VSP + agent
+        assert_eventually(lambda: "host0-0" in tpu_vsp.attachments,
+                          message="attachment on tpu side")
+        states = cp_client.link_state(0)
+        assert states and all(s["wired"] for s in states)
+
+        # second pod claiming the same device must be refused host-side
+        resp_dup = shim.invoke(
+            {"CNI_COMMAND": "ADD", "CNI_CONTAINERID": "podB",
+             "CNI_NETNS": "/var/run/netns/podB", "CNI_IFNAME": "net1",
+             "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=b"},
+            json.dumps({"cniVersion": "0.4.0", "type": "tpu-cni",
+                        "mode": "chip", "deviceID": "0000:00:04.0"}))
+        assert "already allocated" in resp_dup.error
+
+        # DEL unwinds: host allocator released, tpu-side detached
+        resp_del = cni("DEL", "0000:00:04.0")
+        assert resp_del.error == ""
+        assert "host0-0" not in tpu_vsp.attachments
+        assert all(not s["wired"] for s in cp_client.link_state(0))
+        resp2 = cni("ADD", "0000:00:04.0")  # device reusable again
+        assert resp2.error == ""
+    finally:
+        host_mgr.stop()
+        host_vsp_server.stop()
+        tpu_mgr.stop()
+        tpu_vsp_server.stop()
+        cp_client.close()
+        cp.stop()
+        tpu_agent.stop()

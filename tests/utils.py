@@ -1,0 +1,34 @@
+"""Shared test helpers.
+
+Port of the reference's AssertEventually with second-chance timing
+diagnostics (internal/testutils/utils.go:31-58): when the condition only
+becomes true after the deadline, fail with how late it was — turning
+flaky-timeout failures into actionable reports.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+def assert_eventually(condition: Callable[[], bool], timeout: float = 10.0,
+                      interval: float = 0.05, message: str = "") -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if condition():
+            return
+        time.sleep(interval)
+    # second chance: did it become true just after the deadline?
+    late_deadline = time.monotonic() + timeout
+    while time.monotonic() < late_deadline:
+        if condition():
+            late_by = time.monotonic() - deadline
+            raise AssertionError(
+                f"{message or 'condition'} became true {late_by:.2f}s AFTER "
+                f"the {timeout}s deadline — raise the timeout or fix the "
+                f"slowness")
+        time.sleep(interval)
+    raise AssertionError(
+        f"{message or 'condition'} never became true within "
+        f"{timeout}s (nor in the {timeout}s grace window)")
