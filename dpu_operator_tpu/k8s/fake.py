@@ -15,6 +15,7 @@ import copy
 import itertools
 import threading
 import time
+import weakref
 from typing import Callable, Optional
 
 from .client import (
@@ -36,12 +37,17 @@ class AlreadyExists(Exception):
 class FakeKube:
     """Dict-backed apiserver. Objects are deep-copied on the way in and out."""
 
+    #: live instances, for test-failure diagnostics (weak: instances die
+    #: with their tests)
+    instances: "weakref.WeakSet[FakeKube]" = None  # set below
+
     def __init__(self):
         self._lock = threading.RLock()
         self._store: dict[tuple, dict] = {}
         self._watchers: dict[str, list[Callable]] = {}
         self._rv = itertools.count(1)
         self._uid = itertools.count(1)
+        FakeKube.instances.add(self)
 
     # -- internal -------------------------------------------------------------
     def _key(self, api_version, kind, namespace, name):
@@ -242,6 +248,9 @@ class FakeKube:
                 "status": {"phase": "Pending"},
             }
             self.create(pod)
+
+
+FakeKube.instances = weakref.WeakSet()
 
 
 class FakeNodeAgent:

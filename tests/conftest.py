@@ -54,3 +54,27 @@ def node_agent(kube):
 @pytest.fixture
 def images():
     return DummyImageManager()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Dump fake-cluster state when a test fails (the reference's pod
+    diagnostics dump, testcluster.go:341-378)."""
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call" or not report.failed:
+        return
+    from dpu_operator_tpu.k8s import FakeKube
+    lines = []
+    for i, kube in enumerate(list(FakeKube.instances)):
+        lines.append(f"---- fake cluster #{i} state at failure ----")
+        for kind in ("Node", "Pod"):
+            for obj in kube.list("v1", kind):
+                md = obj["metadata"]
+                status = obj.get("status", {})
+                lines.append(
+                    f"{kind} {md.get('namespace', '')}/{md['name']}: "
+                    f"phase={status.get('phase', '-')} "
+                    f"allocatable={status.get('allocatable', '')}")
+    if lines:
+        report.sections.append(("fake cluster", "\n".join(lines)))
