@@ -21,6 +21,7 @@ no Python control flow under jit.
 from __future__ import annotations
 
 from dataclasses import dataclass
+import functools
 from functools import partial
 from typing import Any
 
@@ -41,6 +42,10 @@ class TransformerConfig:
     max_seq: int = 128
     dtype: Any = jnp.bfloat16
     sequence_parallel: bool = True
+    #: "standard" = tp-sharded full attention; "ring" = long-context mode —
+    #: params replicated, sequence sharded over "model", attention rotates
+    #: KV blocks around the ICI ring (ring_attention.py)
+    attention: str = "standard"
     learning_rate: float = 1e-3
 
     @property
@@ -74,8 +79,15 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
 
 
 def param_specs(cfg: TransformerConfig) -> dict:
-    """Partition specs: tp shards heads/ff over "model" (column-parallel
-    wqkv/w1, row-parallel wo/w2); embeddings shard vocab; norms replicate."""
+    """Partition specs. Standard: tp shards heads/ff over "model"
+    (column-parallel wqkv/w1, row-parallel wo/w2), embeddings shard vocab,
+    norms replicate. Ring mode: params replicate — all of "model" is spent
+    on the sequence dimension (long context)."""
+    if cfg.attention == "ring":
+        rep = {"ln1": P(), "ln2": P(), "wqkv": P(), "wo": P(),
+               "w1": P(), "w2": P()}
+        return {"embed": P(), "pos": P(), "out_norm": P(),
+                "layers": [dict(rep) for _ in range(cfg.n_layers)]}
     layer = {
         "ln1": P(), "ln2": P(),
         "wqkv": P(None, "model"), "wo": P("model", None),
@@ -85,6 +97,12 @@ def param_specs(cfg: TransformerConfig) -> dict:
         "embed": P("model", None), "pos": P(), "out_norm": P(),
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
+
+
+@functools.lru_cache(maxsize=8)
+def _ring_attn(mesh: Mesh):
+    from .ring_attention import ring_attention
+    return ring_attention(mesh, "model", causal=True)
 
 
 def _rmsnorm(x, scale):
@@ -125,10 +143,15 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             return t.reshape(B, S, cfg.n_heads, cfg.d_head)
 
         q, k, v = heads(q), heads(k), heads(v)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(cfg.d_head)
-        att = jnp.where(mask, att, -1e9)
-        att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(cfg.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, S, cfg.d_model)
+        if cfg.attention == "ring" and mesh is not None:
+            o = _ring_attn(mesh)(q, k, v).reshape(B, S, cfg.d_model)
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(cfg.d_head)
+            att = jnp.where(mask, att, -1e9)
+            att = jax.nn.softmax(att.astype(jnp.float32),
+                                 -1).astype(cfg.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att,
+                           v).reshape(B, S, cfg.d_model)
         x = x + o @ lp["wo"]
         h = _rmsnorm(_sp(x, cfg, mesh), lp["ln2"])
         x = x + (jax.nn.gelu(_tp_act(h @ lp["w1"], mesh)) @ lp["w2"])
