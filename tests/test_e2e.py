@@ -217,17 +217,11 @@ def test_secondary_network_pod_via_injector(stack):
     kube.create({
         "apiVersion": "k8s.cni.cncf.io/v1",
         "kind": "NetworkAttachmentDefinition",
-        "metadata": {"name": "tpu-secondary", "namespace": "default",
+        "metadata": {"name": v.DEFAULT_NAD_NAME, "namespace": "default",
                      "annotations": {"k8s.v1.cni.cncf.io/resourceName":
                                      "google.com/tpu"}},
         "spec": {"config": "{}"}})
-    pod = {
-        "apiVersion": "v1", "kind": "Pod",
-        "metadata": {"name": "workload-a", "namespace": "default",
-                     "annotations": {"k8s.v1.cni.cncf.io/networks":
-                                     "tpu-secondary"}},
-        "spec": {"containers": [{"name": "w", "image": "jax"}]},
-    }
+    pod = _load_example("my-pod.yaml")
     out = stack["webhook"].review_mutate(
         {"request": {"uid": "u", "object": pod}})
     assert out["response"]["allowed"] is True

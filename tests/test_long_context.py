@@ -125,3 +125,16 @@ def test_ring_mode_matches_standard_forward():
                                                        batch["tokens"])
     np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_s),
                                atol=3e-4, rtol=3e-4)
+
+
+def test_v5p_256_multislice_group_model():
+    """Ladder config 5: 8 x v5p-32 joined over DCN — group accounting and
+    the hierarchical schedule's DCN savings at that scale."""
+    from dpu_operator_tpu.ici import MultiSliceGroup, SliceTopology
+    group = MultiSliceGroup([SliceTopology("v5p-32") for _ in range(8)])
+    assert group.num_chips == 256
+    assert group.dcn_allreduce_algbw_gbps() > 0
+    flat = dcn_bytes_per_host(1 << 30, n_ici=32, n_slices=8,
+                              hierarchical=False)
+    hier = dcn_bytes_per_host(1 << 30, n_ici=32, n_slices=8)
+    assert hier == flat / 32

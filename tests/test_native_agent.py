@@ -150,3 +150,17 @@ def test_google_vsp_over_native_dataplane(agent, short_tmp):
         client.wire_nf("nf-i", "nf-o")  # already wired via the VSP
     vsp.delete_slice_attachment({"name": "host0-1"})
     assert all(not s["wired"] for s in client.link_state(1))
+
+
+def test_v5p_32_wiring_parity(agent):
+    """Ladder config 4: v5p-32 (2x4x4 torus) — native agent and Python
+    model agree on every chip's port count."""
+    _, client = agent
+    info = client.init("v5p-32")
+    assert info["num_chips"] == 32
+    topo = SliceTopology("v5p-32")
+    assert info["shape"] == tuple(topo.shape)
+    chips = client.enumerate()
+    for c, pc in zip(chips, topo.chips):
+        assert c["coords"] == tuple(pc.coords)
+        assert c["nports"] == len(topo.links_from(pc.index))
