@@ -149,11 +149,17 @@ class DevicePlugin:
         return devs
 
     def _to_pb_list(self, devs: dict) -> "pb.ListAndWatchResponse":
-        return pb.ListAndWatchResponse(devices=[
-            pb.Device(ID=dev_id,
-                      health=HEALTHY if d.get("healthy") else UNHEALTHY)
-            for dev_id, d in sorted(devs.items())
-        ])
+        out = []
+        for dev_id, d in sorted(devs.items()):
+            dev = pb.Device(ID=dev_id,
+                            health=HEALTHY if d.get("healthy") else UNHEALTHY)
+            if d.get("numa") is not None:
+                # NUMA affinity hint so kubelet's Topology Manager
+                # co-locates chip allocations with CPU/memory (SURVEY.md §5:
+                # topology hints are how slice shape reaches the scheduler)
+                dev.topology.nodes.add(ID=int(d["numa"]))
+            out.append(dev)
+        return pb.ListAndWatchResponse(devices=out)
 
     def _list_and_watch(self, request, context):
         """Stream device lists; send only on change (deviceplugin.go:92-111)."""

@@ -135,6 +135,9 @@ class GoogleTpuVsp:
             devs[f"chip-{i}"] = {
                 "id": f"chip-{i}", "healthy": self._chip_healthy(path),
                 "dev_path": path, "coords": coords,
+                # PCIe attachment alternates across sockets on TPU VMs:
+                # 4 chips per NUMA node (v5e hosts: 8 chips, 2 sockets)
+                "numa": i // 4,
             }
         return devs
 

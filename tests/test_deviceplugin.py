@@ -155,3 +155,35 @@ def test_host_side_enforces_pci_ids():
     h.setup_devices()
     with pytest.raises(ValueError, match="PCI"):
         h.get_devices()
+
+
+def test_topology_hints_reach_kubelet(pm, node_agent):
+    """Devices carrying a numa field advertise TopologyInfo so kubelet's
+    Topology Manager can co-locate chips (SURVEY.md §5)."""
+    from dpu_operator_tpu.deviceplugin import DevicePlugin
+    from dpu_operator_tpu.deviceplugin.fake_kubelet import FakeKubelet
+
+    class Handler:
+        def get_devices(self):
+            return {
+                "chip-0": {"id": "chip-0", "healthy": True,
+                           "dev_path": "/dev/accel0", "numa": 0},
+                "chip-4": {"id": "chip-4", "healthy": True,
+                           "dev_path": "/dev/accel4", "numa": 1},
+            }
+
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    plugin = DevicePlugin(Handler(), resource="google.com/tpu",
+                          path_manager=pm)
+    plugin.poll_interval = 0.1
+    try:
+        plugin.start()
+        plugin.register_with_kubelet()
+        assert kubelet.wait_for_devices("google.com/tpu", 2)
+        devs = {d.ID: d for d in kubelet.device_lists["google.com/tpu"]}
+        assert [n.ID for n in devs["chip-0"].topology.nodes] == [0]
+        assert [n.ID for n in devs["chip-4"].topology.nodes] == [1]
+    finally:
+        plugin.stop()
+        kubelet.stop()

@@ -1,0 +1,63 @@
+"""tpuctl CLI tests against the live native agent and a VSP server
+(p4rt-ctl analog, cmd/intelvsp/p4runtime-2023.11.0)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dpu_operator_tpu.vsp.mock import MockTpuVsp
+from dpu_operator_tpu.vsp.native_dp import AgentProcess
+from dpu_operator_tpu.vsp.rpc import VspServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="session")
+def agent_binary():
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")], check=True,
+                   capture_output=True)
+    return os.path.join(REPO, "native", "build", "tpu_cp_agent")
+
+
+def _ctl(*argv):
+    out = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.tpuctl", *argv],
+        capture_output=True, text=True, cwd=REPO, check=True)
+    return json.loads(out.stdout)
+
+
+def test_tpuctl_agent_roundtrip(agent_binary, short_tmp):
+    proc = AgentProcess(agent_binary, short_tmp + "/a.sock")
+    proc.start()
+    try:
+        sock = ["--agent-socket", proc.socket_path]
+        info = _ctl(*sock, "init", "v5e-4")
+        assert info["num_chips"] == 4
+        chips = _ctl(*sock, "enum")["chips"]
+        assert len(chips) == 4
+        _ctl(*sock, "attach", "0")
+        state = _ctl(*sock, "link-state", "0")
+        assert all(p["wired"] for p in state["ports"])
+        _ctl(*sock, "wire", "a", "b")
+        _ctl(*sock, "unwire", "a", "b")
+        _ctl(*sock, "detach", "0")
+        assert not any(
+            p["wired"] for p in _ctl(*sock, "link-state", "0")["ports"])
+    finally:
+        proc.stop()
+
+
+def test_tpuctl_vsp_devices(short_tmp):
+    server = VspServer(MockTpuVsp(), socket_path=short_tmp + "/vsp.sock")
+    server.start()
+    try:
+        out = _ctl("--vsp-socket", short_tmp + "/vsp.sock", "devices")
+        assert len(out["devices"]) == 4
+        att = _ctl("--vsp-socket", short_tmp + "/vsp.sock",
+                   "create-attachment", "host0-1", "--chip", "1")
+        assert att["name"] == "host0-1"
+    finally:
+        server.stop()
