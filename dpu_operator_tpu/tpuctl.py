@@ -38,6 +38,11 @@ def _agent_cmds(sub):
     p = sub.add_parser("unwire")
     p.add_argument("input")
     p.add_argument("output")
+    p = sub.add_parser("set-link", help="fault injection: force a port "
+                                        "down/up")
+    p.add_argument("chip", type=int)
+    p.add_argument("port")
+    p.add_argument("state", choices=["up", "down"])
 
 
 def _vsp_cmds(sub):
@@ -69,7 +74,7 @@ def main(argv=None):
 
 def run(args) -> dict:
     agent_cmds = {"enum", "init", "link-state", "attach", "detach", "wire",
-                  "unwire"}
+                  "unwire", "set-link"}
     if args.cmd in agent_cmds:
         if not args.agent_socket:
             raise SystemExit(f"{args.cmd} needs --agent-socket")
@@ -89,6 +94,10 @@ def run(args) -> dict:
             if args.cmd == "detach":
                 client.detach(args.chip)
                 return {"detached": args.chip}
+            if args.cmd == "set-link":
+                client.set_link(args.chip, args.port, args.state == "up")
+                return {"chip": args.chip, "port": args.port,
+                        "state": args.state}
             if args.cmd == "wire":
                 client.wire_nf(args.input, args.output)
                 return {"wired": [args.input, args.output]}

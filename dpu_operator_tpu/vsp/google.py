@@ -132,8 +132,15 @@ class GoogleTpuVsp:
             coords = []
             if self.topology and i < len(self.topology.chips):
                 coords = list(self.topology.chips[i].coords)
+            healthy = self._chip_healthy(path)
+            # ICI link health from the dataplane when it can report it
+            # (native agent): a chip with a downed wired port must go
+            # Unhealthy so Allocate refuses it (deviceplugin.go:127-129)
+            links_ok = getattr(self.dataplane, "chip_links_ok", None)
+            if healthy and links_ok is not None:
+                healthy = bool(links_ok(i))
             devs[f"chip-{i}"] = {
-                "id": f"chip-{i}", "healthy": self._chip_healthy(path),
+                "id": f"chip-{i}", "healthy": healthy,
                 "dev_path": path, "coords": coords,
                 # PCIe attachment alternates across sockets on TPU VMs:
                 # 4 chips per NUMA node (v5e hosts: 8 chips, 2 sockets)

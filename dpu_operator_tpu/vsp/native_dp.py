@@ -32,6 +32,7 @@ MSG_WIRE_NF = 5
 MSG_UNWIRE_NF = 6
 MSG_LINK_STATE = 7
 MSG_SHUTDOWN = 8
+MSG_SET_LINK = 9
 MSG_RESP = 0x80
 
 ST_OK = 0
@@ -46,6 +47,7 @@ _STATUS_RESP = struct.Struct("<i64s")
 _DETACH_REQ = struct.Struct("<I")
 _WIRE_REQ = struct.Struct("<64s64s")
 _LINK_REQ = struct.Struct("<I")
+_SET_LINK_REQ = struct.Struct("<I4sB3x")
 _PORT_STATE = struct.Struct("<4sBBH")
 _LINK_RESP_HEAD = struct.Struct("<iI")
 
@@ -175,6 +177,11 @@ class AgentClient:
                           "wired": bool(wired)})
         return ports
 
+    def set_link(self, chip: int, port: str, up: bool):
+        """Fault injection: force a port down (or restore it)."""
+        self._status_call(MSG_SET_LINK, _SET_LINK_REQ.pack(
+            chip, port.encode(), 1 if up else 0))
+
     def shutdown(self):
         try:
             self._status_call(MSG_SHUTDOWN, b"")
@@ -246,3 +253,12 @@ class NativeIciDataplane:
 
     def unwire_network_function(self, input_id, output_id):
         self.client.unwire_nf(input_id, output_id)
+
+    def chip_links_ok(self, chip_index) -> bool:
+        """Health input for the VSP: every wired ICI port trained. An
+        unattached chip (no wired ports) is healthy by definition."""
+        try:
+            return all(p["up"] for p in self.client.link_state(chip_index)
+                       if p["wired"])
+        except (AgentError, ConnectionError, OSError):
+            return False

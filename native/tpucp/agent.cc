@@ -236,7 +236,25 @@ bool Handle(Agent& agent, int fd, const Header& h,
         PortState& ps = resp.ports[resp.nports++];
         snprintf(ps.port, sizeof(ps.port), "%s", p.c_str());
         ps.wired = chip.attached && chip.wired_ports.count(p) ? 1 : 0;
-        ps.up = ps.wired;  // link trains when both wired (model: instant)
+        // link trains when wired, unless fault-injected down
+        ps.up = (ps.wired && agent.db.LinkUp(req.chip, p)) ? 1 : 0;
+      }
+      return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+    }
+    case MSG_SET_LINK: {
+      StatusResp resp{};
+      SetLinkReq req{};
+      if (payload.size() < sizeof(req)) {
+        FillStatus(&resp, ST_INVALID, "short SetLinkReq");
+        return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
+      }
+      memcpy(&req, payload.data(), sizeof(req));
+      req.port[sizeof(req.port) - 1] = '\0';
+      if (!agent.db.SetLink(req.chip, req.port, req.up != 0, &error)) {
+        FillStatus(&resp, ST_INVALID, error);
+      } else {
+        FillStatus(&resp, ST_OK, "");
+        agent.PersistLocked();
       }
       return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
     }

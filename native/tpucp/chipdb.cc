@@ -1,5 +1,6 @@
 #include "chipdb.h"
 
+#include <algorithm>
 #include <cmath>
 #include <cstdio>
 #include <cstring>
@@ -86,6 +87,7 @@ bool ChipDb::Init(const std::string& topology, std::string* error) {
 
   chips_.clear();
   wires_.clear();
+  downed_.clear();
   chips_.resize(n);
   for (long idx = 0; idx < n; idx++) {
     ChipState& chip = chips_[idx];
@@ -147,6 +149,37 @@ bool ChipDb::Detach(uint32_t chip, std::string* error) {
   return true;
 }
 
+bool ChipDb::SetLink(uint32_t chip, const std::string& port, bool up,
+                     std::string* error) {
+  if (chip >= chips_.size()) {
+    *error = "chip index out of range";
+    return false;
+  }
+  const auto& owned = chips_[chip].torus_ports;
+  if (std::find(owned.begin(), owned.end(), port) == owned.end()) {
+    *error = "chip " + std::to_string(chip) + " has no port '" + port + "'";
+    return false;
+  }
+  if (up) {
+    downed_.erase({chip, port});
+  } else {
+    downed_.insert({chip, port});
+  }
+  return true;
+}
+
+bool ChipDb::LinkUp(uint32_t chip, const std::string& port) const {
+  return !downed_.count({chip, port});
+}
+
+bool ChipDb::ChipLinksOk(uint32_t chip) const {
+  if (chip >= chips_.size()) return false;
+  for (const auto& p : chips_[chip].wired_ports) {
+    if (downed_.count({chip, p})) return false;
+  }
+  return true;
+}
+
 bool ChipDb::Wire(const std::string& input, const std::string& output,
                   std::string* error) {
   if (input.empty() || output.empty()) {
@@ -183,6 +216,9 @@ std::string ChipDb::Serialize() const {
   for (const auto& w : wires_) {
     out << "wire " << w.first << " " << w.second << "\n";
   }
+  for (const auto& d : downed_) {
+    out << "linkdown " << d.first << " " << d.second << "\n";
+  }
   return out.str();
 }
 
@@ -213,6 +249,11 @@ bool ChipDb::Deserialize(const std::string& text, std::string* error) {
       std::string a, b;
       ls >> a >> b;
       if (!Wire(a, b, error)) return false;
+    } else if (op == "linkdown") {
+      uint32_t chip;
+      std::string port;
+      ls >> chip >> port;
+      if (!SetLink(chip, port, false, error)) return false;
     } else {
       *error = "unknown state op '" + op + "'";
       return false;

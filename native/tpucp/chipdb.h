@@ -41,6 +41,12 @@ class ChipDb {
               std::string* error);
   bool Detach(uint32_t chip, std::string* error);
 
+  // Fault injection: force a port down / restore it.
+  bool SetLink(uint32_t chip, const std::string& port, bool up,
+               std::string* error);
+  bool LinkUp(uint32_t chip, const std::string& port) const;
+  bool ChipLinksOk(uint32_t chip) const;  // every wired port trained
+
   // Network-function hops between opaque endpoint ids.
   bool Wire(const std::string& input, const std::string& output,
             std::string* error);
@@ -61,6 +67,7 @@ class ChipDb {
   int dims_ = 0;
   std::vector<ChipState> chips_;
   std::set<std::pair<std::string, std::string>> wires_;
+  std::set<std::pair<uint32_t, std::string>> downed_;  // forced-down ports
 };
 
 }  // namespace tpucp

@@ -27,6 +27,7 @@ enum MsgType : uint16_t {
   MSG_UNWIRE_NF = 6,
   MSG_LINK_STATE = 7, // per-port link state for one chip
   MSG_SHUTDOWN = 8,
+  MSG_SET_LINK = 9,   // fault injection: force a port down (or back up)
   MSG_RESP = 0x80,    // response bit: resp type = req type | MSG_RESP
 };
 
@@ -95,6 +96,13 @@ struct WireReq {
 
 struct LinkStateReq {
   uint32_t chip;
+};
+
+struct SetLinkReq {
+  uint32_t chip;
+  char port[4];
+  uint8_t up;
+  uint8_t pad[3];
 };
 
 struct PortState {

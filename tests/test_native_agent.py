@@ -164,3 +164,54 @@ def test_v5p_32_wiring_parity(agent):
     for c, pc in zip(chips, topo.chips):
         assert c["coords"] == tuple(pc.coords)
         assert c["nports"] == len(topo.links_from(pc.index))
+
+
+def test_fault_injection_link_down_marks_chip_unhealthy(agent, short_tmp):
+    """SURVEY.md §5 gap filled: inject a link fault, watch it surface as
+    device unhealthiness so Allocate refuses the chip."""
+    _, client = agent
+    _fake_accel(short_tmp, 4)
+    plat = FakePlatform(accelerator_type="v5litepod-4",
+                        accel=[f"{short_tmp}/accel{i}" for i in range(4)])
+    vsp = GoogleTpuVsp(plat, dataplane=NativeIciDataplane(client))
+    vsp.init({"tpu_mode": True})
+    vsp.create_slice_attachment({"name": "host0-1", "chip_index": 1})
+    assert vsp.get_devices({})["devices"]["chip-1"]["healthy"] is True
+
+    ports = client.link_state(1)
+    client.set_link(1, ports[0]["port"], up=False)
+    states = {p["port"]: p for p in client.link_state(1)}
+    assert states[ports[0]["port"]]["up"] is False
+    assert vsp.get_devices({})["devices"]["chip-1"]["healthy"] is False
+    # other chips unaffected
+    assert vsp.get_devices({})["devices"]["chip-0"]["healthy"] is True
+
+    client.set_link(1, ports[0]["port"], up=True)
+    assert vsp.get_devices({})["devices"]["chip-1"]["healthy"] is True
+
+
+def test_fault_injection_invalid_port_rejected(agent):
+    _, client = agent
+    client.init("v5e-4")
+    with pytest.raises(AgentError):
+        client.set_link(0, "z+", up=False)
+
+
+def test_link_fault_survives_restart(agent_binary, short_tmp):
+    sock = short_tmp + "/f.sock"
+    state = short_tmp + "/f.state"
+    proc = AgentProcess(agent_binary, sock, state_file=state)
+    proc.start()
+    client = AgentClient(sock)
+    client.init("v5e-4")
+    client.attach(0)
+    client.set_link(0, "x+", up=False)
+    client.close()
+    proc.stop()
+    proc2 = AgentProcess(agent_binary, sock, state_file=state)
+    proc2.start()
+    client2 = AgentClient(sock)
+    states = {p["port"]: p for p in client2.link_state(0)}
+    assert states["x+"]["up"] is False
+    client2.close()
+    proc2.stop()
