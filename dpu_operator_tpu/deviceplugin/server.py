@@ -40,6 +40,48 @@ UNHEALTHY = "Unhealthy"
 POLL_INTERVAL = 5.0
 
 
+def _preferred_chips(available: list, must_include: list, size: int,
+                     devices: dict) -> list:
+    """Pick *size* chips from *available* minimizing pairwise torus
+    distance (coords from the VSP device info). Chips without coords fall
+    back to id order. Greedy growth from every seed; cheapest total wins."""
+    if size <= 0 or size > len(available):
+        return available[:max(size, 0)]
+
+    def coords(dev_id):
+        info = devices.get(dev_id) or {}
+        c = info.get("coords") or []
+        return tuple(c) if c else None
+
+    def dist(a, b):
+        ca, cb = coords(a), coords(b)
+        if ca is None or cb is None or len(ca) != len(cb):
+            return 1  # unknown topology: everything equidistant
+        return sum(abs(x - y) for x, y in zip(ca, cb))
+
+    must = [d for d in must_include if d in available]
+    best, best_cost = None, None
+    seeds = [d for d in available if d not in must] or available
+    for seed in seeds:
+        chosen = list(must)
+        if seed not in chosen:
+            chosen.append(seed)
+        pool = [d for d in available if d not in chosen]
+        while len(chosen) < size and pool:
+            nxt = min(pool, key=lambda d: (sum(dist(d, c) for c in chosen),
+                                           d))
+            chosen.append(nxt)
+            pool.remove(nxt)
+        if len(chosen) < size:
+            continue
+        chosen = chosen[:size]
+        cost = sum(dist(a, b) for i, a in enumerate(chosen)
+                   for b in chosen[i + 1:])
+        if best_cost is None or cost < best_cost:
+            best, best_cost = chosen, cost
+    return best or available[:size]
+
+
 def _ser(msg) -> bytes:
     return msg.SerializeToString()
 
@@ -52,8 +94,14 @@ class _PluginHandler(grpc.GenericRpcHandler):
         m = hcd.method
         if m == "/v1beta1.DevicePlugin/GetDevicePluginOptions":
             return grpc.unary_unary_rpc_method_handler(
-                lambda req, ctx: pb.DevicePluginOptions(),
+                lambda req, ctx: pb.DevicePluginOptions(
+                    get_preferred_allocation_available=True),
                 request_deserializer=pb.Empty.FromString,
+                response_serializer=_ser)
+        if m == "/v1beta1.DevicePlugin/GetPreferredAllocation":
+            return grpc.unary_unary_rpc_method_handler(
+                self.plugin._get_preferred_allocation,
+                request_deserializer=pb.PreferredAllocationRequest.FromString,
                 response_serializer=_ser)
         if m == "/v1beta1.DevicePlugin/ListAndWatch":
             return grpc.unary_stream_rpc_method_handler(
@@ -172,6 +220,23 @@ class DevicePlugin:
                 last = key
                 yield self._to_pb_list(devs)
             self._stop.wait(self.poll_interval)
+
+    def _get_preferred_allocation(self, request, context):
+        """Topology-aware chip selection: prefer ICI-adjacent chips so the
+        workload's collectives stay on short torus paths — the scheduling
+        half of the slice-shape story (SURVEY.md §5). Greedy nearest-
+        neighbor growth by torus coords, best seed wins."""
+        with self._devices_lock:
+            known = dict(self._devices)
+        responses = []
+        for creq in request.container_requests:
+            picked = _preferred_chips(
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                creq.allocation_size, known)
+            responses.append(
+                pb.ContainerPreferredAllocationResponse(deviceIDs=picked))
+        return pb.PreferredAllocationResponse(container_responses=responses)
 
     def _allocate(self, request: "pb.AllocateRequest", context):
         """Validate cached health, then wire devices into the container:

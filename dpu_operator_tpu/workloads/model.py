@@ -46,6 +46,10 @@ class TransformerConfig:
     #: params replicated, sequence sharded over "model", attention rotates
     #: KV blocks around the ICI ring (ring_attention.py)
     attention: str = "standard"
+    #: rematerialize each layer on the backward pass (jax.checkpoint):
+    #: trades recompute FLOPs for activation HBM — the standard lever for
+    #: fitting longer context per chip
+    remat: bool = False
     learning_rate: float = 1e-3
 
     @property
@@ -134,7 +138,8 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     x = params["embed"][tokens] + params["pos"][:S]
     x = x.astype(cfg.dtype)
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    for lp in params["layers"]:
+
+    def layer(x, lp):
         h = _rmsnorm(_sp(x, cfg, mesh), lp["ln1"])
         qkv = _tp_act(h @ lp["wqkv"], mesh)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -154,7 +159,11 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                            v).reshape(B, S, cfg.d_model)
         x = x + o @ lp["wo"]
         h = _rmsnorm(_sp(x, cfg, mesh), lp["ln2"])
-        x = x + (jax.nn.gelu(_tp_act(h @ lp["w1"], mesh)) @ lp["w2"])
+        return x + (jax.nn.gelu(_tp_act(h @ lp["w1"], mesh)) @ lp["w2"])
+
+    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    for lp in params["layers"]:
+        x = layer_fn(x, lp)
     x = _rmsnorm(_sp(x, cfg, mesh), params["out_norm"])
     return (x @ params["embed"].T).astype(jnp.float32)
 

@@ -187,3 +187,70 @@ def test_topology_hints_reach_kubelet(pm, node_agent):
     finally:
         plugin.stop()
         kubelet.stop()
+
+
+def test_preferred_allocation_picks_adjacent_chips():
+    """ICI-adjacency-aware allocation: on a v5e-16 (4x4) the preferred
+    pair out of the four corners + a center pair is the center pair."""
+    from dpu_operator_tpu.deviceplugin.server import _preferred_chips
+    devices = {
+        "chip-0": {"coords": [0, 0]}, "chip-3": {"coords": [0, 3]},
+        "chip-12": {"coords": [3, 0]}, "chip-15": {"coords": [3, 3]},
+        "chip-5": {"coords": [1, 1]}, "chip-6": {"coords": [1, 2]},
+    }
+    picked = _preferred_chips(sorted(devices), [], 2, devices)
+    assert sorted(picked) == ["chip-5", "chip-6"]
+
+
+def test_preferred_allocation_honors_must_include():
+    from dpu_operator_tpu.deviceplugin.server import _preferred_chips
+    devices = {
+        "chip-0": {"coords": [0, 0]}, "chip-1": {"coords": [0, 1]},
+        "chip-15": {"coords": [3, 3]}, "chip-14": {"coords": [3, 2]},
+    }
+    picked = _preferred_chips(sorted(devices), ["chip-15"], 2, devices)
+    assert "chip-15" in picked
+    assert "chip-14" in picked  # its nearest neighbor
+
+
+def test_preferred_allocation_over_wire(pm):
+    """GetPreferredAllocation RPC end to end through the plugin socket."""
+    import grpc
+    from dpu_operator_tpu.deviceplugin import DevicePlugin
+    from dpu_operator_tpu.deviceplugin import kubelet_pb2 as pb
+
+    class Handler:
+        def get_devices(self):
+            return {
+                f"chip-{i}": {"id": f"chip-{i}", "healthy": True,
+                              "coords": [i // 4, i % 4]}
+                for i in range(16)
+            }
+
+    plugin = DevicePlugin(Handler(), resource="google.com/tpu",
+                          path_manager=pm)
+    try:
+        plugin.start()
+        plugin._snapshot()
+        channel = grpc.insecure_channel(
+            f"unix://{pm.device_plugin_socket('google.com/tpu')}")
+        call = channel.unary_unary(
+            "/v1beta1.DevicePlugin/GetPreferredAllocation",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.PreferredAllocationResponse.FromString)
+        resp = call(pb.PreferredAllocationRequest(container_requests=[
+            pb.ContainerPreferredAllocationRequest(
+                available_deviceIDs=[f"chip-{i}" for i in range(16)],
+                allocation_size=4)]), timeout=5, wait_for_ready=True)
+        ids = list(resp.container_responses[0].deviceIDs)
+        assert len(ids) == 4
+        # the four picked chips form a 2x2 block (total pairwise
+        # distance 8 is the minimum for 4 chips on a grid)
+        coords = [(int(i.split("-")[1]) // 4, int(i.split("-")[1]) % 4)
+                  for i in ids]
+        cost = sum(abs(a[0]-b[0]) + abs(a[1]-b[1])
+                   for x, a in enumerate(coords) for b in coords[x+1:])
+        assert cost == 8
+        channel.close()
+    finally:
+        plugin.stop()
