@@ -25,8 +25,12 @@ class SfcReconciler:
     def __init__(self, workload_image: str = ""):
         self.workload_image = workload_image
 
-    def _network_function_pod(self, sfc: ServiceFunctionChain, nf) -> dict:
-        """NF pod spec (sfc.go:32-72): two NAD attachments + 2 chips."""
+    def _network_function_pod(self, sfc: ServiceFunctionChain, nf,
+                              index: int = 0) -> dict:
+        """NF pod spec (sfc.go:32-72): two NAD attachments + 2 chips.
+        Chain annotations let the tpu-side manager steer traffic between
+        consecutive NFs (the ICI analog of the reference's chain flow
+        rules)."""
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -38,6 +42,8 @@ class SfcReconciler:
                 "annotations": {
                     "k8s.v1.cni.cncf.io/networks":
                         f"{v.DEFAULT_NAD_NAME}, {v.DEFAULT_NAD_NAME}",
+                    "tpu.openshift.io/sfc": sfc.name,
+                    "tpu.openshift.io/sfc-index": str(index),
                 },
                 "ownerReferences": [{
                     "apiVersion": API_VERSION,
@@ -66,8 +72,8 @@ class SfcReconciler:
         if obj is None:
             return ReconcileResult()  # pod GC via owner refs
         sfc = ServiceFunctionChain.from_obj(obj)
-        for nf in sfc.network_functions:
-            pod = self._network_function_pod(sfc, nf)
+        for index, nf in enumerate(sfc.network_functions):
+            pod = self._network_function_pod(sfc, nf, index)
             existing = client.get("v1", "Pod", pod["metadata"]["name"],
                                   namespace=sfc.namespace)
             if existing is None:

@@ -74,6 +74,10 @@ class TpuSideManager:
         # value: {"atts": [unique ids in arrival order], "wired": bool}
         self._attach_store: dict[str, dict] = {}
         self._attach_lock = threading.Lock()
+        # chain steering: (ns, sfc) -> {index: {"in","out","sandbox"}};
+        # hops: (ns, sfc, i) -> (out_id, in_id) wired between NF i and i+1
+        self._chain_store: dict[tuple, dict] = {}
+        self._chain_hops: dict[tuple, tuple] = {}
         self._manager: Optional[Manager] = None
 
     # -- SideManager lifecycle ------------------------------------------------
@@ -163,11 +167,75 @@ class TpuSideManager:
                     e2["wiring"] = False
                     e2["wired"] = True
             wired = True
+            self._update_chain(req, pair)
         return {
             "cniVersion": req.netconf.cni_version,
             "interfaces": [{"name": req.ifname, "sandbox": req.netns}],
             "tpu": {"attachment": attachment_id, "networkFunction": wired},
         }
+
+    # -- SFC chain steering ---------------------------------------------------
+    def _update_chain(self, req: PodRequest, pair: tuple):
+        """After a pod's own NF is wired, steer the chain: wire this NF's
+        egress to the next NF's ingress (and previous egress to this
+        ingress) once both sides exist — the ICI analog of the reference's
+        chain flow rules (marvell/main.go:544-560 uplink/hairpin rules)."""
+        if self.client is None or not req.pod_name:
+            return
+        pod = self.client.get("v1", "Pod", req.pod_name,
+                              namespace=req.pod_namespace or "default")
+        if pod is None:
+            return
+        ann = (pod.get("metadata", {}).get("annotations") or {})
+        sfc = ann.get("tpu.openshift.io/sfc")
+        if not sfc:
+            return
+        try:
+            index = int(ann.get("tpu.openshift.io/sfc-index", ""))
+        except ValueError:
+            return
+        key = (req.pod_namespace or "default", sfc)
+        to_wire = []
+        with self._attach_lock:
+            chain = self._chain_store.setdefault(key, {})
+            chain[index] = {"in": pair[0], "out": pair[1],
+                            "sandbox": req.sandbox_id}
+            for i in (index - 1, index):
+                hop_key = key + (i,)
+                if (i in chain and i + 1 in chain
+                        and hop_key not in self._chain_hops):
+                    ids = (chain[i]["out"], chain[i + 1]["in"])
+                    self._chain_hops[hop_key] = ids
+                    to_wire.append((hop_key, ids))
+        for hop_key, ids in to_wire:
+            try:
+                self.vsp.create_network_function(*ids)
+                log.info("wired SFC hop %s: %s -> %s", hop_key, *ids)
+            except Exception:  # noqa: BLE001 — retried on next ADD
+                with self._attach_lock:
+                    self._chain_hops.pop(hop_key, None)
+                log.warning("SFC hop wire failed for %s", hop_key)
+
+    def _teardown_chain(self, sandbox_id: str):
+        """Unwire chain hops touching a departing sandbox."""
+        to_unwire = []
+        with self._attach_lock:
+            for key, chain in list(self._chain_store.items()):
+                for index, entry in list(chain.items()):
+                    if entry["sandbox"] != sandbox_id:
+                        continue
+                    del chain[index]
+                    for i in (index - 1, index):
+                        ids = self._chain_hops.pop(key + (i,), None)
+                        if ids:
+                            to_unwire.append(ids)
+                if not chain:
+                    self._chain_store.pop(key, None)
+        for ids in to_unwire:
+            try:
+                self.vsp.delete_network_function(*ids)
+            except Exception:  # noqa: BLE001 — defensive DEL
+                log.warning("SFC hop unwire failed for %s", ids)
 
     def _cni_nf_del(self, req: PodRequest) -> dict:
         """DEL for one interface removes only that interface's attachment
@@ -198,6 +266,7 @@ class TpuSideManager:
             except Exception:  # noqa: BLE001 — defensive DEL
                 log.warning("delete_network_function failed for %s",
                             req.sandbox_id)
+            self._teardown_chain(req.sandbox_id)
         return {}
 
     # -- ICI port advertisement ----------------------------------------------

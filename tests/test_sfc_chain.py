@@ -1,0 +1,114 @@
+"""SFC chain steering: consecutive NF pods' attachments wired into a path
+over the ICI mesh (north star: "SFC path programming the ICI mesh")."""
+
+import threading
+
+import pytest
+
+from dpu_operator_tpu.daemon import TpuSideManager
+from dpu_operator_tpu.k8s import FakeKube
+
+
+class _RecordingVsp:
+    def __init__(self):
+        self.wired = []
+        self.unwired = []
+
+    def create_network_function(self, a, b):
+        self.wired.append((a, b))
+
+    def delete_network_function(self, a, b):
+        self.unwired.append((a, b))
+
+
+class _Req:
+    def __init__(self, sandbox, device, ifname, pod, ns="default"):
+        self.sandbox_id = sandbox
+        self.device_id = device
+        self.ifname = ifname
+        self.pod_name = pod
+        self.pod_namespace = ns
+        self.netns = f"/var/run/netns/{sandbox}"
+
+        class _NC:
+            cni_version = "0.4.0"
+        self.netconf = _NC()
+
+
+def _nf_pod(kube, name, sfc, index):
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {"tpu.openshift.io/sfc": sfc,
+                                     "tpu.openshift.io/sfc-index":
+                                         str(index)}},
+        "spec": {"containers": [{"name": "c"}]},
+    })
+
+
+@pytest.fixture
+def mgr(kube):
+    m = TpuSideManager.__new__(TpuSideManager)
+    m.vsp = _RecordingVsp()
+    m.client = kube
+    m._attach_store = {}
+    m._attach_lock = threading.Lock()
+    m._chain_store = {}
+    m._chain_hops = {}
+    return m
+
+
+def _wire_pod(mgr, sandbox, pod, chips):
+    mgr._cni_nf_add(_Req(sandbox, chips[0], "net1", pod))
+    return mgr._cni_nf_add(_Req(sandbox, chips[1], "net2", pod))
+
+
+def test_chain_hop_wired_between_consecutive_nfs(kube, mgr):
+    _nf_pod(kube, "my-sfc-nf-a", "my-sfc", 0)
+    _nf_pod(kube, "my-sfc-nf-b", "my-sfc", 1)
+    r0 = _wire_pod(mgr, "sandboxAAAA", "my-sfc-nf-a", ["chip-0", "chip-1"])
+    assert r0["tpu"]["networkFunction"] is True
+    assert len(mgr.vsp.wired) == 1  # pod-internal only; no peer yet
+    _wire_pod(mgr, "sandboxBBBB", "my-sfc-nf-b", ["chip-2", "chip-3"])
+    # 2 pod-internal wires + 1 chain hop: a's egress -> b's ingress
+    assert len(mgr.vsp.wired) == 3
+    hop = mgr.vsp.wired[-1]
+    assert hop == ("nf-sandboxAAAA-chip-1", "nf-sandboxBBBB-chip-2")
+
+
+def test_chain_hop_unwired_on_pod_teardown(kube, mgr):
+    _nf_pod(kube, "my-sfc-nf-a", "my-sfc", 0)
+    _nf_pod(kube, "my-sfc-nf-b", "my-sfc", 1)
+    _wire_pod(mgr, "sandboxAAAA", "my-sfc-nf-a", ["chip-0", "chip-1"])
+    _wire_pod(mgr, "sandboxBBBB", "my-sfc-nf-b", ["chip-2", "chip-3"])
+    mgr._cni_nf_del(_Req("sandboxBBBB", None, "net1", "my-sfc-nf-b"))
+    # pod-internal NF + the chain hop both unwired
+    assert ("nf-sandboxAAAA-chip-1", "nf-sandboxBBBB-chip-2") \
+        in mgr.vsp.unwired
+    assert len(mgr._chain_hops) == 0
+    # replacement pod rewires the hop
+    _nf_pod(kube, "my-sfc-nf-b2", "my-sfc", 1)
+    _wire_pod(mgr, "sandboxCCCC", "my-sfc-nf-b2", ["chip-2", "chip-3"])
+    assert mgr.vsp.wired[-1] == ("nf-sandboxAAAA-chip-1",
+                                 "nf-sandboxCCCC-chip-2")
+
+
+def test_three_nf_chain_wires_two_hops(kube, mgr):
+    for i, nf in enumerate(["a", "b", "c"]):
+        _nf_pod(kube, f"s-{nf}", "s", i)
+    _wire_pod(mgr, "sbxA0000000", "s-a", ["chip-0", "chip-1"])
+    _wire_pod(mgr, "sbxC0000000", "s-c", ["chip-4", "chip-5"])
+    assert len(mgr.vsp.wired) == 2  # no hops yet: b missing
+    _wire_pod(mgr, "sbxB0000000", "s-b", ["chip-2", "chip-3"])
+    hops = mgr.vsp.wired[3:]
+    assert ("nf-sbxA0000000-chip-1", "nf-sbxB0000000-chip-2") in hops
+    assert ("nf-sbxB0000000-chip-3", "nf-sbxC0000000-chip-4") in hops
+
+
+def test_non_sfc_pod_wires_no_chain(kube, mgr):
+    kube.create({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "plain", "namespace": "default"},
+                 "spec": {"containers": [{"name": "c"}]}})
+    _wire_pod(mgr, "sandboxDDDD", "plain", ["chip-0", "chip-1"])
+    assert len(mgr.vsp.wired) == 1
+    assert mgr._chain_store == {}
