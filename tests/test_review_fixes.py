@@ -51,8 +51,11 @@ class _RecordingVsp:
 def _nf_manager(tmp_path, vsp):
     mgr = TpuSideManager.__new__(TpuSideManager)
     mgr.vsp = vsp
+    mgr.client = None
     mgr._attach_store = {}
     mgr._attach_lock = threading.Lock()
+    mgr._chain_store = {}
+    mgr._chain_hops = {}
     return mgr
 
 
@@ -61,6 +64,8 @@ class _Req:
         self.sandbox_id = sandbox
         self.device_id = device
         self.ifname = ifname
+        self.pod_name = "p"
+        self.pod_namespace = "default"
         self.netns = "/var/run/netns/x"
 
         class _NC:
