@@ -130,6 +130,14 @@ class TpuSideManager:
         return self._slice_server.bound_port if self._slice_server else None
 
     # -- CNI network-function handlers (dpusidemanager.go:104-139) ------------
+    def _unwire_quietly(self, ids: tuple, context: str):
+        """Defensive unwind: best-effort delete_network_function with the
+        failure logged, never raised (DEL/unwind paths must make progress)."""
+        try:
+            self.vsp.delete_network_function(*ids)
+        except Exception:  # noqa: BLE001 — defensive unwind
+            log.warning("NF unwire failed (%s) for %s", context, ids)
+
     def _cni_nf_add(self, req: PodRequest) -> dict:
         """Each ADD contributes one slice attachment; once two distinct
         attachments exist for the pod, wire the network function. Idempotent
@@ -161,11 +169,27 @@ class TpuSideManager:
                     if e2:
                         e2["wiring"] = False
                 raise
+            orphaned = False
             with self._attach_lock:
                 e2 = self._attach_store.get(req.sandbox_id)
-                if e2:
+                if (e2 is None or pair[0] not in e2["atts"]
+                        or pair[1] not in e2["atts"]):
+                    orphaned = True
+                    if e2 is not None:
+                        e2["wiring"] = False
+                else:
                     e2["wiring"] = False
                     e2["wired"] = True
+                    e2["pair"] = pair
+            if orphaned:
+                # A concurrent DEL tore down the sandbox (or one of the
+                # wired interfaces) while the wire was in flight; nothing
+                # will unwire it later — undo now and fail the ADD so
+                # kubelet retries against current state.
+                self._unwire_quietly(pair, "orphaned sandbox wire")
+                raise RuntimeError(
+                    "sandbox torn down while network function wire was "
+                    "in flight")
             wired = True
             self._update_chain(req, pair)
         return {
@@ -197,6 +221,12 @@ class TpuSideManager:
         key = (req.pod_namespace or "default", sfc)
         to_wire = []
         with self._attach_lock:
+            entry = self._attach_store.get(req.sandbox_id)
+            if (entry is None or not entry.get("wired")
+                    or entry.get("pair") != pair):
+                # a DEL tore the sandbox down between the wire completing
+                # and this chain registration — don't resurrect it
+                return
             chain = self._chain_store.setdefault(key, {})
             chain[index] = {"in": pair[0], "out": pair[1],
                             "sandbox": req.sandbox_id}
@@ -213,8 +243,18 @@ class TpuSideManager:
                 log.info("wired SFC hop %s: %s -> %s", hop_key, *ids)
             except Exception:  # noqa: BLE001 — retried on next ADD
                 with self._attach_lock:
-                    self._chain_hops.pop(hop_key, None)
+                    # only our own registration: teardown may have removed
+                    # it and a new pod re-registered the same hop key
+                    if self._chain_hops.get(hop_key) == ids:
+                        self._chain_hops.pop(hop_key)
                 log.warning("SFC hop wire failed for %s", hop_key)
+                continue
+            with self._attach_lock:
+                still_wired = self._chain_hops.get(hop_key) == ids
+            if not still_wired:
+                # teardown raced us and already "unwired" the hop before
+                # our wire landed — undo it so nothing leaks
+                self._unwire_quietly(ids, "raced SFC hop")
 
     def _teardown_chain(self, sandbox_id: str):
         """Unwire chain hops touching a departing sandbox."""
@@ -232,10 +272,7 @@ class TpuSideManager:
                 if not chain:
                     self._chain_store.pop(key, None)
         for ids in to_unwire:
-            try:
-                self.vsp.delete_network_function(*ids)
-            except Exception:  # noqa: BLE001 — defensive DEL
-                log.warning("SFC hop unwire failed for %s", ids)
+            self._unwire_quietly(ids, "chain teardown")
 
     def _cni_nf_del(self, req: PodRequest) -> dict:
         """DEL for one interface removes only that interface's attachment
@@ -250,22 +287,19 @@ class TpuSideManager:
                 return {}
             if attachment_id is None:
                 if entry["wired"]:
-                    unwire = (entry["atts"][0], entry["atts"][1])
+                    unwire = entry.get("pair")
                 self._attach_store.pop(req.sandbox_id)
             elif attachment_id in entry["atts"]:
-                if (entry["wired"]
-                        and entry["atts"].index(attachment_id) < 2):
-                    unwire = (entry["atts"][0], entry["atts"][1])
+                if entry["wired"] and attachment_id in (
+                        entry.get("pair") or ()):
+                    unwire = entry.get("pair")
                     entry["wired"] = False
+                    entry["pair"] = None
                 entry["atts"].remove(attachment_id)
                 if not entry["atts"]:
                     self._attach_store.pop(req.sandbox_id, None)
         if unwire is not None:
-            try:
-                self.vsp.delete_network_function(*unwire)
-            except Exception:  # noqa: BLE001 — defensive DEL
-                log.warning("delete_network_function failed for %s",
-                            req.sandbox_id)
+            self._unwire_quietly(unwire, "sandbox DEL")
             self._teardown_chain(req.sandbox_id)
         return {}
 

@@ -47,6 +47,11 @@ def _preferred_chips(available: list, must_include: list, size: int,
     back to id order. Greedy growth from every seed; cheapest total wins."""
     if size <= 0 or size > len(available):
         return available[:max(size, 0)]
+    must = [d for d in must_include if d in available]
+    if len(must) >= size:
+        # GetPreferredAllocation contract: must-include devices appear in
+        # the response — never truncate them away (ADVICE r1).
+        return must
 
     def coords(dev_id):
         info = devices.get(dev_id) or {}
@@ -59,7 +64,6 @@ def _preferred_chips(available: list, must_include: list, size: int,
             return 1  # unknown topology: everything equidistant
         return sum(abs(x - y) for x, y in zip(ca, cb))
 
-    must = [d for d in must_include if d in available]
     best, best_cost = None, None
     seeds = [d for d in available if d not in must] or available
     for seed in seeds:

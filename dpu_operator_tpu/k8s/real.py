@@ -93,6 +93,9 @@ class RealKube:
                 "need token or client certificate (exec plugins / "
                 "auth-providers are not supported)")
         self._watch_threads: list[threading.Thread] = []
+        #: per-request HTTP timeout (connect+read); callers with stricter
+        #: deadlines (leader lease) pass their own
+        self.request_timeout = 30.0
 
     def _url(self, api_version: str, kind: str, namespace: Optional[str],
              name: Optional[str] = None, subresource: Optional[str] = None):
@@ -110,8 +113,9 @@ class RealKube:
             parts.append(subresource)
         return prefix + "/" + "/".join(parts)
 
-    def get(self, api_version, kind, name, namespace=None):
-        r = self.session.get(self._url(api_version, kind, namespace, name))
+    def get(self, api_version, kind, name, namespace=None, timeout=None):
+        r = self.session.get(self._url(api_version, kind, namespace, name),
+                             timeout=timeout or self.request_timeout)
         if r.status_code == 404:
             return None
         r.raise_for_status()
@@ -123,23 +127,24 @@ class RealKube:
             params["labelSelector"] = ",".join(
                 f"{k}={v}" for k, v in label_selector.items())
         r = self.session.get(self._url(api_version, kind, namespace),
-                             params=params)
+                             params=params, timeout=self.request_timeout)
         r.raise_for_status()
         return r.json().get("items", [])
 
-    def create(self, obj):
+    def create(self, obj, timeout=None):
         md = obj["metadata"]
         r = self.session.post(
             self._url(obj["apiVersion"], obj["kind"], md.get("namespace")),
-            json=obj)
+            json=obj, timeout=timeout or self.request_timeout)
         r.raise_for_status()
         return r.json()
 
-    def update(self, obj):
+    def update(self, obj, timeout=None):
         md = obj["metadata"]
         r = self.session.put(
             self._url(obj["apiVersion"], obj["kind"], md.get("namespace"),
-                      md["name"]), json=obj)
+                      md["name"]), json=obj,
+            timeout=timeout or self.request_timeout)
         r.raise_for_status()
         return r.json()
 
@@ -150,12 +155,14 @@ class RealKube:
                       md["name"]),
             params={"fieldManager": "tpu-operator", "force": "true"},
             headers={"Content-Type": "application/apply-patch+yaml"},
-            data=json.dumps(obj))
+            data=json.dumps(obj), timeout=self.request_timeout)
         r.raise_for_status()
         return r.json()
 
     def delete(self, api_version, kind, name, namespace=None):
-        r = self.session.delete(self._url(api_version, kind, namespace, name))
+        r = self.session.delete(
+            self._url(api_version, kind, namespace, name),
+            timeout=self.request_timeout)
         if r.status_code not in (200, 202, 404):
             r.raise_for_status()
 
@@ -163,7 +170,8 @@ class RealKube:
         md = obj["metadata"]
         r = self.session.put(
             self._url(obj["apiVersion"], obj["kind"], md.get("namespace"),
-                      md["name"], subresource="status"), json=obj)
+                      md["name"], subresource="status"), json=obj,
+            timeout=self.request_timeout)
         r.raise_for_status()
         return r.json()
 
@@ -201,9 +209,18 @@ class RealKube:
     def acquire_leader_lease(self, name: str, namespace: str = "kube-system",
                              lease_seconds: int = 15,
                              identity: str = "",
-                             poll: float = 2.0) -> Callable:
+                             poll: float = 2.0,
+                             on_lost: Optional[Callable] = None) -> Callable:
         """Block until this process holds the coordination.k8s.io Lease,
-        then renew in the background. Returns a cancel function."""
+        then renew in the background. Returns a cancel function.
+
+        If renewal fails past the renew deadline (2/3 of the lease
+        duration, mirroring controller-runtime's renewDeadline <
+        leaseDuration), leadership is lost: *on_lost* is invoked and the
+        renew loop stops. The deadline being strictly below the lease
+        duration guarantees the deposed leader stops *before* another
+        replica can legitimately acquire the expired lease — no
+        split-brain window. The default on_lost terminates the process."""
         import datetime
         import os
         import socket as _socket
@@ -213,9 +230,16 @@ class RealKube:
             return datetime.datetime.now(datetime.timezone.utc).strftime(
                 "%Y-%m-%dT%H:%M:%S.%fZ")
 
+        # Bound each lease HTTP call so a black-holed apiserver connection
+        # cannot hang the renew loop past the renew deadline: two calls per
+        # attempt (get + update), attempts every lease_seconds/3, so per-call
+        # timeout of lease_seconds/6 keeps one full failed attempt within a
+        # single renew period.
+        rpc_timeout = max(1.0, lease_seconds / 6.0)
+
         def try_take() -> bool:
             lease = self.get("coordination.k8s.io/v1", "Lease", name,
-                             namespace=namespace)
+                             namespace=namespace, timeout=rpc_timeout)
             if lease is None:
                 try:
                     self.create({
@@ -224,7 +248,7 @@ class RealKube:
                         "metadata": {"name": name, "namespace": namespace},
                         "spec": {"holderIdentity": identity,
                                  "leaseDurationSeconds": lease_seconds,
-                                 "renewTime": now()}})
+                                 "renewTime": now()}}, timeout=rpc_timeout)
                     return True
                 except Exception:  # noqa: BLE001 — lost the create race
                     return False
@@ -249,7 +273,7 @@ class RealKube:
                         leaseDurationSeconds=lease_seconds)
             lease["spec"] = spec
             try:
-                self.update(lease)
+                self.update(lease, timeout=rpc_timeout)
                 return True
             except Exception:  # noqa: BLE001 — conflict: someone else won
                 return False
@@ -261,9 +285,39 @@ class RealKube:
 
         stop = threading.Event()
 
+        def lost():
+            log.critical("leader lease %s/%s lost by %s — stopping",
+                         namespace, name, identity)
+            if on_lost is not None:
+                on_lost()
+            else:  # pragma: no cover — terminates the test process
+                os._exit(1)
+
+        renew_deadline = lease_seconds * 2.0 / 3.0
+
         def renew_loop():
+            last_renewed = time.monotonic()
             while not stop.wait(lease_seconds / 3):
-                try_take()
+                if time.monotonic() - last_renewed >= renew_deadline:
+                    # Don't even start an attempt past the deadline: a
+                    # slow in-flight call (requests timeouts bound connect
+                    # and per-read, not total duration) must not carry us
+                    # past lease expiry while still claiming leadership.
+                    lost()
+                    return
+                try:
+                    renewed = try_take()
+                except Exception as e:  # noqa: BLE001 — apiserver outage
+                    log.warning("lease renewal errored: %s", e)
+                    renewed = False
+                if renewed:
+                    last_renewed = time.monotonic()
+                elif time.monotonic() - last_renewed >= renew_deadline:
+                    # Unable to renew within the deadline: stop while the
+                    # lease is still unexpired, before any other replica
+                    # can legitimately take it.
+                    lost()
+                    return
 
         t = threading.Thread(target=renew_loop, daemon=True,
                              name="leader-lease")

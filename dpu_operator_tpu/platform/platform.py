@@ -29,6 +29,11 @@ class PciDevice:
 
 
 class Platform(Protocol):
+    #: True only for test doubles; gates relaxations like accepting a
+    #: regular file as a chip device node (ADVICE r1: a stale regular
+    #: file at /dev/accel* must not pass health on real hosts).
+    is_fake: bool
+
     def pci_devices(self) -> list[PciDevice]: ...
     def net_devs(self) -> list[str]: ...
     def product_name(self) -> str: ...
@@ -38,6 +43,8 @@ class Platform(Protocol):
 
 class HardwarePlatform:
     """Scan real sysfs/dev. The ghw analog, plus TPU-VM specifics."""
+
+    is_fake = False
 
     def __init__(self, root: str = "/"):
         self.root = root
@@ -106,6 +113,8 @@ class HardwarePlatform:
 
 class FakePlatform:
     """Injectable platform (reference: platform.go:79-129, mutex-guarded)."""
+
+    is_fake = True
 
     def __init__(self, product: str = "", pci: Optional[list] = None,
                  netdevs: Optional[list] = None,

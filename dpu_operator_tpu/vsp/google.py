@@ -165,12 +165,17 @@ class GoogleTpuVsp:
 
     def _chip_healthy(self, dev_path: str) -> bool:
         """Health = device node present (the TPU analog of the Marvell
-        link-up check, marvell/main.go:219-236). Chardev on real hosts;
-        regular files accepted so FakePlatform e2e runs need no mknod."""
+        link-up check, marvell/main.go:219-236). Real hosts require a
+        character device; regular files pass only under a fake platform
+        (so FakePlatform e2e runs need no mknod) — a stale regular file
+        at /dev/accel* must never be advertised as a healthy chip."""
         try:
             import stat
             mode = os.stat(dev_path).st_mode
-            return stat.S_ISCHR(mode) or stat.S_ISREG(mode)
+            if stat.S_ISCHR(mode):
+                return True
+            return (stat.S_ISREG(mode)
+                    and getattr(self.platform, "is_fake", False))
         except OSError:
             return False
 

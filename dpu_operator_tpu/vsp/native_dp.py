@@ -194,11 +194,13 @@ class AgentProcess:
     like cp-agent-run.go:9-73 starts octep_cp_agent)."""
 
     def __init__(self, binary: str, socket_path: str, state_file: str = "",
-                 dev_dir: str = ""):
+                 dev_dir: str = "", allow_regular_dev: bool = False):
         self.binary = binary
         self.socket_path = socket_path
         self.state_file = state_file
         self.dev_dir = dev_dir
+        # test harnesses only: lets regular files stand in for chardevs
+        self.allow_regular_dev = allow_regular_dev
         self._proc: Optional[subprocess.Popen] = None
 
     def start(self, timeout: float = 5.0):
@@ -207,6 +209,8 @@ class AgentProcess:
             cmd += ["--state-file", self.state_file]
         if self.dev_dir:
             cmd += ["--dev-dir", self.dev_dir]
+        if self.allow_regular_dev:
+            cmd.append("--allow-regular-dev")
         self._proc = subprocess.Popen(cmd, stderr=subprocess.DEVNULL)
         deadline = time.monotonic() + timeout
         while not os.path.exists(self.socket_path):

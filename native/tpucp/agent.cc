@@ -40,13 +40,17 @@ struct Agent {
   std::mutex mu;
   std::string state_file;
   std::string dev_dir = "/dev";
+  bool allow_regular_dev = false;
 
   bool ChipHealthy(int local_index) const {
     if (dev_dir.empty()) return true;
     std::string path = dev_dir + "/accel" + std::to_string(local_index);
     struct stat st;
     if (stat(path.c_str(), &st) != 0) return false;
-    return S_ISCHR(st.st_mode) || S_ISREG(st.st_mode);  // regular: test fake
+    if (S_ISCHR(st.st_mode)) return true;
+    // Regular files stand in for chardevs only when the harness opts in;
+    // a stale regular file at /dev/accel* must not pass health otherwise.
+    return allow_regular_dev && S_ISREG(st.st_mode);
   }
 
   void PersistLocked() {
@@ -301,6 +305,7 @@ int main(int argc, char** argv) {
     if (arg == "--socket") socket_path = next();
     else if (arg == "--state-file") agent.state_file = next();
     else if (arg == "--dev-dir") agent.dev_dir = next();
+    else if (arg == "--allow-regular-dev") agent.allow_regular_dev = true;
     else {
       fprintf(stderr, "unknown flag %s\n", arg.c_str());
       return 2;
@@ -308,7 +313,7 @@ int main(int argc, char** argv) {
   }
   if (socket_path.empty()) {
     fprintf(stderr, "usage: tpu_cp_agent --socket PATH [--state-file F] "
-                    "[--dev-dir D]\n");
+                    "[--dev-dir D] [--allow-regular-dev]\n");
     return 2;
   }
   signal(SIGPIPE, SIG_IGN);

@@ -1,0 +1,204 @@
+"""Regression tests for round-1 ADVICE findings: mutating-webhook Service
+target, leader-lease loss handling, S_ISREG health gating, orphaned NF wire
+unwind, and the GetPreferredAllocation must-include contract."""
+
+import threading
+import time
+
+import yaml
+
+from dpu_operator_tpu.cni.types import NetConf, PodRequest
+from dpu_operator_tpu.daemon import TpuSideManager
+from dpu_operator_tpu.deviceplugin.server import _preferred_chips
+from dpu_operator_tpu.k8s.real import RealKube
+from dpu_operator_tpu.platform.platform import FakePlatform, HardwarePlatform
+from dpu_operator_tpu.vsp.google import GoogleTpuVsp
+
+
+def test_mutating_webhook_targets_existing_service():
+    """ADVICE #1: the MutatingWebhookConfiguration must point at a Service
+    that is actually defined, or pod resource injection silently never runs
+    (failurePolicy: Ignore)."""
+    with open("config/webhook/webhook.yaml") as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    services = {d["metadata"]["name"] for d in docs if d["kind"] == "Service"}
+    for doc in docs:
+        if doc["kind"].endswith("WebhookConfiguration"):
+            for wh in doc["webhooks"]:
+                svc = wh["clientConfig"]["service"]["name"]
+                assert svc in services, (
+                    f"webhook {wh['name']} targets undefined Service {svc}")
+
+
+def _lease_kube():
+    """RealKube without a kubeconfig: in-memory Lease store."""
+    kube = RealKube.__new__(RealKube)
+    store = {}
+
+    def get(api_version, kind, name, namespace=None, **kw):
+        return store.get(name)
+
+    def create(obj, **kw):
+        name = obj["metadata"]["name"]
+        if name in store:
+            raise RuntimeError("exists")
+        store[name] = obj
+        return obj
+
+    def update(obj, **kw):
+        store[obj["metadata"]["name"]] = obj
+        return obj
+
+    kube.get, kube.create, kube.update = get, create, update
+    return kube, store
+
+
+def test_leader_lease_lost_invokes_on_lost():
+    """ADVICE #2: when renewal fails past leaseDurationSeconds, the holder
+    must stop (split-brain otherwise)."""
+    kube, store = _lease_kube()
+    lost = threading.Event()
+    cancel = kube.acquire_leader_lease(
+        "op-lease", namespace="ns", lease_seconds=1, poll=0.05,
+        on_lost=lost.set)
+    assert store["op-lease"]["spec"]["holderIdentity"]
+    # Apiserver outage: every renewal attempt now fails.
+    kube.update = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("down"))
+    kube.get = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("down"))
+    kube.create = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("down"))
+    assert lost.wait(10.0), "on_lost never fired after renewal failures"
+    cancel()
+
+
+def test_leader_lease_renews_while_healthy():
+    kube, store = _lease_kube()
+    lost = threading.Event()
+    cancel = kube.acquire_leader_lease(
+        "op-lease", namespace="ns", lease_seconds=1, poll=0.05,
+        on_lost=lost.set)
+    first = store["op-lease"]["spec"]["renewTime"]
+    deadline = time.monotonic() + 5
+    while (store["op-lease"]["spec"]["renewTime"] == first
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert store["op-lease"]["spec"]["renewTime"] != first
+    assert not lost.is_set()
+    cancel()
+
+
+def test_regular_file_unhealthy_on_real_platform(tmp_path):
+    """ADVICE #3: a stale regular file at /dev/accel* must not be advertised
+    as a healthy chip on real hosts; fakes opt in explicitly."""
+    dev = tmp_path / "accel0"
+    dev.write_text("")
+    real = GoogleTpuVsp(HardwarePlatform(str(tmp_path)))
+    assert real._chip_healthy(str(dev)) is False
+    fake = GoogleTpuVsp(FakePlatform(accel=[str(dev)]))
+    assert fake._chip_healthy(str(dev)) is True
+
+
+class _DelRacingVsp:
+    """VSP whose create_network_function races a DEL that empties the
+    attach store while the wire RPC is in flight."""
+
+    def __init__(self, mgr_holder, sandbox):
+        self.mgr_holder = mgr_holder
+        self.sandbox = sandbox
+        self.wired = []
+        self.unwired = []
+
+    def create_network_function(self, a, b):
+        self.mgr_holder[0]._attach_store.pop(self.sandbox, None)
+        self.wired.append((a, b))
+
+    def delete_network_function(self, a, b):
+        self.unwired.append((a, b))
+
+
+def _nf_req(sandbox, dev):
+    return PodRequest(command="ADD", pod_namespace="default", pod_name="nf",
+                      sandbox_id=sandbox, netns="/proc/1/ns/net",
+                      ifname="net1", device_id=dev,
+                      netconf=NetConf(mode="network-function", device_id=dev))
+
+
+def _bare_manager(vsp):
+    mgr = TpuSideManager.__new__(TpuSideManager)
+    mgr.vsp = vsp
+    mgr.client = None
+    mgr._attach_store = {}
+    mgr._attach_lock = threading.Lock()
+    mgr._chain_store = {}
+    mgr._chain_hops = {}
+    return mgr
+
+
+def test_orphaned_nf_wire_unwound_on_concurrent_del():
+    """ADVICE #4: if a concurrent DEL removed the sandbox entry while the
+    wire was in flight, the successful wire must be undone and the ADD
+    must fail (kubelet retries against current state)."""
+    import pytest
+    holder = []
+    vsp = _DelRacingVsp(holder, "sbx-race-1234567890ab")
+    mgr = _bare_manager(vsp)
+    holder.append(mgr)
+    mgr._cni_nf_add(_nf_req("sbx-race-1234567890ab", "chip-0"))
+    with pytest.raises(RuntimeError):
+        mgr._cni_nf_add(_nf_req("sbx-race-1234567890ab", "chip-1"))
+    assert vsp.wired and vsp.unwired == vsp.wired
+    assert "sbx-race-1234567890ab" not in mgr._attach_store
+
+
+class _InterfaceDelRacingVsp:
+    """Races a per-interface DEL (not full teardown) against the wire."""
+
+    def __init__(self):
+        self.holder = []
+        self.wired = []
+        self.unwired = []
+
+    def create_network_function(self, a, b):
+        mgr = self.holder[0]
+        # per-interface DEL for the first attachment lands mid-wire
+        mgr._cni_nf_del(_nf_req("sbx-ifdel-123456789012", "chip-0"))
+        self.wired.append((a, b))
+
+    def delete_network_function(self, a, b):
+        self.unwired.append((a, b))
+
+
+def test_interface_del_mid_wire_unwinds_and_later_del_safe():
+    """A per-interface DEL racing the wire must not leave a wired entry
+    with a single attachment (later DELs would crash) — the in-flight
+    wire is unwound and the surviving attachment stays usable."""
+    import pytest
+    vsp = _InterfaceDelRacingVsp()
+    mgr = _bare_manager(vsp)
+    vsp.holder.append(mgr)
+    sbx = "sbx-ifdel-123456789012"
+    mgr._cni_nf_add(_nf_req(sbx, "chip-0"))
+    with pytest.raises(RuntimeError):
+        mgr._cni_nf_add(_nf_req(sbx, "chip-1"))
+    assert vsp.unwired == vsp.wired
+    entry = mgr._attach_store.get(sbx)
+    assert entry is not None and not entry["wired"] and not entry["wiring"]
+    # the surviving interface's DEL completes cleanly
+    mgr._cni_nf_del(_nf_req(sbx, "chip-1"))
+    assert sbx not in mgr._attach_store
+
+
+def test_preferred_allocation_keeps_all_must_includes():
+    """ADVICE #5: must-include devices may never be truncated out of the
+    GetPreferredAllocation response."""
+    devices = {f"chip-{i}": {"coords": [i % 2, i // 2]} for i in range(4)}
+    avail = sorted(devices)
+    must = ["chip-3", "chip-1"]
+    # len(must) == size
+    got = _preferred_chips(avail, must, 2, devices)
+    assert set(must) <= set(got) and len(got) == 2
+    # len(must) > size: return must unmodified rather than dropping one
+    got = _preferred_chips(avail, must, 1, devices)
+    assert set(must) <= set(got)
+    # normal path still honors must within a larger allocation
+    got = _preferred_chips(avail, ["chip-2"], 3, devices)
+    assert "chip-2" in got and len(got) == 3

@@ -70,7 +70,7 @@ def stack(kube, node_agent, images, short_tmp, agent_binary):
     # native control agent + GoogleTpuVsp on the vendor-plugin socket
     agent = AgentProcess(agent_binary, short_tmp + "/cp.sock",
                          state_file=short_tmp + "/cp.state",
-                         dev_dir=short_tmp)
+                         dev_dir=short_tmp, allow_regular_dev=True)
     agent.start()
     accel = []
     for i in range(4):

@@ -28,7 +28,7 @@ def agent_binary():
 def agent(agent_binary, short_tmp):
     proc = AgentProcess(agent_binary, short_tmp + "/tpucp.sock",
                         state_file=short_tmp + "/tpucp.state",
-                        dev_dir=short_tmp)
+                        dev_dir=short_tmp, allow_regular_dev=True)
     proc.start()
     client = AgentClient(proc.socket_path)
     yield proc, client
@@ -106,6 +106,23 @@ def test_health_from_dev_dir(agent, short_tmp):
     client.init("v5e-4")
     chips = client.enumerate()
     assert [c["healthy"] for c in chips] == [True, True, False, False]
+
+
+def test_regular_dev_unhealthy_without_optin(agent_binary, short_tmp):
+    """ADVICE r1: without --allow-regular-dev a regular file standing at
+    accel<N> must not pass the health probe (stale-file hazard)."""
+    proc = AgentProcess(agent_binary, short_tmp + "/strict.sock",
+                        dev_dir=short_tmp)  # no allow_regular_dev
+    proc.start()
+    client = AgentClient(short_tmp + "/strict.sock")
+    try:
+        _fake_accel(short_tmp, 2)
+        client.init("v5e-4")
+        chips = client.enumerate()
+        assert [c["healthy"] for c in chips] == [False] * 4
+    finally:
+        client.close()
+        proc.stop()
 
 
 def test_state_survives_restart(agent_binary, short_tmp):

@@ -59,7 +59,8 @@ def test_two_cluster_slice_attachment_lifecycle(short_tmp, agent_binary):
     tpu_agent.register_node("tpu-vm-0", labels={"tpu": "true"})
     tpu_pm = PathManager(tpu_dir)
     cp = AgentProcess(agent_binary, tpu_dir + "/cp.sock",
-                      state_file=tpu_dir + "/cp.state", dev_dir=tpu_dir)
+                      state_file=tpu_dir + "/cp.state", dev_dir=tpu_dir,
+                      allow_regular_dev=True)
     cp.start()
     for i in range(4):
         open(f"{tpu_dir}/accel{i}", "w").close()
