@@ -67,3 +67,36 @@ def validate_tpu_operator_config(obj: dict) -> None:
             or log_level < 0):
         raise ValidationError(f"invalid logLevel {log_level!r}")
     validate_slice_topology(spec.get("sliceTopology", ""))
+    nf_ipam = spec.get("nfIpam")
+    if nf_ipam is not None:
+        if not isinstance(nf_ipam, dict):
+            raise ValidationError("nfIpam must be a mapping")
+        import ipaddress
+        kind = nf_ipam.get("type", "")
+        if kind not in ("host-local", "static"):
+            raise ValidationError(
+                f"invalid nfIpam type {kind!r}: want host-local or static")
+        if kind == "host-local":
+            # reject unparseable configs at admission, not per-pod-ADD
+            if not nf_ipam.get("subnet"):
+                raise ValidationError("host-local nfIpam requires 'subnet'")
+            try:
+                ipaddress.ip_network(nf_ipam["subnet"], strict=False)
+                for bound in ("rangeStart", "rangeEnd", "gateway"):
+                    if nf_ipam.get(bound):
+                        ipaddress.ip_address(nf_ipam[bound])
+            except ValueError as e:
+                raise ValidationError(f"invalid nfIpam: {e}") from e
+        if kind == "static":
+            addrs = nf_ipam.get("addresses")
+            if not addrs or not isinstance(addrs, list):
+                raise ValidationError(
+                    "static nfIpam requires a list of 'addresses'")
+            for a in addrs:
+                if not isinstance(a, dict) or not a.get("address"):
+                    raise ValidationError(
+                        "static nfIpam address entries need 'address'")
+                try:
+                    ipaddress.ip_interface(a["address"])
+                except ValueError as e:
+                    raise ValidationError(f"invalid nfIpam: {e}") from e

@@ -39,6 +39,34 @@ class NetConfCache:
         except OSError:
             pass
 
+    def load_any(self, sandbox_id: str) -> Optional[dict]:
+        """Any cached entry for the sandbox (full-teardown DELs don't name
+        an ifname but still need the ADD-time config)."""
+        try:
+            entries = sorted(os.listdir(self.cache_dir))
+        except OSError:
+            return None
+        for fn in entries:
+            if fn.startswith(f"{sandbox_id}-") and fn.endswith(".json"):
+                try:
+                    with open(os.path.join(self.cache_dir, fn)) as f:
+                        return json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+        return None
+
+    def delete_sandbox(self, sandbox_id: str):
+        try:
+            entries = os.listdir(self.cache_dir)
+        except OSError:
+            return
+        for fn in entries:
+            if fn.startswith(f"{sandbox_id}-"):
+                try:
+                    os.unlink(os.path.join(self.cache_dir, fn))
+                except OSError:
+                    pass
+
 
 class ChipAllocator:
     """File-per-chip allocation locks (pci_allocator.go analog)."""

@@ -64,6 +64,54 @@ class NetConf:
 
 
 @dataclass
+class DeviceWiring:
+    """Per-sandbox device wiring record: the concrete OS-level work this
+    attachment implies for the runtime — which device nodes to expose,
+    the device-cgroup rules admitting them, extra mounts (libtpu), and
+    per-attachment env. The TPU analog of the reference's netns VF dance
+    (sriov.go:75-140 SetupVF): there the CNI moves a netdev; here it
+    records the chip chardev + cgroup contract, and DEL unwinds by this
+    record (sriov.go:505-583 restores from the cached NetConf)."""
+    dev_paths: list = field(default_factory=list)
+    cgroup_rules: list = field(default_factory=list)
+    mounts: list = field(default_factory=list)
+    env: dict = field(default_factory=dict)
+
+    @classmethod
+    def for_chip(cls, chip_index: int, dev_path: str = "",
+                 libtpu_path: str = "") -> "DeviceWiring":
+        import os
+        import stat as _stat
+        dev = dev_path or f"/dev/accel{chip_index}"
+        rules = []
+        try:
+            st = os.stat(dev)
+            if _stat.S_ISCHR(st.st_mode):
+                rules.append(f"c {os.major(st.st_rdev)}:"
+                             f"{os.minor(st.st_rdev)} rwm")
+        except OSError:
+            pass
+        mounts = []
+        if libtpu_path and os.path.exists(libtpu_path):
+            mounts.append({"hostPath": libtpu_path,
+                           "containerPath": "/usr/lib/tpu/libtpu.so",
+                           "readOnly": True})
+        return cls(dev_paths=[dev], cgroup_rules=rules, mounts=mounts,
+                   env={"TPU_CHIP_INDEX": str(chip_index)})
+
+    def to_dict(self) -> dict:
+        return {"devPaths": self.dev_paths, "cgroupRules": self.cgroup_rules,
+                "mounts": self.mounts, "env": self.env}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeviceWiring":
+        return cls(dev_paths=list(d.get("devPaths", [])),
+                   cgroup_rules=list(d.get("cgroupRules", [])),
+                   mounts=list(d.get("mounts", [])),
+                   env=dict(d.get("env", {})))
+
+
+@dataclass
 class CniRequest:
     """What the shim posts: CNI_* env + stdin config (cnishim.go:31-55)."""
     env: dict

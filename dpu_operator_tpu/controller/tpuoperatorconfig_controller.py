@@ -55,6 +55,7 @@ class TpuOperatorConfigReconciler:
             # hardcoded resource name parity (controller.go:162)
             "ResourceName": v.TPU_RESOURCE_NAME,
             "NadName": v.DEFAULT_NAD_NAME,
+            "NfIpam": dict(cfg.spec.nf_ipam),
         }
         return merge_vars_with_images(self.image_manager, data)
 
@@ -69,17 +70,23 @@ class TpuOperatorConfigReconciler:
         mode; on the tpu side in netdev/network-function mode."""
         mode = data["Mode"]
         cni_mode = "network-function" if mode == "tpu" else "chip"
+        config = {
+            "cniVersion": "0.4.0",
+            "name": v.DEFAULT_NAD_NAME,
+            "type": "tpu-cni",
+            "mode": cni_mode,
+            "resourceName": data["ResourceName"],
+        }
+        if cni_mode == "network-function" and data.get("NfIpam"):
+            # NF secondary interfaces get real addressing: the NetConf
+            # carries the IPAM the CNI server delegates to (cni/ipam.py)
+            config["ipam"] = data["NfIpam"]
         nad = {
             "apiVersion": "k8s.cni.cncf.io/v1",
             "kind": "NetworkAttachmentDefinition",
             "metadata": {"name": v.DEFAULT_NAD_NAME, "namespace": "default"},
             "spec": {
-                "config": json.dumps({
-                    "cniVersion": "0.4.0",
-                    "type": "tpu-cni",
-                    "mode": cni_mode,
-                    "resourceName": data["ResourceName"],
-                }),
+                "config": json.dumps(config),
             },
         }
         from ..k8s.client import set_owner_reference

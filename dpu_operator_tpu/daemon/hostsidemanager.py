@@ -19,7 +19,8 @@ from typing import Optional
 import grpc
 
 from ..cni import ChipAllocator, CniServer, NetConfCache
-from ..cni.types import PodRequest
+from ..cni.ipam import ipam_add, ipam_del
+from ..cni.types import DeviceWiring, PodRequest
 from ..deviceplugin import DevicePlugin
 from ..k8s.manager import Manager
 from ..utils import vars as v
@@ -49,6 +50,7 @@ class HostSideManager:
             add_handler=self._cni_add, del_handler=self._cni_del)
         self.cache = NetConfCache(path_manager.cni_cache_dir())
         self.allocator = ChipAllocator(path_manager.cni_cache_dir() + "/alloc")
+        self.ipam_dir = path_manager.cni_cache_dir() + "/ipam"
         self._tpu_daemon_addr: Optional[tuple] = None
         self._manager: Optional[Manager] = None
 
@@ -151,18 +153,42 @@ class HostSideManager:
             # roll back so a retried/new sandbox can claim the device
             self.allocator.release(req.device_id, req.sandbox_id)
             raise
+        # IPAM delegation for the attachment (sriov.go:423-484 analog;
+        # optional — chip attachments may be compute-only)
+        try:
+            ips = ipam_add(req.netconf.ipam, self.ipam_dir,
+                           req.netconf.name, req.sandbox_id, req.ifname)
+        except Exception:
+            try:
+                self.delete_slice_attachment(host=0, chip=chip)
+            except Exception:  # noqa: BLE001 — never mask the IPAM error
+                log.warning("attachment rollback failed after IPAM "
+                            "failure for %s", req.sandbox_id)
+            self.allocator.release(req.device_id, req.sandbox_id)
+            raise
+        # concrete per-sandbox wiring: device node, cgroup rule, libtpu
+        # mount, env — what the runtime must materialize (SetupVF analog)
+        info = self.device_handler.get_devices().get(req.device_id) or {}
+        wiring = DeviceWiring.for_chip(
+            chip, dev_path=info.get("dev_path", ""),
+            libtpu_path=self.path_manager.libtpu_path())
         self.cache.save(req.sandbox_id, req.ifname, {
             "deviceID": req.device_id,
             "chip": chip,
             "attachment": att.get("name"),
             "netconf": req.netconf.to_dict(),
+            "wiring": wiring.to_dict(),
         })
-        return {
+        result = {
             "cniVersion": req.netconf.cni_version,
             "interfaces": [{"name": req.ifname, "sandbox": req.netns}],
             "tpu": {"deviceID": req.device_id, "chip": chip,
-                    "attachment": att.get("name")},
+                    "attachment": att.get("name"),
+                    "wiring": wiring.to_dict()},
         }
+        if ips is not None:
+            result.update(ips)
+        return result
 
     def _cni_del(self, req: PodRequest) -> dict:
         cached = self.cache.load(req.sandbox_id, req.ifname)
@@ -173,6 +199,13 @@ class HostSideManager:
         except ConnectionError:
             log.warning("tpu-side daemon unreachable on DEL; releasing "
                         "local state anyway")
+        # release the delegated address using the *cached* NetConf — the
+        # DEL request's stdin may be stale/absent (sriov.go:505-583 reads
+        # the cache for exactly this reason)
+        ipam_cfg = (cached.get("netconf") or {}).get("ipam") or {}
+        ipam_del(ipam_cfg, self.ipam_dir,
+                 (cached.get("netconf") or {}).get("name", ""),
+                 req.sandbox_id, req.ifname)
         self.allocator.release(cached["deviceID"], req.sandbox_id)
         self.cache.delete(req.sandbox_id, req.ifname)
         return {}

@@ -16,6 +16,7 @@ import threading
 from typing import Optional
 
 from ..cni import CniServer
+from ..cni.ipam import ipam_add, ipam_del
 from ..cni.types import PodRequest
 from ..deviceplugin import DevicePlugin
 from ..k8s.manager import Manager
@@ -68,6 +69,12 @@ class TpuSideManager:
         self.cni_server = CniServer(
             path_manager.cni_server_socket(),
             add_handler=self._cni_nf_add, del_handler=self._cni_nf_del)
+        self.ipam_dir = path_manager.cni_cache_dir() + "/ipam"
+        # ADD-time NetConf cache: DEL releases addressing from what ADD
+        # actually configured, even across daemon restarts or NAD updates
+        # (the host side's NetConfCache rationale, sriov.go:505-583)
+        from ..cni import NetConfCache
+        self.nf_cache = NetConfCache(path_manager.cni_cache_dir() + "/nf")
         self._slice_server: Optional[VspServer] = None
         self._addr: Optional[tuple] = None
         # attachment accumulator per pod sandbox (macStore analog, :45);
@@ -146,6 +153,17 @@ class TpuSideManager:
         if not req.device_id:
             raise ValueError("NF CNI ADD without deviceID")
         attachment_id = f"nf-{req.sandbox_id[:12]}-{req.device_id}"
+        # delegate addressing for the NF's secondary interface before any
+        # wiring: NF pods need distinct addresses per interface
+        # (networkfn.go:233-317 optional-IPAM analog); host-local keeps
+        # per-sandbox idempotency so kubelet ADD retries reuse the address
+        ipam_cfg = req.netconf.ipam or {}
+        network = req.netconf.name or ""
+        ips = ipam_add(ipam_cfg, self.ipam_dir, network,
+                       req.sandbox_id, req.ifname)
+        if ips is not None:
+            self.nf_cache.save(req.sandbox_id, req.ifname,
+                               {"ipam": ipam_cfg, "network": network})
         pair = None
         with self._attach_lock:
             entry = self._attach_store.setdefault(
@@ -192,11 +210,14 @@ class TpuSideManager:
                     "in flight")
             wired = True
             self._update_chain(req, pair)
-        return {
+        result = {
             "cniVersion": req.netconf.cni_version,
             "interfaces": [{"name": req.ifname, "sandbox": req.netns}],
             "tpu": {"attachment": attachment_id, "networkFunction": wired},
         }
+        if ips is not None:
+            result.update(ips)
+        return result
 
     # -- SFC chain steering ---------------------------------------------------
     def _update_chain(self, req: PodRequest, pair: tuple):
@@ -280,6 +301,21 @@ class TpuSideManager:
         interface's state); a DEL without deviceID tears the sandbox down."""
         attachment_id = (f"nf-{req.sandbox_id[:12]}-{req.device_id}"
                          if req.device_id else None)
+        # Release delegated addresses FIRST, from the ADD-time cached
+        # config — the in-memory attach entry may be gone (daemon restart)
+        # and the DEL stdin may carry a different IPAM than ADD configured
+        # (NAD updated while the pod ran); per-interface DEL frees this
+        # ifname, full teardown frees every address the sandbox holds.
+        per_if = attachment_id is not None
+        cached = (self.nf_cache.load(req.sandbox_id, req.ifname) if per_if
+                  else self.nf_cache.load_any(req.sandbox_id)) or {}
+        ipam_del(cached.get("ipam") or req.netconf.ipam, self.ipam_dir,
+                 cached.get("network") or req.netconf.name,
+                 req.sandbox_id, req.ifname if per_if else None)
+        if per_if:
+            self.nf_cache.delete(req.sandbox_id, req.ifname)
+        else:
+            self.nf_cache.delete_sandbox(req.sandbox_id)
         unwire = None
         with self._attach_lock:
             entry = self._attach_store.get(req.sandbox_id)

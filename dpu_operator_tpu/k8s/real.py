@@ -6,8 +6,10 @@ controller-runtime; here it is a thin REST mapper: core group objects under
 resourceVersion (list+watch semantics degraded to periodic relist — sufficient
 for the operator's level-triggered reconcilers).
 
-Untested in this environment (no live cluster); covered by the same KubeClient
-protocol the FakeKube tests exercise.
+Tested end-to-end (TLS, bearer auth, REST paths, apply-patch, status
+subresource, watch-relist, leader lease) against an in-process HTTPS
+apiserver speaking the real wire protocol: tests/test_real_apiserver.py +
+tests/apiserver_fixture.py — the envtest analog for this environment.
 """
 
 from __future__ import annotations
@@ -64,6 +66,17 @@ class RealKube:
         user = next(u for u in cfg["users"] if u["name"] == ctx["user"])["user"]
         self.base = cluster["server"].rstrip("/")
         self.session = requests.Session()
+        # The kubeconfig's CA is authoritative (client-go parity): ambient
+        # REQUESTS_CA_BUNDLE/CURL_CA_BUNDLE env vars would otherwise
+        # override session.verify and break apiservers with private CAs.
+        # trust_env=False also drops env proxy handling, so re-apply the
+        # proxy vars explicitly (client-go honors them).
+        self.session.trust_env = False
+        for scheme in ("http", "https"):
+            proxy = (os.environ.get(f"{scheme.upper()}_PROXY")
+                     or os.environ.get(f"{scheme}_proxy"))
+            if proxy:
+                self.session.proxies[scheme] = proxy
         ca = cluster.get("certificate-authority-data")
         if ca:
             f = tempfile.NamedTemporaryFile(delete=False, suffix=".crt")

@@ -130,6 +130,11 @@ def _bare_manager(vsp):
     mgr._attach_lock = threading.Lock()
     mgr._chain_store = {}
     mgr._chain_hops = {}
+    import tempfile as _tf
+    from dpu_operator_tpu.cni import NetConfCache as _NCC
+    _d = _tf.mkdtemp(prefix="nf-ipam-")
+    mgr.ipam_dir = _d + "/ipam"
+    mgr.nf_cache = _NCC(_d + "/nf")
     return mgr
 
 

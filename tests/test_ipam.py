@@ -1,0 +1,228 @@
+"""IPAM delegation + sandbox device-wiring records (VERDICT r1 item 2).
+
+Reference parity: sriov.go:423-484 (IPAM ExecAdd + cache-driven DEL unwind),
+networkfn.go:233-317 (optional IPAM on NF interfaces), sriov.go:75-140
+(SetupVF — the per-sandbox OS wiring whose TPU analog is DeviceWiring).
+"""
+
+import threading
+
+import pytest
+
+from dpu_operator_tpu.cni.ipam import (
+    HostLocalIpam,
+    IpamError,
+    StaticIpam,
+    ipam_add,
+    ipam_del,
+)
+from dpu_operator_tpu.cni.types import DeviceWiring, NetConf, PodRequest
+from dpu_operator_tpu.daemon import TpuSideManager
+
+HOST_LOCAL = {"type": "host-local", "subnet": "10.56.0.0/29",
+              "gateway": "10.56.0.1"}
+
+
+# -- host-local allocator ----------------------------------------------------
+
+def test_host_local_distinct_addresses(tmp_path):
+    ipam = HostLocalIpam(str(tmp_path))
+    r1 = ipam.add(HOST_LOCAL, "tpunf", "sbx-a", "net1")
+    r2 = ipam.add(HOST_LOCAL, "tpunf", "sbx-b", "net1")
+    a1 = r1["ips"][0]["address"]
+    a2 = r2["ips"][0]["address"]
+    assert a1 != a2
+    assert a1 == "10.56.0.2/29"  # gateway .1 skipped
+    assert r1["ips"][0]["gateway"] == "10.56.0.1"
+
+
+def test_host_local_idempotent_per_sandbox(tmp_path):
+    ipam = HostLocalIpam(str(tmp_path))
+    r1 = ipam.add(HOST_LOCAL, "tpunf", "sbx-a", "net1")
+    r2 = ipam.add(HOST_LOCAL, "tpunf", "sbx-a", "net1")  # kubelet retry
+    assert r1["ips"][0]["address"] == r2["ips"][0]["address"]
+    # same sandbox, second interface → different address
+    r3 = ipam.add(HOST_LOCAL, "tpunf", "sbx-a", "net2")
+    assert r3["ips"][0]["address"] != r1["ips"][0]["address"]
+
+
+def test_host_local_release_and_reuse(tmp_path):
+    ipam = HostLocalIpam(str(tmp_path))
+    r1 = ipam.add(HOST_LOCAL, "tpunf", "sbx-a", "net1")
+    ipam.delete(HOST_LOCAL, "tpunf", "sbx-a", "net1")
+    r2 = ipam.add(HOST_LOCAL, "tpunf", "sbx-c", "net1")
+    assert r2["ips"][0]["address"] == r1["ips"][0]["address"]
+
+
+def test_host_local_exhaustion(tmp_path):
+    cfg = {"type": "host-local", "subnet": "10.56.0.0/30",
+           "gateway": "10.56.0.1"}  # one usable host (.2)
+    ipam = HostLocalIpam(str(tmp_path))
+    ipam.add(cfg, "n", "sbx-a", "net1")
+    with pytest.raises(IpamError, match="exhausted"):
+        ipam.add(cfg, "n", "sbx-b", "net1")
+
+
+def test_host_local_range_bounds(tmp_path):
+    cfg = {"type": "host-local", "subnet": "10.0.0.0/24",
+           "rangeStart": "10.0.0.10", "rangeEnd": "10.0.0.11"}
+    ipam = HostLocalIpam(str(tmp_path))
+    assert ipam.add(cfg, "n", "a", "i")["ips"][0]["address"] == "10.0.0.10/24"
+    assert ipam.add(cfg, "n", "b", "i")["ips"][0]["address"] == "10.0.0.11/24"
+    with pytest.raises(IpamError):
+        ipam.add(cfg, "n", "c", "i")
+
+
+def test_host_local_survives_restart(tmp_path):
+    r1 = HostLocalIpam(str(tmp_path)).add(HOST_LOCAL, "n", "sbx-a", "net1")
+    # a fresh allocator over the same dir (daemon restart) must not
+    # re-issue the address
+    r2 = HostLocalIpam(str(tmp_path)).add(HOST_LOCAL, "n", "sbx-b", "net1")
+    assert r1["ips"][0]["address"] != r2["ips"][0]["address"]
+
+
+def test_sandbox_teardown_releases_all(tmp_path):
+    ipam = HostLocalIpam(str(tmp_path))
+    ipam.add(HOST_LOCAL, "n", "sbx-a", "net1")
+    ipam.add(HOST_LOCAL, "n", "sbx-a", "net2")
+    keep = ipam.add(HOST_LOCAL, "n", "sbx-b", "net1")["ips"][0]["address"]
+    ipam.delete(HOST_LOCAL, "n", "sbx-a", None)  # full teardown
+    got = {ipam.add(HOST_LOCAL, "n", f"sbx-{i}", "net1")["ips"][0]["address"]
+           for i in ("c", "d")}
+    assert keep not in got and len(got) == 2
+
+
+# -- static ------------------------------------------------------------------
+
+def test_static_ipam(tmp_path):
+    cfg = {"type": "static",
+           "addresses": [{"address": "192.168.1.5/24",
+                          "gateway": "192.168.1.1"}]}
+    r = StaticIpam().add(cfg, "n", "sbx", "net1")
+    assert r["ips"][0]["address"] == "192.168.1.5/24"
+    with pytest.raises(IpamError):
+        StaticIpam().add({"type": "static"}, "n", "sbx", "net1")
+
+
+def test_dispatch_and_optional(tmp_path):
+    assert ipam_add({}, str(tmp_path), "n", "s", "i") is None  # optional
+    with pytest.raises(IpamError, match="unsupported"):
+        ipam_add({"type": "dhcp"}, str(tmp_path), "n", "s", "i")
+    ipam_del({}, str(tmp_path), "n", "s", "i")  # no-op
+
+
+# -- NF pods over the CNI path (VERDICT done-criterion) ----------------------
+
+class _QuietVsp:
+    def __init__(self):
+        self.wired, self.unwired = [], []
+
+    def create_network_function(self, a, b):
+        self.wired.append((a, b))
+
+    def delete_network_function(self, a, b):
+        self.unwired.append((a, b))
+
+
+def _nf_manager(tmp_path):
+    mgr = TpuSideManager.__new__(TpuSideManager)
+    mgr.vsp = _QuietVsp()
+    mgr.client = None
+    mgr._attach_store = {}
+    mgr._attach_lock = threading.Lock()
+    mgr._chain_store = {}
+    mgr._chain_hops = {}
+    from dpu_operator_tpu.cni import NetConfCache
+    mgr.ipam_dir = str(tmp_path / "ipam")
+    mgr.nf_cache = NetConfCache(str(tmp_path / "nf"))
+    return mgr
+
+
+def _nf_req(sandbox, dev, ifname="net1", command="ADD"):
+    nc = NetConf(mode="network-function", name="tpunf", device_id=dev,
+                 ipam=dict(HOST_LOCAL))
+    return PodRequest(command=command, pod_namespace="default",
+                      pod_name=f"nf-{sandbox}", sandbox_id=sandbox,
+                      netns="/proc/1/ns/net", ifname=ifname, device_id=dev,
+                      netconf=nc)
+
+
+def test_nf_pods_get_distinct_addresses_and_del_releases(tmp_path):
+    """Two NF pods receive distinct addresses from the NetConf-configured
+    IPAM; DEL releases them — the verdict's done-criterion."""
+    mgr = _nf_manager(tmp_path)
+    sbx_a, sbx_b = "sbx-nf-a-0123456789", "sbx-nf-b-0123456789"
+    r_a1 = mgr._cni_nf_add(_nf_req(sbx_a, "chip-0", "net1"))
+    r_a2 = mgr._cni_nf_add(_nf_req(sbx_a, "chip-1", "net2"))
+    r_b1 = mgr._cni_nf_add(_nf_req(sbx_b, "chip-2", "net1"))
+    addrs = {r["ips"][0]["address"] for r in (r_a1, r_a2, r_b1)}
+    assert len(addrs) == 3  # all distinct
+    assert mgr.vsp.wired  # pod A's pair got wired
+
+    # DEL pod A entirely → its two addresses return to the pool
+    mgr._cni_nf_del(_nf_req(sbx_a, "", "net1", command="DEL"))
+    r_c = mgr._cni_nf_add(_nf_req("sbx-nf-c-0123456789", "chip-3", "net1"))
+    assert r_c["ips"][0]["address"] in {r_a1["ips"][0]["address"],
+                                        r_a2["ips"][0]["address"]}
+
+
+def test_nf_del_after_restart_releases_address(tmp_path):
+    """DEL landing after a daemon restart (in-memory attach store lost)
+    must still release the pod's addresses from the ADD-time disk cache —
+    otherwise pod churn across restarts exhausts the range."""
+    mgr = _nf_manager(tmp_path)
+    sbx = "sbx-nf-restart-012345"
+    addr = mgr._cni_nf_add(_nf_req(sbx, "chip-0"))["ips"][0]["address"]
+    mgr2 = _nf_manager(tmp_path)  # same dirs, empty in-memory state
+    mgr2._cni_nf_del(_nf_req(sbx, "", command="DEL"))  # full teardown
+    # the address is reusable again
+    got = mgr2._cni_nf_add(
+        _nf_req("sbx-nf-after-0123456", "chip-1"))["ips"][0]["address"]
+    assert got == addr
+
+
+def test_nf_del_uses_add_time_ipam_not_del_stdin(tmp_path):
+    """A NAD update between ADD and DEL must not orphan the ADD-time
+    allocation: release follows the cached config, not DEL's stdin."""
+    mgr = _nf_manager(tmp_path)
+    sbx = "sbx-nf-nadupd-012345"
+    addr = mgr._cni_nf_add(_nf_req(sbx, "chip-0"))["ips"][0]["address"]
+    # DEL arrives with the NAD switched to no-IPAM
+    del_req = _nf_req(sbx, "chip-0", command="DEL")
+    del_req.netconf.ipam = {}
+    mgr._cni_nf_del(del_req)
+    got = mgr._cni_nf_add(
+        _nf_req("sbx-nf-other-0123456", "chip-1"))["ips"][0]["address"]
+    assert got == addr  # released despite DEL stdin lacking the config
+
+
+def test_nf_add_retry_keeps_address(tmp_path):
+    mgr = _nf_manager(tmp_path)
+    sbx = "sbx-nf-r-0123456789"
+    r1 = mgr._cni_nf_add(_nf_req(sbx, "chip-0"))
+    r2 = mgr._cni_nf_add(_nf_req(sbx, "chip-0"))  # kubelet ADD retry
+    assert r1["ips"][0]["address"] == r2["ips"][0]["address"]
+
+
+# -- device wiring records ---------------------------------------------------
+
+def test_device_wiring_record(tmp_path):
+    dev = tmp_path / "accel3"
+    dev.write_text("")
+    lib = tmp_path / "libtpu.so"
+    lib.write_text("")
+    w = DeviceWiring.for_chip(3, dev_path=str(dev), libtpu_path=str(lib))
+    assert w.dev_paths == [str(dev)]
+    assert w.env == {"TPU_CHIP_INDEX": "3"}
+    assert w.mounts[0]["hostPath"] == str(lib)
+    assert w.mounts[0]["readOnly"] is True
+    # regular file → no chardev cgroup rule claimed
+    assert w.cgroup_rules == []
+    rt = DeviceWiring.from_dict(w.to_dict())
+    assert rt == w
+
+
+def test_device_wiring_chardev_rule():
+    # /dev/null is a real chardev on any test host: 1:3
+    w = DeviceWiring.for_chip(0, dev_path="/dev/null")
+    assert w.cgroup_rules == ["c 1:3 rwm"]

@@ -56,6 +56,11 @@ def _nf_manager(tmp_path, vsp):
     mgr._attach_lock = threading.Lock()
     mgr._chain_store = {}
     mgr._chain_hops = {}
+    import tempfile as _tf
+    from dpu_operator_tpu.cni import NetConfCache as _NCC
+    _d = _tf.mkdtemp(prefix="nf-ipam-")
+    mgr.ipam_dir = _d + "/ipam"
+    mgr.nf_cache = _NCC(_d + "/nf")
     return mgr
 
 
@@ -70,6 +75,8 @@ class _Req:
 
         class _NC:
             cni_version = "0.4.0"
+            name = ""
+            ipam = {}
         self.netconf = _NC()
 
 

@@ -32,6 +32,8 @@ class _Req:
 
         class _NC:
             cni_version = "0.4.0"
+            name = ""
+            ipam = {}
         self.netconf = _NC()
 
 
@@ -47,7 +49,8 @@ def _nf_pod(kube, name, sfc, index):
 
 
 @pytest.fixture
-def mgr(kube):
+def mgr(kube, tmp_path):
+    from dpu_operator_tpu.cni import NetConfCache
     m = TpuSideManager.__new__(TpuSideManager)
     m.vsp = _RecordingVsp()
     m.client = kube
@@ -55,6 +58,8 @@ def mgr(kube):
     m._attach_lock = threading.Lock()
     m._chain_store = {}
     m._chain_hops = {}
+    m.ipam_dir = str(tmp_path / "ipam")
+    m.nf_cache = NetConfCache(str(tmp_path / "nf"))
     return m
 
 
