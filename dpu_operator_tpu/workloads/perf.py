@@ -1,0 +1,165 @@
+"""Compute-path performance accounting: honest FLOPs, MFU, tokens/s.
+
+Round-1 verdict item 3: the flash-attention number must use *causal* FLOP
+accounting (a causal kernel does ~half the FLOPs of full S^2 attention —
+counting full FLOPs inflates "effective TFLOPS" ~2x), and the flagship
+train step must be timed in steady state (many steps, dispatch amortized)
+before claiming tokens/s or MFU.
+
+MFU here = achieved_model_flops / wall_clock / peak_flops, with
+model FLOPs = 6*N*T for the matmul path (fwd+bwd+param-grad x 2 flops/MAC)
+plus the causal attention term 6*L*B*S^2*d_model (QK^T and PV, fwd 2x +
+bwd 4x, halved for causality) — the PaLM-appendix accounting.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: chip kind (jax.devices()[0].device_kind, lowered) -> peak bf16 TFLOPS.
+#: Public spec-sheet numbers.
+PEAK_TFLOPS_BF16 = {
+    "tpu v4": 275.0,
+    "tpu v5 lite": 197.0,   # v5e
+    "tpu v5e": 197.0,
+    "tpu v5": 459.0,        # v5p
+    "tpu v5p": 459.0,
+    "tpu v6 lite": 918.0,   # v6e / Trillium
+    "tpu v6e": 918.0,
+}
+_CPU_FALLBACK_TFLOPS = 0.2  # only so CPU CI runs produce finite ratios
+
+
+def peak_tflops(device=None) -> float:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_TFLOPS_BF16.items():
+        if kind.startswith(key):
+            return val
+    # longest-prefix miss: "TPU v5" would also prefix-match "TPU v5 lite"
+    # strings, so exact kinds are listed first above; unknown hardware
+    # falls back to a conservative CPU number rather than lying high.
+    return _CPU_FALLBACK_TFLOPS
+
+
+def param_count(cfg) -> int:
+    per_layer = (2 * cfg.d_model                       # ln1, ln2
+                 + cfg.d_model * 3 * cfg.d_model       # wqkv
+                 + cfg.d_model * cfg.d_model           # wo
+                 + 2 * cfg.d_model * cfg.d_ff)         # w1, w2
+    return (cfg.vocab * cfg.d_model + cfg.max_seq * cfg.d_model
+            + cfg.d_model + cfg.n_layers * per_layer)
+
+
+def train_step_flops(cfg, batch: int, seq: int) -> float:
+    """Model FLOPs of one fwd+bwd step with causal-attention accounting."""
+    tokens = batch * seq
+    matmul = 6.0 * param_count(cfg) * tokens
+    attn_causal = 6.0 * cfg.n_layers * batch * seq * seq * cfg.d_model
+    return matmul + attn_causal
+
+
+def attention_flops(b: int, s: int, h: int, d: int, causal: bool) -> float:
+    """Forward attention FLOPs: QK^T + PV, 2 flops/MAC, halved if causal."""
+    full = 4.0 * b * h * s * s * d
+    return full / 2.0 if causal else full
+
+
+@dataclass
+class TrainPerf:
+    step_ms: float
+    tokens_per_s: float
+    mfu: float
+    model_tflops: float      # achieved model TFLOPS
+    peak_tflops: float
+    params: int
+    steps_timed: int
+
+
+def measure_train(cfg, mesh, batch: int = 8, steps: int = 10,
+                  warmup: int = 3) -> TrainPerf:
+    """Steady-state train-step timing: *warmup* compiled steps first, then
+    *steps* issued back-to-back (donated state, one final sync) so per-call
+    dispatch latency amortizes instead of dominating (round-1 measured a
+    30M model at 521 ms/step = sub-1% MFU because each step paid a full
+    host->tunnel->chip round trip)."""
+    from .model import make_example_batch, make_train_step
+    step, init_state, place = make_train_step(cfg, mesh)
+    params, opt = init_state(jax.random.key(0))
+    data = place(make_example_batch(cfg, batch=batch))
+    for _ in range(warmup):
+        params, opt, loss = step(params, opt, data)
+    float(loss)  # force completion: some transports (axon tunnel) return
+    # from block_until_ready before the chip is done; a device-to-host
+    # scalar fetch cannot lie
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, data)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    seq = cfg.max_seq
+    flops = train_step_flops(cfg, batch, seq)
+    peak = peak_tflops()
+    achieved = flops / dt / 1e12
+    return TrainPerf(
+        step_ms=dt * 1e3,
+        tokens_per_s=batch * seq / dt,
+        mfu=achieved / peak,
+        model_tflops=achieved,
+        peak_tflops=peak,
+        params=param_count(cfg),
+        steps_timed=steps,
+    )
+
+
+@dataclass
+class FlashPerf:
+    call_ms: float
+    tflops_causal: float
+    frac_of_peak: float
+    peak_tflops: float
+
+
+def measure_flash_attention(b: int = 2, s: int = 2048, h: int = 8,
+                            d: int = 128, causal: bool = True,
+                            iters: int = 20, warmup: int = 3) -> FlashPerf:
+    """Pallas flash-attention forward with honest causal-FLOP accounting
+    (round 1 reported 194 "effective" TFLOPS by counting full S^2 FLOPs
+    for a causal kernel — the causal number is ~half)."""
+    from ..ops.flash_attention import flash_attention
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+               for kk in keys)
+    out = flash_attention(q, k, v, causal=causal)
+    for _ in range(warmup):
+        out = flash_attention(q, k, v, causal=causal)
+    float(jnp.sum(out))  # scalar fetch: see measure_train
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(iters):
+        out = flash_attention(q, k, v, causal=causal)
+    float(jnp.sum(out))
+    dt = (time.perf_counter() - t0) / iters
+    flops = attention_flops(b, s, h, d, causal)
+    peak = peak_tflops()
+    tf = flops / dt / 1e12
+    return FlashPerf(call_ms=dt * 1e3, tflops_causal=tf,
+                     frac_of_peak=tf / peak, peak_tflops=peak)
+
+
+def flagship_config():
+    """The config bench.py times on the real chip: GPT-2-small-shaped so
+    the step is compute-bound, not dispatch- or vocab-bound."""
+    from .model import TransformerConfig
+    return TransformerConfig(
+        vocab=32768, d_model=768, n_heads=12, n_layers=12, d_ff=3072,
+        max_seq=1024, remat=False)
+
+
+FLAGSHIP_BATCH = 16  # B16 S1024 measured compute-bound on one v5e chip
+# (B32 OOMs without remat; remat trades ~6 MFU points for the memory)

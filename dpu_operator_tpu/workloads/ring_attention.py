@@ -60,27 +60,34 @@ def ring_attention(mesh: Mesh, axis: str = "model", causal: bool = True):
         me = lax.axis_index(axis)
         sq = q.shape[1]
         qpos = me * sq + jnp.arange(sq)
-        acc = jnp.zeros(q.shape[:2] + q.shape[2:], jnp.float32)
-        row_max = jnp.full(q.shape[:1] + (q.shape[2], sq), _NEG_INF,
-                           jnp.float32)  # (B, H, Sq)
-        row_sum = jnp.zeros_like(row_max)
+        acc0 = jnp.zeros(q.shape[:2] + q.shape[2:], jnp.float32)
+        row_max0 = jnp.full(q.shape[:1] + (q.shape[2], sq), _NEG_INF,
+                            jnp.float32)  # (B, H, Sq)
+        row_sum0 = jnp.zeros_like(row_max0)
 
-        k_cur, v_cur = k, v
-        for step in range(n):
+        # The ring as a fori_loop: K/V ride the carry and hop one ICI
+        # neighbor per iteration, so program size and compile time are
+        # O(1) in the axis size (a Python-unrolled ring is O(n) — fine at
+        # n=8, hostile at a v5p-256's n). One extra final permute returns
+        # K/V to their owners; XLA overlaps it with the epilogue.
+        def body(step, carry):
+            k_cur, v_cur, acc, row_max, row_sum = carry
             blk = (me - step) % n
             kpos = blk * sq + jnp.arange(sq)
-            out, blk_sum, blk_max = _block_attn(q, k_cur, v_cur, qpos, kpos,
-                                                causal)
+            out, blk_sum, blk_max = _block_attn(q, k_cur, v_cur, qpos,
+                                                kpos, causal)
             new_max = jnp.maximum(row_max, blk_max)
             scale_old = jnp.exp(row_max - new_max)
             scale_new = jnp.exp(blk_max - new_max)
             row_sum = row_sum * scale_old + blk_sum * scale_new
             acc = (acc * jnp.moveaxis(scale_old, 1, -1)[..., None]
                    + out * jnp.moveaxis(scale_new, 1, -1)[..., None])
-            row_max = new_max
-            if step < n - 1:
-                k_cur = lax.ppermute(k_cur, axis, fwd)
-                v_cur = lax.ppermute(v_cur, axis, fwd)
+            k_cur = lax.ppermute(k_cur, axis, fwd)
+            v_cur = lax.ppermute(v_cur, axis, fwd)
+            return (k_cur, v_cur, acc, new_max, row_sum)
+
+        _, _, acc, _, row_sum = lax.fori_loop(
+            0, n, body, (k, v, acc0, row_max0, row_sum0))
 
         denom = jnp.moveaxis(row_sum, 1, -1)[..., None]
         return (acc / jnp.maximum(denom, 1e-20)).astype(q.dtype)

@@ -39,6 +39,23 @@ def test_ring_attention_4way_axis():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_ring_program_size_constant_in_axis():
+    """The ring is a fori_loop, not a Python unroll: the lowered program
+    must carry ONE collective-permute pair regardless of axis size, so a
+    v5p-256-sized axis compiles in the same bounded time as n=4
+    (VERDICT r1 weak-item 3)."""
+    sizes = {}
+    for n in (4, 8):
+        mesh = make_mesh(("data", "model"), axis_sizes=(8 // n, n))
+        q, k, v = _qkv(s=64 if n == 8 else 32)
+        txt = ring_attention(mesh, "model").lower(q, k, v).as_text()
+        sizes[n] = (txt.count("collective_permute"), len(txt))
+    # one logical permute pair (k and v), not n-1 of them
+    assert sizes[4][0] == sizes[8][0] <= 4
+    # program text grows marginally (shape literals), not linearly
+    assert sizes[8][1] < sizes[4][1] * 1.5
+
+
 def test_ring_attention_bf16():
     mesh = make_mesh(("data", "model"), axis_sizes=(1, 8))
     q, k, v = _qkv(dtype=jnp.bfloat16)
