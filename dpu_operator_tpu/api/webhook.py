@@ -81,12 +81,25 @@ def validate_tpu_operator_config(obj: dict) -> None:
             if not nf_ipam.get("subnet"):
                 raise ValidationError("host-local nfIpam requires 'subnet'")
             try:
-                ipaddress.ip_network(nf_ipam["subnet"], strict=False)
+                net = ipaddress.ip_network(nf_ipam["subnet"], strict=False)
+                bounds = {}
                 for bound in ("rangeStart", "rangeEnd", "gateway"):
                     if nf_ipam.get(bound):
-                        ipaddress.ip_address(nf_ipam[bound])
+                        bounds[bound] = ipaddress.ip_address(nf_ipam[bound])
             except ValueError as e:
                 raise ValidationError(f"invalid nfIpam: {e}") from e
+            # Containment + ordering: a reversed or out-of-subnet range
+            # passes parsing but makes every pod ADD fail at runtime with
+            # "range exhausted" — reject it at admission instead.
+            for bound, ip in bounds.items():
+                if ip not in net:
+                    raise ValidationError(
+                        f"invalid nfIpam: {bound} {ip} not in subnet {net}")
+            if ("rangeStart" in bounds and "rangeEnd" in bounds
+                    and bounds["rangeStart"] > bounds["rangeEnd"]):
+                raise ValidationError(
+                    "invalid nfIpam: rangeStart "
+                    f"{bounds['rangeStart']} > rangeEnd {bounds['rangeEnd']}")
         if kind == "static":
             addrs = nf_ipam.get("addresses")
             if not addrs or not isinstance(addrs, list):

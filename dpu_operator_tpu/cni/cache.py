@@ -42,18 +42,26 @@ class NetConfCache:
     def load_any(self, sandbox_id: str) -> Optional[dict]:
         """Any cached entry for the sandbox (full-teardown DELs don't name
         an ifname but still need the ADD-time config)."""
+        return next(iter(self.load_all(sandbox_id)), None)
+
+    def load_all(self, sandbox_id: str) -> list:
+        """Every cached entry for the sandbox. A sandbox attached via
+        multiple networks/NADs has one entry per ifname, each possibly
+        carrying a different ipam/network — full teardown must release
+        all of them, not just the first (advisor round-2 finding)."""
+        out = []
         try:
             entries = sorted(os.listdir(self.cache_dir))
         except OSError:
-            return None
+            return out
         for fn in entries:
             if fn.startswith(f"{sandbox_id}-") and fn.endswith(".json"):
                 try:
                     with open(os.path.join(self.cache_dir, fn)) as f:
-                        return json.load(f)
+                        out.append(json.load(f))
                 except (OSError, json.JSONDecodeError):
                     continue
-        return None
+        return out
 
     def delete_sandbox(self, sandbox_id: str):
         try:

@@ -12,6 +12,8 @@ restarts like upstream host-local's ``/var/lib/cni/networks/<name>/`` dir.
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import ipaddress
 import json
 import os
@@ -64,12 +66,32 @@ class HostLocalIpam:
                 continue
             yield ip, net
 
+    @contextlib.contextmanager
+    def _net_lock(self, net_dir: str):
+        """Per-network flock serializing add(): the scan-then-O_EXCL-create
+        idempotency check is not atomic on its own, so two concurrent ADDs
+        for the same sandbox+ifname (overlapping kubelet retries) could each
+        miss the owner scan and claim two different IPs, leaking one."""
+        fd = os.open(os.path.join(net_dir, ".lock"),
+                     os.O_CREAT | os.O_WRONLY, 0o600)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
     def add(self, cfg: dict, network: str, sandbox: str,
             ifname: str) -> dict:
         if not cfg.get("subnet"):
             raise IpamError("host-local IPAM requires 'subnet'")
         net_dir = self._net_dir(network)
         os.makedirs(net_dir, exist_ok=True)
+        with self._net_lock(net_dir):
+            return self._add_locked(cfg, net_dir, sandbox, ifname)
+
+    def _add_locked(self, cfg: dict, net_dir: str, sandbox: str,
+                    ifname: str) -> dict:
         owner = f"{sandbox} {ifname}"
         # idempotent retry: the same sandbox+ifname keeps its address
         for fn in sorted(os.listdir(net_dir)):
@@ -105,8 +127,19 @@ class HostLocalIpam:
     def delete(self, cfg: dict, network: str, sandbox: str,
                ifname: Optional[str] = None):
         """Release this sandbox's address for *ifname*; with ifname None,
-        release every address the sandbox holds (full sandbox teardown)."""
+        release every address the sandbox holds (full sandbox teardown).
+
+        Takes the same per-network lock as add(): a teardown DEL racing a
+        slow retried ADD would otherwise listdir before the ADD's O_EXCL
+        create lands, miss the new file, and leak that IP forever."""
         net_dir = self._net_dir(network)
+        if not os.path.isdir(net_dir):
+            return
+        with self._net_lock(net_dir):
+            self._delete_locked(net_dir, sandbox, ifname)
+
+    def _delete_locked(self, net_dir: str, sandbox: str,
+                       ifname: Optional[str]):
         owner = f"{sandbox} {ifname}" if ifname else None
         try:
             entries = os.listdir(net_dir)

@@ -10,6 +10,7 @@ embedded controller manager with the SFC reconciler (:176-254).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -307,14 +308,31 @@ class TpuSideManager:
         # (NAD updated while the pod ran); per-interface DEL frees this
         # ifname, full teardown frees every address the sandbox holds.
         per_if = attachment_id is not None
-        cached = (self.nf_cache.load(req.sandbox_id, req.ifname) if per_if
-                  else self.nf_cache.load_any(req.sandbox_id)) or {}
-        ipam_del(cached.get("ipam") or req.netconf.ipam, self.ipam_dir,
-                 cached.get("network") or req.netconf.name,
-                 req.sandbox_id, req.ifname if per_if else None)
         if per_if:
+            cached = self.nf_cache.load(req.sandbox_id, req.ifname) or {}
+            ipam_del(cached.get("ipam") or req.netconf.ipam, self.ipam_dir,
+                     cached.get("network") or req.netconf.name,
+                     req.sandbox_id, req.ifname)
             self.nf_cache.delete(req.sandbox_id, req.ifname)
         else:
+            # Full teardown: the sandbox may hold addresses under several
+            # networks/NADs (one cached entry per ifname, each with its own
+            # ipam + network) — release every (ipam, network) before the
+            # cache entries are destroyed, else the other networks'
+            # host-local allocations leak permanently.
+            cached_all = self.nf_cache.load_all(req.sandbox_id)
+            released = set()
+            for cached in cached_all:
+                key = (json.dumps(cached.get("ipam"), sort_keys=True),
+                       cached.get("network"))
+                if key in released:
+                    continue
+                released.add(key)
+                ipam_del(cached.get("ipam"), self.ipam_dir,
+                         cached.get("network"), req.sandbox_id, None)
+            if not cached_all:
+                ipam_del(req.netconf.ipam, self.ipam_dir, req.netconf.name,
+                         req.sandbox_id, None)
             self.nf_cache.delete_sandbox(req.sandbox_id)
         unwire = None
         with self._attach_lock:

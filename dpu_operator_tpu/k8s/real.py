@@ -70,13 +70,18 @@ class RealKube:
         # REQUESTS_CA_BUNDLE/CURL_CA_BUNDLE env vars would otherwise
         # override session.verify and break apiservers with private CAs.
         # trust_env=False also drops env proxy handling, so re-apply the
-        # proxy vars explicitly (client-go honors them).
+        # proxy vars explicitly (client-go honors them) — unless NO_PROXY
+        # excludes the apiserver host (client-go honors that too; forcing
+        # kubernetes.default.svc through a proxy breaks in-cluster traffic).
         self.session.trust_env = False
-        for scheme in ("http", "https"):
-            proxy = (os.environ.get(f"{scheme.upper()}_PROXY")
-                     or os.environ.get(f"{scheme}_proxy"))
-            if proxy:
-                self.session.proxies[scheme] = proxy
+        no_proxy = os.environ.get("NO_PROXY") or os.environ.get("no_proxy")
+        if not requests.utils.should_bypass_proxies(self.base,
+                                                    no_proxy=no_proxy):
+            for scheme in ("http", "https"):
+                proxy = (os.environ.get(f"{scheme.upper()}_PROXY")
+                         or os.environ.get(f"{scheme}_proxy"))
+                if proxy:
+                    self.session.proxies[scheme] = proxy
         ca = cluster.get("certificate-authority-data")
         if ca:
             f = tempfile.NamedTemporaryFile(delete=False, suffix=".crt")

@@ -140,7 +140,6 @@ def measure_flash_attention(b: int = 2, s: int = 2048, h: int = 8,
         out = flash_attention(q, k, v, causal=causal)
     float(jnp.sum(out))  # scalar fetch: see measure_train
     t0 = time.perf_counter()
-    acc = None
     for _ in range(iters):
         out = flash_attention(q, k, v, causal=causal)
     float(jnp.sum(out))

@@ -1,0 +1,144 @@
+"""Regression tests for round-2 ADVICE findings: NO_PROXY honored when
+re-applying proxies, multi-network full-teardown IPAM release, nfIpam range
+containment at admission, and concurrent host-local ADD atomicity."""
+
+import concurrent.futures
+import threading
+
+import yaml
+
+from dpu_operator_tpu.api.webhook import (ValidationError,
+                                          validate_tpu_operator_config)
+from dpu_operator_tpu.cni import NetConfCache
+from dpu_operator_tpu.cni.ipam import HostLocalIpam, ipam_add
+from dpu_operator_tpu.cni.types import NetConf, PodRequest
+from dpu_operator_tpu.daemon import TpuSideManager
+from dpu_operator_tpu.k8s.real import RealKube
+
+import pytest
+
+
+def _kubeconfig(tmp_path, server):
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump({
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx",
+                      "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server}}],
+        "users": [{"name": "u", "user": {"token": "t0ken"}}],
+    }))
+    return str(path)
+
+
+def test_no_proxy_excludes_apiserver(tmp_path, monkeypatch):
+    """ADVICE r2 #1 (medium): NO_PROXY-excluded apiserver traffic must not
+    be forced through HTTPS_PROXY after trust_env=False re-application."""
+    monkeypatch.setenv("HTTPS_PROXY", "http://proxy.corp:3128")
+    monkeypatch.setenv("HTTP_PROXY", "http://proxy.corp:3128")
+    monkeypatch.setenv("NO_PROXY", "kubernetes.default.svc,10.0.0.0/8")
+    kube = RealKube(_kubeconfig(tmp_path, "https://10.1.2.3:6443"))
+    assert not kube.session.proxies, (
+        "apiserver in NO_PROXY CIDR must bypass the proxy")
+    kube2 = RealKube(
+        _kubeconfig(tmp_path, "https://kubernetes.default.svc:443"))
+    assert not kube2.session.proxies
+
+
+def test_proxy_applied_when_not_excluded(tmp_path, monkeypatch):
+    monkeypatch.setenv("HTTPS_PROXY", "http://proxy.corp:3128")
+    monkeypatch.setenv("NO_PROXY", "10.0.0.0/8")
+    monkeypatch.delenv("HTTP_PROXY", raising=False)
+    kube = RealKube(_kubeconfig(tmp_path, "https://203.0.113.7:6443"))
+    assert kube.session.proxies.get("https") == "http://proxy.corp:3128"
+
+
+def _full_teardown_req(sandbox):
+    return PodRequest(command="DEL", pod_namespace="default", pod_name="nf",
+                      sandbox_id=sandbox, netns="/proc/1/ns/net",
+                      ifname="", device_id=None,
+                      netconf=NetConf(mode="network-function"))
+
+
+def _bare_manager(tmp_path):
+    mgr = TpuSideManager.__new__(TpuSideManager)
+    mgr.vsp = None
+    mgr.client = None
+    mgr._attach_store = {}
+    mgr._attach_lock = threading.Lock()
+    mgr._chain_store = {}
+    mgr._chain_hops = {}
+    mgr.ipam_dir = str(tmp_path / "ipam")
+    mgr.nf_cache = NetConfCache(str(tmp_path / "nf"))
+    return mgr
+
+
+def test_full_teardown_releases_every_networks_addresses(tmp_path):
+    """ADVICE r2 #2: a sandbox attached via two NADs (different ipam +
+    network per ifname) must release BOTH host-local allocations on full
+    teardown, not just the one load_any() happened to return."""
+    mgr = _bare_manager(tmp_path)
+    sbx = "sbx-multinet-1234"
+    ipam_a = {"type": "host-local", "subnet": "10.10.0.0/24"}
+    ipam_b = {"type": "host-local", "subnet": "10.20.0.0/24"}
+    ipam_add(ipam_a, mgr.ipam_dir, "net-a", sbx, "net1")
+    ipam_add(ipam_b, mgr.ipam_dir, "net-b", sbx, "net2")
+    mgr.nf_cache.save(sbx, "net1", {"ipam": ipam_a, "network": "net-a"})
+    mgr.nf_cache.save(sbx, "net2", {"ipam": ipam_b, "network": "net-b"})
+
+    mgr._cni_nf_del(_full_teardown_req(sbx))
+
+    alloc_a = HostLocalIpam(mgr.ipam_dir)
+    # both subnets hand out their first address again => nothing leaked
+    res_a = alloc_a.add(ipam_a, "net-a", "sbx-new", "net1")
+    res_b = alloc_a.add(ipam_b, "net-b", "sbx-new", "net1")
+    assert res_a["ips"][0]["address"] == "10.10.0.1/24"
+    assert res_b["ips"][0]["address"] == "10.20.0.1/24"
+
+
+def _cfg(nf_ipam):
+    return {"apiVersion": "config.tpu.google.com/v1",
+            "kind": "TpuOperatorConfig",
+            "metadata": {"name": "tpu-operator-config"},
+            "spec": {"mode": "auto", "nfIpam": nf_ipam}}
+
+
+def test_nf_ipam_range_containment_rejected_at_admission():
+    """ADVICE r2 #3: reversed or out-of-subnet ranges must fail admission,
+    not every subsequent pod ADD."""
+    with pytest.raises(ValidationError, match="not in subnet"):
+        validate_tpu_operator_config(_cfg(
+            {"type": "host-local", "subnet": "10.0.0.0/24",
+             "rangeStart": "10.9.0.5"}))
+    with pytest.raises(ValidationError, match="not in subnet"):
+        validate_tpu_operator_config(_cfg(
+            {"type": "host-local", "subnet": "10.0.0.0/24",
+             "gateway": "192.168.1.1"}))
+    with pytest.raises(ValidationError, match="rangeStart"):
+        validate_tpu_operator_config(_cfg(
+            {"type": "host-local", "subnet": "10.0.0.0/24",
+             "rangeStart": "10.0.0.50", "rangeEnd": "10.0.0.10"}))
+    # a well-formed range still passes
+    validate_tpu_operator_config(_cfg(
+        {"type": "host-local", "subnet": "10.0.0.0/24",
+         "rangeStart": "10.0.0.10", "rangeEnd": "10.0.0.50",
+         "gateway": "10.0.0.1"}))
+
+
+def test_concurrent_add_same_owner_single_ip(tmp_path):
+    """ADVICE r2 #4: two concurrent ADDs for the same sandbox+ifname
+    (overlapping kubelet retries) must converge on ONE address."""
+    ipam = HostLocalIpam(str(tmp_path))
+    cfg = {"type": "host-local", "subnet": "10.5.0.0/24"}
+    barrier = threading.Barrier(8)
+
+    def one_add(_):
+        barrier.wait()
+        return ipam.add(cfg, "net", "sbx-retry", "net1")["ips"][0]["address"]
+
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        got = list(ex.map(one_add, range(8)))
+    assert len(set(got)) == 1, f"concurrent retries claimed {set(got)}"
+    # and exactly one allocation record exists
+    import os
+    recs = [f for f in os.listdir(tmp_path / "net") if f != ".lock"]
+    assert len(recs) == 1
