@@ -29,6 +29,11 @@ class NetConf:
     resource_name: str = ""
     topology: str = ""
     device_id: str = ""             # from runtimeConfig / CNI_ARGS deviceID
+    #: ICI port ids the device plugin allocated to this pod (runtime passes
+    #: them alongside deviceID the way multus forwards podresources ids);
+    #: chain steering wires hops over these instead of inferring from the
+    #: slice topology
+    ici_ports: list = field(default_factory=list)
     log_level: str = "info"         # per-invocation logging (cnitypes.go:133)
     log_file: str = ""
     ipam: dict = field(default_factory=dict)
@@ -43,6 +48,7 @@ class NetConf:
             resource_name=d.get("resourceName", ""),
             topology=d.get("topology", ""),
             device_id=d.get("deviceID", ""),
+            ici_ports=list(d.get("iciPorts") or []),
             log_level=d.get("logLevel", "info"),
             log_file=d.get("logFile", ""),
             ipam=d.get("ipam", {}) or {},
@@ -57,6 +63,7 @@ class NetConf:
             "resourceName": self.resource_name,
             "topology": self.topology,
             "deviceID": self.device_id,
+            "iciPorts": list(self.ici_ports),
             "logLevel": self.log_level,
             "logFile": self.log_file,
             "ipam": self.ipam,

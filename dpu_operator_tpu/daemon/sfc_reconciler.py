@@ -59,8 +59,13 @@ class SfcReconciler:
                     "image": nf.image or self.workload_image,
                     "securityContext": {"privileged": True},
                     "resources": {
-                        "requests": {v.TPU_RESOURCE_NAME: "2"},
-                        "limits": {v.TPU_RESOURCE_NAME: "2"},
+                        # 2 chips (sfc.go:53-60 parity) + 2 ICI ports: the
+                        # chain hop into/out of this NF is steered over
+                        # scheduler-allocated ports, not topology inference
+                        "requests": {v.TPU_RESOURCE_NAME: "2",
+                                     v.ICI_RESOURCE_NAME: "2"},
+                        "limits": {v.TPU_RESOURCE_NAME: "2",
+                                   v.ICI_RESOURCE_NAME: "2"},
                     },
                 }],
             },

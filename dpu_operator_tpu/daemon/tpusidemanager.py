@@ -169,9 +169,14 @@ class TpuSideManager:
         with self._attach_lock:
             entry = self._attach_store.setdefault(
                 req.sandbox_id, {"atts": [], "wired": False,
-                                 "wiring": False})
+                                 "wiring": False, "ici_ports": []})
             if attachment_id not in entry["atts"]:
                 entry["atts"].append(attachment_id)
+            # scheduler-allocated ICI ports (device plugin Allocate →
+            # runtime → NetConf); arrival-order dedup — [ingress, egress]
+            for p in req.netconf.ici_ports:
+                if p not in entry["ici_ports"]:
+                    entry["ici_ports"].append(p)
             if (len(entry["atts"]) >= 2 and not entry["wired"]
                     and not entry["wiring"]):
                 entry["wiring"] = True  # claim the wire; VSP call is slow
@@ -221,6 +226,19 @@ class TpuSideManager:
         return result
 
     # -- SFC chain steering ---------------------------------------------------
+    @staticmethod
+    def _hop_ids(upstream: dict, downstream: dict) -> tuple:
+        """Endpoint ids for the hop between consecutive NFs: the upstream
+        NF's EGRESS ici-port to the downstream NF's INGRESS ici-port when
+        the scheduler allocated ports (google.com/ici-port — VERDICT r2
+        #2: steer over allocations, not topology inference); attachment
+        ids otherwise (ports are optional for plain NF pods)."""
+        up_ports = upstream.get("ports") or []
+        down_ports = downstream.get("ports") or []
+        out_id = up_ports[-1] if up_ports else upstream["out"]
+        in_id = down_ports[0] if down_ports else downstream["in"]
+        return (out_id, in_id)
+
     def _update_chain(self, req: PodRequest, pair: tuple):
         """After a pod's own NF is wired, steer the chain: wire this NF's
         egress to the next NF's ingress (and previous egress to this
@@ -251,12 +269,13 @@ class TpuSideManager:
                 return
             chain = self._chain_store.setdefault(key, {})
             chain[index] = {"in": pair[0], "out": pair[1],
-                            "sandbox": req.sandbox_id}
+                            "sandbox": req.sandbox_id,
+                            "ports": list(entry.get("ici_ports") or [])}
             for i in (index - 1, index):
                 hop_key = key + (i,)
                 if (i in chain and i + 1 in chain
                         and hop_key not in self._chain_hops):
-                    ids = (chain[i]["out"], chain[i + 1]["in"])
+                    ids = self._hop_ids(chain[i], chain[i + 1])
                     self._chain_hops[hop_key] = ids
                     to_wire.append((hop_key, ids))
         for hop_key, ids in to_wire:

@@ -33,6 +33,7 @@ MSG_UNWIRE_NF = 6
 MSG_LINK_STATE = 7
 MSG_SHUTDOWN = 8
 MSG_SET_LINK = 9
+MSG_LIST_WIRES = 10
 MSG_RESP = 0x80
 
 ST_OK = 0
@@ -50,6 +51,7 @@ _LINK_REQ = struct.Struct("<I")
 _SET_LINK_REQ = struct.Struct("<I4sB3x")
 _PORT_STATE = struct.Struct("<4sBBH")
 _LINK_RESP_HEAD = struct.Struct("<iI")
+_WIRE_LIST_HEAD = struct.Struct("<iI")
 
 MAX_PORTS = 8
 
@@ -176,6 +178,21 @@ class AgentClient:
             ports.append({"port": _cstr(name), "up": bool(up),
                           "wired": bool(wired)})
         return ports
+
+    def list_wires(self) -> list[tuple[str, str]]:
+        """Programmed SFC hops as (input, output) endpoint-id pairs — the
+        observability view e2e tests assert allocated ICI ports against."""
+        data = self._call(MSG_LIST_WIRES, b"")
+        status, count = _WIRE_LIST_HEAD.unpack(data[:_WIRE_LIST_HEAD.size])
+        if status != ST_OK:
+            raise AgentError(status)
+        wires = []
+        off = _WIRE_LIST_HEAD.size
+        for _ in range(count):
+            raw_in, raw_out = _WIRE_REQ.unpack(data[off:off + _WIRE_REQ.size])
+            off += _WIRE_REQ.size
+            wires.append((_cstr(raw_in), _cstr(raw_out)))
+        return wires
 
     def set_link(self, chip: int, port: str, up: bool):
         """Fault injection: force a port down (or restore it)."""

@@ -42,7 +42,9 @@ class TransformerConfig:
     max_seq: int = 128
     dtype: Any = jnp.bfloat16
     sequence_parallel: bool = True
-    #: "standard" = tp-sharded full attention; "ring" = long-context mode —
+    #: "standard" = tp-sharded full attention; "flash" = same sharding but
+    #: the Pallas flash kernel fwd+bwd (no (S,S) matrix in HBM — the
+    #: training hot path on real chips); "ring" = long-context mode —
     #: params replicated, sequence sharded over "model", attention rotates
     #: KV blocks around the ICI ring (ring_attention.py)
     attention: str = "standard"
@@ -109,6 +111,26 @@ def _ring_attn(mesh: Mesh):
     return ring_attention(mesh, "model", causal=True)
 
 
+@functools.lru_cache(maxsize=8)
+def _flash_attn(mesh: Mesh | None):
+    """Differentiable flash attention, head-sharded over "model" when a
+    mesh is present (heads are independent, so tp shards partition the
+    kernel grid; Pallas calls need shard_map — XLA cannot auto-partition
+    them)."""
+    from ..ops.flash_attention import flash_attention_vjp
+
+    def call(q, k, v):
+        return flash_attention_vjp(q, k, v, True)
+
+    if mesh is None:
+        return call
+    spec = P("data", None, "model", None)
+    # check_vma=False: pallas_call's ShapeDtypeStruct outputs carry no vma
+    # annotation, which the default varying-mesh-axes check rejects
+    return jax.shard_map(call, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
+
+
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale
@@ -150,6 +172,8 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         q, k, v = heads(q), heads(k), heads(v)
         if cfg.attention == "ring" and mesh is not None:
             o = _ring_attn(mesh)(q, k, v).reshape(B, S, cfg.d_model)
+        elif cfg.attention == "flash":
+            o = _flash_attn(mesh)(q, k, v).reshape(B, S, cfg.d_model)
         else:
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(cfg.d_head)
             att = jnp.where(mask, att, -1e9)

@@ -262,6 +262,21 @@ bool Handle(Agent& agent, int fd, const Header& h,
       }
       return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
     }
+    case MSG_LIST_WIRES: {
+      const auto& wires = agent.db.wires();
+      WireListResp resp{ST_OK, static_cast<uint32_t>(wires.size())};
+      std::vector<char> out(sizeof(resp) + wires.size() * sizeof(WireReq));
+      memcpy(out.data(), &resp, sizeof(resp));
+      size_t i = 0;
+      for (const auto& w : wires) {
+        WireReq e{};
+        snprintf(e.input, sizeof(e.input), "%s", w.first.c_str());
+        snprintf(e.output, sizeof(e.output), "%s", w.second.c_str());
+        memcpy(out.data() + sizeof(resp) + i++ * sizeof(e), &e, sizeof(e));
+      }
+      return SendResp(fd, h.type, h.seq, out.data(),
+                      static_cast<uint32_t>(out.size()));
+    }
     case MSG_SHUTDOWN: {
       StatusResp resp{};
       FillStatus(&resp, ST_OK, "");

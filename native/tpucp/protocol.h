@@ -28,6 +28,7 @@ enum MsgType : uint16_t {
   MSG_LINK_STATE = 7, // per-port link state for one chip
   MSG_SHUTDOWN = 8,
   MSG_SET_LINK = 9,   // fault injection: force a port down (or back up)
+  MSG_LIST_WIRES = 10,  // enumerate programmed SFC hops
   MSG_RESP = 0x80,    // response bit: resp type = req type | MSG_RESP
 };
 
@@ -116,6 +117,11 @@ struct LinkStateResp {
   int32_t status;
   uint32_t nports;
   PortState ports[kMaxPorts];
+};
+
+struct WireListResp {
+  int32_t status;
+  uint32_t count;  // followed by count WireReq-shaped (input, output) pairs
 };
 
 #pragma pack(pop)

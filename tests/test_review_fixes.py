@@ -77,6 +77,7 @@ class _Req:
             cni_version = "0.4.0"
             name = ""
             ipam = {}
+            ici_ports = []
         self.netconf = _NC()
 
 

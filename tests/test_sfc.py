@@ -53,7 +53,8 @@ def test_sfc_resource_exhaustion_then_unblock(kube, node_agent, manager):
     """4 chips, 2 per NF: third NF stays Pending until an SFC is deleted
     (e2e_test.go:525-593)."""
     node_agent.register_node("tpu-vm-0", labels={"tpu": "true"},
-                             allocatable={"google.com/tpu": "4"})
+                             allocatable={"google.com/tpu": "4",
+                                          "google.com/ici-port": "8"})
     for i in range(3):
         kube.create(_sfc(name=f"sfc-{i}",
                          nfs=[NetworkFunction("nf", f"img-{i}")]))
