@@ -138,11 +138,14 @@ def _fwd_with_lse(qr, kr, vr, causal, block_q, block_k, sm_scale, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda b, qi: (b, qi)),
+            # lse rides as (bh, s, 1): TPU blocks need the last two dims
+            # (8, 128)-aligned or equal to the array dims, so a trailing
+            # unit lane dim makes the (block_q, 1) row-stat block legal
+            pl.BlockSpec((None, block_q, 1), lambda b, qi: (b, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), qr.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
@@ -276,12 +279,13 @@ def _vjp_bwd(causal, block_q, block_k, _interpret, res, g):
     block_k = min(block_k, s)
     sm_scale = 1.0 / np.sqrt(d)
     do = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    # delta_i = rowsum(dO_i * O_i) — the softmax-normalization term
+    # delta_i = rowsum(dO_i * O_i) — the softmax-normalization term;
+    # trailing unit dim for the same TPU block-alignment reason as lse
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)
+                    axis=-1, keepdims=True)
     bh = b * h
     qkv_spec = pl.BlockSpec((None, s, d), lambda bb, i: (bb, 0, 0))
-    row_spec = pl.BlockSpec((None, s), lambda bb, i: (bb, 0))
+    row_spec = pl.BlockSpec((None, s, 1), lambda bb, i: (bb, 0, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
                           sm_scale=sm_scale),
@@ -290,8 +294,8 @@ def _vjp_bwd(causal, block_q, block_k, _interpret, res, g):
             pl.BlockSpec((None, block_q, d), lambda bb, qi: (bb, qi, 0)),
             qkv_spec, qkv_spec,
             pl.BlockSpec((None, block_q, d), lambda bb, qi: (bb, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bb, qi: (bb, qi)),
-            pl.BlockSpec((None, block_q), lambda bb, qi: (bb, qi)),
+            pl.BlockSpec((None, block_q, 1), lambda bb, qi: (bb, qi, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bb, qi: (bb, qi, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda bb, qi: (bb, qi, 0)),
