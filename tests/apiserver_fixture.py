@@ -84,6 +84,104 @@ def _status(code: int, reason: str, message: str) -> dict:
             "reason": reason, "message": message}
 
 
+class AdmissionDenied(Exception):
+    """A webhook (or its failurePolicy) rejected the request."""
+
+    def __init__(self, message: str, code: int = 403):
+        super().__init__(message)
+        self.code = code
+
+
+def _apply_json_patch(obj: dict, patches: list) -> dict:
+    """Minimal RFC-6902 applier (add/replace/remove, ~0/~1 escapes, list
+    append via '-') — what the apiserver does with a mutating webhook's
+    JSONPatch response."""
+    import copy
+
+    obj = copy.deepcopy(obj)
+    for patch in patches:
+        op, path = patch["op"], patch["path"]
+        tokens = [t.replace("~1", "/").replace("~0", "~")
+                  for t in path.lstrip("/").split("/")]
+        parent = obj
+        for tok in tokens[:-1]:
+            parent = parent[int(tok)] if isinstance(parent, list) else parent[tok]
+        last = tokens[-1]
+        if isinstance(parent, list):
+            if op == "add":
+                idx = len(parent) if last == "-" else int(last)
+                parent.insert(idx, patch["value"])
+            elif op == "replace":
+                parent[int(last)] = patch["value"]
+            elif op == "remove":
+                del parent[int(last)]
+            else:
+                raise ValueError(f"unsupported patch op {op!r}")
+        else:
+            if op in ("add", "replace"):
+                parent[last] = patch["value"]
+            elif op == "remove":
+                del parent[last]
+            else:
+                raise ValueError(f"unsupported patch op {op!r}")
+    return obj
+
+
+def _rule_matches(rule: dict, group: str, version: str, resource: str,
+                  op: str) -> bool:
+    def _in(values, x):
+        return "*" in (values or []) or x in (values or [])
+
+    return (_in(rule.get("apiGroups"), group)
+            and _in(rule.get("apiVersions"), version)
+            and _in(rule.get("resources"), resource)
+            and _in(rule.get("operations"), op))
+
+
+def _resolve_client_config(kube: FakeKube, cc: dict) -> tuple[str, str]:
+    """clientConfig -> (url, caBundle-b64). Service refs resolve through the
+    store's Endpoints the way kube-proxy would route the Service."""
+    if cc.get("url"):
+        return cc["url"], cc.get("caBundle", "")
+    svc = cc.get("service") or {}
+    ep = kube.get("v1", "Endpoints", svc.get("name", ""),
+                  namespace=svc.get("namespace"))
+    if ep is None:
+        raise ConnectionError(
+            f"no Endpoints for webhook service "
+            f"{svc.get('namespace')}/{svc.get('name')}")
+    subset = (ep.get("subsets") or [{}])[0]
+    addr = (subset.get("addresses") or [{}])[0].get("ip")
+    # Endpoints ports are the RESOLVED backend (targetPort) ports — the
+    # Service-level clientConfig port (usually 443) is only a fallback when
+    # the Endpoints entry carries none, mirroring kube-proxy's routing.
+    port = ((subset.get("ports") or [{}])[0].get("port")
+            or svc.get("port") or 443)
+    if not addr:
+        raise ConnectionError("webhook Endpoints has no addresses")
+    return (f"https://{addr}:{port}{svc.get('path', '/')}",
+            cc.get("caBundle", ""))
+
+
+def _call_webhook(url: str, ca_bundle_b64: str, review: dict,
+                  timeout: float) -> dict:
+    import urllib.request
+
+    if ca_bundle_b64:
+        ctx = ssl.create_default_context(
+            cadata=base64.b64decode(ca_bundle_b64).decode())
+        ctx.check_hostname = False  # IP SANs; verification is via the CA
+    else:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    req = urllib.request.Request(
+        url, data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, context=ctx, timeout=timeout) as r:
+        return json.loads(r.read() or b"{}")
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "MiniApiServer/1.0"
@@ -148,6 +246,98 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
+    # -- admission chain (webhook invocation over the wire) ------------------
+    def _run_admission(self, obj: dict, operation: str) -> dict:
+        """Invoke registered Mutating- then ValidatingWebhookConfigurations
+        whose rules match, over real HTTPS with AdmissionReview JSON —
+        what the reference's envtest apiserver does for its webhook suite
+        (api/v1/webhook_suite_test.go). Returns the (possibly mutated)
+        object; raises AdmissionDenied on rejection or Fail-policy errors.
+
+        For operation DELETE, *obj* is the existing object: the review
+        carries it as oldObject with object null, and patches are ignored
+        (nothing to mutate), matching apiserver semantics.
+        """
+        import uuid
+
+        api_version = obj.get("apiVersion", "v1")
+        group, _, version = api_version.rpartition("/")
+        resource = plural(obj.get("kind", ""))
+        md = obj.get("metadata") or {}
+        deleting = operation == "DELETE"
+
+        def review_for(current: dict) -> dict:
+            return {
+                "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+                "request": {
+                    "uid": str(uuid.uuid4()),
+                    "kind": {"group": group, "version": version,
+                             "kind": obj.get("kind", "")},
+                    "resource": {"group": group, "version": version,
+                                 "resource": resource},
+                    "name": md.get("name", ""),
+                    "namespace": md.get("namespace", ""),
+                    "operation": operation,
+                    "object": None if deleting else current,
+                    "oldObject": current if deleting else None,
+                },
+            }
+
+        for config_kind, mutating in (("MutatingWebhookConfiguration", True),
+                                      ("ValidatingWebhookConfiguration",
+                                       False)):
+            configs = sorted(
+                self.kube.list("admissionregistration.k8s.io/v1",
+                               config_kind),
+                key=lambda o: o["metadata"]["name"])
+            for cfg in configs:
+                for wh in cfg.get("webhooks") or []:
+                    if not any(_rule_matches(r, group, version, resource,
+                                             operation)
+                               for r in wh.get("rules") or []):
+                        continue
+                    ignore = wh.get("failurePolicy", "Fail") == "Ignore"
+                    name = wh.get("name", "?")
+                    try:
+                        url, ca = _resolve_client_config(
+                            self.kube, wh.get("clientConfig") or {})
+                        resp = _call_webhook(
+                            url, ca, review_for(obj),
+                            timeout=wh.get("timeoutSeconds", 10))
+                        r = resp.get("response")
+                        if not isinstance(r, dict) or "allowed" not in r:
+                            raise ValueError(
+                                "malformed AdmissionReview response")
+                    except Exception as e:  # noqa: BLE001 — policy decides
+                        if ignore:
+                            continue
+                        raise AdmissionDenied(
+                            f"calling webhook {name!r}: {e}",
+                            code=500) from e
+                    if not r["allowed"]:
+                        msg = ((r.get("status") or {}).get("message")
+                               or "denied the request")
+                        raise AdmissionDenied(
+                            f"admission webhook {name!r} "
+                            f"denied the request: {msg}")
+                    if mutating and r.get("patch") and not deleting:
+                        # a malformed patch is a webhook FAILURE (policy
+                        # applies), not a denial
+                        try:
+                            if r.get("patchType") != "JSONPatch":
+                                raise ValueError(
+                                    f"unsupported patchType "
+                                    f"{r.get('patchType')!r}")
+                            patches = json.loads(base64.b64decode(r["patch"]))
+                            obj = _apply_json_patch(obj, patches)
+                        except Exception as e:  # noqa: BLE001
+                            if ignore:
+                                continue
+                            raise AdmissionDenied(
+                                f"webhook {name!r} patch failed: {e}",
+                                code=500) from e
+        return obj
+
     # -- verbs ---------------------------------------------------------------
     def do_GET(self):  # noqa: N802
         if not self._authed():
@@ -181,6 +371,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self._parse() is None:
             return
         try:
+            obj = self._run_admission(obj, "CREATE")
+        except AdmissionDenied as e:
+            self._send(e.code, _status(
+                e.code, "Forbidden" if e.code == 403 else "InternalError",
+                str(e)))
+            return
+        try:
             self._send(201, self.kube.create(obj))
         except AlreadyExists as e:
             self._send(409, _status(409, "AlreadyExists", str(e)))
@@ -193,6 +390,15 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed is None:
             return
         _, _, _, _, subresource, _ = parsed
+        if subresource is None:
+            try:
+                obj = self._run_admission(obj, "UPDATE")
+            except AdmissionDenied as e:
+                self._send(e.code, _status(
+                    e.code,
+                    "Forbidden" if e.code == 403 else "InternalError",
+                    str(e)))
+                return
         try:
             if subresource == "status":
                 self._send(200, self.kube.update_status(obj))
@@ -207,11 +413,27 @@ class _Handler(BaseHTTPRequestHandler):
         obj = self._read_body()
         if not self._authed():
             return
-        if self._parse() is None:
+        parsed = self._parse()
+        if parsed is None:
             return
+        api_version, kind, namespace, name, _, _ = parsed
         ctype = self.headers.get("Content-Type", "")
         if "apply-patch" not in ctype:
             self._send(415, _status(415, "UnsupportedMediaType", ctype))
+            return
+        # server-side apply is CREATE-or-UPDATE; webhooks fire on the apply
+        # intent (our apply bodies are full manifests, so the admitted
+        # object is what gets merged — fixture-grade approximation of the
+        # real apiserver admitting the merged result)
+        existing = self.kube.get(api_version, kind, name,
+                                 namespace=namespace)
+        try:
+            obj = self._run_admission(
+                obj, "UPDATE" if existing is not None else "CREATE")
+        except AdmissionDenied as e:
+            self._send(e.code, _status(
+                e.code, "Forbidden" if e.code == 403 else "InternalError",
+                str(e)))
             return
         try:
             self._send(200, self.kube.apply(obj))
@@ -228,10 +450,19 @@ class _Handler(BaseHTTPRequestHandler):
         if name is None:
             self._send(405, _status(405, "MethodNotAllowed", "collection"))
             return
-        existed = self.kube.get(api_version, kind, name,
-                                namespace=namespace) is not None
+        existing = self.kube.get(api_version, kind, name,
+                                 namespace=namespace)
+        if existing is not None:
+            try:
+                self._run_admission(existing, "DELETE")
+            except AdmissionDenied as e:
+                self._send(e.code, _status(
+                    e.code,
+                    "Forbidden" if e.code == 403 else "InternalError",
+                    str(e)))
+                return
         self.kube.delete(api_version, kind, name, namespace=namespace)
-        if existed:
+        if existing is not None:
             self._send(200, _status(200, "Success", name))
         else:
             self._send(404, _status(404, "NotFound", name))
