@@ -137,6 +137,46 @@ class TpuSideManager:
     def bound_port(self) -> Optional[int]:
         return self._slice_server.bound_port if self._slice_server else None
 
+    # -- disruptive reconfiguration -------------------------------------------
+    def resize_chips(self, count: int, node_name: str = "") -> list:
+        """Change the advertised chip count; shrinking DRAINS first.
+
+        Chips vanishing from allocatable strand any pod still consuming
+        them, so a shrink cordons the node, evicts chip-consuming pods,
+        applies SetNumChips, and uncordons — the drain the reference left
+        as a TODO before SetNumVfs (dpudevicehandler.go:78-83; facade
+        parity pkgs/drain/drain.go:19-43). Growth is non-disruptive and
+        skips the drain. Returns evicted pod names. The device plugin's
+        ListAndWatch poll pushes the shrunken set to the kubelet."""
+        node_name = node_name or os.environ.get("NODE_NAME", "")
+        current = len(self.device_handler.get_devices())
+        shrink = count < current
+        drainer = None
+        evicted: list = []
+        if shrink and self.client is not None and node_name:
+            from ..utils.drain import Drainer
+            drainer = Drainer(self.client)
+        elif shrink:
+            log.warning(
+                "resize_chips %d->%d: shrinking WITHOUT drain (no kube "
+                "client or node name) — chip-consuming pods are stranded",
+                current, count)
+        try:
+            if drainer is not None:
+                evicted = drainer.drain(node_name)
+                log.info("resize_chips %d->%d: drained %s", current, count,
+                         evicted)
+            self.vsp.set_num_chips(count)
+        finally:
+            if drainer is not None:
+                # never leave the node cordoned, even if eviction or the
+                # VSP call blew up mid-way
+                try:
+                    drainer.uncordon(node_name)
+                except Exception:  # noqa: BLE001 — best-effort restore
+                    log.exception("uncordon %s failed", node_name)
+        return evicted
+
     # -- CNI network-function handlers (dpusidemanager.go:104-139) ------------
     def _unwire_quietly(self, ids: tuple, context: str):
         """Defensive unwind: best-effort delete_network_function with the

@@ -39,6 +39,8 @@ class Platform(Protocol):
     def product_name(self) -> str: ...
     def accel_devices(self) -> list[str]: ...
     def accelerator_type(self) -> str: ...
+    def read_device_serial(self, address: str) -> str: ...
+    def device_alive(self, address: str) -> bool: ...
 
 
 class HardwarePlatform:
@@ -110,6 +112,47 @@ class HardwarePlatform:
         except OSError:
             return ""
 
+    #: PCIe Device Serial Number extended capability lives at 0x150 on the
+    #: supported endpoints (reference: platform.go:46-77 does the same raw
+    #: config-space read instead of walking the capability list)
+    DSN_OFFSET = 0x150
+
+    def read_device_serial(self, address: str) -> str:
+        """IEEE 64-bit serial from PCIe config space; "" when the device
+        has none (config space truncated for non-root readers) or reads
+        all-zeros/all-ones. Multi-function endpoints of one accelerator
+        share this serial — the dedup key (netsec-accelerator.go:36-54)."""
+        cfg = self._sys("bus/pci/devices", address, "config")
+        try:
+            with open(cfg, "rb") as f:
+                f.seek(self.DSN_OFFSET)
+                raw = f.read(12)
+        except OSError:
+            return ""
+        if len(raw) < 12:
+            return ""
+        # trust the payload only if the extended-capability header at the
+        # fixed offset really is DSN (cap id 0x0003) — other capability
+        # layouts would fabricate serials and mis-dedup distinct chips
+        cap_id = raw[0] | ((raw[1] & 0x0F) << 8)
+        if cap_id != 0x0003:
+            return ""
+        serial = raw[4:12]
+        if all(b == 0 for b in serial) or all(b == 0xFF for b in serial):
+            return ""
+        return "-".join(f"{b:02x}" for b in reversed(serial))
+
+    def device_alive(self, address: str) -> bool:
+        """Live-device probe: a surprise-removed or wedged PCIe endpoint
+        reads vendor id 0xffff from config space (or the file vanishes)."""
+        cfg = self._sys("bus/pci/devices", address, "config")
+        try:
+            with open(cfg, "rb") as f:
+                vendor = f.read(2)
+        except OSError:
+            return False
+        return len(vendor) == 2 and vendor != b"\xff\xff"
+
 
 class FakePlatform:
     """Injectable platform (reference: platform.go:79-129, mutex-guarded)."""
@@ -126,6 +169,7 @@ class FakePlatform:
         self._netdevs = list(netdevs or [])
         self._accel = list(accel or [])
         self._accel_type = accelerator_type
+        self._dead: set[str] = set()
 
     def pci_devices(self):
         with self._lock:
@@ -147,6 +191,17 @@ class FakePlatform:
         with self._lock:
             return self._accel_type
 
+    def read_device_serial(self, address):
+        with self._lock:
+            for dev in self._pci:
+                if dev.address == address:
+                    return dev.serial
+        return ""
+
+    def device_alive(self, address):
+        with self._lock:
+            return address not in self._dead
+
     # test mutators
     def set_accel_devices(self, devs):
         with self._lock:
@@ -155,3 +210,7 @@ class FakePlatform:
     def set_pci_devices(self, devs):
         with self._lock:
             self._pci = list(devs)
+
+    def set_device_alive(self, address, alive: bool):
+        with self._lock:
+            (self._dead.discard if alive else self._dead.add)(address)

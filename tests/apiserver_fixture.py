@@ -246,6 +246,11 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
+    def _send_denied(self, e: "AdmissionDenied"):
+        self._send(e.code, _status(
+            e.code, "Forbidden" if e.code == 403 else "InternalError",
+            str(e)))
+
     # -- admission chain (webhook invocation over the wire) ------------------
     def _run_admission(self, obj: dict, operation: str) -> dict:
         """Invoke registered Mutating- then ValidatingWebhookConfigurations
@@ -373,9 +378,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             obj = self._run_admission(obj, "CREATE")
         except AdmissionDenied as e:
-            self._send(e.code, _status(
-                e.code, "Forbidden" if e.code == 403 else "InternalError",
-                str(e)))
+            self._send_denied(e)
             return
         try:
             self._send(201, self.kube.create(obj))
@@ -394,10 +397,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 obj = self._run_admission(obj, "UPDATE")
             except AdmissionDenied as e:
-                self._send(e.code, _status(
-                    e.code,
-                    "Forbidden" if e.code == 403 else "InternalError",
-                    str(e)))
+                self._send_denied(e)
                 return
         try:
             if subresource == "status":
@@ -431,9 +431,7 @@ class _Handler(BaseHTTPRequestHandler):
             obj = self._run_admission(
                 obj, "UPDATE" if existing is not None else "CREATE")
         except AdmissionDenied as e:
-            self._send(e.code, _status(
-                e.code, "Forbidden" if e.code == 403 else "InternalError",
-                str(e)))
+            self._send_denied(e)
             return
         try:
             self._send(200, self.kube.apply(obj))
@@ -456,10 +454,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 self._run_admission(existing, "DELETE")
             except AdmissionDenied as e:
-                self._send(e.code, _status(
-                    e.code,
-                    "Forbidden" if e.code == 403 else "InternalError",
-                    str(e)))
+                self._send_denied(e)
                 return
         self.kube.delete(api_version, kind, name, namespace=namespace)
         if existing is not None:

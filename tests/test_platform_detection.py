@@ -75,3 +75,44 @@ def test_fake_detector_product_match():
     p = FakePlatform(product="tpu-sim v5e")
     res = DetectorManager([FakeVendorDetector()]).detect(p)
     assert res.tpu_mode and res.vendor == "fake-tpu"
+
+
+def test_hardware_platform_reads_dsn_serial(tmp_path):
+    """Config-space serial read at DSN_OFFSET (reference: platform.go:46-77
+    reads the PCIe Device Serial Number capability at 0x150)."""
+    from dpu_operator_tpu.platform.platform import HardwarePlatform
+
+    dev = tmp_path / "sys/bus/pci/devices/0000:5e:00.0"
+    dev.mkdir(parents=True)
+    cfg = bytearray(0x150)
+    cfg[0:2] = b"\xe0\x1a"  # vendor 0x1ae0, little-endian
+    cfg += b"\x03\x00\x01\x00"              # DSN capability header
+    cfg += bytes([0x77, 0x66, 0x55, 0x44, 0x33, 0x22, 0x11, 0x00])
+    (dev / "config").write_bytes(bytes(cfg))
+
+    hw = HardwarePlatform(root=str(tmp_path))
+    assert (hw.read_device_serial("0000:5e:00.0")
+            == "00-11-22-33-44-55-66-77")
+    assert hw.device_alive("0000:5e:00.0") is True
+
+
+def test_hardware_platform_serial_missing_and_dead_device(tmp_path):
+    from dpu_operator_tpu.platform.platform import HardwarePlatform
+
+    dev = tmp_path / "sys/bus/pci/devices/0000:5e:00.0"
+    dev.mkdir(parents=True)
+    # truncated config space (what non-root readers of some devices see)
+    (dev / "config").write_bytes(b"\xe0\x1a" + b"\x00" * 62)
+    hw = HardwarePlatform(root=str(tmp_path))
+    assert hw.read_device_serial("0000:5e:00.0") == ""
+    assert hw.device_alive("0000:5e:00.0") is True
+
+    # surprise-removed endpoint: vendor reads 0xffff
+    (dev / "config").write_bytes(b"\xff\xff" + b"\xff" * 62)
+    assert hw.device_alive("0000:5e:00.0") is False
+    # all-ones DSN region must not fabricate a serial
+    (dev / "config").write_bytes(b"\xff" * 0x160)
+    assert hw.read_device_serial("0000:5e:00.0") == ""
+
+    assert hw.device_alive("0000:missing") is False
+    assert hw.read_device_serial("0000:missing") == ""

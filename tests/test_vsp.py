@@ -144,6 +144,55 @@ def test_google_vsp_host_mode_devices():
     vsp.init({"tpu_mode": False})
     devs = vsp.get_devices({})["devices"]
     assert list(devs) == ["0000:00:04.0"]
+    assert devs["0000:00:04.0"]["healthy"] is True
+
+
+def test_host_mode_dual_function_dedups_by_serial():
+    """VERDICT r2 #4: a dual-function endpoint (one chip, two PCI
+    functions sharing a PCIe serial) must advertise as ONE schedulable
+    device (reference: netsec-accelerator.go:36-54)."""
+    from dpu_operator_tpu.platform import PciDevice
+    platform = FakePlatform(pci=[
+        PciDevice(address="0000:5e:00.0", vendor_id="1ae0",
+                  device_id="0062", serial="00-11-22-33-44-55-66-77"),
+        PciDevice(address="0000:5e:00.1", vendor_id="1ae0",
+                  device_id="0062", serial="00-11-22-33-44-55-66-77"),
+        PciDevice(address="0000:af:00.0", vendor_id="1ae0",
+                  device_id="0062", serial="aa-bb-cc-dd-ee-ff-00-11"),
+    ])
+    vsp = GoogleTpuVsp(platform)
+    vsp.init({"tpu_mode": False})
+    devs = vsp.get_devices({})["devices"]
+    assert set(devs) == {"0000:5e:00.0", "0000:af:00.0"}
+    first = devs["0000:5e:00.0"]
+    assert first["functions"] == ["0000:5e:00.0", "0000:5e:00.1"]
+    assert first["serial"] == "00-11-22-33-44-55-66-77"
+    # stable chip numbering is keyed by serial, not address
+    assert first["chip_index"] == 0
+    assert devs["0000:af:00.0"]["chip_index"] == 1
+
+
+def test_host_mode_failed_probe_surfaces_unhealthy():
+    """VERDICT r2 #4/#5: host-side health must come from a real probe —
+    a dead config-space read (surprise removal) flips the chip Unhealthy,
+    including when only a secondary function dies."""
+    from dpu_operator_tpu.platform import PciDevice
+    platform = FakePlatform(pci=[
+        PciDevice(address="0000:5e:00.0", vendor_id="1ae0",
+                  device_id="0062", serial="s-1"),
+        PciDevice(address="0000:5e:00.1", vendor_id="1ae0",
+                  device_id="0062", serial="s-1"),
+    ])
+    vsp = GoogleTpuVsp(platform)
+    vsp.init({"tpu_mode": False})
+    assert vsp.get_devices({})["devices"]["0000:5e:00.0"]["healthy"] is True
+
+    platform.set_device_alive("0000:5e:00.1", False)
+    assert vsp.get_devices({})["devices"]["0000:5e:00.0"]["healthy"] is False
+
+    platform.set_device_alive("0000:5e:00.1", True)
+    platform.set_device_alive("0000:5e:00.0", False)
+    assert vsp.get_devices({})["devices"]["0000:5e:00.0"]["healthy"] is False
 
 
 def test_google_vsp_slice_attachment_programs_dataplane():
