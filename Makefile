@@ -3,15 +3,15 @@ PYTHON ?= python
 REGISTRY ?= localhost:5000
 TAG ?= latest
 
-.PHONY: test fast-test bench native traffic-flow images deploy undeploy \
-        graft-check clean
+.PHONY: test fast-test bench native traffic-flow images smoke-images \
+        deploy undeploy graft-check clean
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
 
 # reference `fast-test`: skip the slow e2e tier
 fast-test: native
-	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_e2e.py
+	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_e2e.py -m "not slow"
 
 # flake detector (reference: ginkgo --repeat 4 in `task test`)
 test-repeat: native
@@ -28,6 +28,11 @@ graft-check:
 
 traffic-flow:
 	$(PYTHON) hack/traffic_flow_tests.py --cpu
+
+# docker-less image proof: lint COPY/entrypoint paths + run each image's
+# exact entrypoint from a clean venv (reference: taskfiles/images.yaml)
+smoke-images: native
+	$(PYTHON) hack/smoke_images.py
 
 # image matrix (reference: taskfiles/images.yaml, 9 images)
 IMAGES = operator daemon vsp cp-agent nri workload

@@ -1,0 +1,22 @@
+"""dpu_operator_tpu — a TPU-native Kubernetes operator framework.
+
+Re-provides, for Google TPUs, the capabilities of the OpenShift DPU operator
+(reference: Ximinhan/dpu-operator):
+
+- a cluster controller reconciling ``TpuOperatorConfig`` into per-node daemons
+  (reference: internal/controller/dpuoperatorconfig_controller.go:98)
+- a per-node daemon with hardware detection, vendor-plugin seam, kubelet device
+  plugin, and CNI server (reference: internal/daemon/daemon.go:58)
+- a vendor plugin API over gRPC/unix socket (reference: dpu-api/api.proto:7-54)
+  with a GoogleTpuVSP backend programming the ICI mesh instead of OVS/P4
+- a CNI path that mounts TPU devices + libtpu and writes topology env
+  (reference: dpu-cni/pkgs/sriov/sriov.go:359)
+- a service-function-chain reconciler creating JAX workload pods
+  (reference: internal/daemon/sfc-reconciler/sfc.go:114)
+- a JAX/pallas workload layer (models/, ops/, parallel/) that is what the
+  reference keeps *outside* its tree (OVS, P4 pipelines, traffic-flow tests):
+  the flagship long-context transformer and the collective benchmarks that
+  exercise the ICI topology the operator programs.
+"""
+
+__version__ = "0.1.0"

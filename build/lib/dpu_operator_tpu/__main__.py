@@ -1,0 +1,73 @@
+"""Operator entrypoint — the controller manager process.
+
+Reference: cmd/main.go:45-133 — controller-runtime manager with metrics
+:18090, health :18091, webhook :9443, leader election, and the two
+controllers registered. Here: Manager + TpuOperatorConfigReconciler + SFC
+cluster stub, a MetricsServer for /metrics+/healthz+/readyz, the admission
+WebhookServer, and a lease-based leader election against the apiserver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from .controller import (ServiceFunctionChainClusterReconciler,
+                         TpuOperatorConfigReconciler)
+from .images import EnvImageManager
+from .k8s.manager import Manager
+from .utils.metrics import MetricsServer
+
+log = logging.getLogger(__name__)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("tpu-operator")
+    parser.add_argument("--kubeconfig", default="")
+    parser.add_argument("--metrics-port", type=int, default=18090)
+    parser.add_argument("--webhook-port", type=int, default=9443)
+    parser.add_argument("--webhook-cert", default="")
+    parser.add_argument("--webhook-key", default="")
+    parser.add_argument("--leader-elect", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from .k8s.real import RealKube
+    client = RealKube(args.kubeconfig or None)
+
+    mgr = Manager(client)
+    mgr.add_reconciler(TpuOperatorConfigReconciler(EnvImageManager()))
+    mgr.add_reconciler(ServiceFunctionChainClusterReconciler())
+
+    started = threading.Event()
+    metrics_server = MetricsServer(port=args.metrics_port,
+                                   ready_check=started.is_set)
+    metrics_server.start()
+
+    from .webhook import WebhookServer
+    webhook = WebhookServer(client, host="0.0.0.0", port=args.webhook_port,
+                            certfile=args.webhook_cert,
+                            keyfile=args.webhook_key)
+    webhook.start()
+
+    if args.leader_elect:
+        client.acquire_leader_lease("tpu-operator-leader")
+
+    mgr.start()
+    started.set()
+    log.info("operator running (metrics :%d, webhook :%d)",
+             metrics_server.port, webhook.port)
+
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    mgr.stop()
+    webhook.stop()
+    metrics_server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+from .types import (
+    TpuOperatorConfig,
+    TpuOperatorConfigSpec,
+    ServiceFunctionChain,
+    NetworkFunction,
+    MODES,
+)
+from .webhook import validate_tpu_operator_config, ValidationError
+
+__all__ = [
+    "TpuOperatorConfig",
+    "TpuOperatorConfigSpec",
+    "ServiceFunctionChain",
+    "NetworkFunction",
+    "MODES",
+    "validate_tpu_operator_config",
+    "ValidationError",
+]

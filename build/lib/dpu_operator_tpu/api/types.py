@@ -1,0 +1,139 @@
+"""CRD types: TpuOperatorConfig and ServiceFunctionChain.
+
+Reference: api/v1/dpuoperatorconfig_types.go:29-36 (cluster-scoped singleton,
+``spec.mode`` ∈ host/dpu/auto, ``spec.logLevel``) and
+api/v1/servicefunctionchain_types.go:27-34 (namespaced, shortName sfc, a list
+of {name, image} network functions).
+
+The TPU build keeps both shapes and adds the TPU-specific spec surface the
+north star requires: the accelerator side is a TPU VM ("tpu" mode ≈ reference
+"dpu" mode: the daemon runs next to the chips), and the config may pin an
+expected slice topology (e.g. "v5e-16") that detection validates against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils import vars as v
+
+GROUP = "config.tpu.openshift.io"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+#: reference mode values host/dpu/auto (dpuoperatorconfig_webhook.go:50-61);
+#: here "tpu" replaces "dpu" — the side running on the accelerator VM.
+MODES = ("host", "tpu", "auto")
+
+
+#: default NF secondary-interface range when spec.nfIpam is unset; NF pods
+#: need per-interface addressing for chain traffic (VERDICT r1 item 2;
+#: reference: networkfn.go:233-317 delegates to the NetConf's IPAM)
+DEFAULT_NF_IPAM = {"type": "host-local", "subnet": "10.56.0.0/24"}
+
+
+@dataclass
+class TpuOperatorConfigSpec:
+    mode: str = "auto"
+    log_level: int = 0
+    #: optional expected slice topology, e.g. "v5e-4", "v5p-32"; empty = accept
+    #: whatever detection finds.
+    slice_topology: str = ""
+    #: IPAM config embedded into the network-function NAD (host-local or
+    #: static); defaults to DEFAULT_NF_IPAM.
+    nf_ipam: dict = field(default_factory=lambda: dict(DEFAULT_NF_IPAM))
+
+    def to_dict(self) -> dict:
+        return {
+            "mode": self.mode,
+            "logLevel": self.log_level,
+            "sliceTopology": self.slice_topology,
+            "nfIpam": dict(self.nf_ipam),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TpuOperatorConfigSpec":
+        return cls(
+            mode=d.get("mode", "auto"),
+            log_level=d.get("logLevel", 0),
+            slice_topology=d.get("sliceTopology", ""),
+            nf_ipam=dict(d.get("nfIpam") or DEFAULT_NF_IPAM),
+        )
+
+
+@dataclass
+class TpuOperatorConfig:
+    name: str = v.CONFIG_NAME
+    spec: TpuOperatorConfigSpec = field(default_factory=TpuOperatorConfigSpec)
+    uid: str = ""
+
+    KIND = "TpuOperatorConfig"
+
+    def to_obj(self) -> dict:
+        md = {"name": self.name}
+        if self.uid:
+            md["uid"] = self.uid
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": md,
+            "spec": self.spec.to_dict(),
+        }
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "TpuOperatorConfig":
+        return cls(
+            name=obj.get("metadata", {}).get("name", ""),
+            spec=TpuOperatorConfigSpec.from_dict(obj.get("spec", {})),
+            uid=obj.get("metadata", {}).get("uid", ""),
+        )
+
+
+@dataclass
+class NetworkFunction:
+    """One element of an SFC (reference: servicefunctionchain_types.go:27-34)."""
+    name: str
+    image: str
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "image": self.image}
+
+
+@dataclass
+class ServiceFunctionChain:
+    name: str
+    namespace: str = "default"
+    network_functions: list = field(default_factory=list)
+    uid: str = ""
+
+    KIND = "ServiceFunctionChain"
+
+    def to_obj(self) -> dict:
+        md = {"name": self.name, "namespace": self.namespace}
+        if self.uid:
+            md["uid"] = self.uid
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": md,
+            "spec": {
+                "networkFunctions": [
+                    nf.to_dict() if isinstance(nf, NetworkFunction) else nf
+                    for nf in self.network_functions
+                ],
+            },
+        }
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "ServiceFunctionChain":
+        nfs = [
+            NetworkFunction(name=nf.get("name", ""), image=nf.get("image", ""))
+            for nf in obj.get("spec", {}).get("networkFunctions", [])
+        ]
+        return cls(
+            name=obj.get("metadata", {}).get("name", ""),
+            namespace=obj.get("metadata", {}).get("namespace", "default"),
+            network_functions=nfs,
+            uid=obj.get("metadata", {}).get("uid", ""),
+        )

@@ -1,0 +1,115 @@
+"""Validating webhook logic for TpuOperatorConfig.
+
+Reference: api/v1/dpuoperatorconfig_webhook.go:50-61 — enforce the singleton
+name and a valid mode. The TPU build additionally validates sliceTopology
+against known accelerator generations. The HTTP admission wrapper lives in
+``dpu_operator_tpu.webhook``; this module is the pure logic so envtest-style
+unit tests (reference: dpuoperatorconfig_webhook_test.go) need no server.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..utils import vars as v
+from .types import MODES
+
+
+class ValidationError(ValueError):
+    pass
+
+
+_TOPOLOGY_RE = re.compile(r"^(v[2-6][ep]?)-(\d+)$")
+
+# chips-per-slice upper bounds by generation (public TPU podslice sizes)
+_MAX_CHIPS = {"v2": 512, "v3": 1024, "v4": 4096, "v5e": 256, "v5p": 8960,
+              "v6e": 256}
+
+
+def validate_slice_topology(topology: str) -> None:
+    if topology == "":
+        return
+    m = _TOPOLOGY_RE.match(topology)
+    if not m:
+        raise ValidationError(
+            f"invalid sliceTopology {topology!r}: want <gen>-<chips>, "
+            f"e.g. v5e-16")
+    gen, chips = m.group(1), int(m.group(2))
+    limit = _MAX_CHIPS.get(gen)
+    if limit is None:
+        raise ValidationError(f"unknown TPU generation {gen!r}")
+    if chips < 1 or chips > limit:
+        raise ValidationError(
+            f"sliceTopology {topology!r}: chip count out of range (1..{limit})")
+
+
+def validate_tpu_operator_config(obj: dict) -> None:
+    """Raise ValidationError on an invalid CR; mirror of
+    validateDpuOperatorConfig (dpuoperatorconfig_webhook.go:50-61)."""
+    if not isinstance(obj, dict):
+        raise ValidationError(f"object must be a mapping, got {type(obj).__name__}")
+    metadata = obj.get("metadata") or {}
+    if not isinstance(metadata, dict):
+        raise ValidationError("metadata must be a mapping")
+    name = metadata.get("name", "")
+    if name != v.CONFIG_NAME:
+        raise ValidationError(
+            f"invalid name {name!r}: TpuOperatorConfig is a singleton named "
+            f"{v.CONFIG_NAME!r}")
+    spec = obj.get("spec") or {}
+    if not isinstance(spec, dict):
+        raise ValidationError("spec must be a mapping")
+    mode = spec.get("mode", "auto")
+    if mode not in MODES:
+        raise ValidationError(f"invalid mode {mode!r}: want one of {MODES}")
+    log_level = spec.get("logLevel", 0)
+    if (not isinstance(log_level, int) or isinstance(log_level, bool)
+            or log_level < 0):
+        raise ValidationError(f"invalid logLevel {log_level!r}")
+    validate_slice_topology(spec.get("sliceTopology", ""))
+    nf_ipam = spec.get("nfIpam")
+    if nf_ipam is not None:
+        if not isinstance(nf_ipam, dict):
+            raise ValidationError("nfIpam must be a mapping")
+        import ipaddress
+        kind = nf_ipam.get("type", "")
+        if kind not in ("host-local", "static"):
+            raise ValidationError(
+                f"invalid nfIpam type {kind!r}: want host-local or static")
+        if kind == "host-local":
+            # reject unparseable configs at admission, not per-pod-ADD
+            if not nf_ipam.get("subnet"):
+                raise ValidationError("host-local nfIpam requires 'subnet'")
+            try:
+                net = ipaddress.ip_network(nf_ipam["subnet"], strict=False)
+                bounds = {}
+                for bound in ("rangeStart", "rangeEnd", "gateway"):
+                    if nf_ipam.get(bound):
+                        bounds[bound] = ipaddress.ip_address(nf_ipam[bound])
+            except ValueError as e:
+                raise ValidationError(f"invalid nfIpam: {e}") from e
+            # Containment + ordering: a reversed or out-of-subnet range
+            # passes parsing but makes every pod ADD fail at runtime with
+            # "range exhausted" — reject it at admission instead.
+            for bound, ip in bounds.items():
+                if ip not in net:
+                    raise ValidationError(
+                        f"invalid nfIpam: {bound} {ip} not in subnet {net}")
+            if ("rangeStart" in bounds and "rangeEnd" in bounds
+                    and bounds["rangeStart"] > bounds["rangeEnd"]):
+                raise ValidationError(
+                    "invalid nfIpam: rangeStart "
+                    f"{bounds['rangeStart']} > rangeEnd {bounds['rangeEnd']}")
+        if kind == "static":
+            addrs = nf_ipam.get("addresses")
+            if not addrs or not isinstance(addrs, list):
+                raise ValidationError(
+                    "static nfIpam requires a list of 'addresses'")
+            for a in addrs:
+                if not isinstance(a, dict) or not a.get("address"):
+                    raise ValidationError(
+                        "static nfIpam address entries need 'address'")
+                try:
+                    ipaddress.ip_interface(a["address"])
+                except ValueError as e:
+                    raise ValidationError(f"invalid nfIpam: {e}") from e

@@ -1,0 +1,16 @@
+from .types import CniRequest, CniResponse, PodRequest, NetConf, CNI_TIMEOUT
+from .server import CniServer
+from .shim import CniShim
+from .cache import NetConfCache, ChipAllocator
+
+__all__ = [
+    "CniRequest",
+    "CniResponse",
+    "PodRequest",
+    "NetConf",
+    "CNI_TIMEOUT",
+    "CniServer",
+    "CniShim",
+    "NetConfCache",
+    "ChipAllocator",
+]

@@ -1,0 +1,36 @@
+"""Standalone CNI server for manual testing (reference:
+dpu-cni/example/cniserver_main.py analog) — echoes requests with a
+logging handler so the shim path can be exercised without a daemon."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from .server import CniServer
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("tpu-cni-server")
+    parser.add_argument("--socket", required=True)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG)
+
+    def echo(req):
+        logging.info("CNI %s sandbox=%s if=%s device=%s", req.command,
+                     req.sandbox_id, req.ifname, req.device_id)
+        return {"cniVersion": req.netconf.cni_version, "echo": True}
+
+    server = CniServer(args.socket, add_handler=echo, del_handler=echo)
+    server.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""On-disk CNI state surviving daemon restarts.
+
+Reference: sriov.go:489-500 (NetConf cache keyed by container id + ifname,
+read back on DEL) and pci_allocator.go:25-96 (file-per-PCI allocation lock
+dir storing the owning netns). The TPU analog allocates chips instead of VFs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+class NetConfCache:
+    def __init__(self, cache_dir: str):
+        self.cache_dir = cache_dir
+
+    def _path(self, sandbox_id: str, ifname: str) -> str:
+        return os.path.join(self.cache_dir, f"{sandbox_id}-{ifname}.json")
+
+    def save(self, sandbox_id: str, ifname: str, data: dict):
+        os.makedirs(self.cache_dir, exist_ok=True)
+        tmp = self._path(sandbox_id, ifname) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._path(sandbox_id, ifname))
+
+    def load(self, sandbox_id: str, ifname: str) -> Optional[dict]:
+        try:
+            with open(self._path(sandbox_id, ifname)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None  # DEL is defensive about missing cache (sriov.go:553-566)
+
+    def delete(self, sandbox_id: str, ifname: str):
+        try:
+            os.unlink(self._path(sandbox_id, ifname))
+        except OSError:
+            pass
+
+    def load_any(self, sandbox_id: str) -> Optional[dict]:
+        """Any cached entry for the sandbox (full-teardown DELs don't name
+        an ifname but still need the ADD-time config)."""
+        return next(iter(self.load_all(sandbox_id)), None)
+
+    def load_all(self, sandbox_id: str) -> list:
+        """Every cached entry for the sandbox. A sandbox attached via
+        multiple networks/NADs has one entry per ifname, each possibly
+        carrying a different ipam/network — full teardown must release
+        all of them, not just the first (advisor round-2 finding)."""
+        out = []
+        try:
+            entries = sorted(os.listdir(self.cache_dir))
+        except OSError:
+            return out
+        for fn in entries:
+            if fn.startswith(f"{sandbox_id}-") and fn.endswith(".json"):
+                try:
+                    with open(os.path.join(self.cache_dir, fn)) as f:
+                        out.append(json.load(f))
+                except (OSError, json.JSONDecodeError):
+                    continue
+        return out
+
+    def delete_sandbox(self, sandbox_id: str):
+        try:
+            entries = os.listdir(self.cache_dir)
+        except OSError:
+            return
+        for fn in entries:
+            if fn.startswith(f"{sandbox_id}-"):
+                try:
+                    os.unlink(os.path.join(self.cache_dir, fn))
+                except OSError:
+                    pass
+
+
+class ChipAllocator:
+    """File-per-chip allocation locks (pci_allocator.go analog)."""
+
+    def __init__(self, alloc_dir: str):
+        self.alloc_dir = alloc_dir
+
+    def _path(self, chip_id: str) -> str:
+        return os.path.join(self.alloc_dir, chip_id.replace("/", "_"))
+
+    def allocate(self, chip_id: str, owner: str) -> bool:
+        """Record *owner* (sandbox id) as holding *chip_id*; False if held
+        by someone else."""
+        os.makedirs(self.alloc_dir, exist_ok=True)
+        path = self._path(chip_id)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+        except FileExistsError:
+            return self.owner(chip_id) == owner
+        with os.fdopen(fd, "w") as f:
+            f.write(owner)
+        return True
+
+    def owner(self, chip_id: str) -> Optional[str]:
+        try:
+            with open(self._path(chip_id)) as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    def release(self, chip_id: str, owner: str) -> bool:
+        cur = self.owner(chip_id)
+        if cur is not None and cur != owner:
+            return False
+        try:
+            os.unlink(self._path(chip_id))
+        except OSError:
+            pass
+        return True

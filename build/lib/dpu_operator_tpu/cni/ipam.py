@@ -1,0 +1,217 @@
+"""IPAM delegation for CNI attachments.
+
+Reference: the SR-IOV CNI delegates addressing to an IPAM plugin via
+``ipam.ExecAdd`` and unwinds with ``ExecDel`` (dpu-cni/pkgs/sriov/sriov.go:
+423-484, networkfn.go:233-317 optional IPAM).  The reference shells out to
+CNI plugin binaries; here the two plugins every deployment actually uses —
+``host-local`` ranges and ``static`` addresses — are implemented in-process
+behind the same delegate seam (no plugin binaries are guaranteed to exist on
+a TPU VM image), with file-per-IP allocation records surviving daemon
+restarts like upstream host-local's ``/var/lib/cni/networks/<name>/`` dir.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import ipaddress
+import json
+import os
+from typing import Optional
+
+__all__ = ["IpamError", "ipam_add", "ipam_del", "HostLocalIpam",
+           "StaticIpam"]
+
+
+class IpamError(Exception):
+    pass
+
+
+def _ip_result(address: str, gateway: Optional[str]) -> dict:
+    iface = ipaddress.ip_interface(address)
+    out = {"version": "6" if iface.version == 6 else "4", "address": address}
+    if gateway:
+        out["gateway"] = gateway
+    return out
+
+
+class HostLocalIpam:
+    """``host-local`` range allocator: first-free address from a subnet
+    (optionally bounded by rangeStart/rangeEnd), gateway excluded, one
+    file per allocated IP recording ``<sandbox> <ifname>``."""
+
+    def __init__(self, data_dir: str):
+        self.data_dir = data_dir
+
+    def _net_dir(self, name: str) -> str:
+        return os.path.join(self.data_dir, name or "default")
+
+    def _iter_candidates(self, cfg: dict):
+        subnet = cfg.get("subnet")
+        if not subnet:
+            raise IpamError("host-local IPAM requires 'subnet'")
+        net = ipaddress.ip_network(subnet, strict=False)
+        gateway = cfg.get("gateway")
+        gw_ip = ipaddress.ip_address(gateway) if gateway else None
+        start = (ipaddress.ip_address(cfg["rangeStart"])
+                 if cfg.get("rangeStart") else None)
+        end = (ipaddress.ip_address(cfg["rangeEnd"])
+               if cfg.get("rangeEnd") else None)
+        for ip in net.hosts():
+            if start and ip < start:
+                continue
+            if end and ip > end:
+                break
+            if gw_ip and ip == gw_ip:
+                continue
+            yield ip, net
+
+    @contextlib.contextmanager
+    def _net_lock(self, net_dir: str):
+        """Per-network flock serializing add(): the scan-then-O_EXCL-create
+        idempotency check is not atomic on its own, so two concurrent ADDs
+        for the same sandbox+ifname (overlapping kubelet retries) could each
+        miss the owner scan and claim two different IPs, leaking one."""
+        fd = os.open(os.path.join(net_dir, ".lock"),
+                     os.O_CREAT | os.O_WRONLY, 0o600)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def add(self, cfg: dict, network: str, sandbox: str,
+            ifname: str) -> dict:
+        if not cfg.get("subnet"):
+            raise IpamError("host-local IPAM requires 'subnet'")
+        net_dir = self._net_dir(network)
+        os.makedirs(net_dir, exist_ok=True)
+        with self._net_lock(net_dir):
+            return self._add_locked(cfg, net_dir, sandbox, ifname)
+
+    def _add_locked(self, cfg: dict, net_dir: str, sandbox: str,
+                    ifname: str) -> dict:
+        owner = f"{sandbox} {ifname}"
+        # idempotent retry: the same sandbox+ifname keeps its address
+        for fn in sorted(os.listdir(net_dir)):
+            path = os.path.join(net_dir, fn)
+            try:
+                with open(path) as f:
+                    if f.read().strip() == owner:
+                        ip = ipaddress.ip_address(fn)
+                        net = ipaddress.ip_network(cfg["subnet"],
+                                                   strict=False)
+                        return self._result(cfg, ip, net)
+            except (OSError, ValueError):
+                continue
+        for ip, net in self._iter_candidates(cfg):
+            path = os.path.join(net_dir, str(ip))
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o600)
+            except FileExistsError:
+                continue
+            with os.fdopen(fd, "w") as f:
+                f.write(owner)
+            return self._result(cfg, ip, net)
+        raise IpamError(f"host-local range exhausted in {cfg.get('subnet')}")
+
+    def _result(self, cfg: dict, ip, net) -> dict:
+        return {
+            "ips": [_ip_result(f"{ip}/{net.prefixlen}", cfg.get("gateway"))],
+            "routes": list(cfg.get("routes") or []),
+            "dns": dict(cfg.get("dns") or {}),
+        }
+
+    def delete(self, cfg: dict, network: str, sandbox: str,
+               ifname: Optional[str] = None):
+        """Release this sandbox's address for *ifname*; with ifname None,
+        release every address the sandbox holds (full sandbox teardown).
+
+        Takes the same per-network lock as add(): a teardown DEL racing a
+        slow retried ADD would otherwise listdir before the ADD's O_EXCL
+        create lands, miss the new file, and leak that IP forever."""
+        net_dir = self._net_dir(network)
+        if not os.path.isdir(net_dir):
+            return
+        with self._net_lock(net_dir):
+            self._delete_locked(net_dir, sandbox, ifname)
+
+    def _delete_locked(self, net_dir: str, sandbox: str,
+                       ifname: Optional[str]):
+        owner = f"{sandbox} {ifname}" if ifname else None
+        try:
+            entries = os.listdir(net_dir)
+        except OSError:
+            return
+        for fn in entries:
+            path = os.path.join(net_dir, fn)
+            try:
+                with open(path) as f:
+                    content = f.read().strip()
+                if (content == owner if owner
+                        else content.startswith(f"{sandbox} ")):
+                    os.unlink(path)
+            except OSError:
+                continue
+
+
+class StaticIpam:
+    """``static`` addresses straight from the NetConf."""
+
+    def add(self, cfg: dict, network: str, sandbox: str,
+            ifname: str) -> dict:
+        addrs = cfg.get("addresses") or []
+        if not addrs:
+            raise IpamError("static IPAM requires 'addresses'")
+        ips = []
+        for a in addrs:
+            address = a.get("address")
+            if not address:
+                raise IpamError("static IPAM address entry missing 'address'")
+            ipaddress.ip_interface(address)  # validate
+            ips.append(_ip_result(address, a.get("gateway")))
+        return {"ips": ips, "routes": list(cfg.get("routes") or []),
+                "dns": dict(cfg.get("dns") or {})}
+
+    def delete(self, cfg: dict, network: str, sandbox: str,
+               ifname: Optional[str] = None):
+        pass  # nothing allocated
+
+
+def _delegate(cfg: dict, data_dir: str):
+    kind = cfg.get("type", "")
+    if kind == "host-local":
+        return HostLocalIpam(data_dir)
+    if kind == "static":
+        return StaticIpam()
+    raise IpamError(f"unsupported IPAM type {kind!r} "
+                    "(host-local and static are built in)")
+
+
+def ipam_add(netconf_ipam: dict, data_dir: str, network: str,
+             sandbox: str, ifname: str) -> Optional[dict]:
+    """Delegate-ADD: returns the CNI result fragment (ips/routes/dns) or
+    None when the NetConf carries no IPAM section (addressing optional,
+    networkfn.go:233-317)."""
+    if not netconf_ipam:
+        return None
+    return _delegate(netconf_ipam, data_dir).add(
+        netconf_ipam, network, sandbox, ifname)
+
+
+def ipam_del(netconf_ipam: dict, data_dir: str, network: str,
+             sandbox: str, ifname: Optional[str] = None):
+    """Delegate-DEL; ifname None releases all of the sandbox's addresses."""
+    if not netconf_ipam:
+        return
+    try:
+        _delegate(netconf_ipam, data_dir).delete(
+            netconf_ipam, network, sandbox, ifname)
+    except IpamError:
+        pass  # DEL is defensive (sriov.go:553-566)
+
+
+def serialize(result: Optional[dict]) -> str:
+    return json.dumps(result or {})

@@ -1,0 +1,34 @@
+"""Per-invocation CNI logging.
+
+Reference: dpu-cni/pkgs/cnilogging/cnilogging.go:26-55 — a logger labelled
+with container/netns/ifname whose level and file come from the NetConf
+(NetConf.LogLevel/LogFile, cnitypes.go:133-134), so one misbehaving pod's
+CNI calls can be traced without drowning the daemon log.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warning": logging.WARNING, "error": logging.ERROR,
+           "panic": logging.CRITICAL}
+
+
+def request_logger(pod_req) -> logging.LoggerAdapter:
+    """Logger for one CNI invocation, labelled and routed per NetConf."""
+    name = f"cni.{pod_req.sandbox_id[:12]}.{pod_req.ifname}"
+    logger = logging.getLogger(name)
+    nc = pod_req.netconf
+    logger.setLevel(_LEVELS.get((nc.log_level or "info").lower(),
+                                logging.INFO))
+    if nc.log_file and not any(
+            isinstance(h, logging.FileHandler)
+            and h.baseFilename == nc.log_file for h in logger.handlers):
+        handler = logging.FileHandler(nc.log_file)
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(message)s"))
+        logger.addHandler(handler)
+    return logging.LoggerAdapter(logger, {
+        "container": pod_req.sandbox_id, "netns": pod_req.netns,
+        "ifname": pod_req.ifname})

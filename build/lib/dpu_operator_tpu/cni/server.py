@@ -1,0 +1,149 @@
+"""CNI server: HTTP over a root-only unix socket with injected handlers.
+
+Reference: dpu-cni/pkgs/cniserver/cniserver.go — gorilla/mux server on a
+0600 unix socket (:52-67), route /cni (:288-307), CNI_* env parsing into a
+PodRequest with a 2-minute deadline (:141-231), dispatch to add/del handlers
+injected by the side managers (:234-263).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socketserver
+import threading
+from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutTimeout
+from http.server import BaseHTTPRequestHandler
+from typing import Callable, Optional
+
+from ..utils import metrics
+from ..utils.tracing import span
+from .logging import request_logger
+from .types import CNI_TIMEOUT, CniRequest, CniResponse, PodRequest
+
+log = logging.getLogger(__name__)
+
+
+class _UnixHTTPServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def get_request(self):
+        request, _ = super().get_request()
+        # BaseHTTPRequestHandler wants a client address tuple
+        return request, ("unix", 0)
+
+
+class CniServer:
+    def __init__(self, socket_path: str,
+                 add_handler: Optional[Callable[[PodRequest], dict]] = None,
+                 del_handler: Optional[Callable[[PodRequest], dict]] = None,
+                 timeout: float = CNI_TIMEOUT):
+        self.socket_path = socket_path
+        self.add_handler = add_handler
+        self.del_handler = del_handler
+        self.timeout = timeout
+        self._server: Optional[_UnixHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._pool = ThreadPoolExecutor(max_workers=8)
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.socket_path), mode=0o700,
+                    exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("cni-server: " + fmt, *args)
+
+            def do_POST(self):
+                if self.path != "/cni":
+                    self._reply(404, CniResponse(error="not found"))
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    resp = outer._handle(CniRequest.from_dict(body))
+                    self._reply(200 if not resp.error else 500, resp)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("cni request failed")
+                    self._reply(500, CniResponse(error=str(e)))
+
+            def _reply(self, code: int, resp: CniResponse):
+                data = json.dumps(resp.to_dict()).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = _UnixHTTPServer(self.socket_path, Handler)
+        os.chmod(self.socket_path, 0o600)  # root-only (cniserver.go:52-67)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="cni-server")
+        self._thread.start()
+        log.info("CNI server on %s", self.socket_path)
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        self._pool.shutdown(wait=False)
+
+    # -- request dispatch (cniserver.go:234-263) ------------------------------
+    def _handle(self, req: CniRequest) -> CniResponse:
+        pod_req = PodRequest.from_cni_request(req)
+        if pod_req.command == "CHECK":
+            return CniResponse(result={})  # no-op (dpu-cni.go:17-42)
+        handler = (self.add_handler if pod_req.command == "ADD"
+                   else self.del_handler)
+        if handler is None:
+            return CniResponse(error=f"no handler for {pod_req.command}")
+        request_logger(pod_req).debug("CNI %s device=%s", pod_req.command,
+                                      pod_req.device_id)
+        with span("cni." + pod_req.command.lower(),
+                  sandbox=pod_req.sandbox_id, ifname=pod_req.ifname):
+            return self._dispatch(handler, pod_req)
+
+    def _dispatch(self, handler, pod_req: PodRequest) -> CniResponse:
+        fut = self._pool.submit(handler, pod_req)
+        try:
+            with metrics.CNI_SECONDS.time():
+                result = fut.result(timeout=self.timeout)
+            metrics.CNI_REQUESTS.inc(command=pod_req.command, result="ok")
+        except FutTimeout:
+            metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                                     result="timeout")
+            # The error response below makes kubelet tear the sandbox down,
+            # but the handler thread may still be running and commit its
+            # side effects afterwards. Cancel if still queued; if a late ADD
+            # succeeds anyway, undo it so allocator/cache state doesn't leak
+            # for a dead sandbox.
+            fut.cancel()
+            if pod_req.command == "ADD" and self.del_handler is not None:
+                rollback = self.del_handler
+
+                def _undo_late_add(f):
+                    if f.cancelled() or f.exception() is not None:
+                        return
+                    log.warning("late CNI ADD success after timeout; "
+                                "rolling back sandbox %s", pod_req.sandbox_id)
+                    try:
+                        rollback(pod_req)
+                    except Exception:  # noqa: BLE001
+                        log.exception("rollback of timed-out ADD failed")
+
+                fut.add_done_callback(_undo_late_add)
+            return CniResponse(
+                error=f"CNI {pod_req.command} timed out after {self.timeout}s")
+        except Exception:
+            metrics.CNI_REQUESTS.inc(command=pod_req.command, result="error")
+            raise
+        return CniResponse(result=result or {"cniVersion":
+                                             pod_req.netconf.cni_version})

@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""CNI shim: the executable CRI/multus invokes per pod.
+
+Reference: dpu-cni/dpu-cni.go:17-42 + pkgs/cni/cnishim.go — read CNI_* env
+and stdin netconf, forward as JSON over the daemon's unix socket, print the
+CNI result JSON on stdout (errors as CNI error JSON, exit 1). CmdCheck is a
+no-op.
+
+This file is copied VERBATIM into the host CNI bin dir by the daemon's
+prepare step (daemon.go:195-209 analog), so it must be fully self-contained:
+stdlib only, no package imports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+
+_CNI_ENV_KEYS = ("CNI_COMMAND", "CNI_CONTAINERID", "CNI_NETNS", "CNI_IFNAME",
+                 "CNI_ARGS", "CNI_PATH")
+
+DEFAULT_SOCKET = "/var/run/tpu-daemon/tpu-cni-server.sock"
+
+
+def _post(socket_path: str, payload: dict, timeout: float = 120.0) -> dict:
+    """Minimal HTTP-over-unix-socket POST (cnishim.go:59-89)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+        sock.settimeout(timeout)
+        sock.connect(socket_path)
+        body = json.dumps(payload).encode()
+        headers = (
+            f"POST /cni HTTP/1.1\r\nHost: unix\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+        ).encode()
+        sock.sendall(headers + body)
+        chunks = []
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break
+            chunks.append(data)
+    raw = b"".join(chunks)
+    header, _, payload_out = raw.partition(b"\r\n\r\n")
+    status = int(header.split()[1])
+    resp = json.loads(payload_out or b"{}")
+    if status != 200 and not resp.get("error"):
+        resp["error"] = f"HTTP {status}"
+    return resp
+
+
+class CniShim:
+    """Importable wrapper used by tests and the in-package client."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+
+    def invoke(self, env: dict, stdin_data: str):
+        from .types import CniResponse
+        config = json.loads(stdin_data or "{}")
+        if env.get("CNI_COMMAND") == "CHECK":
+            return CniResponse(result={})
+        raw = _post(self.socket_path, {
+            "env": {k: env[k] for k in _CNI_ENV_KEYS if k in env},
+            "config": config,
+        })
+        return CniResponse(result=raw.get("result"),
+                           error=raw.get("error", ""))
+
+
+def main(argv=None) -> int:
+    socket_path = os.environ.get("TPU_CNI_SOCKET", DEFAULT_SOCKET)
+    try:
+        env = {k: os.environ[k] for k in _CNI_ENV_KEYS if k in os.environ}
+        if env.get("CNI_COMMAND") == "CHECK":
+            print(json.dumps({}))
+            return 0
+        config = json.loads(sys.stdin.read() or "{}")
+        resp = _post(socket_path, {"env": env, "config": config})
+    except Exception as e:  # noqa: BLE001 — CNI error JSON contract
+        print(json.dumps({"cniVersion": "0.4.0", "code": 999,
+                          "msg": str(e)}))
+        return 1
+    if resp.get("error"):
+        print(json.dumps({"cniVersion": "0.4.0", "code": 999,
+                          "msg": resp["error"]}))
+        return 1
+    print(json.dumps(resp.get("result") or {}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

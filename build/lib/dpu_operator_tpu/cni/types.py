@@ -1,0 +1,182 @@
+"""CNI request/response types.
+
+Reference: dpu-cni/pkgs/cnitypes/cnitypes.go — Request/Response/PodRequest
+structs (:113-135) and socket path constants (:13-16). The TPU ``NetConf``
+replaces VF knobs (vlan/rate/spoofchk/trust) with chip/slice knobs: which
+resource the attachment consumes, the slice topology, and the device id the
+device plugin allocated (passed via the runtime's deviceID like the
+reference's SR-IOV DeviceID).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: CNI request deadline — kubelet CRI op timeout parity (cniserver.go:226-227)
+CNI_TIMEOUT = 120.0
+
+CNI_VERSION = "0.4.0"
+
+
+@dataclass
+class NetConf:
+    """Parsed CNI network configuration (stdin JSON)."""
+    cni_version: str = CNI_VERSION
+    name: str = ""
+    type: str = "tpu-cni"
+    mode: str = "chip"              # "chip" (host side) | "network-function"
+    resource_name: str = ""
+    topology: str = ""
+    device_id: str = ""             # from runtimeConfig / CNI_ARGS deviceID
+    #: ICI port ids the device plugin allocated to this pod (runtime passes
+    #: them alongside deviceID the way multus forwards podresources ids);
+    #: chain steering wires hops over these instead of inferring from the
+    #: slice topology
+    ici_ports: list = field(default_factory=list)
+    log_level: str = "info"         # per-invocation logging (cnitypes.go:133)
+    log_file: str = ""
+    ipam: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetConf":
+        return cls(
+            cni_version=d.get("cniVersion", CNI_VERSION),
+            name=d.get("name", ""),
+            type=d.get("type", "tpu-cni"),
+            mode=d.get("mode", "chip"),
+            resource_name=d.get("resourceName", ""),
+            topology=d.get("topology", ""),
+            device_id=d.get("deviceID", ""),
+            ici_ports=list(d.get("iciPorts") or []),
+            log_level=d.get("logLevel", "info"),
+            log_file=d.get("logFile", ""),
+            ipam=d.get("ipam", {}) or {},
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "cniVersion": self.cni_version,
+            "name": self.name,
+            "type": self.type,
+            "mode": self.mode,
+            "resourceName": self.resource_name,
+            "topology": self.topology,
+            "deviceID": self.device_id,
+            "iciPorts": list(self.ici_ports),
+            "logLevel": self.log_level,
+            "logFile": self.log_file,
+            "ipam": self.ipam,
+        }
+
+
+@dataclass
+class DeviceWiring:
+    """Per-sandbox device wiring record: the concrete OS-level work this
+    attachment implies for the runtime — which device nodes to expose,
+    the device-cgroup rules admitting them, extra mounts (libtpu), and
+    per-attachment env. The TPU analog of the reference's netns VF dance
+    (sriov.go:75-140 SetupVF): there the CNI moves a netdev; here it
+    records the chip chardev + cgroup contract, and DEL unwinds by this
+    record (sriov.go:505-583 restores from the cached NetConf)."""
+    dev_paths: list = field(default_factory=list)
+    cgroup_rules: list = field(default_factory=list)
+    mounts: list = field(default_factory=list)
+    env: dict = field(default_factory=dict)
+
+    @classmethod
+    def for_chip(cls, chip_index: int, dev_path: str = "",
+                 libtpu_path: str = "") -> "DeviceWiring":
+        import os
+        import stat as _stat
+        dev = dev_path or f"/dev/accel{chip_index}"
+        rules = []
+        try:
+            st = os.stat(dev)
+            if _stat.S_ISCHR(st.st_mode):
+                rules.append(f"c {os.major(st.st_rdev)}:"
+                             f"{os.minor(st.st_rdev)} rwm")
+        except OSError:
+            pass
+        mounts = []
+        if libtpu_path and os.path.exists(libtpu_path):
+            mounts.append({"hostPath": libtpu_path,
+                           "containerPath": "/usr/lib/tpu/libtpu.so",
+                           "readOnly": True})
+        return cls(dev_paths=[dev], cgroup_rules=rules, mounts=mounts,
+                   env={"TPU_CHIP_INDEX": str(chip_index)})
+
+    def to_dict(self) -> dict:
+        return {"devPaths": self.dev_paths, "cgroupRules": self.cgroup_rules,
+                "mounts": self.mounts, "env": self.env}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeviceWiring":
+        return cls(dev_paths=list(d.get("devPaths", [])),
+                   cgroup_rules=list(d.get("cgroupRules", [])),
+                   mounts=list(d.get("mounts", [])),
+                   env=dict(d.get("env", {})))
+
+
+@dataclass
+class CniRequest:
+    """What the shim posts: CNI_* env + stdin config (cnishim.go:31-55)."""
+    env: dict
+    config: dict
+
+    def to_dict(self) -> dict:
+        return {"env": self.env, "config": self.config}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CniRequest":
+        return cls(env=d.get("env", {}), config=d.get("config", {}))
+
+
+@dataclass
+class PodRequest:
+    """Server-side parsed request (cniserver.go:141-231)."""
+    command: str                     # ADD | DEL | CHECK
+    pod_namespace: str
+    pod_name: str
+    sandbox_id: str
+    netns: str
+    ifname: str
+    device_id: str
+    netconf: NetConf
+
+    @classmethod
+    def from_cni_request(cls, req: CniRequest) -> "PodRequest":
+        env = req.env
+        args = {}
+        for kv in env.get("CNI_ARGS", "").split(";"):
+            if "=" in kv:
+                k, val = kv.split("=", 1)
+                args[k] = val
+        command = env.get("CNI_COMMAND", "")
+        if command not in ("ADD", "DEL", "CHECK"):
+            raise ValueError(f"unexpected CNI_COMMAND {command!r}")
+        netconf = NetConf.from_dict(req.config)
+        return cls(
+            command=command,
+            pod_namespace=args.get("K8S_POD_NAMESPACE", ""),
+            pod_name=args.get("K8S_POD_NAME", ""),
+            sandbox_id=env.get("CNI_CONTAINERID", ""),
+            netns=env.get("CNI_NETNS", ""),
+            ifname=env.get("CNI_IFNAME", ""),
+            device_id=netconf.device_id or args.get("deviceID", ""),
+            netconf=netconf,
+        )
+
+
+@dataclass
+class CniResponse:
+    """CNI result JSON the shim prints (types.PrintResult parity)."""
+    result: Optional[dict] = None
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {"result": self.result, "error": self.error}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CniResponse":
+        return cls(result=d.get("result"), error=d.get("error", ""))

@@ -1,0 +1,7 @@
+from .tpuoperatorconfig_controller import TpuOperatorConfigReconciler
+from .servicefunctionchain_controller import ServiceFunctionChainClusterReconciler
+
+__all__ = [
+    "TpuOperatorConfigReconciler",
+    "ServiceFunctionChainClusterReconciler",
+]

@@ -1,0 +1,19 @@
+"""Cluster-side ServiceFunctionChain controller.
+
+Reference: internal/controller/servicefunctionchain_controller.go:49-55 — a
+registered but intentionally empty stub; the node-side reconciler embedded in
+the daemon does the actual work (internal/daemon/sfc-reconciler/sfc.go).
+Kept for parity so the cluster manager watches the CRD and surfaces events.
+"""
+
+from __future__ import annotations
+
+from ..api.types import API_VERSION
+from ..k8s.manager import ReconcileResult, Request
+
+
+class ServiceFunctionChainClusterReconciler:
+    watches = (API_VERSION, "ServiceFunctionChain")
+
+    def reconcile(self, client, req: Request) -> ReconcileResult:
+        return ReconcileResult()

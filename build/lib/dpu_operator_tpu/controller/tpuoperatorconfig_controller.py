@@ -1,0 +1,117 @@
+"""Cluster controller reconciling TpuOperatorConfig.
+
+Reference: internal/controller/dpuoperatorconfig_controller.go:98-211 —
+Reconcile fetches the CR, then ensures (1) the daemon DaemonSet + RBAC from
+bindata, (2) the mode-switched network-function NetworkAttachmentDefinition,
+(3) the network-resources-injector deployment. Template vars are computed at
+reconcile time from cluster flavour + filesystem mode (yamlVars, :131-167).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from ..api.types import API_VERSION, TpuOperatorConfig
+from ..images import merge_vars_with_images
+from ..k8s.manager import ReconcileResult, Request
+from ..render import apply_all_from_bindata
+from ..utils import vars as v
+from ..utils.cluster_environment import ClusterEnvironment
+from ..utils.filesystem_mode_detector import FilesystemModeDetector, FsMode
+from ..utils.path_manager import PathManager
+
+log = logging.getLogger(__name__)
+
+_BINDATA = os.path.join(os.path.dirname(__file__), "bindata")
+
+
+class TpuOperatorConfigReconciler:
+    watches = (API_VERSION, "TpuOperatorConfig")
+
+    def __init__(self, image_manager, path_manager: PathManager | None = None,
+                 fs_detector: FilesystemModeDetector | None = None):
+        self.image_manager = image_manager
+        self.path_manager = path_manager or PathManager()
+        self.fs_detector = fs_detector or FilesystemModeDetector()
+
+    # -- template vars (reference: yamlVars :131-167) -------------------------
+    def _yaml_vars(self, client, cfg: TpuOperatorConfig) -> dict:
+        flavour = ClusterEnvironment(client).flavour()
+        # PermissionError propagates: detection failure must fail the
+        # reconcile (and retry) rather than render a wrong CniBinDir.
+        fs_mode = self.fs_detector.detect_mode()
+        data = {
+            "Namespace": v.NAMESPACE,
+            "Mode": cfg.spec.mode,
+            "LogLevel": cfg.spec.log_level,
+            "SliceTopology": cfg.spec.slice_topology,
+            "Flavour": flavour.value,
+            "FsMode": fs_mode.value,
+            "CniBinDir": self.path_manager.cni_host_dir(flavour.value),
+            "NodeLabelKey": v.NODE_LABEL_KEY,
+            "NodeLabelValue": v.NODE_LABEL_VALUE,
+            # hardcoded resource name parity (controller.go:162)
+            "ResourceName": v.TPU_RESOURCE_NAME,
+            "NadName": v.DEFAULT_NAD_NAME,
+            "NfIpam": dict(cfg.spec.nf_ipam),
+        }
+        return merge_vars_with_images(self.image_manager, data)
+
+    # -- ensure steps ---------------------------------------------------------
+    def _ensure_daemon_daemonset(self, client, cfg_obj: dict, data: dict):
+        apply_all_from_bindata(
+            client, os.path.join(_BINDATA, "daemon"), data, owner=cfg_obj)
+
+    def _ensure_network_function_nad(self, client, cfg_obj: dict, data: dict):
+        """Mode-switched NAD (reference: controller.go:189-204). On the host
+        side the NAD routes pod attachments through the TPU CNI in chip-mount
+        mode; on the tpu side in netdev/network-function mode."""
+        mode = data["Mode"]
+        cni_mode = "network-function" if mode == "tpu" else "chip"
+        config = {
+            "cniVersion": "0.4.0",
+            "name": v.DEFAULT_NAD_NAME,
+            "type": "tpu-cni",
+            "mode": cni_mode,
+            "resourceName": data["ResourceName"],
+        }
+        if cni_mode == "network-function" and data.get("NfIpam"):
+            # NF secondary interfaces get real addressing: the NetConf
+            # carries the IPAM the CNI server delegates to (cni/ipam.py)
+            config["ipam"] = data["NfIpam"]
+        nad = {
+            "apiVersion": "k8s.cni.cncf.io/v1",
+            "kind": "NetworkAttachmentDefinition",
+            "metadata": {"name": v.DEFAULT_NAD_NAME, "namespace": "default"},
+            "spec": {
+                "config": json.dumps(config),
+            },
+        }
+        from ..k8s.client import set_owner_reference
+        set_owner_reference(cfg_obj, nad)
+        client.apply(nad)
+
+    def _ensure_network_resources_injector(self, client, cfg_obj: dict,
+                                           data: dict):
+        apply_all_from_bindata(
+            client, os.path.join(_BINDATA, "network-resources-injector"),
+            data, owner=cfg_obj)
+
+    # -- Reconcile ------------------------------------------------------------
+    def reconcile(self, client, req: Request) -> ReconcileResult:
+        obj = client.get(API_VERSION, "TpuOperatorConfig", req.name)
+        if obj is None:
+            return ReconcileResult()  # deleted; GC handles children
+        cfg = TpuOperatorConfig.from_obj(obj)
+        data = self._yaml_vars(client, cfg)
+        self._ensure_daemon_daemonset(client, obj, data)
+        self._ensure_network_function_nad(client, obj, data)
+        self._ensure_network_resources_injector(client, obj, data)
+        status = dict(obj.get("status", {}))
+        status["observedGeneration"] = obj["metadata"].get("generation", 0)
+        status["flavour"] = data["Flavour"]
+        obj["status"] = status
+        client.update_status(obj)
+        return ReconcileResult()

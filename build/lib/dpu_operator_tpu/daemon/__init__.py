@@ -1,0 +1,14 @@
+from .daemon import Daemon
+from .hostsidemanager import HostSideManager
+from .tpusidemanager import TpuSideManager
+from .device_handler import TpuDeviceHandler, IciPortDeviceHandler
+from .sfc_reconciler import SfcReconciler
+
+__all__ = [
+    "Daemon",
+    "HostSideManager",
+    "TpuSideManager",
+    "TpuDeviceHandler",
+    "IciPortDeviceHandler",
+    "SfcReconciler",
+]

@@ -1,0 +1,56 @@
+"""Daemon entrypoint (reference: cmd/daemon/daemon.go:18-40)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+from ..images import EnvImageManager
+from ..platform import HardwarePlatform
+from ..utils.path_manager import PathManager
+from .daemon import Daemon
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("tpu-daemon")
+    parser.add_argument("--mode", default="auto",
+                        choices=["host", "tpu", "auto"])
+    parser.add_argument("--root", default="/")
+    parser.add_argument("--flavour", default="kind")
+    parser.add_argument("--kubeconfig", default="")
+    args = parser.parse_args(argv)
+    # CR spec.logLevel lands here via the DaemonSet env (0 = info,
+    # >=1 = debug — klog-verbosity style)
+    verbosity = int(os.environ.get("TPU_LOG_LEVEL", "0") or 0)
+    logging.basicConfig(
+        level=logging.DEBUG if verbosity >= 1 else logging.INFO)
+
+    # Fail fast when an apiserver is expected (explicit kubeconfig or
+    # in-cluster env): silently downgrading to standalone would disable VSP
+    # deployment and the SFC reconciler in production. Standalone is only
+    # for dev machines with no cluster configured at all.
+    client = None
+    in_cluster = bool(os.environ.get("KUBERNETES_SERVICE_HOST"))
+    default_kubeconfig = os.path.expanduser("~/.kube/config")
+    if args.kubeconfig or in_cluster or os.path.exists(default_kubeconfig):
+        from ..k8s.real import RealKube
+        client = RealKube(args.kubeconfig or None)
+    else:
+        logging.warning("no kubeconfig and not in-cluster; "
+                        "running standalone")
+
+    daemon = Daemon(
+        platform=HardwarePlatform(args.root),
+        mode=args.mode,
+        path_manager=PathManager(args.root),
+        client=client,
+        image_manager=EnvImageManager(),
+        node_name=os.environ.get("NODE_NAME", ""),
+        flavour=args.flavour,
+    )
+    daemon.prepare_and_serve()
+
+
+if __name__ == "__main__":
+    main()

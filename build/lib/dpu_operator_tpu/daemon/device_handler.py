@@ -1,0 +1,78 @@
+"""Device handler: bridges the device plugin to the VSP.
+
+Reference: internal/daemon/device-handler/ — ``SetupDevices`` calls
+``vsp.SetNumVfs(8)`` (hardcoded count, dpudevicehandler.go:89) with errors
+tolerated on the accelerator side (:92-97); ``GetDevices`` blocks until setup
+completes, then calls the VSP, enforcing PCI-address ids host-side only
+(:60-73). The TPU handler keeps that contract with SetNumChips, plus an
+ICI-port handler deriving port inventory from the slice topology.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+
+log = logging.getLogger(__name__)
+
+#: chips advertised by default (reference parity: SetNumVfs(8))
+DEFAULT_NUM_CHIPS = 8
+
+_PCI_RE = re.compile(
+    r"^[0-9a-fA-F]{4}:[0-9a-fA-F]{2}:[0-9a-fA-F]{2}\.[0-7]$")
+
+
+class TpuDeviceHandler:
+    def __init__(self, vsp, tpu_mode: bool,
+                 num_chips: int = DEFAULT_NUM_CHIPS):
+        self.vsp = vsp
+        self.tpu_mode = tpu_mode
+        self.num_chips = num_chips
+        self._setup_done = threading.Event()
+
+    def setup_devices(self):
+        """SetNumChips; failures tolerated in tpu mode (the VSP may not
+        support resizing a fixed slice — dpudevicehandler.go:92-97)."""
+        try:
+            self.vsp.set_num_chips(self.num_chips)
+        except Exception:
+            if not self.tpu_mode:
+                raise
+            log.info("SetNumChips not supported by VSP in tpu mode; "
+                     "continuing with native chip count")
+        self._setup_done.set()
+
+    def get_devices(self) -> dict:
+        """Blocks until setup ran once (dpudevicehandler.go:50)."""
+        if not self._setup_done.wait(timeout=30):
+            raise TimeoutError("setup_devices did not complete")
+        devs = self.vsp.get_devices()
+        if not self.tpu_mode:
+            # host side advertises PCI addresses only (:60-73)
+            bad = [d for d in devs if not _PCI_RE.match(d)]
+            if bad:
+                raise ValueError(
+                    f"host-side device ids must be PCI addresses, got {bad}")
+        return devs
+
+
+class IciPortDeviceHandler:
+    """Advertise ICI ports of the local slice as a second resource
+    (google.com/ici-port) — the BASELINE.json north-star requirement that
+    ICI links are schedulable alongside chips."""
+
+    def __init__(self, topology_provider):
+        """*topology_provider*: callable returning (SliceTopology | None,
+        host_index)."""
+        self.topology_provider = topology_provider
+
+    def get_devices(self) -> dict:
+        topo, host = self.topology_provider()
+        if topo is None:
+            return {}
+        return {
+            link.id: {"id": link.id, "healthy": True, "dev_path": "",
+                      "coords": []}
+            for link in topo.ici_ports_on_host(host)
+        }

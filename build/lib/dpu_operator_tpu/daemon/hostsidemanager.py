@@ -1,0 +1,211 @@
+"""Host-side manager: the daemon personality on the CPU host.
+
+Reference: internal/daemon/hostsidemanager.go — starts the VSP, a device
+plugin and a CNI server; its CNI ADD handler provisions the local device then
+calls CreateBridgePort on the *tpu-side* daemon over TCP with a retry policy
+(:48-74, :145-174, :176-197); an embedded manager runs the SfcReconciler
+(:320-346). The TPU translation: CNI ADD allocates the TPU PCIe function /
+chip to the pod (allocator + disk cache standing in for the VF netns dance)
+and registers a slice attachment with the tpu-side daemon so the chip's ICI
+ports are wired into the pod slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import grpc
+
+from ..cni import ChipAllocator, CniServer, NetConfCache
+from ..cni.ipam import ipam_add, ipam_del
+from ..cni.types import DeviceWiring, PodRequest
+from ..deviceplugin import DevicePlugin
+from ..k8s.manager import Manager
+from ..utils import vars as v
+from ..utils.path_manager import PathManager
+from ..vsp.rpc import VspChannel
+from .device_handler import TpuDeviceHandler
+from .sfc_reconciler import SfcReconciler
+
+log = logging.getLogger(__name__)
+
+
+class HostSideManager:
+    def __init__(self, vsp_plugin, path_manager: PathManager,
+                 client=None, dial_retries: int = 8,
+                 dial_backoff: float = 0.25):
+        self.vsp = vsp_plugin
+        self.path_manager = path_manager
+        self.client = client
+        self.dial_retries = dial_retries
+        self.dial_backoff = dial_backoff
+        self.device_handler = TpuDeviceHandler(self.vsp, tpu_mode=False)
+        self.device_plugin = DevicePlugin(
+            self.device_handler, resource=v.TPU_RESOURCE_NAME,
+            path_manager=path_manager)
+        self.cni_server = CniServer(
+            path_manager.cni_server_socket(),
+            add_handler=self._cni_add, del_handler=self._cni_del)
+        self.cache = NetConfCache(path_manager.cni_cache_dir())
+        self.allocator = ChipAllocator(path_manager.cni_cache_dir() + "/alloc")
+        self.ipam_dir = path_manager.cni_cache_dir() + "/ipam"
+        self._tpu_daemon_addr: Optional[tuple] = None
+        self._manager: Optional[Manager] = None
+
+    # -- SideManager lifecycle (daemon.go:23-28) ------------------------------
+    def start_vsp(self):
+        ip, port = self.vsp.start(tpu_mode=False)
+        self._tpu_daemon_addr = (ip, port)
+        log.info("host side: tpu-side daemon at %s:%d", ip, port)
+
+    def setup_devices(self):
+        self.device_handler.setup_devices()
+
+    def listen(self):
+        self.device_plugin.start()
+        self.cni_server.start()
+
+    def serve(self):
+        self.device_plugin.register_with_kubelet()
+        if self.client is not None:
+            self._manager = Manager(self.client)
+            self._manager.add_reconciler(SfcReconciler())
+            self._manager.start()
+
+    def stop(self):
+        if self._manager:
+            self._manager.stop()
+        self.cni_server.stop()
+        self.device_plugin.stop()
+        self.vsp.close()
+
+    # -- cross-boundary slice attachment (hostsidemanager.go:48-74) -----------
+    #: transport-level statuses worth retrying; anything else is the
+    #: tpu-side daemon *answering* with an application error — retrying
+    #: burns the CNI deadline and must surface as-is, not ConnectionError
+    _RETRYABLE = (grpc.StatusCode.UNAVAILABLE,
+                  grpc.StatusCode.DEADLINE_EXCEEDED)
+
+    def _tpu_daemon_call(self, method: str, req: dict) -> dict:
+        if self._tpu_daemon_addr is None:
+            raise RuntimeError("VSP not started")
+        ip, port = self._tpu_daemon_addr
+        last: Optional[Exception] = None
+        for attempt in range(self.dial_retries):
+            channel = VspChannel(f"{ip}:{port}")
+            try:
+                return channel.call("SliceService", method, req, timeout=10.0)
+            except grpc.RpcError as e:  # retry w/ backoff (:154-166)
+                if e.code() not in self._RETRYABLE:
+                    raise RuntimeError(
+                        f"tpu-side daemon rejected {method}: "
+                        f"{e.details()}") from e
+                last = e
+                if attempt < self.dial_retries - 1:
+                    time.sleep(self.dial_backoff * (2 ** min(attempt, 4)))
+            finally:
+                channel.close()
+        raise ConnectionError(
+            f"tpu-side daemon unreachable after {self.dial_retries} tries: "
+            f"{last}")
+
+    def create_slice_attachment(self, host: int, chip: int,
+                                topology: str = "") -> dict:
+        return self._tpu_daemon_call("CreateSliceAttachment", {
+            "name": f"host{host}-{chip}",
+            "chip_index": chip,
+            "topology": topology,
+        })
+
+    def delete_slice_attachment(self, host: int, chip: int) -> None:
+        self._tpu_daemon_call("DeleteSliceAttachment",
+                              {"name": f"host{host}-{chip}"})
+
+    # -- CNI handlers (hostsidemanager.go:176-197) ----------------------------
+    def _chip_index_for_device(self, device_id: str) -> int:
+        """Stable chip index from the allocated device id (the reference
+        derives VF index from PCI-address math): chip-<n> ids carry it,
+        PCI-address ids carry a VSP-assigned append-only ``chip_index`` —
+        never list position, which shifts when the device set changes."""
+        if device_id.startswith("chip-"):
+            return int(device_id.split("-", 1)[1])
+        info = self.device_handler.get_devices().get(device_id)
+        if info is not None and "chip_index" in info:
+            return int(info["chip_index"])
+        raise ValueError(
+            f"unknown device id {device_id!r} (no stable chip index)")
+
+    def _cni_add(self, req: PodRequest) -> dict:
+        if not req.device_id:
+            raise ValueError("CNI ADD without deviceID (device plugin must "
+                             "allocate first)")
+        chip = self._chip_index_for_device(req.device_id)
+        if not self.allocator.allocate(req.device_id, req.sandbox_id):
+            raise RuntimeError(
+                f"device {req.device_id} already allocated to "
+                f"{self.allocator.owner(req.device_id)}")
+        try:
+            att = self.create_slice_attachment(
+                host=0, chip=chip, topology=req.netconf.topology)
+        except Exception:
+            # roll back so a retried/new sandbox can claim the device
+            self.allocator.release(req.device_id, req.sandbox_id)
+            raise
+        # IPAM delegation for the attachment (sriov.go:423-484 analog;
+        # optional — chip attachments may be compute-only)
+        try:
+            ips = ipam_add(req.netconf.ipam, self.ipam_dir,
+                           req.netconf.name, req.sandbox_id, req.ifname)
+        except Exception:
+            try:
+                self.delete_slice_attachment(host=0, chip=chip)
+            except Exception:  # noqa: BLE001 — never mask the IPAM error
+                log.warning("attachment rollback failed after IPAM "
+                            "failure for %s", req.sandbox_id)
+            self.allocator.release(req.device_id, req.sandbox_id)
+            raise
+        # concrete per-sandbox wiring: device node, cgroup rule, libtpu
+        # mount, env — what the runtime must materialize (SetupVF analog)
+        info = self.device_handler.get_devices().get(req.device_id) or {}
+        wiring = DeviceWiring.for_chip(
+            chip, dev_path=info.get("dev_path", ""),
+            libtpu_path=self.path_manager.libtpu_path())
+        self.cache.save(req.sandbox_id, req.ifname, {
+            "deviceID": req.device_id,
+            "chip": chip,
+            "attachment": att.get("name"),
+            "netconf": req.netconf.to_dict(),
+            "wiring": wiring.to_dict(),
+        })
+        result = {
+            "cniVersion": req.netconf.cni_version,
+            "interfaces": [{"name": req.ifname, "sandbox": req.netns}],
+            "tpu": {"deviceID": req.device_id, "chip": chip,
+                    "attachment": att.get("name"),
+                    "wiring": wiring.to_dict()},
+        }
+        if ips is not None:
+            result.update(ips)
+        return result
+
+    def _cni_del(self, req: PodRequest) -> dict:
+        cached = self.cache.load(req.sandbox_id, req.ifname)
+        if cached is None:
+            return {}  # defensive DEL (sriov.go:553-566)
+        try:
+            self.delete_slice_attachment(host=0, chip=cached["chip"])
+        except ConnectionError:
+            log.warning("tpu-side daemon unreachable on DEL; releasing "
+                        "local state anyway")
+        # release the delegated address using the *cached* NetConf — the
+        # DEL request's stdin may be stale/absent (sriov.go:505-583 reads
+        # the cache for exactly this reason)
+        ipam_cfg = (cached.get("netconf") or {}).get("ipam") or {}
+        ipam_del(ipam_cfg, self.ipam_dir,
+                 (cached.get("netconf") or {}).get("name", ""),
+                 req.sandbox_id, req.ifname)
+        self.allocator.release(cached["deviceID"], req.sandbox_id)
+        self.cache.delete(req.sandbox_id, req.ifname)
+        return {}

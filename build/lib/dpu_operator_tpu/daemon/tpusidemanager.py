@@ -1,0 +1,427 @@
+"""TPU-side manager: the daemon personality on the TPU VM.
+
+Reference: internal/daemon/dpusidemanager.go — additionally serves the OPI
+BridgePort service on the addr:port the VSP Init returned, forwarding to the
+VSP (:141-165); CNI handlers accumulate two attachments per pod netns and
+then call CreateNetworkFunction (macStore, :45, :104-139); Serve runs four
+servers concurrently: cross-boundary gRPC, device plugin, CNI server, and the
+embedded controller manager with the SFC reconciler (:176-254).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Optional
+
+from ..cni import CniServer
+from ..cni.ipam import ipam_add, ipam_del
+from ..cni.types import PodRequest
+from ..deviceplugin import DevicePlugin
+from ..k8s.manager import Manager
+from ..utils import vars as v
+from ..utils.path_manager import PathManager
+from ..vsp.rpc import VspServer
+from .device_handler import IciPortDeviceHandler, TpuDeviceHandler
+from .sfc_reconciler import SfcReconciler
+
+log = logging.getLogger(__name__)
+
+
+class _SliceServiceForwarder:
+    """Implementation backing the cross-boundary TCP server: forwards
+    slice/NF calls into the VSP (dpusidemanager.go:51 pass-through)."""
+
+    def __init__(self, vsp):
+        self.vsp = vsp
+
+    def create_slice_attachment(self, req: dict) -> dict:
+        return self.vsp.create_slice_attachment(req)
+
+    def delete_slice_attachment(self, req: dict) -> dict:
+        self.vsp.delete_slice_attachment(req.get("name", ""))
+        return {}
+
+    def create_network_function(self, req: dict) -> dict:
+        self.vsp.create_network_function(req.get("input", ""),
+                                         req.get("output", ""))
+        return {}
+
+    def delete_network_function(self, req: dict) -> dict:
+        self.vsp.delete_network_function(req.get("input", ""),
+                                         req.get("output", ""))
+        return {}
+
+
+class TpuSideManager:
+    def __init__(self, vsp_plugin, path_manager: PathManager, client=None,
+                 workload_image: str = ""):
+        self.vsp = vsp_plugin
+        self.path_manager = path_manager
+        self.client = client
+        self.workload_image = workload_image
+        self.device_handler = TpuDeviceHandler(self.vsp, tpu_mode=True)
+        self.device_plugin = DevicePlugin(
+            self.device_handler, resource=v.TPU_RESOURCE_NAME,
+            path_manager=path_manager)
+        self.ici_device_plugin: Optional[DevicePlugin] = None
+        self.cni_server = CniServer(
+            path_manager.cni_server_socket(),
+            add_handler=self._cni_nf_add, del_handler=self._cni_nf_del)
+        self.ipam_dir = path_manager.cni_cache_dir() + "/ipam"
+        # ADD-time NetConf cache: DEL releases addressing from what ADD
+        # actually configured, even across daemon restarts or NAD updates
+        # (the host side's NetConfCache rationale, sriov.go:505-583)
+        from ..cni import NetConfCache
+        self.nf_cache = NetConfCache(path_manager.cni_cache_dir() + "/nf")
+        self._slice_server: Optional[VspServer] = None
+        self._addr: Optional[tuple] = None
+        # attachment accumulator per pod sandbox (macStore analog, :45);
+        # value: {"atts": [unique ids in arrival order], "wired": bool}
+        self._attach_store: dict[str, dict] = {}
+        self._attach_lock = threading.Lock()
+        # chain steering: (ns, sfc) -> {index: {"in","out","sandbox"}};
+        # hops: (ns, sfc, i) -> (out_id, in_id) wired between NF i and i+1
+        self._chain_store: dict[tuple, dict] = {}
+        self._chain_hops: dict[tuple, tuple] = {}
+        self._manager: Optional[Manager] = None
+
+    # -- SideManager lifecycle ------------------------------------------------
+    def start_vsp(self):
+        ip, port = self.vsp.start(tpu_mode=True)
+        self._addr = (ip, port)
+
+    def setup_devices(self):
+        self.device_handler.setup_devices()
+
+    def listen(self):
+        # cross-boundary server on the VSP-returned addr (:141-165)
+        ip, port = self._addr
+        self._slice_server = VspServer(
+            _SliceServiceForwarder(self.vsp), tcp_addr=(ip, port))
+        self._slice_server.start()
+        self.device_plugin.start()
+        self.cni_server.start()
+
+    def serve(self):
+        self.device_plugin.register_with_kubelet()
+        # advertise google.com/ici-port once the VSP reported its slice
+        # topology (the BASELINE north-star: ICI links schedulable
+        # alongside chips); worker index from the TPU VM environment
+        topology = getattr(self.vsp, "topology", "")
+        if topology and self.ici_device_plugin is None:
+            from ..ici import SliceTopology
+            topo = SliceTopology(topology)
+            worker = int(os.environ.get("TPU_WORKER_ID", "0"))
+            self.enable_ici_ports(lambda: (topo, worker))
+        if self.client is not None:
+            self._manager = Manager(self.client)
+            self._manager.add_reconciler(
+                SfcReconciler(workload_image=self.workload_image))
+            self._manager.start()
+
+    def stop(self):
+        if self._manager:
+            self._manager.stop()
+        self.cni_server.stop()
+        self.device_plugin.stop()
+        if self.ici_device_plugin:
+            self.ici_device_plugin.stop()
+        if self._slice_server:
+            self._slice_server.stop()
+        self.vsp.close()
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        return self._slice_server.bound_port if self._slice_server else None
+
+    # -- disruptive reconfiguration -------------------------------------------
+    def resize_chips(self, count: int, node_name: str = "") -> list:
+        """Change the advertised chip count; shrinking DRAINS first.
+
+        Chips vanishing from allocatable strand any pod still consuming
+        them, so a shrink cordons the node, evicts chip-consuming pods,
+        applies SetNumChips, and uncordons — the drain the reference left
+        as a TODO before SetNumVfs (dpudevicehandler.go:78-83; facade
+        parity pkgs/drain/drain.go:19-43). Growth is non-disruptive and
+        skips the drain. Returns evicted pod names. The device plugin's
+        ListAndWatch poll pushes the shrunken set to the kubelet."""
+        node_name = node_name or os.environ.get("NODE_NAME", "")
+        current = len(self.device_handler.get_devices())
+        shrink = count < current
+        drainer = None
+        evicted: list = []
+        if shrink and self.client is not None and node_name:
+            from ..utils.drain import Drainer
+            drainer = Drainer(self.client)
+        elif shrink:
+            log.warning(
+                "resize_chips %d->%d: shrinking WITHOUT drain (no kube "
+                "client or node name) — chip-consuming pods are stranded",
+                current, count)
+        try:
+            if drainer is not None:
+                evicted = drainer.drain(node_name)
+                log.info("resize_chips %d->%d: drained %s", current, count,
+                         evicted)
+            self.vsp.set_num_chips(count)
+        finally:
+            if drainer is not None:
+                # never leave the node cordoned, even if eviction or the
+                # VSP call blew up mid-way
+                try:
+                    drainer.uncordon(node_name)
+                except Exception:  # noqa: BLE001 — best-effort restore
+                    log.exception("uncordon %s failed", node_name)
+        return evicted
+
+    # -- CNI network-function handlers (dpusidemanager.go:104-139) ------------
+    def _unwire_quietly(self, ids: tuple, context: str):
+        """Defensive unwind: best-effort delete_network_function with the
+        failure logged, never raised (DEL/unwind paths must make progress)."""
+        try:
+            self.vsp.delete_network_function(*ids)
+        except Exception:  # noqa: BLE001 — defensive unwind
+            log.warning("NF unwire failed (%s) for %s", context, ids)
+
+    def _cni_nf_add(self, req: PodRequest) -> dict:
+        """Each ADD contributes one slice attachment; once two distinct
+        attachments exist for the pod, wire the network function. Idempotent
+        under kubelet ADD retries: duplicate attachment ids are deduped, and
+        a failed wire is re-attempted on the next retry."""
+        if not req.device_id:
+            raise ValueError("NF CNI ADD without deviceID")
+        attachment_id = f"nf-{req.sandbox_id[:12]}-{req.device_id}"
+        # delegate addressing for the NF's secondary interface before any
+        # wiring: NF pods need distinct addresses per interface
+        # (networkfn.go:233-317 optional-IPAM analog); host-local keeps
+        # per-sandbox idempotency so kubelet ADD retries reuse the address
+        ipam_cfg = req.netconf.ipam or {}
+        network = req.netconf.name or ""
+        ips = ipam_add(ipam_cfg, self.ipam_dir, network,
+                       req.sandbox_id, req.ifname)
+        if ips is not None:
+            self.nf_cache.save(req.sandbox_id, req.ifname,
+                               {"ipam": ipam_cfg, "network": network})
+        pair = None
+        with self._attach_lock:
+            entry = self._attach_store.setdefault(
+                req.sandbox_id, {"atts": [], "wired": False,
+                                 "wiring": False, "ici_ports": []})
+            if attachment_id not in entry["atts"]:
+                entry["atts"].append(attachment_id)
+            # scheduler-allocated ICI ports (device plugin Allocate →
+            # runtime → NetConf); arrival-order dedup — [ingress, egress]
+            for p in req.netconf.ici_ports:
+                if p not in entry["ici_ports"]:
+                    entry["ici_ports"].append(p)
+            if (len(entry["atts"]) >= 2 and not entry["wired"]
+                    and not entry["wiring"]):
+                entry["wiring"] = True  # claim the wire; VSP call is slow
+                pair = (entry["atts"][0], entry["atts"][1])
+            wired = entry["wired"]
+        if pair is not None:
+            # outside the lock: a stalled VSP must not serialize every
+            # other pod's NF ADD behind this one
+            try:
+                self.vsp.create_network_function(*pair)
+            except Exception:
+                with self._attach_lock:
+                    e2 = self._attach_store.get(req.sandbox_id)
+                    if e2:
+                        e2["wiring"] = False
+                raise
+            orphaned = False
+            with self._attach_lock:
+                e2 = self._attach_store.get(req.sandbox_id)
+                if (e2 is None or pair[0] not in e2["atts"]
+                        or pair[1] not in e2["atts"]):
+                    orphaned = True
+                    if e2 is not None:
+                        e2["wiring"] = False
+                else:
+                    e2["wiring"] = False
+                    e2["wired"] = True
+                    e2["pair"] = pair
+            if orphaned:
+                # A concurrent DEL tore down the sandbox (or one of the
+                # wired interfaces) while the wire was in flight; nothing
+                # will unwire it later — undo now and fail the ADD so
+                # kubelet retries against current state.
+                self._unwire_quietly(pair, "orphaned sandbox wire")
+                raise RuntimeError(
+                    "sandbox torn down while network function wire was "
+                    "in flight")
+            wired = True
+            self._update_chain(req, pair)
+        result = {
+            "cniVersion": req.netconf.cni_version,
+            "interfaces": [{"name": req.ifname, "sandbox": req.netns}],
+            "tpu": {"attachment": attachment_id, "networkFunction": wired},
+        }
+        if ips is not None:
+            result.update(ips)
+        return result
+
+    # -- SFC chain steering ---------------------------------------------------
+    @staticmethod
+    def _hop_ids(upstream: dict, downstream: dict) -> tuple:
+        """Endpoint ids for the hop between consecutive NFs: the upstream
+        NF's EGRESS ici-port to the downstream NF's INGRESS ici-port when
+        the scheduler allocated ports (google.com/ici-port — VERDICT r2
+        #2: steer over allocations, not topology inference); attachment
+        ids otherwise (ports are optional for plain NF pods)."""
+        up_ports = upstream.get("ports") or []
+        down_ports = downstream.get("ports") or []
+        out_id = up_ports[-1] if up_ports else upstream["out"]
+        in_id = down_ports[0] if down_ports else downstream["in"]
+        return (out_id, in_id)
+
+    def _update_chain(self, req: PodRequest, pair: tuple):
+        """After a pod's own NF is wired, steer the chain: wire this NF's
+        egress to the next NF's ingress (and previous egress to this
+        ingress) once both sides exist — the ICI analog of the reference's
+        chain flow rules (marvell/main.go:544-560 uplink/hairpin rules)."""
+        if self.client is None or not req.pod_name:
+            return
+        pod = self.client.get("v1", "Pod", req.pod_name,
+                              namespace=req.pod_namespace or "default")
+        if pod is None:
+            return
+        ann = (pod.get("metadata", {}).get("annotations") or {})
+        sfc = ann.get("tpu.openshift.io/sfc")
+        if not sfc:
+            return
+        try:
+            index = int(ann.get("tpu.openshift.io/sfc-index", ""))
+        except ValueError:
+            return
+        key = (req.pod_namespace or "default", sfc)
+        to_wire = []
+        with self._attach_lock:
+            entry = self._attach_store.get(req.sandbox_id)
+            if (entry is None or not entry.get("wired")
+                    or entry.get("pair") != pair):
+                # a DEL tore the sandbox down between the wire completing
+                # and this chain registration — don't resurrect it
+                return
+            chain = self._chain_store.setdefault(key, {})
+            chain[index] = {"in": pair[0], "out": pair[1],
+                            "sandbox": req.sandbox_id,
+                            "ports": list(entry.get("ici_ports") or [])}
+            for i in (index - 1, index):
+                hop_key = key + (i,)
+                if (i in chain and i + 1 in chain
+                        and hop_key not in self._chain_hops):
+                    ids = self._hop_ids(chain[i], chain[i + 1])
+                    self._chain_hops[hop_key] = ids
+                    to_wire.append((hop_key, ids))
+        for hop_key, ids in to_wire:
+            try:
+                self.vsp.create_network_function(*ids)
+                log.info("wired SFC hop %s: %s -> %s", hop_key, *ids)
+            except Exception:  # noqa: BLE001 — retried on next ADD
+                with self._attach_lock:
+                    # only our own registration: teardown may have removed
+                    # it and a new pod re-registered the same hop key
+                    if self._chain_hops.get(hop_key) == ids:
+                        self._chain_hops.pop(hop_key)
+                log.warning("SFC hop wire failed for %s", hop_key)
+                continue
+            with self._attach_lock:
+                still_wired = self._chain_hops.get(hop_key) == ids
+            if not still_wired:
+                # teardown raced us and already "unwired" the hop before
+                # our wire landed — undo it so nothing leaks
+                self._unwire_quietly(ids, "raced SFC hop")
+
+    def _teardown_chain(self, sandbox_id: str):
+        """Unwire chain hops touching a departing sandbox."""
+        to_unwire = []
+        with self._attach_lock:
+            for key, chain in list(self._chain_store.items()):
+                for index, entry in list(chain.items()):
+                    if entry["sandbox"] != sandbox_id:
+                        continue
+                    del chain[index]
+                    for i in (index - 1, index):
+                        ids = self._chain_hops.pop(key + (i,), None)
+                        if ids:
+                            to_unwire.append(ids)
+                if not chain:
+                    self._chain_store.pop(key, None)
+        for ids in to_unwire:
+            self._unwire_quietly(ids, "chain teardown")
+
+    def _cni_nf_del(self, req: PodRequest) -> dict:
+        """DEL for one interface removes only that interface's attachment
+        (a multus-style per-interface DEL+retry must not discard the other
+        interface's state); a DEL without deviceID tears the sandbox down."""
+        attachment_id = (f"nf-{req.sandbox_id[:12]}-{req.device_id}"
+                         if req.device_id else None)
+        # Release delegated addresses FIRST, from the ADD-time cached
+        # config — the in-memory attach entry may be gone (daemon restart)
+        # and the DEL stdin may carry a different IPAM than ADD configured
+        # (NAD updated while the pod ran); per-interface DEL frees this
+        # ifname, full teardown frees every address the sandbox holds.
+        per_if = attachment_id is not None
+        if per_if:
+            cached = self.nf_cache.load(req.sandbox_id, req.ifname) or {}
+            ipam_del(cached.get("ipam") or req.netconf.ipam, self.ipam_dir,
+                     cached.get("network") or req.netconf.name,
+                     req.sandbox_id, req.ifname)
+            self.nf_cache.delete(req.sandbox_id, req.ifname)
+        else:
+            # Full teardown: the sandbox may hold addresses under several
+            # networks/NADs (one cached entry per ifname, each with its own
+            # ipam + network) — release every (ipam, network) before the
+            # cache entries are destroyed, else the other networks'
+            # host-local allocations leak permanently.
+            cached_all = self.nf_cache.load_all(req.sandbox_id)
+            released = set()
+            for cached in cached_all:
+                key = (json.dumps(cached.get("ipam"), sort_keys=True),
+                       cached.get("network"))
+                if key in released:
+                    continue
+                released.add(key)
+                ipam_del(cached.get("ipam"), self.ipam_dir,
+                         cached.get("network"), req.sandbox_id, None)
+            if not cached_all:
+                ipam_del(req.netconf.ipam, self.ipam_dir, req.netconf.name,
+                         req.sandbox_id, None)
+            self.nf_cache.delete_sandbox(req.sandbox_id)
+        unwire = None
+        with self._attach_lock:
+            entry = self._attach_store.get(req.sandbox_id)
+            if entry is None:
+                return {}
+            if attachment_id is None:
+                if entry["wired"]:
+                    unwire = entry.get("pair")
+                self._attach_store.pop(req.sandbox_id)
+            elif attachment_id in entry["atts"]:
+                if entry["wired"] and attachment_id in (
+                        entry.get("pair") or ()):
+                    unwire = entry.get("pair")
+                    entry["wired"] = False
+                    entry["pair"] = None
+                entry["atts"].remove(attachment_id)
+                if not entry["atts"]:
+                    self._attach_store.pop(req.sandbox_id, None)
+        if unwire is not None:
+            self._unwire_quietly(unwire, "sandbox DEL")
+            self._teardown_chain(req.sandbox_id)
+        return {}
+
+    # -- ICI port advertisement ----------------------------------------------
+    def enable_ici_ports(self, topology_provider):
+        """Advertise google.com/ici-port as a second device plugin."""
+        self.ici_device_plugin = DevicePlugin(
+            IciPortDeviceHandler(topology_provider),
+            resource=v.ICI_RESOURCE_NAME,
+            path_manager=self.path_manager)
+        self.ici_device_plugin.start()
+        self.ici_device_plugin.register_with_kubelet()

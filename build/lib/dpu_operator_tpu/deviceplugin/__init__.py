@@ -1,0 +1,4 @@
+from .server import DevicePlugin
+from .fake_kubelet import FakeKubelet
+
+__all__ = ["DevicePlugin", "FakeKubelet"]

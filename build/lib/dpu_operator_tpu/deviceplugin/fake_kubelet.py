@@ -1,0 +1,153 @@
+"""Fake kubelet: a real gRPC Registration server on kubelet.sock.
+
+The test analog of the reference's Kind trick (kindcluster.go:162-214 mounts
+the test dir so real kubelet sees plugin sockets). Here the kubelet itself is
+faked instead: it accepts Register, dials the plugin's socket back (the
+reference's self-connect concern, deviceplugin.go:166-204), consumes the
+ListAndWatch stream, and mirrors healthy-device counts into FakeKube node
+allocatable — so dpusidemanager_test.go:22-49-style assertions ("node reports
+google.com/tpu allocatable") run against real device-plugin wire traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..utils.path_manager import PathManager
+from . import kubelet_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+
+class _RegistrationHandler(grpc.GenericRpcHandler):
+    def __init__(self, kubelet: "FakeKubelet"):
+        self.kubelet = kubelet
+
+    def service(self, hcd):
+        if hcd.method == "/v1beta1.Registration/Register":
+            return grpc.unary_unary_rpc_method_handler(
+                self.kubelet._register,
+                request_deserializer=pb.RegisterRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString())
+        return None
+
+
+class FakeKubelet:
+    def __init__(self, path_manager: PathManager, node_agent=None,
+                 node_name: str = ""):
+        """*node_agent* (FakeNodeAgent) + *node_name*: where allocatable
+        updates land; optional for pure wire-level tests."""
+        self.path_manager = path_manager
+        self.node_agent = node_agent
+        self.node_name = node_name
+        self._server: Optional[grpc.Server] = None
+        self._watch_threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self.registrations: list[pb.RegisterRequest] = []
+        self.device_lists: dict[str, list] = {}
+        self._alloc_channels: dict[str, grpc.Channel] = {}
+        self._lock = threading.Lock()
+        self._updated = threading.Condition(self._lock)
+
+    def start(self):
+        sock = self.path_manager.kubelet_socket()
+        os.makedirs(os.path.dirname(sock), exist_ok=True)
+        if os.path.exists(sock):
+            os.unlink(sock)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers((_RegistrationHandler(self),))
+        self._server.add_insecure_port(f"unix://{sock}")
+        self._server.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._server:
+            self._server.stop(0.5).wait()
+            self._server = None
+        for t in self._watch_threads:
+            t.join(timeout=2)
+        with self._lock:
+            for channel in self._alloc_channels.values():
+                channel.close()
+            self._alloc_channels.clear()
+
+    # -- Registration service -------------------------------------------------
+    def _register(self, request: pb.RegisterRequest, context):
+        with self._lock:
+            self.registrations.append(request)
+        endpoint = os.path.join(self.path_manager.kubelet_plugin_dir(),
+                                request.endpoint)
+        t = threading.Thread(
+            target=self._watch_plugin,
+            args=(request.resource_name, endpoint), daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+        return pb.Empty()
+
+    # -- kubelet-side ListAndWatch consumption -------------------------------
+    def _watch_plugin(self, resource: str, endpoint: str):
+        channel = grpc.insecure_channel(f"unix://{endpoint}")
+        try:
+            grpc.channel_ready_future(channel).result(timeout=5)
+            stream = channel.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ListAndWatchResponse.FromString)
+            for resp in stream(pb.Empty()):
+                if self._stop.is_set():
+                    break
+                devices = list(resp.devices)
+                healthy = sum(1 for d in devices if d.health == "Healthy")
+                with self._updated:
+                    self.device_lists[resource] = devices
+                    self._updated.notify_all()
+                if self.node_agent and self.node_name:
+                    self.node_agent.set_allocatable(
+                        self.node_name, resource, healthy)
+        except grpc.RpcError as e:
+            if not self._stop.is_set():
+                log.warning("kubelet watch of %s ended: %s", resource, e)
+        finally:
+            channel.close()
+
+    # -- test helpers ---------------------------------------------------------
+    def wait_for_devices(self, resource: str, count: int,
+                         timeout: float = 10.0) -> bool:
+        def ok():
+            devs = self.device_lists.get(resource)
+            return devs is not None and len(devs) == count
+
+        start = time.monotonic()
+        with self._updated:
+            while not ok():
+                remaining = timeout - (time.monotonic() - start)
+                if remaining <= 0:
+                    return False
+                self._updated.wait(remaining)
+            return True
+
+    def allocate(self, resource: str, device_ids: list,
+                 timeout: float = 10.0) -> pb.AllocateResponse:
+        """Drive the plugin's Allocate like kubelet would at pod admission.
+        The channel is cached per resource — real kubelet holds the plugin
+        connection open, and channel_ready polling costs ~200 ms/call."""
+        with self._lock:
+            channel = self._alloc_channels.get(resource)
+            if channel is None:
+                endpoint = self.path_manager.device_plugin_socket(resource)
+                channel = grpc.insecure_channel(f"unix://{endpoint}")
+                self._alloc_channels[resource] = channel
+        allocate = channel.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.AllocateResponse.FromString)
+        return allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=device_ids)]),
+            timeout=timeout, wait_for_ready=True)

@@ -1,0 +1,17 @@
+from .topology import (
+    SliceTopology,
+    Chip,
+    IciLink,
+    parse_topology,
+    slice_shape,
+    MultiSliceGroup,
+)
+
+__all__ = [
+    "SliceTopology",
+    "Chip",
+    "IciLink",
+    "parse_topology",
+    "slice_shape",
+    "MultiSliceGroup",
+]

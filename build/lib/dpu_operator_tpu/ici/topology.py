@@ -1,0 +1,255 @@
+"""ICI mesh topology model: chips, links, slices, multi-slice groups.
+
+This is the TPU dataplane the operator programs — the analog of the
+reference's OVS bridges / P4 pipeline (marvell/ovs-dp/ovsdp.go:40-162,
+cmd/intelvsp/p4sdk). Where the reference programs flow rules between VFs and
+uplinks, the TPU build programs pod-slice construction: chip coordinates, ICI
+port wiring (2D torus for v5e, 3D torus for v5p with wraparound), and
+multi-slice grouping over DCN (SURVEY.md §2.7).
+
+Shapes follow public TPU system documentation: v5e slices are 2D meshes up to
+16x16 (256 chips, tori on 8x8+), v4/v5p slices are 3D tori built from 4x4x4
+cubes with wraparound links on full-cube dimensions.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+_TOPOLOGY_RE = re.compile(r"^(v[2-6][ep]?)-(\d+)$")
+
+#: ICI links per chip by generation (public: v5e has 4 2D-ICI ports,
+#: v5p/v4 have 6 3D-ICI ports).
+PORTS_PER_CHIP = {"v2": 4, "v3": 4, "v4": 6, "v5e": 4, "v5p": 6, "v6e": 4}
+
+#: per-link ICI bandwidth, GB/s each direction (public numbers:
+#: v4 ≈ 50 GB/s/link, v5e ≈ 50, v5p ≈ 100, v6e ≈ 100).
+LINK_GBPS = {"v2": 50.0, "v3": 70.0, "v4": 50.0, "v5e": 50.0, "v5p": 100.0,
+             "v6e": 100.0}
+
+#: chips per host VM by generation (v5e: 8 for standard hosts, v5p: 4).
+CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
+
+
+def parse_topology(topology: str) -> tuple[str, int]:
+    m = _TOPOLOGY_RE.match(topology)
+    if not m:
+        raise ValueError(f"invalid topology {topology!r}")
+    return m.group(1), int(m.group(2))
+
+
+def _factor_2d(n: int) -> tuple[int, int]:
+    """Most-square 2D factorization (v5e slice shapes: 2x2, 2x4, 4x4, 4x8,
+    8x8, 8x16, 16x16)."""
+    best = (1, n)
+    for a in range(1, int(math.isqrt(n)) + 1):
+        if n % a == 0:
+            best = (a, n // a)
+    return best
+
+
+def _factor_3d(n: int) -> tuple[int, int, int]:
+    """Most-cubic 3D factorization for v4/v5p tori."""
+    best = (1, 1, n)
+    best_score = n * 3
+    for a in range(1, int(round(n ** (1 / 3))) + 2):
+        if n % a:
+            continue
+        for b in range(a, int(math.isqrt(n // a)) + 1):
+            if (n // a) % b:
+                continue
+            c = n // a // b
+            score = a + b + c
+            if score < best_score:
+                best_score = score
+                best = (a, b, c)
+    return best
+
+
+def slice_shape(topology: str) -> tuple[int, ...]:
+    """Grid shape for a slice, e.g. v5e-16 → (4, 4); v5p-32 → (2, 4, 4)."""
+    gen, chips = parse_topology(topology)
+    if PORTS_PER_CHIP[gen] == 4:
+        return _factor_2d(chips)
+    return _factor_3d(chips)
+
+
+@dataclass(frozen=True)
+class Chip:
+    """One TPU chip: index within slice, torus coordinates, owning host."""
+    index: int
+    coords: tuple
+    host: int
+    local_index: int = 0  # position within its host VM
+
+    @property
+    def id(self) -> str:
+        return f"chip-{self.index}"
+
+    @property
+    def device_path(self) -> str:
+        """Char device within its host VM (one accel dev per local chip)."""
+        return f"/dev/accel{self.local_index}"
+
+
+@dataclass(frozen=True)
+class IciLink:
+    """A directed ICI link between neighbor chips on one torus dimension."""
+    src: int
+    dst: int
+    dim: int
+    port: str  # e.g. "x+", "y-"
+
+    @property
+    def id(self) -> str:
+        return f"ici-{self.src}-{self.port}"
+
+
+@dataclass
+class SliceTopology:
+    """A fully-wired pod slice: the object the GoogleTpuVSP programs.
+
+    The equivalent of the reference's bridge + flow-rule state: chips are
+    ports, ICI links are flows, and ``wire()`` is InitDataPlane
+    (marvell/main.go:272-277).
+    """
+
+    topology: str
+    generation: str = field(init=False)
+    shape: tuple = field(init=False)
+    chips: list = field(init=False, default_factory=list)
+    links: list = field(init=False, default_factory=list)
+
+    def __post_init__(self):
+        self.generation, n = parse_topology(self.topology)
+        self.shape = slice_shape(self.topology)
+        per_host = CHIPS_PER_HOST[self.generation]
+        dims = len(self.shape)
+        for idx in range(n):
+            coords = []
+            rem = idx
+            for d in reversed(self.shape):
+                coords.append(rem % d)
+                rem //= d
+            coords = tuple(reversed(coords))
+            self.chips.append(Chip(index=idx, coords=coords,
+                                   host=idx // per_host,
+                                   local_index=idx % per_host))
+        self._wire(dims)
+
+    def _index(self, coords: tuple) -> int:
+        idx = 0
+        for c, d in zip(coords, self.shape):
+            idx = idx * d + c
+        return idx
+
+    def _wire(self, dims: int):
+        """Wire torus neighbor links. Dimensions of extent 1 get no links;
+        extent-2 dimensions get a single (non-duplicated) link; wraparound on
+        every dimension ≥3 (torus) matching v5e 8x8+ / v5p cube semantics."""
+        axis_names = "xyz"
+        for chip in self.chips:
+            for d in range(dims):
+                extent = self.shape[d]
+                if extent == 1:
+                    continue
+                up = list(chip.coords)
+                up[d] = (up[d] + 1) % extent
+                dst = self._index(tuple(up))
+                if extent == 2 and chip.coords[d] == 1:
+                    continue  # avoid double link on extent-2 dims
+                self.links.append(IciLink(
+                    src=chip.index, dst=dst, dim=d,
+                    port=f"{axis_names[d]}+"))
+                self.links.append(IciLink(
+                    src=dst, dst=chip.index, dim=d,
+                    port=f"{axis_names[d]}-"))
+
+    # -- resource accounting (device-plugin view) ----------------------------
+    @property
+    def num_chips(self) -> int:
+        return len(self.chips)
+
+    @property
+    def num_hosts(self) -> int:
+        return 1 + max(c.host for c in self.chips)
+
+    def chips_on_host(self, host: int) -> list:
+        return [c for c in self.chips if c.host == host]
+
+    def links_from(self, chip_index: int) -> list:
+        return [l for l in self.links if l.src == chip_index]
+
+    def ici_ports_on_host(self, host: int) -> list:
+        local = {c.index for c in self.chips_on_host(host)}
+        return [l for l in self.links if l.src in local]
+
+    # -- bandwidth model (feeds bench + traffic tests) -----------------------
+    def bisection_bandwidth_gbps(self) -> float:
+        """Aggregate one-direction bandwidth across the slice bisection."""
+        per_link = LINK_GBPS[self.generation]
+        d = int(max(range(len(self.shape)), key=lambda i: self.shape[i]))
+        cut = 0
+        half = self.shape[d] // 2
+        for link in self.links:
+            a = self.chips[link.src].coords[d]
+            b = self.chips[link.dst].coords[d]
+            if (a < half) != (b < half):
+                cut += 1
+        return cut / 2 * per_link  # /2: count each bidirectional pair once
+
+    def allreduce_algbw_gbps(self, bytes_per_chip: int) -> float:
+        """Ideal ring-allreduce algorithmic bandwidth bound over the slowest
+        torus dimension ring (the 'ring' the SFC path must sustain)."""
+        per_link = LINK_GBPS[self.generation]
+        n = self.num_chips
+        if n <= 1:
+            return float("inf")
+        # ring allreduce moves 2*(n-1)/n of the data over each link
+        return per_link * n / (2 * (n - 1))
+
+    def to_dict(self) -> dict:
+        return {
+            "topology": self.topology,
+            "generation": self.generation,
+            "shape": list(self.shape),
+            "numChips": self.num_chips,
+            "numHosts": self.num_hosts,
+            "chips": [
+                {"id": c.id, "index": c.index, "coords": list(c.coords),
+                 "host": c.host}
+                for c in self.chips
+            ],
+            "links": [
+                {"id": l.id, "src": l.src, "dst": l.dst, "port": l.port}
+                for l in self.links
+            ],
+        }
+
+
+@dataclass
+class MultiSliceGroup:
+    """Multiple slices joined over DCN (multi-slice training analog of the
+    reference's host↔DPU cross-cluster channel, SURVEY.md §2.7 item 2)."""
+
+    slices: list
+    dcn_gbps_per_host: float = 25.0
+
+    @property
+    def num_chips(self) -> int:
+        return sum(s.num_chips for s in self.slices)
+
+    def dcn_allreduce_algbw_gbps(self) -> float:
+        n = len(self.slices)
+        if n <= 1:
+            return float("inf")
+        hosts = min(s.num_hosts for s in self.slices)
+        return self.dcn_gbps_per_host * hosts * n / (2 * (n - 1))
+
+    def to_dict(self) -> dict:
+        return {
+            "slices": [s.to_dict() for s in self.slices],
+            "numChips": self.num_chips,
+        }

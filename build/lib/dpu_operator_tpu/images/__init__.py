@@ -1,0 +1,25 @@
+from .images import (
+    ImageManager,
+    EnvImageManager,
+    DummyImageManager,
+    merge_vars_with_images,
+    TPU_OPERATOR_DAEMON_IMAGE,
+    TPU_VSP_IMAGE,
+    TPU_CNI_IMAGE,
+    NETWORK_RESOURCES_INJECTOR_IMAGE,
+    TPU_CP_AGENT_IMAGE,
+    TPU_WORKLOAD_IMAGE,
+)
+
+__all__ = [
+    "ImageManager",
+    "EnvImageManager",
+    "DummyImageManager",
+    "merge_vars_with_images",
+    "TPU_OPERATOR_DAEMON_IMAGE",
+    "TPU_VSP_IMAGE",
+    "TPU_CNI_IMAGE",
+    "NETWORK_RESOURCES_INJECTOR_IMAGE",
+    "TPU_CP_AGENT_IMAGE",
+    "TPU_WORKLOAD_IMAGE",
+]

@@ -1,0 +1,74 @@
+"""Container image indirection.
+
+Reference: internal/images/images.go:6-13 maps six image keys to env vars set
+on the manager Deployment and propagated into the daemon DaemonSet env
+(bindata/daemon/99.daemonset.yaml:44-51); EnvImageManager reads them
+(env_manager.go:14-33) and DummyImageManager returns ``<key>-mock-image`` for
+tests (dummy_manager.go:11).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol
+
+TPU_OPERATOR_DAEMON_IMAGE = "TpuOperatorDaemonImage"
+TPU_VSP_IMAGE = "TpuVspImage"
+TPU_CNI_IMAGE = "TpuCniImage"
+NETWORK_RESOURCES_INJECTOR_IMAGE = "NetworkResourcesInjectorImage"
+TPU_CP_AGENT_IMAGE = "TpuCpAgentImage"
+TPU_WORKLOAD_IMAGE = "TpuWorkloadImage"
+
+ALL_KEYS = (
+    TPU_OPERATOR_DAEMON_IMAGE,
+    TPU_VSP_IMAGE,
+    TPU_CNI_IMAGE,
+    NETWORK_RESOURCES_INJECTOR_IMAGE,
+    TPU_CP_AGENT_IMAGE,
+    TPU_WORKLOAD_IMAGE,
+)
+
+# must match the env names the daemon DaemonSet bindata sets
+# (controller/bindata/daemon/99.daemonset.yaml env block)
+_ENV_VARS = {
+    TPU_OPERATOR_DAEMON_IMAGE: "TPU_OPERATOR_DAEMON_IMAGE",
+    TPU_VSP_IMAGE: "TPU_VSP_IMAGE",
+    TPU_CNI_IMAGE: "TPU_CNI_IMAGE",
+    NETWORK_RESOURCES_INJECTOR_IMAGE: "NETWORK_RESOURCES_INJECTOR_IMAGE",
+    TPU_CP_AGENT_IMAGE: "TPU_CP_AGENT_IMAGE",
+    TPU_WORKLOAD_IMAGE: "TPU_WORKLOAD_IMAGE",
+}
+
+
+class ImageManager(Protocol):
+    def get_image(self, key: str) -> str: ...
+
+
+class EnvImageManager:
+    """Resolve image keys from environment variables; missing env is an error
+    (reference: env_manager.go:23-31)."""
+
+    def get_image(self, key: str) -> str:
+        env = _ENV_VARS.get(key)
+        if env is None:
+            raise KeyError(f"unknown image key {key!r}")
+        val = os.environ.get(env)
+        if not val:
+            raise KeyError(f"image env var {env} not set")
+        return val
+
+
+class DummyImageManager:
+    def get_image(self, key: str) -> str:
+        if key not in ALL_KEYS:
+            raise KeyError(f"unknown image key {key!r}")
+        return f"{key}-mock-image"
+
+
+def merge_vars_with_images(image_manager: ImageManager, data: dict) -> dict:
+    """MergeVarsWithImages analog (images.go:40): template vars + every image
+    key resolved."""
+    out = dict(data)
+    for key in ALL_KEYS:
+        out[key] = image_manager.get_image(key)
+    return out

@@ -1,0 +1,15 @@
+from .client import KubeClient, gvk_key, set_owner_reference, owned_by
+from .fake import FakeKube, FakeNodeAgent
+from .manager import Manager, Reconciler, ReconcileResult
+
+__all__ = [
+    "KubeClient",
+    "gvk_key",
+    "set_owner_reference",
+    "owned_by",
+    "FakeKube",
+    "FakeNodeAgent",
+    "Manager",
+    "Reconciler",
+    "ReconcileResult",
+]

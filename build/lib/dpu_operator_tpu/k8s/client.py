@@ -1,0 +1,125 @@
+"""Kubernetes client abstraction.
+
+The reference uses controller-runtime's generic client everywhere; this module
+defines the equivalent seam so the operator, daemon and tests share one
+interface with two implementations: :class:`~dpu_operator_tpu.k8s.fake.FakeKube`
+(in-memory, the envtest/Kind analog) and
+:class:`~dpu_operator_tpu.k8s.real.RealKube` (HTTP against an apiserver).
+
+Objects are plain dicts in standard Kubernetes shape (apiVersion/kind/metadata/
+spec/status) — the unstructured style the reference's render engine uses
+(pkgs/render/render.go:56-92).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Protocol
+
+
+def gvk_key(api_version: str, kind: str) -> str:
+    return f"{api_version}/{kind}"
+
+
+def obj_key(obj: dict) -> tuple:
+    md = obj.get("metadata", {})
+    return (
+        gvk_key(obj.get("apiVersion", ""), obj.get("kind", "")),
+        md.get("namespace") or "",
+        md.get("name", ""),
+    )
+
+
+class KubeClient(Protocol):
+    """Seam between controllers and the apiserver."""
+
+    def get(self, api_version: str, kind: str, name: str,
+            namespace: Optional[str] = None) -> Optional[dict]: ...
+
+    def list(self, api_version: str, kind: str,
+             namespace: Optional[str] = None,
+             label_selector: Optional[dict] = None) -> list[dict]: ...
+
+    def create(self, obj: dict) -> dict: ...
+
+    def update(self, obj: dict) -> dict: ...
+
+    def apply(self, obj: dict) -> dict: ...
+
+    def delete(self, api_version: str, kind: str, name: str,
+               namespace: Optional[str] = None) -> None: ...
+
+    def update_status(self, obj: dict) -> dict: ...
+
+    def watch(self, api_version: str, kind: str,
+              callback: Callable[[str, dict], None]) -> Callable[[], None]:
+        """Register *callback(event_type, obj)*; returns a cancel function."""
+        ...
+
+
+def set_owner_reference(owner: dict, obj: dict, controller: bool = True) -> None:
+    """SetControllerReference analog (reference: render.go:84 sets owner refs
+    on every rendered object so CR deletion garbage-collects children)."""
+    md = obj.setdefault("metadata", {})
+    ref = {
+        "apiVersion": owner.get("apiVersion", ""),
+        "kind": owner.get("kind", ""),
+        "name": owner.get("metadata", {}).get("name", ""),
+        "uid": owner.get("metadata", {}).get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+    refs = [r for r in md.get("ownerReferences", [])
+            if not (r.get("kind") == ref["kind"] and r.get("name") == ref["name"])]
+    refs.append(ref)
+    md["ownerReferences"] = refs
+
+
+def owned_by(obj: dict, owner: dict) -> bool:
+    owner_uid = owner.get("metadata", {}).get("uid")
+    return any(r.get("uid") == owner_uid
+               for r in obj.get("metadata", {}).get("ownerReferences", []))
+
+
+def match_labels(obj: dict, selector: Optional[dict]) -> bool:
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def deep_merge(base: dict, patch: dict) -> dict:
+    """Strategic-merge-lite used by apply(): dict values merge recursively,
+    everything else (including lists) replaces."""
+    out = dict(base)
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def parse_quantity(q) -> float:
+    """Parse a Kubernetes resource quantity ('2', '500m', '1Gi')."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    suffixes = {
+        "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+    }
+    for suf in ("Ki", "Mi", "Gi", "Ti", "m", "k", "M", "G", "T"):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * suffixes[suf]
+    return float(s)
+
+
+def pod_resource_requests(pod: dict) -> dict[str, float]:
+    """Sum container resource requests (falling back to limits) for a pod."""
+    total: dict[str, float] = {}
+    for c in pod.get("spec", {}).get("containers", []):
+        res = c.get("resources", {})
+        req = res.get("requests") or res.get("limits") or {}
+        for name, qty in req.items():
+            total[name] = total.get(name, 0.0) + parse_quantity(qty)
+    return total

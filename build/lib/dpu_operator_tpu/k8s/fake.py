@@ -1,0 +1,361 @@
+"""In-memory fake Kubernetes apiserver.
+
+This is the test backbone replacing the reference's envtest + Kind fixture
+(internal/testutils/kindcluster.go:66): a thread-safe object store with
+watches, ownerReference garbage collection, DaemonSet fan-out and a
+resource-aware pod scheduler/kubelet simulation (:class:`FakeNodeAgent`) rich
+enough for the reference's integration-test scenarios — device-plugin
+allocatable assertions (dpusidemanager_test.go:22-49) and the N+1 SFC
+resource-exhaustion test (e2e_test.go:525-593).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+import weakref
+from typing import Callable, Optional
+
+from .client import (
+    deep_merge,
+    gvk_key,
+    match_labels,
+    pod_resource_requests,
+)
+
+
+class Conflict(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+class FakeKube:
+    """Dict-backed apiserver. Objects are deep-copied on the way in and out."""
+
+    #: live instances, for test-failure diagnostics (weak: instances die
+    #: with their tests)
+    instances: "weakref.WeakSet[FakeKube]" = None  # set below
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict[tuple, dict] = {}
+        self._watchers: dict[str, list[Callable]] = {}
+        self._rv = itertools.count(1)
+        self._uid = itertools.count(1)
+        FakeKube.instances.add(self)
+
+    # -- internal -------------------------------------------------------------
+    def _key(self, api_version, kind, namespace, name):
+        return (gvk_key(api_version, kind), namespace or "", name)
+
+    def _notify(self, event: str, obj: dict):
+        for cb in list(self._watchers.get(
+                gvk_key(obj.get("apiVersion", ""), obj.get("kind", "")), [])):
+            cb(event, copy.deepcopy(obj))
+
+    def _stamp(self, obj: dict, new: bool):
+        md = obj.setdefault("metadata", {})
+        md["resourceVersion"] = str(next(self._rv))
+        if new:
+            md.setdefault("uid", f"uid-{next(self._uid)}")
+            md.setdefault("creationTimestamp", time.time())
+
+    # -- KubeClient interface -------------------------------------------------
+    def get(self, api_version, kind, name, namespace=None):
+        with self._lock:
+            obj = self._store.get(self._key(api_version, kind, namespace, name))
+            return copy.deepcopy(obj) if obj else None
+
+    def list(self, api_version, kind, namespace=None, label_selector=None):
+        with self._lock:
+            out = []
+            for (g, ns, _), obj in self._store.items():
+                if g != gvk_key(api_version, kind):
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if not match_labels(obj, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def create(self, obj):
+        obj = copy.deepcopy(obj)
+        md = obj.get("metadata", {})
+        key = self._key(obj.get("apiVersion"), obj.get("kind"),
+                        md.get("namespace"), md.get("name"))
+        if obj.get("kind") == "Pod":
+            obj.setdefault("status", {}).setdefault("phase", "Pending")
+        with self._lock:
+            if key in self._store:
+                raise AlreadyExists(str(key))
+            self._stamp(obj, new=True)
+            self._store[key] = obj
+            stored = copy.deepcopy(obj)
+        self._notify("ADDED", stored)
+        self._fan_out(stored)
+        return stored
+
+    def update(self, obj):
+        obj = copy.deepcopy(obj)
+        md = obj.get("metadata", {})
+        key = self._key(obj.get("apiVersion"), obj.get("kind"),
+                        md.get("namespace"), md.get("name"))
+        with self._lock:
+            cur = self._store.get(key)
+            if cur is None:
+                raise KeyError(str(key))
+            sent_rv = md.get("resourceVersion")
+            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                raise Conflict(str(key))
+            obj.setdefault("status", cur.get("status", {}))
+            md["uid"] = cur["metadata"]["uid"]
+            self._stamp(obj, new=False)
+            self._store[key] = obj
+            stored = copy.deepcopy(obj)
+        self._notify("MODIFIED", stored)
+        self._fan_out(stored)
+        return stored
+
+    def apply(self, obj):
+        """Create-or-merge, tolerant like the reference's ApplyObject path
+        (render.go:84-92 swallows AlreadyExists/Conflict): retries on
+        concurrent create/update/delete races."""
+        md = obj.get("metadata", {})
+        key = self._key(obj.get("apiVersion"), obj.get("kind"),
+                        md.get("namespace"), md.get("name"))
+        for _ in range(10):
+            with self._lock:
+                cur = self._store.get(key)
+            if cur is None:
+                try:
+                    return self.create(obj)
+                except AlreadyExists:
+                    continue
+            merged = deep_merge(cur, copy.deepcopy(obj))
+            merged["metadata"]["resourceVersion"] = \
+                cur["metadata"]["resourceVersion"]
+            try:
+                return self.update(merged)
+            except (Conflict, KeyError):
+                continue
+        raise Conflict(f"apply kept racing for {key}")
+
+    def delete(self, api_version, kind, name, namespace=None):
+        key = self._key(api_version, kind, namespace, name)
+        with self._lock:
+            obj = self._store.pop(key, None)
+        if obj is None:
+            return
+        self._notify("DELETED", obj)
+        self._gc(obj)
+
+    def update_status(self, obj):
+        md = obj.get("metadata", {})
+        key = self._key(obj.get("apiVersion"), obj.get("kind"),
+                        md.get("namespace"), md.get("name"))
+        with self._lock:
+            cur = self._store.get(key)
+            if cur is None:
+                raise KeyError(str(key))
+            if cur.get("status", {}) == obj.get("status", {}):
+                return copy.deepcopy(cur)  # no-op: don't re-trigger watchers
+            cur["status"] = copy.deepcopy(obj.get("status", {}))
+            cur["metadata"]["resourceVersion"] = str(next(self._rv))
+            stored = copy.deepcopy(cur)
+        self._notify("MODIFIED", stored)
+        return stored
+
+    def watch(self, api_version, kind, callback):
+        g = gvk_key(api_version, kind)
+        with self._lock:
+            self._watchers.setdefault(g, []).append(callback)
+            existing = [copy.deepcopy(o) for (k, _, _), o in self._store.items()
+                        if k == g]
+        for obj in existing:
+            callback("ADDED", obj)
+
+        def cancel():
+            with self._lock:
+                try:
+                    self._watchers[g].remove(callback)
+                except ValueError:
+                    pass
+        return cancel
+
+    # -- controller-manager-ish behaviors ------------------------------------
+    def _gc(self, owner: dict):
+        """ownerReference cascade delete."""
+        uid = owner.get("metadata", {}).get("uid")
+        if not uid:
+            return
+        with self._lock:
+            victims = [
+                (k[0].rsplit("/", 1), k[1], k[2])
+                for k, o in list(self._store.items())
+                if any(r.get("uid") == uid
+                       for r in o.get("metadata", {}).get("ownerReferences", []))
+            ]
+        for (gv_kind, ns, name) in victims:
+            api_version, kind = gv_kind
+            self.delete(api_version, kind, name, namespace=ns or None)
+
+    def _fan_out(self, obj: dict):
+        """DaemonSet controller simulation: one pod per node matching the
+        nodeSelector (reference relies on the real DS controller;
+        bindata/daemon/99.daemonset.yaml:20-21). A Node appearing after the
+        DaemonSet also triggers fan-out, as the real controller would."""
+        if obj.get("kind") == "Node":
+            for ds in self.list("apps/v1", "DaemonSet"):
+                self._fan_out(ds)
+            return
+        if obj.get("kind") != "DaemonSet":
+            return
+        sel = obj.get("spec", {}).get("template", {}).get("spec", {}) \
+                 .get("nodeSelector", {})
+        ns = obj["metadata"].get("namespace")
+        ds_name = obj["metadata"]["name"]
+        for node in self.list("v1", "Node"):
+            labels = node.get("metadata", {}).get("labels", {}) or {}
+            if not all(labels.get(k) == v for k, v in sel.items()):
+                continue
+            node_name = node["metadata"]["name"]
+            pod_name = f"{ds_name}-{node_name}"
+            if self.get("v1", "Pod", pod_name, namespace=ns):
+                continue
+            pod = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": pod_name,
+                    "namespace": ns,
+                    "labels": dict(obj["spec"]["template"]
+                                   .get("metadata", {}).get("labels", {})),
+                    "ownerReferences": [{
+                        "apiVersion": "apps/v1", "kind": "DaemonSet",
+                        "name": ds_name, "uid": obj["metadata"]["uid"],
+                        "controller": True,
+                    }],
+                },
+                "spec": deep_merge(
+                    copy.deepcopy(obj["spec"]["template"].get("spec", {})),
+                    {"nodeName": node_name}),
+                "status": {"phase": "Pending"},
+            }
+            self.create(pod)
+
+
+FakeKube.instances = weakref.WeakSet()
+
+
+class FakeNodeAgent:
+    """Scheduler + kubelet simulation for FakeKube.
+
+    Schedules Pending pods onto nodes with sufficient allocatable extended
+    resources, then marks them Running after ``startup_delay`` — giving tests
+    the same observable behavior the reference gets from Kind's real kubelet:
+    allocatable accounting, Pending-until-capacity (e2e_test.go:525-593), and
+    a measurable schedule→Running latency (BASELINE.md p50 metric).
+    """
+
+    def __init__(self, kube: FakeKube, startup_delay: float = 0.0):
+        self.kube = kube
+        self.startup_delay = startup_delay
+        self._cancel = None
+
+    def start(self):
+        self._cancel = self.kube.watch("v1", "Pod", self._on_pod)
+
+    def stop(self):
+        if self._cancel:
+            self._cancel()
+
+    def register_node(self, name: str, labels: Optional[dict] = None,
+                      allocatable: Optional[dict] = None):
+        self.kube.apply({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels or {}},
+            "status": {"allocatable": dict(allocatable or {}),
+                       "capacity": dict(allocatable or {})},
+        })
+        self.sync()
+
+    def set_allocatable(self, node: str, resource: str, count: int):
+        """Device-plugin registration surfaces here (the fake kubelet's
+        equivalent of kubelet updating node allocatable after a device plugin
+        registers — reference: dpusidemanager_test.go:22-49 asserts this)."""
+        n = self.kube.get("v1", "Node", node)
+        if n is None:
+            raise KeyError(node)
+        n.setdefault("status", {}).setdefault("allocatable", {})[resource] = str(count)
+        n["status"].setdefault("capacity", {})[resource] = str(count)
+        self.kube.update_status(n)
+        self.sync()
+
+    # -- scheduling -----------------------------------------------------------
+    def _used(self, node_name: str) -> dict[str, float]:
+        used: dict[str, float] = {}
+        for pod in self.kube.list("v1", "Pod"):
+            if pod.get("spec", {}).get("nodeName") != node_name:
+                continue
+            if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            for r, v in pod_resource_requests(pod).items():
+                used[r] = used.get(r, 0.0) + v
+        return used
+
+    def _fits(self, pod: dict, node: dict) -> bool:
+        reqs = pod_resource_requests(pod)
+        alloc = node.get("status", {}).get("allocatable", {}) or {}
+        used = self._used(node["metadata"]["name"])
+        for r, v in reqs.items():
+            if r in ("cpu", "memory"):
+                continue
+            from .client import parse_quantity
+            if used.get(r, 0.0) + v > parse_quantity(alloc.get(r, 0)):
+                return False
+        sel = pod.get("spec", {}).get("nodeSelector", {}) or {}
+        labels = node.get("metadata", {}).get("labels", {}) or {}
+        return all(labels.get(k) == v for k, v in sel.items())
+
+    def _on_pod(self, event, pod):
+        if event in ("ADDED", "MODIFIED"):
+            self.sync()
+
+    def sync(self):
+        """One scheduling + kubelet pass. Idempotent; called on pod events."""
+        for pod in self.kube.list("v1", "Pod"):
+            phase = pod.get("status", {}).get("phase", "Pending")
+            spec = pod.setdefault("spec", {})
+            if phase == "Pending" and not spec.get("nodeName"):
+                for node in self.kube.list("v1", "Node"):
+                    if self._fits(pod, node):
+                        spec["nodeName"] = node["metadata"]["name"]
+                        try:
+                            self.kube.update(pod)
+                        except Exception:
+                            pass
+                        break
+                else:
+                    continue
+                pod = self.kube.get("v1", "Pod", pod["metadata"]["name"],
+                                    namespace=pod["metadata"].get("namespace"))
+                if pod is None:
+                    continue
+                phase = pod.get("status", {}).get("phase", "Pending")
+            if phase == "Pending" and pod["spec"].get("nodeName"):
+                if self.startup_delay:
+                    time.sleep(self.startup_delay)
+                pod.setdefault("status", {})["phase"] = "Running"
+                pod["status"]["startTime"] = time.time()
+                conds = pod["status"].setdefault("conditions", [])
+                conds.append({"type": "Ready", "status": "True"})
+                try:
+                    self.kube.update_status(pod)
+                except KeyError:
+                    pass

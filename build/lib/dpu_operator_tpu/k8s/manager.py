@@ -1,0 +1,152 @@
+"""Tiny controller-runtime analog: Manager + Reconciler + workqueue.
+
+Reference: cmd/main.go:45-133 builds a ctrl.Manager, registers reconcilers via
+SetupWithManager, then mgr.Start blocks. Here a Manager owns watch
+registrations and a single worker thread draining a deduplicating workqueue —
+the same level-triggered reconcile semantics controller-runtime provides.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+from ..utils import metrics, tracing
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Request:
+    api_version: str
+    kind: str
+    name: str
+    namespace: Optional[str] = None
+
+
+@dataclass
+class ReconcileResult:
+    requeue_after: Optional[float] = None
+
+
+class Reconciler(Protocol):
+    #: (api_version, kind) this reconciler watches
+    watches: tuple
+
+    def reconcile(self, client, req: Request) -> ReconcileResult: ...
+
+
+class Manager:
+    def __init__(self, client):
+        self.client = client
+        self._reconcilers: list[Reconciler] = []
+        self._queue: "queue.Queue[tuple[Reconciler, Request]]" = queue.Queue()
+        self._pending: set[tuple[int, Request]] = set()
+        self._lock = threading.Lock()
+        self._cancels = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._idle = threading.Event()
+        self._idle.set()
+        self._inflight_timers = 0
+
+    def add_reconciler(self, rec: Reconciler):
+        self._reconcilers.append(rec)
+
+    def _enqueue(self, rec: Reconciler, req: Request):
+        key = (id(rec), req)
+        with self._lock:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+        self._idle.clear()
+        self._queue.put((rec, req))
+
+    def start(self):
+        for rec in self._reconcilers:
+            api_version, kind = rec.watches
+
+            def cb(event, obj, rec=rec, api_version=api_version, kind=kind):
+                md = obj.get("metadata", {})
+                self._enqueue(rec, Request(api_version, kind, md.get("name"),
+                                           md.get("namespace") or None))
+            self._cancels.append(self.client.watch(api_version, kind, cb))
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="manager-worker")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        for c in self._cancels:
+            c()
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Test helper: block until the workqueue drains."""
+        return self._idle.wait(timeout)
+
+    #: error-retry backoff bounds (controller-runtime uses 5ms..16m;
+    #: scaled down since our base reconciles are cheap)
+    RETRY_BASE = 0.5
+    RETRY_MAX = 60.0
+
+    def _schedule_retry(self, delay: float, rec, req,
+                        timers: dict) -> None:
+        with self._lock:
+            self._inflight_timers += 1
+
+        key = object()
+
+        def fire():
+            self._enqueue(rec, req)
+            with self._lock:
+                self._inflight_timers -= 1
+            timers.pop(key, None)
+
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        t.start()
+        timers[key] = t
+
+    def _run(self):
+        timers: dict = {}
+        failures: dict[tuple, int] = {}
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                break
+            rec, req = item
+            fkey = (id(rec), req)
+            controller = type(rec).__name__
+            with self._lock:
+                self._pending.discard(fkey)
+            try:
+                metrics.RECONCILE_TOTAL.inc(controller=controller)
+                with metrics.RECONCILE_SECONDS.time(), \
+                        tracing.span("reconcile", controller=controller,
+                                     request=req.name or ""):
+                    result = (rec.reconcile(self.client, req)
+                              or ReconcileResult())
+                failures.pop(fkey, None)
+            except Exception:
+                metrics.RECONCILE_ERRORS.inc(controller=controller)
+                n = failures.get(fkey, 0)
+                failures[fkey] = n + 1
+                delay = min(self.RETRY_BASE * (2 ** n), self.RETRY_MAX)
+                log.exception("reconcile failed for %s (retry in %.1fs)",
+                              req, delay)
+                self._schedule_retry(delay, rec, req, timers)
+                result = ReconcileResult()
+            if result.requeue_after:
+                self._schedule_retry(result.requeue_after, rec, req, timers)
+            with self._lock:
+                if (not self._pending and self._queue.empty()
+                        and self._inflight_timers == 0):
+                    self._idle.set()
+        for t in list(timers.values()):
+            t.cancel()

@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the workload hot path.
+
+The compute-side analog of the reference's P4 pipeline artifacts
+(cmd/intelvsp/fxp-net_linux-networking): hand-written dataplane programs
+for the cases the generic compiler path leaves bandwidth on the table.
+Kernels run compiled on TPU and in interpret mode on the CPU test mesh.
+"""
+
+from .flash_attention import flash_attention
+from .rmsnorm import fused_rmsnorm
+
+__all__ = ["flash_attention", "fused_rmsnorm"]

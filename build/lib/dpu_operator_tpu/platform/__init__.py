@@ -1,0 +1,20 @@
+from .platform import Platform, HardwarePlatform, FakePlatform, PciDevice
+from .vendordetector import (
+    VendorDetector,
+    TpuDetector,
+    FakeVendorDetector,
+    DetectorManager,
+    DetectionResult,
+)
+
+__all__ = [
+    "Platform",
+    "HardwarePlatform",
+    "FakePlatform",
+    "PciDevice",
+    "VendorDetector",
+    "TpuDetector",
+    "FakeVendorDetector",
+    "DetectorManager",
+    "DetectionResult",
+]

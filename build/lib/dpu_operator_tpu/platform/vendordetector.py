@@ -1,0 +1,154 @@
+"""Vendor detection: which accelerator is on this node, and which side am I.
+
+Reference: internal/platform/vendordetector.go:20-135 — an ordered detector
+list; each detector answers (1) "am I the accelerator platform itself" (DPU
+mode — product-name / backplane probes, e.g. ipu.go:59-69) and (2) "does this
+host have accelerator endpoints" (host mode — PCI scan with serial dedup,
+netsec-accelerator.go:36-75). Ambiguity across detectors is an error
+(vendordetector.go:82-85).
+
+TPU mapping: "tpu mode" = running on the TPU VM (accel devices +
+accelerator-type metadata present); "host mode" = a CPU host seeing TPU PCIe
+endpoints (Google vendor id) without the TPU runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+from .platform import Platform
+
+#: Google PCI vendor id (pci-ids: 1ae0 Google, Inc.).
+GOOGLE_VENDOR_ID = "1ae0"
+
+#: TPU PCIe device-id → generation (the TPU analog of the reference's
+#: per-vendor device tables, marvell-dpu.go:12-16).
+TPU_DEVICE_IDS = {
+    "0027": "v2/v3",
+    "005e": "v4",
+    "0062": "v5e",
+    "0063": "v5p",
+    "006f": "v6e",
+}
+
+
+@dataclass
+class DetectionResult:
+    tpu_mode: bool            # True: this node is the accelerator platform
+    vendor: str               # detector name, e.g. "google-tpu"
+    identifier: str           # stable device identifier (dedup key)
+    vsp_image_key: str        # which image the VSP DaemonSet runs
+    vsp_command: list         # VSP container command
+
+
+class VendorDetector(Protocol):
+    name: str
+
+    def is_tpu_platform(self, platform: Platform) -> bool: ...
+    def is_tpu_device(self, platform: Platform, dev) -> Optional[str]:
+        """Return a stable identifier if *dev* is this vendor's accelerator
+        endpoint, else None."""
+        ...
+
+    def detection_result(self, tpu_mode: bool,
+                         identifier: str) -> DetectionResult: ...
+
+
+class TpuDetector:
+    """GoogleTpuVSP detector (the north-star vendor backend)."""
+
+    name = "google-tpu"
+
+    def is_tpu_platform(self, platform: Platform) -> bool:
+        # TPU VM: accelerator metadata or accel chardevs present
+        # (analog of the IPU product-name match, ipu.go:59-69).
+        if platform.accelerator_type():
+            return True
+        return len(platform.accel_devices()) > 0
+
+    def is_tpu_device(self, platform: Platform, dev) -> Optional[str]:
+        if dev.vendor_id != GOOGLE_VENDOR_ID:
+            return None
+        if dev.device_id not in TPU_DEVICE_IDS:
+            return None
+        if dev.is_vf:
+            return None  # only PFs identify the accelerator (ipu.go:34-57)
+        # dedup multi-function devices by serial when present
+        # (netsec-accelerator.go:72-75)
+        return dev.serial or dev.address
+
+    def detection_result(self, tpu_mode: bool, identifier: str):
+        return DetectionResult(
+            tpu_mode=tpu_mode,
+            vendor=self.name,
+            identifier=identifier,
+            vsp_image_key="TpuVspImage",
+            vsp_command=["python3", "-m", "dpu_operator_tpu.vsp"],
+        )
+
+
+class FakeVendorDetector:
+    """Test detector keyed on a product-name substring, mirroring
+    daemon_test.go:47 faking 'IPU Adapter E2100-CCQDA2'."""
+
+    def __init__(self, product_substr: str = "tpu-sim",
+                 name: str = "fake-tpu"):
+        self.name = name
+        self.product_substr = product_substr
+
+    def is_tpu_platform(self, platform: Platform) -> bool:
+        return self.product_substr in platform.product_name()
+
+    def is_tpu_device(self, platform: Platform, dev) -> Optional[str]:
+        if dev.product_name and self.product_substr in dev.product_name:
+            return dev.address
+        return None
+
+    def detection_result(self, tpu_mode: bool, identifier: str):
+        return DetectionResult(
+            tpu_mode=tpu_mode,
+            vendor=self.name,
+            identifier=identifier,
+            vsp_image_key="TpuVspImage",
+            vsp_command=["python3", "-m", "dpu_operator_tpu.vsp", "--mock"],
+        )
+
+
+class DetectorManager:
+    """Ordered detection across vendors (vendordetector.go:48-135)."""
+
+    def __init__(self, detectors: Optional[list] = None):
+        self.detectors = detectors if detectors is not None else [TpuDetector()]
+
+    def detect(self, platform: Platform) -> Optional[DetectionResult]:
+        """Returns None when nothing detected (daemon keeps polling at 1 Hz,
+        daemon.go:86-170); raises on cross-vendor ambiguity."""
+        platform_hits = [d for d in self.detectors
+                         if d.is_tpu_platform(platform)]
+        if len(platform_hits) > 1:
+            raise RuntimeError(
+                f"ambiguous accelerator platform: "
+                f"{[d.name for d in platform_hits]}")
+        if platform_hits:
+            det = platform_hits[0]
+            ident = platform.accelerator_type() or "tpu-platform"
+            return det.detection_result(tpu_mode=True, identifier=ident)
+
+        found: list[tuple] = []
+        for det in self.detectors:
+            idents: list[str] = []
+            for dev in platform.pci_devices():
+                ident = det.is_tpu_device(platform, dev)
+                if ident and ident not in idents:  # serial dedup (:94-135)
+                    idents.append(ident)
+            if idents:
+                found.append((det, idents[0]))
+        if len(found) > 1:
+            raise RuntimeError(
+                f"ambiguous accelerator endpoints: "
+                f"{[d.name for d, _ in found]}")
+        if found:
+            det, ident = found[0]
+            return det.detection_result(tpu_mode=False, identifier=ident)
+        return None

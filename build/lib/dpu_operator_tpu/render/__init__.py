@@ -1,0 +1,3 @@
+from .render import render_template, render_dir, apply_all_from_bindata, RenderError
+
+__all__ = ["render_template", "render_dir", "apply_all_from_bindata", "RenderError"]

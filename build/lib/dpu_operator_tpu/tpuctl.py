@@ -1,0 +1,133 @@
+"""tpuctl — operator CLI for the ICI dataplane and the VSP seam.
+
+The reference ships p4rt-ctl (cmd/intelvsp/p4runtime-2023.11.0) to poke the
+P4 pipeline directly; tpuctl is the same tool for the TPU dataplane: speak
+the native agent's mailbox (--agent-socket) for slice/link state, or the
+VSP gRPC (--vsp-socket) for device enumeration and attachments — without
+going through the daemon.
+
+Usage:
+  python -m dpu_operator_tpu.tpuctl --agent-socket /run/tpucp.sock enum
+  python -m dpu_operator_tpu.tpuctl --agent-socket S init v5e-16
+  python -m dpu_operator_tpu.tpuctl --agent-socket S link-state 3
+  python -m dpu_operator_tpu.tpuctl --agent-socket S attach 3 x+ y-
+  python -m dpu_operator_tpu.tpuctl --vsp-socket V devices
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _agent_cmds(sub):
+    sub.add_parser("enum", help="list chips + attachment state")
+    p = sub.add_parser("init", help="program a slice topology")
+    p.add_argument("topology")
+    p = sub.add_parser("link-state", help="per-port link state of a chip")
+    p.add_argument("chip", type=int)
+    p = sub.add_parser("attach", help="wire a chip's ICI ports")
+    p.add_argument("chip", type=int)
+    p.add_argument("ports", nargs="*")
+    p = sub.add_parser("detach")
+    p.add_argument("chip", type=int)
+    p = sub.add_parser("wire", help="wire a network-function hop")
+    p.add_argument("input")
+    p.add_argument("output")
+    p = sub.add_parser("unwire")
+    p.add_argument("input")
+    p.add_argument("output")
+    p = sub.add_parser("set-link", help="fault injection: force a port "
+                                        "down/up")
+    p.add_argument("chip", type=int)
+    p.add_argument("port")
+    p.add_argument("state", choices=["up", "down"])
+
+
+def _vsp_cmds(sub):
+    sub.add_parser("devices", help="DeviceService.GetDevices")
+    p = sub.add_parser("set-num-chips")
+    p.add_argument("count", type=int)
+    p = sub.add_parser("create-attachment")
+    p.add_argument("name")
+    p.add_argument("--chip", type=int, default=None)
+    p.add_argument("--topology", default="")
+    p.add_argument("--peer", default="")
+    p = sub.add_parser("delete-attachment")
+    p.add_argument("name")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("tpuctl")
+    parser.add_argument("--agent-socket", default="")
+    parser.add_argument("--vsp-socket", default="")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    _agent_cmds(sub)
+    _vsp_cmds(sub)
+    args = parser.parse_args(argv)
+
+    out = run(args)
+    json.dump(out, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+
+
+def run(args) -> dict:
+    agent_cmds = {"enum", "init", "link-state", "attach", "detach", "wire",
+                  "unwire", "set-link"}
+    if args.cmd in agent_cmds:
+        if not args.agent_socket:
+            raise SystemExit(f"{args.cmd} needs --agent-socket")
+        from .vsp.native_dp import AgentClient
+        client = AgentClient(args.agent_socket)
+        try:
+            if args.cmd == "enum":
+                return {"chips": client.enumerate()}
+            if args.cmd == "init":
+                return client.init(args.topology)
+            if args.cmd == "link-state":
+                return {"chip": args.chip,
+                        "ports": client.link_state(args.chip)}
+            if args.cmd == "attach":
+                client.attach(args.chip, args.ports or None)
+                return {"attached": args.chip}
+            if args.cmd == "detach":
+                client.detach(args.chip)
+                return {"detached": args.chip}
+            if args.cmd == "set-link":
+                client.set_link(args.chip, args.port, args.state == "up")
+                return {"chip": args.chip, "port": args.port,
+                        "state": args.state}
+            if args.cmd == "wire":
+                client.wire_nf(args.input, args.output)
+                return {"wired": [args.input, args.output]}
+            client.unwire_nf(args.input, args.output)
+            return {"unwired": [args.input, args.output]}
+        finally:
+            client.close()
+
+    if not args.vsp_socket:
+        raise SystemExit(f"{args.cmd} needs --vsp-socket")
+    from .vsp.rpc import VspChannel, unix_target
+    channel = VspChannel(unix_target(args.vsp_socket))
+    try:
+        if args.cmd == "devices":
+            return channel.call("DeviceService", "GetDevices", {})
+        if args.cmd == "set-num-chips":
+            return channel.call("DeviceService", "SetNumChips",
+                                {"count": args.count})
+        if args.cmd == "create-attachment":
+            req = {"name": args.name, "topology": args.topology}
+            if args.chip is not None:
+                req["chip_index"] = args.chip
+            if args.peer:
+                req["peer_address"] = args.peer
+            return channel.call("SliceService", "CreateSliceAttachment", req)
+        return channel.call("SliceService", "DeleteSliceAttachment",
+                            {"name": args.name})
+    finally:
+        channel.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+from .vars import (
+    NAMESPACE,
+    CONFIG_NAME,
+    DEFAULT_NAD_NAME,
+    TPU_RESOURCE_NAME,
+    ICI_RESOURCE_NAME,
+)
+from .path_manager import PathManager
+from .filesystem_mode_detector import FilesystemModeDetector, FsMode
+from .cluster_environment import ClusterEnvironment, Flavour
+
+__all__ = [
+    "NAMESPACE",
+    "CONFIG_NAME",
+    "DEFAULT_NAD_NAME",
+    "TPU_RESOURCE_NAME",
+    "ICI_RESOURCE_NAME",
+    "PathManager",
+    "FilesystemModeDetector",
+    "FsMode",
+    "ClusterEnvironment",
+    "Flavour",
+]

@@ -1,0 +1,47 @@
+"""Cluster flavour detection: microshift / openshift / kind.
+
+Reference: internal/utils/cluster_environment.go:34-96 — probes, in order, the
+microshift-version ConfigMap (kube-public), the clusterversions CRD, and the
+kindest node image.  The flavour feeds template vars (CNI dirs, SCC-vs-PSP
+manifests) at reconcile time (dpuoperatorconfig_controller.go:131-167).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Flavour(str, enum.Enum):
+    MICROSHIFT = "microshift"
+    OPENSHIFT = "openshift"
+    KIND = "kind"
+
+
+class ClusterEnvironment:
+    def __init__(self, client):
+        self.client = client
+
+    def flavour(self) -> Flavour:
+        # microshift ships a version ConfigMap in kube-public
+        # (reference: cluster_environment.go:61).
+        cm = self.client.get("v1", "ConfigMap", "microshift-version",
+                             namespace="kube-public")
+        if cm is not None:
+            return Flavour.MICROSHIFT
+        # OpenShift exposes the clusterversions CRD
+        # (reference: cluster_environment.go:74).
+        crd = self.client.get(
+            "apiextensions.k8s.io/v1", "CustomResourceDefinition",
+            "clusterversions.config.openshift.io")
+        if crd is not None:
+            return Flavour.OPENSHIFT
+        # Kind nodes run the kindest/node image (reference: :88).
+        for node in self.client.list("v1", "Node"):
+            images = [
+                i
+                for img in node.get("status", {}).get("images", [])
+                for i in img.get("names", [])
+            ]
+            if any("kindest/node" in i for i in images):
+                return Flavour.KIND
+        return Flavour.KIND

@@ -1,0 +1,59 @@
+"""Node drain facade.
+
+Reference: pkgs/drain/drain.go:19-43 — a thin wrapper around the
+sriov-network-operator DrainInterface, reserved for disruptive device
+reconfiguration (the SetNumVfs TODO, dpudevicehandler.go:78-83). The TPU
+equivalent is resizing/re-wiring a slice: chips vanish from allocatable,
+so pods consuming them must be evicted first.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from . import vars as v
+
+log = logging.getLogger(__name__)
+
+
+class Drainer:
+    def __init__(self, client):
+        self.client = client
+
+    def cordon(self, node_name: str):
+        node = self.client.get("v1", "Node", node_name)
+        if node is None:
+            raise KeyError(node_name)
+        node.setdefault("spec", {})["unschedulable"] = True
+        self.client.update(node)
+
+    def uncordon(self, node_name: str):
+        node = self.client.get("v1", "Node", node_name)
+        if node is None:
+            raise KeyError(node_name)
+        node.setdefault("spec", {})["unschedulable"] = False
+        self.client.update(node)
+
+    def drain(self, node_name: str,
+              resource: str = v.TPU_RESOURCE_NAME) -> list:
+        """Cordon, then evict pods on *node_name* that consume *resource*
+        (only accelerator consumers block a slice re-wire; system pods
+        stay). Returns evicted pod names."""
+        self.cordon(node_name)
+        evicted = []
+        for pod in self.client.list("v1", "Pod"):
+            spec = pod.get("spec", {})
+            if spec.get("nodeName") != node_name:
+                continue
+            requests = {}
+            for c in spec.get("containers", []):
+                requests.update(
+                    (c.get("resources", {}).get("requests") or {}))
+            if resource not in requests:
+                continue
+            md = pod["metadata"]
+            self.client.delete("v1", "Pod", md["name"],
+                               namespace=md.get("namespace"))
+            evicted.append(md["name"])
+            log.info("drained pod %s from %s", md["name"], node_name)
+        return evicted

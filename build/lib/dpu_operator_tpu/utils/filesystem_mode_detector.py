@@ -1,0 +1,32 @@
+"""Detect whether the node root filesystem is image-based (ostree) or rpm.
+
+Reference: internal/utils/filesystem_mode_detector.go:42 — probes
+``/run/ostree-booted``; the result picks which CNI bin dir the daemon
+DaemonSet mounts.  Permission-denied on the probe file is an error, absence
+means plain rpm mode (reference test: filesystem_mode_detector_test.go).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+
+
+class FsMode(str, enum.Enum):
+    OSTREE = "ostree"
+    RPM = "rpm"
+
+
+class FilesystemModeDetector:
+    def __init__(self, root: str = "/"):
+        self.root = root
+
+    def detect_mode(self) -> FsMode:
+        probe = os.path.join(self.root, "run/ostree-booted")
+        try:
+            with open(probe, "rb"):
+                return FsMode.OSTREE
+        except FileNotFoundError:
+            return FsMode.RPM
+        except PermissionError as e:
+            raise PermissionError(f"cannot probe {probe}: {e}") from e

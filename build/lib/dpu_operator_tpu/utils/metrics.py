@@ -1,0 +1,217 @@
+"""Prometheus-format metrics + health endpoints.
+
+Reference: controller-runtime metrics on :18090 (cmd/main.go:50,66-70), the
+DPU-side daemon's :18001 (dpusidemanager.go:271-275), health/ready probes
+(cmd/main.go:119-126) and the ServiceMonitor (config/prometheus/monitor.yaml).
+A dependency-free registry serving the text exposition format, so every
+binary (operator, daemon, webhook) exposes the same observability surface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def _render(self) -> list:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, val in sorted(self._values.items()):
+                out.append(f"{self.name}{_labels(key)} {_num(val)}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _render(self) -> list:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, val in sorted(self._values.items()):
+                out.append(f"{self.name}{_labels(key)} {_num(val)}")
+        return out
+
+
+class Histogram:
+    """Fixed-bucket histogram (reconcile/CNI latencies)."""
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                       5.0, 10.0, 30.0, 60.0, 120.0)
+
+    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        with self._lock:
+            self._sum += value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+
+    def time(self):
+        return _Timer(self)
+
+    def _render(self) -> list:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                out.append(f'{self.name}_bucket{{le="{_num(b)}"}} {cum}')
+            cum += self._counts[-1]
+            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+            out.append(f"{self.name}_sum {_num(self._sum)}")
+            out.append(f"{self.name}_count {cum}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._start)
+        return False
+
+
+def _labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str) -> Counter:
+        return self._add(Counter(name, help_))
+
+    def gauge(self, name: str, help_: str) -> Gauge:
+        return self._add(Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str, **kw) -> Histogram:
+        return self._add(Histogram(name, help_, **kw))
+
+    def _add(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            for m in self._metrics:
+                lines.extend(m._render())
+        return "\n".join(lines) + "\n"
+
+
+#: process-global registry (controller-runtime's metrics.Registry analog)
+REGISTRY = Registry()
+
+RECONCILE_TOTAL = REGISTRY.counter(
+    "tpu_operator_reconcile_total", "Reconcile invocations by controller")
+RECONCILE_ERRORS = REGISTRY.counter(
+    "tpu_operator_reconcile_errors_total", "Reconcile errors by controller")
+RECONCILE_SECONDS = REGISTRY.histogram(
+    "tpu_operator_reconcile_seconds", "Reconcile latency")
+CNI_REQUESTS = REGISTRY.counter(
+    "tpu_daemon_cni_requests_total", "CNI requests by command and result")
+CNI_SECONDS = REGISTRY.histogram(
+    "tpu_daemon_cni_seconds", "CNI handler latency")
+DEVICES_ADVERTISED = REGISTRY.gauge(
+    "tpu_daemon_devices_advertised", "Devices advertised to kubelet")
+
+
+class MetricsServer:
+    """/metrics + /healthz + /readyz on one port (the operator binds
+    metrics :18090 and health :18091 separately; one mux suffices here)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 registry: Registry = REGISTRY,
+                 ready_check: Optional[Callable[[], bool]] = None):
+        self.host = host
+        self.port = port
+        self.registry = registry
+        self.ready_check = ready_check or (lambda: True)
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def start(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = outer.registry.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                    code = 200
+                elif self.path == "/healthz":
+                    body, ctype, code = b"ok", "text/plain", 200
+                elif self.path == "/readyz":
+                    ready = outer.ready_check()
+                    body = b"ok" if ready else b"not ready"
+                    ctype, code = "text/plain", (200 if ready else 503)
+                else:
+                    body, ctype, code = b"not found", "text/plain", 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="metrics").start()
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

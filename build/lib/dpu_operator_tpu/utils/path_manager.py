@@ -1,0 +1,86 @@
+"""Filesystem path computation for sockets, CNI dirs and device nodes.
+
+Reference: internal/utils/path_manager.go:12 — a PathManager rooted at a
+configurable prefix so tests can relocate every host path under a tmpdir, and
+so containerized daemons can address the host filesystem via a ``/host`` bind
+mount.  Socket directories are created 0700-root like the reference's
+EnsureSocketDirExists (path_manager.go:67-100).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PathManager:
+    root: str = "/"
+
+    def _p(self, *parts: str) -> str:
+        return os.path.join(self.root, *parts)
+
+    # --- CNI -----------------------------------------------------------------
+    def cni_host_dir(self, flavour: str = "kind") -> str:
+        """Directory kubelet/CRI loads CNI binaries from.
+
+        Reference: path_manager.go:41-56 switches on cluster flavour
+        (OpenShift vs MicroShift vs Kind have different CNI bin dirs).
+        """
+        if flavour == "openshift":
+            return self._p("var/lib/cni/bin")
+        if flavour == "microshift":
+            return self._p("opt/cni/bin")
+        return self._p("opt/cni/bin")
+
+    def cni_server_socket(self) -> str:
+        """Unix socket the CNI shim POSTs requests to.
+
+        Reference: dpu-cni/pkgs/cnitypes/cnitypes.go:13-16.
+        """
+        return self._p("var/run/tpu-daemon/tpu-cni-server.sock")
+
+    def cni_cache_dir(self) -> str:
+        """On-disk NetConf cache surviving daemon restarts.
+
+        Reference: sriov.go:489-500 + pci_allocator.go:25-96.
+        """
+        return self._p("var/lib/cni/tpu")
+
+    # --- VSP seam ------------------------------------------------------------
+    def vendor_plugin_socket(self) -> str:
+        """Unix socket the vendor-specific plugin serves gRPC on.
+
+        Reference: path_manager.go:58-60
+        (/var/run/dpu-daemon/vendor-plugin/vendor-plugin.sock).
+        """
+        return self._p("var/run/tpu-daemon/vendor-plugin/vendor-plugin.sock")
+
+    # --- kubelet device plugin ----------------------------------------------
+    def kubelet_plugin_dir(self) -> str:
+        return self._p("var/lib/kubelet/device-plugins")
+
+    def kubelet_socket(self) -> str:
+        """kubelet's registration socket (reference: deviceplugin.go:240)."""
+        return os.path.join(self.kubelet_plugin_dir(), "kubelet.sock")
+
+    def device_plugin_socket(self, resource: str) -> str:
+        safe = resource.replace("/", "_").replace(".", "_")
+        return os.path.join(self.kubelet_plugin_dir(), f"{safe}.sock")
+
+    # --- TPU devices ---------------------------------------------------------
+    def accel_dev_dir(self) -> str:
+        """Directory TPU chip character devices appear under."""
+        return self._p("dev")
+
+    def libtpu_path(self) -> str:
+        """Host path of libtpu.so the injector mounts into workload pods."""
+        return self._p("usr/lib/tpu/libtpu.so")
+
+    def ensure_socket_dir(self, socket_path: str) -> None:
+        d = os.path.dirname(socket_path)
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        try:
+            os.chmod(d, 0o700)
+        except OSError:
+            pass

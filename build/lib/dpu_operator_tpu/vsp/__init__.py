@@ -1,0 +1,16 @@
+from .rpc import VspServer, VspChannel, unix_target
+from .plugin import GrpcPlugin, VendorPlugin
+from .mock import MockTpuVsp
+from .google import GoogleTpuVsp, DebugIciDataplane, IciDataplane
+
+__all__ = [
+    "VspServer",
+    "VspChannel",
+    "unix_target",
+    "GrpcPlugin",
+    "VendorPlugin",
+    "MockTpuVsp",
+    "GoogleTpuVsp",
+    "DebugIciDataplane",
+    "IciDataplane",
+]

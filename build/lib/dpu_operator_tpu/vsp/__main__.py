@@ -1,0 +1,63 @@
+"""VSP entrypoint: serve GoogleTpuVsp (or the mock) on the vendor-plugin
+socket — the standalone-binary analog of the reference VSP mains
+(marvell/main.go:729-746)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from ..platform import HardwarePlatform
+from ..utils.path_manager import PathManager
+from .google import GoogleTpuVsp
+from .mock import MockTpuVsp
+from .rpc import VspServer
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("tpu-vsp")
+    parser.add_argument("--mock", action="store_true",
+                        help="serve the mock VSP (tests/dev)")
+    parser.add_argument("--root", default="/")
+    parser.add_argument("--socket", default="")
+    parser.add_argument("--cp-agent", default="",
+                        help="path to the tpu_cp_agent binary; when set the "
+                             "VSP spawns it and uses the native ICI "
+                             "dataplane (cp-agent-run.go:9-73 analog)")
+    parser.add_argument("--cp-agent-state", default="/var/run/tpucp.state")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    pm = PathManager(args.root)
+    sock = args.socket or pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+
+    agent_proc = None
+    dataplane = None
+    if args.cp_agent and not args.mock:
+        from .native_dp import AgentClient, AgentProcess, NativeIciDataplane
+        agent_sock = sock + ".cp-agent"
+        agent_proc = AgentProcess(args.cp_agent, agent_sock,
+                                  state_file=args.cp_agent_state)
+        agent_proc.start()
+        dataplane = NativeIciDataplane(AgentClient(agent_sock))
+        logging.info("native cp-agent on %s", agent_sock)
+
+    impl = MockTpuVsp() if args.mock else GoogleTpuVsp(
+        HardwarePlatform(args.root), dataplane=dataplane)
+    server = VspServer(impl, sock)
+    server.start()
+    logging.info("VSP serving on %s", sock)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+    if agent_proc:
+        agent_proc.stop()
+
+
+if __name__ == "__main__":
+    main()

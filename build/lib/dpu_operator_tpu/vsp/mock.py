@@ -1,0 +1,87 @@
+"""Mock TPU VSP for tests.
+
+Reference: internal/daemon/vendor-specific-plugins/mock-vsp/mockvsp.go:31-152
+— a real gRPC server on the real unix socket path: Init returns
+127.0.0.1:50051, GetDevices returns 4 fake devices, slice/NF ops are recorded
+no-ops. The TPU mock models a v5e-4 host slice so device-plugin and SFC tests
+see realistic chip metadata.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..ici import SliceTopology
+
+
+class MockTpuVsp:
+    def __init__(self, topology: str = "v5e-4", ip: str = "127.0.0.1",
+                 port: int = 50051):
+        self.topology = topology
+        self.ip = ip
+        self.port = port
+        self.num_chips = None
+        self.slice_attachments: dict[str, dict] = {}
+        self.network_functions: list[tuple] = []
+        self.init_requests: list[dict] = []
+        self._slice = SliceTopology(topology)
+        self._lock = threading.Lock()
+
+    # -- LifeCycleService -----------------------------------------------------
+    def init(self, req: dict) -> dict:
+        with self._lock:
+            self.init_requests.append(req)
+        return {"ip": self.ip, "port": self.port,
+                "topology": self._slice.topology}
+
+    def shutdown(self, req: dict) -> dict:
+        return {}
+
+    # -- DeviceService --------------------------------------------------------
+    def get_devices(self, req: dict) -> dict:
+        with self._lock:
+            n = self.num_chips
+        chips = self._slice.chips[: n if n is not None else None]
+        return {
+            "devices": {
+                c.id: {
+                    "id": c.id,
+                    "healthy": True,
+                    "dev_path": f"/dev/accel{c.index}",
+                    "coords": list(c.coords),
+                }
+                for c in chips
+            }
+        }
+
+    def set_num_chips(self, req: dict) -> dict:
+        with self._lock:
+            self.num_chips = int(req.get("count", 0))
+        return {}
+
+    # -- SliceService ---------------------------------------------------------
+    def create_slice_attachment(self, req: dict) -> dict:
+        with self._lock:
+            self.slice_attachments[req.get("name", "")] = req
+        return req
+
+    def delete_slice_attachment(self, req: dict) -> dict:
+        with self._lock:
+            self.slice_attachments.pop(req.get("name", ""), None)
+        return {}
+
+    # -- NetworkFunctionService ----------------------------------------------
+    def create_network_function(self, req: dict) -> dict:
+        with self._lock:
+            self.network_functions.append(
+                (req.get("input", ""), req.get("output", "")))
+        return {}
+
+    def delete_network_function(self, req: dict) -> dict:
+        with self._lock:
+            try:
+                self.network_functions.remove(
+                    (req.get("input", ""), req.get("output", "")))
+            except ValueError:
+                pass
+        return {}

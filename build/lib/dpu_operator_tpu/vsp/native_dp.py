@@ -1,0 +1,285 @@
+"""Native ICI dataplane: client for the C++ tpu_cp_agent mailbox.
+
+The production counterpart of DebugIciDataplane (google.py): slice wiring is
+delegated to the native control-plane agent (native/tpucp/agent.cc, the
+octep_cp_agent analog) over the framed unix-socket protocol defined in
+native/tpucp/protocol.h. Struct layouts here must stay in sync with that
+header. The reference's equivalent seam is the Marvell VSP exec-ing into the
+octep service (marvell/mrvl-utils/mrvlutils.go:299-381).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+MAGIC = 0x54504355
+VERSION = 1
+
+MSG_INIT = 1
+MSG_ENUM = 2
+MSG_ATTACH = 3
+MSG_DETACH = 4
+MSG_WIRE_NF = 5
+MSG_UNWIRE_NF = 6
+MSG_LINK_STATE = 7
+MSG_SHUTDOWN = 8
+MSG_SET_LINK = 9
+MSG_LIST_WIRES = 10
+MSG_RESP = 0x80
+
+ST_OK = 0
+
+_HEADER = struct.Struct("<IHHII")
+_INIT_REQ = struct.Struct("<32s")
+_INIT_RESP = struct.Struct("<iI3I")
+_CHIP_ENTRY = struct.Struct("<I3iBBH")
+_ENUM_RESP = struct.Struct("<iI")
+_ATTACH_REQ = struct.Struct("<II" + "4s" * 8)
+_STATUS_RESP = struct.Struct("<i64s")
+_DETACH_REQ = struct.Struct("<I")
+_WIRE_REQ = struct.Struct("<64s64s")
+_LINK_REQ = struct.Struct("<I")
+_SET_LINK_REQ = struct.Struct("<I4sB3x")
+_PORT_STATE = struct.Struct("<4sBBH")
+_LINK_RESP_HEAD = struct.Struct("<iI")
+_WIRE_LIST_HEAD = struct.Struct("<iI")
+
+MAX_PORTS = 8
+
+
+class AgentError(RuntimeError):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"agent status {status}: {message}")
+        self.status = status
+
+
+def _cstr(raw: bytes) -> str:
+    return raw.split(b"\0", 1)[0].decode()
+
+
+class AgentClient:
+    """Framed-protocol client; one connection, sequential request/response
+    (the agent serializes on its db mutex anyway)."""
+
+    def __init__(self, socket_path: str, connect_timeout: float = 5.0):
+        self.socket_path = socket_path
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._lock = threading.Lock()
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(socket_path)
+                self._sock = s
+                return
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+
+    def close(self):
+        if self._sock:
+            self._sock.close()
+            self._sock = None
+
+    def _recv_all(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("agent closed connection")
+            buf += chunk
+        return buf
+
+    def _call(self, msg_type: int, payload: bytes) -> bytes:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._sock.sendall(_HEADER.pack(MAGIC, VERSION, msg_type, seq,
+                                            len(payload)) + payload)
+            magic, version, rtype, rseq, rlen = _HEADER.unpack(
+                self._recv_all(_HEADER.size))
+            if magic != MAGIC or version != VERSION:
+                raise ConnectionError("bad frame from agent")
+            if rtype != (msg_type | MSG_RESP) or rseq != seq:
+                raise ConnectionError(
+                    f"out-of-order response (type={rtype:#x} seq={rseq})")
+            return self._recv_all(rlen) if rlen else b""
+
+    def _status_call(self, msg_type: int, payload: bytes):
+        status, err = _STATUS_RESP.unpack(self._call(msg_type, payload))
+        if status != ST_OK:
+            raise AgentError(status, _cstr(err))
+
+    # -- operations -----------------------------------------------------------
+    def init(self, topology: str) -> dict:
+        data = self._call(MSG_INIT, _INIT_REQ.pack(topology.encode()))
+        status, num_chips, sx, sy, sz = _INIT_RESP.unpack(data)
+        if status != ST_OK:
+            raise AgentError(status, f"invalid topology {topology!r}")
+        return {"num_chips": num_chips, "shape": (sx, sy, sz)}
+
+    def enumerate(self) -> list[dict]:
+        data = self._call(MSG_ENUM, b"")
+        status, count = _ENUM_RESP.unpack(data[:_ENUM_RESP.size])
+        if status != ST_OK:
+            raise AgentError(status)
+        chips = []
+        off = _ENUM_RESP.size
+        for _ in range(count):
+            idx, cx, cy, cz, healthy, attached, nports = _CHIP_ENTRY.unpack(
+                data[off:off + _CHIP_ENTRY.size])
+            off += _CHIP_ENTRY.size
+            chips.append({"index": idx, "coords": (cx, cy, cz),
+                          "healthy": bool(healthy),
+                          "attached": bool(attached), "nports": nports})
+        return chips
+
+    def attach(self, chip: int, ports: Optional[list] = None):
+        ports = ports or []
+        if len(ports) > MAX_PORTS:
+            raise ValueError(f"at most {MAX_PORTS} ports")
+        padded = [p.encode() for p in ports] + [b""] * (MAX_PORTS - len(ports))
+        self._status_call(MSG_ATTACH,
+                          _ATTACH_REQ.pack(chip, len(ports), *padded))
+
+    def detach(self, chip: int):
+        self._status_call(MSG_DETACH, _DETACH_REQ.pack(chip))
+
+    def wire_nf(self, input_id: str, output_id: str):
+        self._status_call(MSG_WIRE_NF, _WIRE_REQ.pack(
+            input_id.encode(), output_id.encode()))
+
+    def unwire_nf(self, input_id: str, output_id: str):
+        self._status_call(MSG_UNWIRE_NF, _WIRE_REQ.pack(
+            input_id.encode(), output_id.encode()))
+
+    def link_state(self, chip: int) -> list[dict]:
+        data = self._call(MSG_LINK_STATE, _LINK_REQ.pack(chip))
+        status, nports = _LINK_RESP_HEAD.unpack(data[:_LINK_RESP_HEAD.size])
+        if status != ST_OK:
+            raise AgentError(status, f"chip {chip}")
+        ports = []
+        off = _LINK_RESP_HEAD.size
+        for _ in range(min(nports, MAX_PORTS)):
+            name, up, wired, _pad = _PORT_STATE.unpack(
+                data[off:off + _PORT_STATE.size])
+            off += _PORT_STATE.size
+            ports.append({"port": _cstr(name), "up": bool(up),
+                          "wired": bool(wired)})
+        return ports
+
+    def list_wires(self) -> list[tuple[str, str]]:
+        """Programmed SFC hops as (input, output) endpoint-id pairs — the
+        observability view e2e tests assert allocated ICI ports against."""
+        data = self._call(MSG_LIST_WIRES, b"")
+        status, count = _WIRE_LIST_HEAD.unpack(data[:_WIRE_LIST_HEAD.size])
+        if status != ST_OK:
+            raise AgentError(status)
+        wires = []
+        off = _WIRE_LIST_HEAD.size
+        for _ in range(count):
+            raw_in, raw_out = _WIRE_REQ.unpack(data[off:off + _WIRE_REQ.size])
+            off += _WIRE_REQ.size
+            wires.append((_cstr(raw_in), _cstr(raw_out)))
+        return wires
+
+    def set_link(self, chip: int, port: str, up: bool):
+        """Fault injection: force a port down (or restore it)."""
+        self._status_call(MSG_SET_LINK, _SET_LINK_REQ.pack(
+            chip, port.encode(), 1 if up else 0))
+
+    def shutdown(self):
+        try:
+            self._status_call(MSG_SHUTDOWN, b"")
+        except (ConnectionError, OSError):
+            pass  # agent exits before/while replying
+
+
+class AgentProcess:
+    """Spawn + supervise a local tpu_cp_agent (the VSP runs it as a child,
+    like cp-agent-run.go:9-73 starts octep_cp_agent)."""
+
+    def __init__(self, binary: str, socket_path: str, state_file: str = "",
+                 dev_dir: str = "", allow_regular_dev: bool = False):
+        self.binary = binary
+        self.socket_path = socket_path
+        self.state_file = state_file
+        self.dev_dir = dev_dir
+        # test harnesses only: lets regular files stand in for chardevs
+        self.allow_regular_dev = allow_regular_dev
+        self._proc: Optional[subprocess.Popen] = None
+
+    def start(self, timeout: float = 5.0):
+        cmd = [self.binary, "--socket", self.socket_path]
+        if self.state_file:
+            cmd += ["--state-file", self.state_file]
+        if self.dev_dir:
+            cmd += ["--dev-dir", self.dev_dir]
+        if self.allow_regular_dev:
+            cmd.append("--allow-regular-dev")
+        self._proc = subprocess.Popen(cmd, stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(self.socket_path):
+            if self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"tpu_cp_agent exited rc={self._proc.returncode}")
+            if time.monotonic() >= deadline:
+                raise TimeoutError("tpu_cp_agent socket never appeared")
+            time.sleep(0.02)
+
+    def stop(self):
+        if self._proc and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._proc = None
+
+
+class NativeIciDataplane:
+    """IciDataplane (google.py) backed by the native agent."""
+
+    def __init__(self, client: AgentClient):
+        self.client = client
+
+    def init_dataplane(self, topology):
+        info = self.client.init(topology.topology)
+        if info["num_chips"] != topology.num_chips:
+            raise RuntimeError(
+                f"agent chip count {info['num_chips']} != topology "
+                f"{topology.num_chips}")
+
+    def attach_chip(self, chip_index, ici_ports):
+        # IciLink objects or raw port names both accepted
+        ports = [getattr(p, "port", p) for p in ici_ports]
+        self.client.attach(chip_index, ports[:MAX_PORTS])
+
+    def detach_chip(self, chip_index):
+        self.client.detach(chip_index)
+
+    def wire_network_function(self, input_id, output_id):
+        self.client.wire_nf(input_id, output_id)
+
+    def unwire_network_function(self, input_id, output_id):
+        self.client.unwire_nf(input_id, output_id)
+
+    def chip_links_ok(self, chip_index) -> bool:
+        """Health input for the VSP: every wired ICI port trained. An
+        unattached chip (no wired ports) is healthy by definition."""
+        try:
+            return all(p["up"] for p in self.client.link_state(chip_index)
+                       if p["wired"])
+        except (AgentError, ConnectionError, OSError):
+            return False

@@ -1,0 +1,123 @@
+"""Daemon-side vendor plugin client (GrpcPlugin analog).
+
+Reference: internal/daemon/plugin/vendorplugin.go — the ``VendorPlugin``
+interface (:29-38), DaemonSet deployment of the VSP from embedded bindata
+(:141-164), unix-socket dial with retried Init (:82-115), and pass-through
+RPCs (:209-265).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional, Protocol
+
+from ..render import apply_all_from_bindata
+from ..utils import vars as v
+from ..utils.path_manager import PathManager
+from .rpc import VspChannel, unix_target
+
+log = logging.getLogger(__name__)
+
+_BINDATA = os.path.join(os.path.dirname(__file__), "bindata", "vsp-ds")
+
+
+class VendorPlugin(Protocol):
+    def start(self, tpu_mode: bool) -> tuple[str, int]: ...
+    def close(self) -> None: ...
+    def get_devices(self) -> dict: ...
+    def set_num_chips(self, count: int) -> None: ...
+    def create_slice_attachment(self, attachment: dict) -> dict: ...
+    def delete_slice_attachment(self, name: str) -> None: ...
+    def create_network_function(self, input_id: str, output_id: str) -> None: ...
+    def delete_network_function(self, input_id: str, output_id: str) -> None: ...
+
+
+class GrpcPlugin:
+    def __init__(self, detection, client=None, image_manager=None,
+                 path_manager: Optional[PathManager] = None,
+                 node_name: str = "", init_timeout: float = 10.0):
+        """*detection* is a DetectionResult; *client* a KubeClient (None skips
+        VSP DaemonSet deployment — used when the VSP runs in-process)."""
+        self.detection = detection
+        self.client = client
+        self.image_manager = image_manager
+        self.path_manager = path_manager or PathManager()
+        self.node_name = node_name
+        self.init_timeout = init_timeout
+        self.topology = ""  # programmed slice topology from Init (tpu mode)
+        self._channel: Optional[VspChannel] = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def _deploy_vsp(self):
+        """Render + apply the VSP DaemonSet (vendorplugin.go:141-164)."""
+        if self.client is None or self.image_manager is None:
+            return
+        data = {
+            "Namespace": v.NAMESPACE,
+            "VendorName": self.detection.vendor,
+            "NodeName": self.node_name,
+            "VspImage": self.image_manager.get_image(
+                self.detection.vsp_image_key),
+            "VspCommand": json.dumps(self.detection.vsp_command),
+        }
+        apply_all_from_bindata(self.client, _BINDATA, data)
+
+    def start(self, tpu_mode: bool) -> tuple[str, int]:
+        """Deploy VSP, dial the unix socket, call Init with retry
+        (vendorplugin.go:82-115). Returns the (ip, port) the tpu-side
+        slice-attachment server binds; the programmed slice topology (tpu
+        mode) lands on ``self.topology``."""
+        self._deploy_vsp()
+        sock = self.path_manager.vendor_plugin_socket()
+        self._channel = VspChannel(unix_target(sock))
+        deadline = time.monotonic() + self.init_timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                resp = self._channel.call(
+                    "LifeCycleService", "Init",
+                    {"tpu_mode": tpu_mode,
+                     "tpu_identifier": self.detection.identifier},
+                    timeout=2.0)
+                self.topology = resp.get("topology", "")
+                return resp.get("ip", ""), int(resp.get("port", 0))
+            except Exception as e:  # noqa: BLE001 — retry any dial error
+                last_err = e
+                time.sleep(0.1)
+        raise TimeoutError(
+            f"VSP Init did not succeed within {self.init_timeout}s: "
+            f"{last_err}")
+
+    def close(self):
+        if self._channel:
+            self._channel.close()
+            self._channel = None
+
+    # -- pass-throughs (vendorplugin.go:209-265) ------------------------------
+    def _call(self, service, method, req, timeout=30.0):
+        if self._channel is None:
+            raise RuntimeError("plugin not started")
+        return self._channel.call(service, method, req, timeout=timeout)
+
+    def get_devices(self) -> dict:
+        return self._call("DeviceService", "GetDevices", {}).get("devices", {})
+
+    def set_num_chips(self, count: int) -> None:
+        self._call("DeviceService", "SetNumChips", {"count": count})
+
+    def create_slice_attachment(self, attachment: dict) -> dict:
+        return self._call("SliceService", "CreateSliceAttachment", attachment)
+
+    def delete_slice_attachment(self, name: str) -> None:
+        self._call("SliceService", "DeleteSliceAttachment", {"name": name})
+
+    def create_network_function(self, input_id: str, output_id: str) -> None:
+        self._call("NetworkFunctionService", "CreateNetworkFunction",
+                   {"input": input_id, "output": output_id})
+
+    def delete_network_function(self, input_id: str, output_id: str) -> None:
+        self._call("NetworkFunctionService", "DeleteNetworkFunction",
+                   {"input": input_id, "output": output_id})

@@ -1,0 +1,146 @@
+"""gRPC plumbing for the VSP seam: JSON-encoded messages over real gRPC.
+
+The build image lacks grpc_tools codegen, so instead of generated stubs the
+services are registered with :class:`grpc.GenericRpcHandler` using the same
+``/tpuvsp.<Service>/<Method>`` paths ``api.proto`` defines; messages are dicts
+serialized as JSON. The daemon↔VSP transport is a unix socket exactly like
+the reference (vendorplugin.go:183-207).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+
+SERVICE_METHODS = {
+    "LifeCycleService": ["Init", "Shutdown"],
+    "DeviceService": ["GetDevices", "SetNumChips"],
+    "SliceService": ["CreateSliceAttachment", "DeleteSliceAttachment"],
+    "NetworkFunctionService": ["CreateNetworkFunction",
+                               "DeleteNetworkFunction"],
+}
+
+
+def _ser(obj: dict) -> bytes:
+    return json.dumps(obj or {}).encode()
+
+
+def _de(data: bytes) -> dict:
+    return json.loads(data.decode()) if data else {}
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, methods: dict):
+        self._methods = methods
+
+    def service(self, handler_call_details):
+        fn = self._methods.get(handler_call_details.method)
+        if fn is None:
+            return None
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=_de, response_serializer=_ser)
+
+
+class VspServer:
+    """Serve a VSP implementation on a unix socket.
+
+    *impl* provides snake_case methods (``init``, ``get_devices``,
+    ``set_num_chips``, ``create_slice_attachment``, ...) taking and returning
+    dicts matching api.proto messages.
+    """
+
+    _RPC_TO_ATTR = {
+        ("LifeCycleService", "Init"): "init",
+        ("LifeCycleService", "Shutdown"): "shutdown",
+        ("DeviceService", "GetDevices"): "get_devices",
+        ("DeviceService", "SetNumChips"): "set_num_chips",
+        ("SliceService", "CreateSliceAttachment"): "create_slice_attachment",
+        ("SliceService", "DeleteSliceAttachment"): "delete_slice_attachment",
+        ("NetworkFunctionService", "CreateNetworkFunction"):
+            "create_network_function",
+        ("NetworkFunctionService", "DeleteNetworkFunction"):
+            "delete_network_function",
+    }
+
+    def __init__(self, impl, socket_path: Optional[str] = None,
+                 tcp_addr: Optional[tuple] = None):
+        """Bind to a unix *socket_path* (daemon↔VSP seam) or a TCP
+        *(ip, port)* (the host↔tpu cross-boundary channel, the reference's
+        OPI server on the VSP-returned IpPort, dpusidemanager.go:141-165)."""
+        if (socket_path is None) == (tcp_addr is None):
+            raise ValueError("exactly one of socket_path/tcp_addr required")
+        self.impl = impl
+        self.socket_path = socket_path
+        self.tcp_addr = tcp_addr
+        self._server: Optional[grpc.Server] = None
+        self.bound_port: Optional[int] = None
+
+    def start(self):
+        if self.socket_path:
+            os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+        methods = {}
+        for (svc, rpc), attr in self._RPC_TO_ATTR.items():
+            fn = getattr(self.impl, attr, None)
+            if fn is None:
+                continue
+
+            def wrap(fn=fn):
+                def handler(request, context):
+                    return fn(request) or {}
+                return handler
+            methods[f"/tpuvsp.{svc}/{rpc}"] = wrap()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((_GenericHandler(methods),))
+        if self.socket_path:
+            self._server.add_insecure_port(f"unix://{self.socket_path}")
+        else:
+            ip, port = self.tcp_addr
+            self.bound_port = self._server.add_insecure_port(f"{ip}:{port}")
+            if self.bound_port == 0:
+                raise OSError(f"cannot bind VSP server to {ip}:{port}")
+        self._server.start()
+
+    def stop(self, grace: float = 0.5):
+        if self._server:
+            self._server.stop(grace).wait()
+            self._server = None
+
+
+class VspChannel:
+    """Client-side channel with per-method callables (stub analog)."""
+
+    def __init__(self, target: str):
+        self.target = target
+        self._channel = grpc.insecure_channel(target)
+        self._calls: dict[tuple, Callable] = {}
+        self._lock = threading.Lock()
+
+    def close(self):
+        self._channel.close()
+
+    def wait_ready(self, timeout: float = 10.0):
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+
+    def call(self, service: str, method: str, request: dict,
+             timeout: float = 30.0) -> dict:
+        key = (service, method)
+        with self._lock:
+            fn = self._calls.get(key)
+            if fn is None:
+                fn = self._channel.unary_unary(
+                    f"/tpuvsp.{service}/{method}",
+                    request_serializer=_ser,
+                    response_deserializer=_de)
+                self._calls[key] = fn
+        return fn(request, timeout=timeout)
+
+
+def unix_target(socket_path: str) -> str:
+    return f"unix://{socket_path}"

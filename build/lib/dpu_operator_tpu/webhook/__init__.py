@@ -1,0 +1,15 @@
+"""Admission webhooks: pod resource injector (NRI analog) + CR validation.
+
+Reference: cmd/nri/networkresourcesinjector.go and the validating webhook
+registration in cmd/main.go; pure mutation logic in injector.py, HTTP(S)
+server with cert hot-reload + control switches in server.py.
+"""
+
+from .injector import (NETWORKS_ANNOTATION, RESOURCE_NAME_ANNOTATION,
+                       mutate_pod, parse_network_refs)
+from .server import CONTROL_SWITCHES_CONFIGMAP, WebhookServer
+
+__all__ = [
+    "NETWORKS_ANNOTATION", "RESOURCE_NAME_ANNOTATION", "mutate_pod",
+    "parse_network_refs", "WebhookServer", "CONTROL_SWITCHES_CONFIGMAP",
+]

@@ -1,0 +1,36 @@
+"""Injector webhook entrypoint (reference: cmd/nri main, :60-117)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from .server import WebhookServer
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("tpu-network-resources-injector")
+    parser.add_argument("--bind", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--tls-cert", default="")
+    parser.add_argument("--tls-key", default="")
+    parser.add_argument("--kubeconfig", default="")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from ..k8s.real import RealKube
+    client = RealKube(args.kubeconfig or None)
+    server = WebhookServer(client, host=args.bind, port=args.port,
+                           certfile=args.tls_cert, keyfile=args.tls_key)
+    server.start()
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

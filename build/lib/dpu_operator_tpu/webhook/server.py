@@ -1,0 +1,202 @@
+"""Admission webhook server: /mutate (injector), /validate (CR), /healthz.
+
+Reference: cmd/nri/networkresourcesinjector.go — TLS server with cert
+hot-reload via fsnotify (:186-242; here an mtime-poll reloading the live
+SSLContext, which applies to new handshakes), a health port (:92-104), and
+a control-switches ConfigMap polled every 30 s (:229-240) that can turn
+injection off cluster-wide without restarting the webhook.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..api.webhook import ValidationError, validate_tpu_operator_config
+from ..utils import vars as v
+from .injector import RESOURCE_NAME_ANNOTATION, mutate_pod
+
+log = logging.getLogger(__name__)
+
+CONTROL_SWITCHES_CONFIGMAP = "nri-control-switches"
+
+
+class WebhookServer:
+    def __init__(self, client=None, host: str = "127.0.0.1", port: int = 0,
+                 certfile: str = "", keyfile: str = "",
+                 switch_poll_interval: float = 30.0):
+        """*client*: kube client for NAD lookups + control switches; when
+        None, injection uses an empty NAD set (mutations become no-ops)."""
+        self.client = client
+        self.host = host
+        self.port = port
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self.switch_poll_interval = switch_poll_interval
+        self.injection_enabled = True
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ssl_context: Optional[ssl.SSLContext] = None
+        self._cert_mtime = 0.0
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+
+    # -- NAD resource lookup --------------------------------------------------
+    def _nad_resource(self, ns: str, name: str) -> Optional[str]:
+        if self.client is None:
+            return None
+        nad = self.client.get("k8s.cni.cncf.io/v1",
+                              "NetworkAttachmentDefinition", name,
+                              namespace=ns)
+        if nad is None:
+            return None
+        return ((nad.get("metadata") or {}).get("annotations") or {}
+                ).get(RESOURCE_NAME_ANNOTATION)
+
+    # -- admission handlers ---------------------------------------------------
+    def review_mutate(self, review: dict) -> dict:
+        req = review.get("request") or {}
+        uid = req.get("uid", "")
+        if not self.injection_enabled:
+            return _response(uid, allowed=True)
+        pod = req.get("object") or {}
+        try:
+            patches = mutate_pod(pod, self._nad_resource)
+        except ValueError as e:
+            return _response(uid, allowed=False, message=str(e))
+        if not patches:
+            return _response(uid, allowed=True)
+        patch = base64.b64encode(json.dumps(patches).encode()).decode()
+        resp = _response(uid, allowed=True)
+        resp["response"]["patchType"] = "JSONPatch"
+        resp["response"]["patch"] = patch
+        return resp
+
+    def review_validate(self, review: dict) -> dict:
+        req = review.get("request") or {}
+        uid = req.get("uid", "")
+        if req.get("operation") == "DELETE":
+            return _response(uid, allowed=True)
+        try:
+            validate_tpu_operator_config(req.get("object") or {})
+        except ValidationError as e:
+            return _response(uid, allowed=False, message=str(e))
+        return _response(uid, allowed=True)
+
+    # -- control switches (:229-240) ------------------------------------------
+    def refresh_switches(self):
+        if self.client is None:
+            return
+        cm = self.client.get("v1", "ConfigMap", CONTROL_SWITCHES_CONFIGMAP,
+                             namespace=v.NAMESPACE)
+        if cm is None:
+            self.injection_enabled = True
+            return
+        try:
+            cfg = json.loads((cm.get("data") or {}).get("config.json", "{}"))
+            self.injection_enabled = bool(
+                cfg.get("networkResourceInjection", True))
+        except (ValueError, TypeError):
+            log.warning("malformed %s ConfigMap; leaving switches unchanged",
+                        CONTROL_SWITCHES_CONFIGMAP)
+
+    # -- TLS hot-reload (fsnotify analog, :186-228) ---------------------------
+    def _maybe_reload_certs(self):
+        if not (self.certfile and self._ssl_context):
+            return
+        try:
+            mtime = max(os.stat(self.certfile).st_mtime,
+                        os.stat(self.keyfile).st_mtime)
+        except OSError:
+            return
+        if mtime > self._cert_mtime:
+            self._ssl_context.load_cert_chain(self.certfile, self.keyfile)
+            self._cert_mtime = mtime
+            log.info("reloaded webhook serving certs")
+
+    # -- server ---------------------------------------------------------------
+    def start(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("webhook: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                routes: dict[str, Callable[[dict], dict]] = {
+                    "/mutate": outer.review_mutate,
+                    "/validate": outer.review_validate,
+                }
+                handler = routes.get(self.path)
+                if handler is None:
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                    self._reply(200, handler(review))
+                except Exception as e:  # noqa: BLE001
+                    log.exception("admission review failed")
+                    self._reply(500, {"error": str(e)})
+
+            def _reply(self, code, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        if self.certfile:
+            self._ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_context.load_cert_chain(self.certfile, self.keyfile)
+            self._cert_mtime = max(os.stat(self.certfile).st_mtime,
+                                   os.stat(self.keyfile).st_mtime)
+            self._server.socket = self._ssl_context.wrap_socket(
+                self._server.socket, server_side=True)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="webhook")
+        self._thread.start()
+        self.refresh_switches()
+        self._poll_thread = threading.Thread(
+            target=self._poll_switches_loop, daemon=True,
+            name="webhook-switches")
+        self._poll_thread.start()
+        log.info("webhook server on %s:%d (tls=%s)", self.host, self.port,
+                 bool(self.certfile))
+
+    def _poll_switches_loop(self):
+        while not self._stop.wait(self.switch_poll_interval):
+            self.refresh_switches()
+            self._maybe_reload_certs()
+
+    def stop(self):
+        self._stop.set()
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def _response(uid: str, allowed: bool, message: str = "") -> dict:
+    resp = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "response": {"uid": uid, "allowed": allowed}}
+    if message:
+        resp["response"]["status"] = {"message": message}
+    return resp

@@ -1,0 +1,28 @@
+"""JAX workloads the operator schedules onto programmed slices.
+
+The reference keeps its dataplane consumers outside the tree (OVS flows are
+exercised by the kubernetes-traffic-flow-tests submodule,
+hack/traffic_flow_tests.sh:1-30); the TPU analog of "traffic" is collective
+communication over the ICI mesh, so this package carries the workloads the
+SFC reconciler's NF pods run and the traffic-flow suite measures:
+
+- :mod:`.mesh` — build `jax.sharding.Mesh` objects matching a
+  :class:`~dpu_operator_tpu.ici.SliceTopology` the VSP programmed.
+- :mod:`.collectives` — psum and explicit ring (ppermute) allreduce, with
+  bandwidth measurement: the iperf of the ICI dataplane.
+- :mod:`.model` — the flagship sharded-transformer train step (dp/tp/sp)
+  used as the NF payload and as the driver's compile-check entry.
+"""
+
+from .mesh import make_mesh, mesh_for_topology
+from .collectives import (psum_allreduce, ring_allreduce,
+                          measure_allreduce_gbps)
+from .model import (TransformerConfig, init_params, forward, loss_fn,
+                    make_train_step, make_example_batch)
+
+__all__ = [
+    "make_mesh", "mesh_for_topology",
+    "psum_allreduce", "ring_allreduce", "measure_allreduce_gbps",
+    "TransformerConfig", "init_params", "forward", "loss_fn",
+    "make_train_step", "make_example_batch",
+]

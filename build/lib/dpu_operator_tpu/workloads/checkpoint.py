@@ -1,0 +1,78 @@
+"""Workload checkpoint/resume.
+
+The operator side persists through k8s CRs + on-disk CNI/agent state
+(SURVEY.md §5 checkpoint/resume); the workload side checkpoints train
+state so an NF pod rescheduled by the SFC reconciler (or preempted with
+its slice) resumes instead of restarting. Orbax handles the sharded
+save/restore; restore re-shards onto the current mesh, so a pod that
+comes back on a different host of the slice still loads.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
+
+
+class TrainCheckpointer:
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep,
+                                                 create=True))
+
+    def save(self, step: int, params: Any, opt_state: Any) -> None:
+        self._mgr.save(step, args=ocp.args.Composite(
+            params=ocp.args.StandardSave(params),
+            opt_state=ocp.args.StandardSave(opt_state)))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, params_like: Any, opt_state_like: Any,
+                step: Optional[int] = None) -> tuple:
+        """Restore onto the shardings of *params_like*/*opt_state_like*
+        (abstract or concrete trees from init_state on the current mesh)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # mesh from any mesh-sharded leaf; leaves without one (e.g. the
+        # optimizer step counter, created off-mesh) restore replicated —
+        # a committed single-device restore would clash with sharded
+        # params under jit
+        mesh = None
+        for leaf in jax.tree_util.tree_leaves((params_like, opt_state_like)):
+            if isinstance(getattr(leaf, "sharding", None), NamedSharding):
+                mesh = leaf.sharding.mesh
+                break
+
+        def as_abstract(tree):
+            def one(x):
+                if not hasattr(x, "sharding"):
+                    return x
+                sharding = x.sharding
+                if not isinstance(sharding, NamedSharding) and mesh is not None:
+                    sharding = NamedSharding(mesh, PartitionSpec())
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=sharding)
+            return jax.tree_util.tree_map(one, tree)
+
+        restored = self._mgr.restore(step, args=ocp.args.Composite(
+            params=ocp.args.StandardRestore(as_abstract(params_like)),
+            opt_state=ocp.args.StandardRestore(as_abstract(opt_state_like))))
+        return restored["params"], restored["opt_state"], step
+
+    def close(self):
+        self._mgr.close()

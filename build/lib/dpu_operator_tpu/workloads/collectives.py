@@ -1,0 +1,111 @@
+"""Collective workloads: the ICI traffic the SFC path must sustain.
+
+The reference's traffic-flow suite pushes iperf flows through OVS-programmed
+VF paths (hack/traffic_flow_tests.sh); here "traffic" is allreduce over the
+slice the VSP wired. Two implementations are provided:
+
+- :func:`psum_allreduce` — XLA's native collective; the production path.
+- :func:`ring_allreduce` — explicit reduce-scatter + all-gather rings built
+  from `lax.ppermute`, one hop per step. This is the "ring" component made
+  concrete: each hop crosses exactly one ICI link of the torus dimension the
+  mesh axis is laid on, so measuring it is measuring the wiring.
+
+Both run under `shard_map`, so they compile to the same SPMD program shape
+on the 8-device CPU test mesh as on a real slice.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def psum_allreduce(mesh: Mesh, axis: str = "model"):
+    """Jitted x -> allreduce(x) over *axis* via the native collective."""
+    spec = P(axis)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
+             check_vma=False)
+    def _ar(x):
+        return lax.psum(x, axis)
+
+    return jax.jit(_ar)
+
+
+def ring_allreduce(mesh: Mesh, axis: str = "model"):
+    """Jitted allreduce built from 2*(n-1) single-hop ppermute steps.
+
+    reduce-scatter then all-gather around the ring — the bandwidth-optimal
+    schedule on a torus dimension, moving 2*(n-1)/n of the data per link
+    (the bound SliceTopology.allreduce_algbw_gbps models).
+    """
+    n = mesh.shape[axis]
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    spec = P(axis)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
+             check_vma=False)
+    def _ar(x):
+        if n == 1:
+            return x
+        me = lax.axis_index(axis)
+        chunks = x.reshape(n, -1)
+
+        # reduce-scatter: at step i rank r sends chunk (r-i)%n one hop
+        # forward; the receiver accumulates it. After n-1 steps rank r
+        # holds the fully-reduced chunk (r+1)%n.
+        def rs(i, chunks):
+            moved = lax.ppermute(
+                lax.dynamic_index_in_dim(chunks, (me - i) % n,
+                                         keepdims=False), axis, fwd)
+            acc_idx = (me - 1 - i) % n
+            acc = lax.dynamic_index_in_dim(chunks, acc_idx, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                chunks, acc + moved, acc_idx, axis=0)
+
+        chunks = lax.fori_loop(0, n - 1, rs, chunks)
+
+        # all-gather: rotate completed chunks around the ring
+        def ag(i, chunks):
+            moved = lax.ppermute(
+                lax.dynamic_index_in_dim(chunks, (me + 1 - i) % n,
+                                         keepdims=False), axis, fwd)
+            return lax.dynamic_update_index_in_dim(
+                chunks, moved, (me - i) % n, axis=0)
+
+        chunks = lax.fori_loop(0, n - 1, ag, chunks)
+        return chunks.reshape(x.shape)
+
+    return jax.jit(_ar)
+
+
+def measure_allreduce_gbps(mesh: Mesh, axis: str = "model",
+                           mbytes: float = 64.0, iters: int = 10,
+                           impl: str = "psum") -> dict:
+    """Time allreduce and report algorithmic bandwidth.
+
+    algbw = payload / time; busbw = algbw * 2*(n-1)/n — the per-link ICI
+    rate, comparable against SliceTopology.LINK_GBPS.
+    """
+    n = mesh.shape[axis]
+    per_shard = int(mbytes * 1e6 / 4 / n)
+    per_shard = max(n, per_shard - per_shard % n)  # ring needs n|size
+    x = jnp.ones((n * per_shard,), jnp.float32)
+    fn = (ring_allreduce if impl == "ring" else psum_allreduce)(mesh, axis)
+    fn(x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    payload = x.size * 4
+    algbw = payload / dt / 1e9
+    busbw = algbw * 2 * (n - 1) / n if n > 1 else algbw
+    return {"impl": impl, "axis_size": n, "bytes": payload,
+            "sec_per_iter": dt, "algbw_gbps": algbw, "busbw_gbps": busbw}

@@ -1,0 +1,86 @@
+"""Device-mesh construction aligned with programmed slice topology.
+
+The operator advertises slice shapes (ici/topology.py); workloads must lay
+their logical mesh axes onto those physical torus dimensions so collectives
+ride ICI, not DCN. This is the workload-side half of the contract the
+reference leaves to OVS flow programming (SURVEY.md §2.7): the VSP wires the
+links, this module lines the `jax.sharding.Mesh` up with the wiring.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..ici.topology import SliceTopology, slice_shape
+
+
+def _balanced_factor(n: int, k: int) -> tuple[int, ...]:
+    """Factor n into k near-equal factors, largest last (so the fastest-
+    varying mesh axis — typically model — gets the bigger extent)."""
+    dims = [1] * k
+    rem = n
+    for i in range(k - 1):
+        target = round(rem ** (1 / (k - i)))
+        f = 1
+        for cand in range(target, 0, -1):
+            if rem % cand == 0:
+                f = cand
+                break
+        dims[i] = f
+        rem //= f
+    dims[k - 1] = rem
+    return tuple(sorted(dims))
+
+
+def make_mesh(axis_names: Sequence[str] = ("data", "model"),
+              devices: Optional[list] = None,
+              axis_sizes: Optional[Sequence[int]] = None) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    Without explicit *axis_sizes* the device count is factored into
+    near-equal axis extents with "model" (the last axis) largest, since
+    tensor-parallel collectives are the most latency-sensitive and belong on
+    the shortest-hop ICI ring.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = _balanced_factor(n, len(axis_names))
+    if math.prod(axis_sizes) != n:
+        raise ValueError(
+            f"axis sizes {tuple(axis_sizes)} do not cover {n} devices")
+    arr = np.array(devices).reshape(tuple(axis_sizes))
+    return Mesh(arr, tuple(axis_names))
+
+
+def mesh_for_topology(topology: str | SliceTopology,
+                      axis_names: Sequence[str] = ("data", "model"),
+                      devices: Optional[list] = None) -> Mesh:
+    """Mesh whose axis extents follow the physical slice shape.
+
+    For a v5e-16 (4x4) with axes (data, model) this yields a 4x4 mesh whose
+    "model" axis walks the x torus dimension — each model-parallel collective
+    stays on one physical ring. Extra physical dims are folded into the
+    first (data) axis, matching how dp tolerates longer hop counts.
+    """
+    topo = (topology if isinstance(topology, SliceTopology)
+            else SliceTopology(topology))
+    shape = topo.shape
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != topo.num_chips:
+        # Degraded environment (fewer devices than chips): fall back to a
+        # balanced mesh so tests and single-host runs still work.
+        return make_mesh(axis_names, devices)
+    k = len(axis_names)
+    if len(shape) >= k:
+        folded = (math.prod(shape[: len(shape) - k + 1]),) + \
+            tuple(shape[len(shape) - k + 1:])
+    else:
+        folded = (1,) * (k - len(shape)) + tuple(shape)
+    arr = np.array(devices).reshape(folded)
+    return Mesh(arr, tuple(axis_names))

@@ -1,0 +1,95 @@
+"""Multi-slice collectives: ICI within a slice, DCN across slices.
+
+The workload half of MultiSliceGroup (ici/topology.py): slices are joined
+over the datacenter network, which is an order of magnitude slower per host
+than ICI — so cross-slice traffic must be minimized. The canonical schedule
+is hierarchical allreduce: reduce-scatter inside the slice (ICI), allreduce
+the 1/n shard across slices (DCN), all-gather inside the slice (ICI) —
+moving 1/n of the payload over DCN instead of all of it.
+
+On hardware the "dcn" mesh axis comes from multi-slice device order
+(megascale); on the CPU test mesh it is just another axis, but the compiled
+collective schedule is identical.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_multislice_mesh(n_slices: int,
+                         axis_names: Sequence[str] = ("dcn", "data", "model"),
+                         devices: Optional[list] = None) -> Mesh:
+    """Mesh whose leading axis spans slices (DCN) and whose trailing axes
+    stay inside one slice (ICI). Device order must enumerate slice-major,
+    which matches multi-slice runtime enumeration."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} slices")
+    per_slice = len(devices) // n_slices
+    inner = len(axis_names) - 1
+    shape = [n_slices]
+    rem = per_slice
+    for i in range(inner - 1):
+        f = 1
+        target = round(rem ** (1 / (inner - i)))
+        for cand in range(target, 0, -1):
+            if rem % cand == 0:
+                f = cand
+                break
+        shape.append(f)
+        rem //= f
+    shape.append(rem)
+    arr = np.array(devices).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def hierarchical_allreduce(mesh: Mesh, ici_axis: str = "model",
+                           dcn_axis: str = "dcn"):
+    """Jitted allreduce over both axes with the DCN-minimizing schedule:
+    psum_scatter(ici) -> psum(dcn) -> all_gather(ici). DCN bytes per host
+    drop by the ICI axis size versus a flat psum over both axes."""
+    n_ici = mesh.shape[ici_axis]
+    spec = P((dcn_axis, ici_axis))
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
+             check_vma=False)
+    def _ar(x):
+        shard = lax.psum_scatter(x, ici_axis, tiled=True)   # ICI
+        shard = lax.psum(shard, dcn_axis)                    # DCN (1/n_ici)
+        return lax.all_gather(shard, ici_axis, tiled=True)   # ICI
+
+    return jax.jit(_ar)
+
+
+def flat_allreduce(mesh: Mesh, ici_axis: str = "model",
+                   dcn_axis: str = "dcn"):
+    """Baseline: one psum over both axes (XLA may or may not pick the
+    hierarchical schedule itself; this is the comparison point)."""
+    spec = P((dcn_axis, ici_axis))
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
+             check_vma=False)
+    def _ar(x):
+        return lax.psum(x, (dcn_axis, ici_axis))
+
+    return jax.jit(_ar)
+
+
+def dcn_bytes_per_host(payload_bytes: int, n_ici: int, n_slices: int,
+                       hierarchical: bool = True) -> float:
+    """Model of cross-slice traffic for the two schedules (feeds
+    BASELINE.md and the traffic-flow report)."""
+    if n_slices <= 1:
+        return 0.0
+    ring_factor = 2 * (n_slices - 1) / n_slices
+    full = payload_bytes * ring_factor
+    return full / n_ici if hierarchical else full

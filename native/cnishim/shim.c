@@ -1,0 +1,313 @@
+/* tpu-cni: static CNI shim binary.
+ *
+ * The executable the CRI/multus invokes per pod networking operation.
+ * Reference: dpu-cni/dpu-cni.go:17-42 — a static Go binary, because the
+ * kubelet execs the shim in a mount namespace where no Python (or any
+ * runtime) is guaranteed.  This is the C equivalent: zero dependencies
+ * beyond the kernel, works with an empty PATH and no repo checkout.
+ *
+ * Protocol (pkgs/cni/cnishim.go:31-89 analog, matching cni/shim.py):
+ *   read CNI_* env + stdin netconf JSON
+ *   POST {"env":{...},"config":<netconf>} as HTTP/1.1 to /cni over the
+ *     daemon's unix socket (TPU_CNI_SOCKET or the default path)
+ *   print response "result" JSON on stdout, or a CNI error JSON + exit 1
+ *   CNI_COMMAND=CHECK is a no-op success
+ */
+
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#define DEFAULT_SOCKET "/var/run/tpu-daemon/tpu-cni-server.sock"
+#define MAX_BODY (1 << 20)
+
+static const char *ENV_KEYS[] = {"CNI_COMMAND", "CNI_CONTAINERID",
+                                 "CNI_NETNS",   "CNI_IFNAME",
+                                 "CNI_ARGS",    "CNI_PATH"};
+enum { N_ENV = sizeof(ENV_KEYS) / sizeof(ENV_KEYS[0]) };
+
+/* -- tiny growable buffer -------------------------------------------------- */
+struct buf {
+    char *p;
+    size_t len, cap;
+};
+
+static int buf_put(struct buf *b, const char *s, size_t n) {
+    if (b->len + n + 1 > b->cap) {
+        size_t cap = b->cap ? b->cap : 4096;
+        while (cap < b->len + n + 1) cap *= 2;
+        char *np = realloc(b->p, cap);
+        if (!np) return -1;
+        b->p = np;
+        b->cap = cap;
+    }
+    memcpy(b->p + b->len, s, n);
+    b->len += n;
+    b->p[b->len] = '\0';
+    return 0;
+}
+
+static int buf_str(struct buf *b, const char *s) {
+    return buf_put(b, s, strlen(s));
+}
+
+/* JSON string escape (quotes, backslash, control chars) */
+static int buf_json_str(struct buf *b, const char *s) {
+    if (buf_str(b, "\"")) return -1;
+    for (; *s; s++) {
+        unsigned char c = (unsigned char)*s;
+        char tmp[8];
+        if (c == '"' || c == '\\') {
+            tmp[0] = '\\';
+            tmp[1] = (char)c;
+            if (buf_put(b, tmp, 2)) return -1;
+        } else if (c < 0x20) {
+            snprintf(tmp, sizeof tmp, "\\u%04x", c);
+            if (buf_str(b, tmp)) return -1;
+        } else {
+            if (buf_put(b, (const char *)&c, 1)) return -1;
+        }
+    }
+    return buf_str(b, "\"");
+}
+
+/* -- CNI error output ------------------------------------------------------ */
+static int die_cni(const char *msg) {
+    struct buf b = {0};
+    buf_str(&b, "{\"cniVersion\": \"0.4.0\", \"code\": 999, \"msg\": ");
+    buf_json_str(&b, msg);
+    buf_str(&b, "}");
+    if (b.p) puts(b.p);
+    return 1;
+}
+
+/* -- minimal JSON top-level scanner ---------------------------------------
+ * The daemon's CNI server replies {"result": ..., "error": "..."} in
+ * compact well-formed JSON; find the span of a top-level key's value.
+ * Returns 0 and sets out/outlen on success. */
+static int json_top_value(const char *json, const char *key, const char **out,
+                          size_t *outlen) {
+    size_t klen = strlen(key);
+    int depth = 0, in_str = 0, esc = 0;
+    const char *p = json;
+    while (*p) {
+        char c = *p;
+        if (in_str) {
+            if (esc)
+                esc = 0;
+            else if (c == '\\')
+                esc = 1;
+            else if (c == '"')
+                in_str = 0;
+            p++;
+            continue;
+        }
+        if (c == '"') {
+            /* at depth 1 a string here is a key (objects only) */
+            if (depth == 1) {
+                const char *kstart = p + 1;
+                const char *q = kstart;
+                int e2 = 0;
+                while (*q && (e2 || *q != '"')) {
+                    e2 = (!e2 && *q == '\\');
+                    q++;
+                }
+                if (!*q) return -1;
+                size_t got = (size_t)(q - kstart);
+                const char *after = q + 1;
+                while (*after == ' ' || *after == '\t') after++;
+                if (*after == ':') {
+                    after++;
+                    while (*after == ' ' || *after == '\t') after++;
+                    if (got == klen && strncmp(kstart, key, klen) == 0) {
+                        /* value spans to the matching comma/brace */
+                        const char *v = after;
+                        int d2 = 0, s2 = 0, es2 = 0;
+                        const char *r = v;
+                        for (; *r; r++) {
+                            char vc = *r;
+                            if (s2) {
+                                if (es2)
+                                    es2 = 0;
+                                else if (vc == '\\')
+                                    es2 = 1;
+                                else if (vc == '"')
+                                    s2 = 0;
+                                continue;
+                            }
+                            if (vc == '"')
+                                s2 = 1;
+                            else if (vc == '{' || vc == '[')
+                                d2++;
+                            else if (vc == '}' || vc == ']') {
+                                if (d2 == 0) break;
+                                d2--;
+                            } else if (vc == ',' && d2 == 0)
+                                break;
+                        }
+                        while (r > v && (r[-1] == ' ' || r[-1] == '\t' ||
+                                         r[-1] == '\n' || r[-1] == '\r'))
+                            r--;
+                        *out = v;
+                        *outlen = (size_t)(r - v);
+                        return 0;
+                    }
+                    /* not our key: skip past to keep scanning */
+                    p = after;
+                    continue;
+                }
+                p = after;
+                continue;
+            }
+            in_str = 1;
+            p++;
+            continue;
+        }
+        if (c == '{' || c == '[')
+            depth++;
+        else if (c == '}' || c == ']')
+            depth--;
+        p++;
+    }
+    return -1;
+}
+
+/* unescape a JSON string literal span ("..." included) into a C string */
+static char *json_unescape(const char *span, size_t len) {
+    if (len < 2 || span[0] != '"') return NULL;
+    char *out = malloc(len);
+    if (!out) return NULL;
+    size_t o = 0;
+    for (size_t i = 1; i + 1 < len; i++) {
+        char c = span[i];
+        if (c == '\\' && i + 2 < len + 1) {
+            i++;
+            switch (span[i]) {
+            case 'n': out[o++] = '\n'; break;
+            case 't': out[o++] = '\t'; break;
+            case 'r': out[o++] = '\r'; break;
+            case 'u': i += 4; out[o++] = '?'; break; /* lossy is fine here */
+            default: out[o++] = span[i];
+            }
+        } else {
+            out[o++] = c;
+        }
+    }
+    out[o] = '\0';
+    return out;
+}
+
+int main(void) {
+    const char *cmd = getenv("CNI_COMMAND");
+    if (cmd && strcmp(cmd, "CHECK") == 0) {
+        puts("{}");
+        return 0;
+    }
+
+    /* stdin netconf (verbatim JSON; empty -> {}) */
+    struct buf conf = {0};
+    char tmp[8192];
+    ssize_t n;
+    while ((n = read(STDIN_FILENO, tmp, sizeof tmp)) > 0)
+        if (buf_put(&conf, tmp, (size_t)n)) return die_cni("out of memory");
+    if (n < 0) return die_cni("reading stdin failed");
+    if (conf.len == 0) buf_str(&conf, "{}");
+    if (conf.len > MAX_BODY) return die_cni("netconf too large");
+
+    /* request body */
+    struct buf body = {0};
+    buf_str(&body, "{\"env\": {");
+    int first = 1;
+    for (int i = 0; i < (int)N_ENV; i++) {
+        const char *v = getenv(ENV_KEYS[i]);
+        if (!v) continue;
+        if (!first) buf_str(&body, ", ");
+        first = 0;
+        buf_json_str(&body, ENV_KEYS[i]);
+        buf_str(&body, ": ");
+        buf_json_str(&body, v);
+    }
+    buf_str(&body, "}, \"config\": ");
+    buf_put(&body, conf.p, conf.len);
+    buf_str(&body, "}");
+
+    /* connect */
+    const char *sock_path = getenv("TPU_CNI_SOCKET");
+    if (!sock_path || !*sock_path) sock_path = DEFAULT_SOCKET;
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return die_cni("socket() failed");
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof addr);
+    addr.sun_family = AF_UNIX;
+    if (strlen(sock_path) >= sizeof addr.sun_path)
+        return die_cni("socket path too long");
+    strcpy(addr.sun_path, sock_path);
+    /* deadline BEFORE connect — a wedged daemon with a full listen
+     * backlog blocks AF_UNIX connect() itself (2 min parity:
+     * cniserver.go:226-227; cni/shim.py settimeout-then-connect) */
+    struct timeval tv = {120, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof addr) < 0) {
+        char msg[256];
+        snprintf(msg, sizeof msg, "connect %s: %s", sock_path,
+                 strerror(errno));
+        return die_cni(msg);
+    }
+    char hdr[256];
+    snprintf(hdr, sizeof hdr,
+             "POST /cni HTTP/1.1\r\nHost: unix\r\n"
+             "Content-Type: application/json\r\n"
+             "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+             body.len);
+    struct buf req = {0};
+    buf_str(&req, hdr);
+    buf_put(&req, body.p, body.len);
+    size_t off = 0;
+    while (off < req.len) {
+        ssize_t w = write(fd, req.p + off, req.len - off);
+        if (w <= 0) return die_cni("writing request failed");
+        off += (size_t)w;
+    }
+
+    /* read full response */
+    struct buf resp = {0};
+    while ((n = read(fd, tmp, sizeof tmp)) > 0)
+        if (buf_put(&resp, tmp, (size_t)n)) return die_cni("out of memory");
+    close(fd);
+    if (resp.len == 0) return die_cni("empty response from daemon");
+
+    char *sep = strstr(resp.p, "\r\n\r\n");
+    if (!sep) return die_cni("malformed HTTP response");
+    int status = 0;
+    (void)sscanf(resp.p, "HTTP/1.%*c %d", &status);
+    const char *payload = sep + 4;
+
+    const char *err_span;
+    size_t err_len;
+    if (json_top_value(payload, "error", &err_span, &err_len) == 0 &&
+        err_len > 2) {
+        char *msg = json_unescape(err_span, err_len);
+        return die_cni(msg ? msg : "daemon error");
+    }
+    if (status != 200) {
+        char msg[64];
+        snprintf(msg, sizeof msg, "HTTP %d", status);
+        return die_cni(msg);
+    }
+    const char *res_span;
+    size_t res_len;
+    if (json_top_value(payload, "result", &res_span, &res_len) == 0 &&
+        res_len > 0 && strncmp(res_span, "null", 4) != 0) {
+        fwrite(res_span, 1, res_len, stdout);
+        fputc('\n', stdout);
+    } else {
+        puts("{}");
+    }
+    return 0;
+}

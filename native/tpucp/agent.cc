@@ -317,7 +317,12 @@ int main(int argc, char** argv) {
     auto next = [&]() -> const char* {
       return (i + 1 < argc) ? argv[++i] : "";
     };
-    if (arg == "--socket") socket_path = next();
+    if (arg == "--help" || arg == "-h") {
+      printf("usage: tpu_cp_agent --socket PATH [--state-file F] "
+             "[--dev-dir D] [--allow-regular-dev]\n");
+      return 0;
+    }
+    else if (arg == "--socket") socket_path = next();
     else if (arg == "--state-file") agent.state_file = next();
     else if (arg == "--dev-dir") agent.dev_dir = next();
     else if (arg == "--allow-regular-dev") agent.allow_regular_dev = true;
