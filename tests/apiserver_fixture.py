@@ -127,11 +127,13 @@ def _apply_json_patch(obj: dict, patches: list) -> dict:
     return obj
 
 
+def _in(values, x) -> bool:
+    """Wildcard-or-member rule matching shared by admission + RBAC."""
+    return "*" in (values or []) or x in (values or [])
+
+
 def _rule_matches(rule: dict, group: str, version: str, resource: str,
                   op: str) -> bool:
-    def _in(values, x):
-        return "*" in (values or []) or x in (values or [])
-
     return (_in(rule.get("apiGroups"), group)
             and _in(rule.get("apiVersions"), version)
             and _in(rule.get("resources"), resource)
@@ -205,8 +207,84 @@ class _Handler(BaseHTTPRequestHandler):
     def _authed(self) -> bool:
         got = self.headers.get("Authorization", "")
         if got == f"Bearer {self.server.token}":
+            self._subject = None  # the suite's admin token: no RBAC
             return True
+        for token, subject in self.server.owner.token_subjects.items():
+            if got == f"Bearer {token}":
+                self._subject = subject
+                return True
         self._send(401, _status(401, "Unauthorized", "bad or missing token"))
+        return False
+
+    def _body_matches_url(self, obj: dict, api_version: str,
+                          kind: str) -> bool:
+        """Writes must target the URL's resource: a body whose kind
+        differs (e.g. a ClusterRoleBinding POSTed to /configmaps) would
+        otherwise bypass the per-resource RBAC grant. Real apiservers
+        400 on the mismatch."""
+        b_av, b_kind = obj.get("apiVersion", ""), obj.get("kind", "")
+        if b_av == api_version and b_kind == kind:
+            return True
+        self._send(400, _status(
+            400, "BadRequest",
+            f"body is {b_av}/{b_kind} but URL addresses "
+            f"{api_version}/{plural(kind)}"))
+        return False
+
+    # -- RBAC (reference: config/rbac/ exercised implicitly by envtest) ------
+    def _authorized(self, verb: str, group: str, resource: str,
+                    subresource: str | None) -> bool:
+        """ClusterRole/ClusterRoleBinding evaluation for the authenticated
+        subject. The admin token (subject None) bypasses, matching
+        envtest's cluster-admin default; tokens registered in
+        token_subjects get real rule evaluation (VERDICT r2 #9: role.yaml
+        must be validated by something that can fail)."""
+        if self._subject is None or not self.server.owner.rbac_enabled:
+            return True
+        full_resource = (f"{resource}/{subresource}" if subresource
+                         else resource)
+        for binding in self.kube.list("rbac.authorization.k8s.io/v1",
+                                      "ClusterRoleBinding"):
+            if not any(self._subject_matches(s)
+                       for s in binding.get("subjects") or []):
+                continue
+            ref = binding.get("roleRef") or {}
+            if ref.get("kind") != "ClusterRole":
+                continue
+            role = self.kube.get("rbac.authorization.k8s.io/v1",
+                                 "ClusterRole", ref.get("name", ""))
+            if role is None:
+                continue
+            for rule in role.get("rules") or []:
+                if (_in(rule.get("apiGroups"), group)
+                        and _in(rule.get("resources"), full_resource)
+                        and _in(rule.get("verbs"), verb)):
+                    return True
+        return False
+
+    def _subject_matches(self, subject: dict) -> bool:
+        mine = self._subject or {}
+        if subject.get("kind") != mine.get("kind"):
+            return False
+        if subject.get("name") != mine.get("name"):
+            return False
+        if subject.get("kind") == "ServiceAccount":
+            return subject.get("namespace") == mine.get("namespace")
+        return True
+
+    def _check_rbac(self, verb: str, api_version: str, resource_kind: str,
+                    subresource: str | None) -> bool:
+        """Send 403 and return False when the subject lacks the verb."""
+        group = api_version.rpartition("/")[0]
+        resource = plural(resource_kind)
+        if self._authorized(verb, group, resource, subresource):
+            return True
+        mine = self._subject or {}
+        self._send(403, _status(
+            403, "Forbidden",
+            f"{resource}{'/' + subresource if subresource else ''} is "
+            f"forbidden: subject {mine.get('name', '?')!r} cannot {verb} "
+            f"in apiGroup {group!r}"))
         return False
 
     def _parse(self):
@@ -350,7 +428,10 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = self._parse()
         if parsed is None:
             return
-        api_version, kind, namespace, name, _, query = parsed
+        api_version, kind, namespace, name, subresource, query = parsed
+        if not self._check_rbac("get" if name else "list", api_version,
+                                kind, subresource):
+            return
         if name:
             obj = self.kube.get(api_version, kind, name, namespace=namespace)
             if obj is None:
@@ -373,7 +454,12 @@ class _Handler(BaseHTTPRequestHandler):
         obj = self._read_body()
         if not self._authed():
             return
-        if self._parse() is None:
+        parsed = self._parse()
+        if parsed is None:
+            return
+        if not self._body_matches_url(obj, parsed[0], parsed[1]):
+            return
+        if not self._check_rbac("create", parsed[0], parsed[1], None):
             return
         try:
             obj = self._run_admission(obj, "CREATE")
@@ -393,6 +479,10 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed is None:
             return
         _, _, _, _, subresource, _ = parsed
+        if not self._body_matches_url(obj, parsed[0], parsed[1]):
+            return
+        if not self._check_rbac("update", parsed[0], parsed[1], subresource):
+            return
         if subresource is None:
             try:
                 obj = self._run_admission(obj, "UPDATE")
@@ -421,6 +511,10 @@ class _Handler(BaseHTTPRequestHandler):
         if "apply-patch" not in ctype:
             self._send(415, _status(415, "UnsupportedMediaType", ctype))
             return
+        if not self._body_matches_url(obj, api_version, kind):
+            return
+        if not self._check_rbac("patch", api_version, kind, None):
+            return
         # server-side apply is CREATE-or-UPDATE; webhooks fire on the apply
         # intent (our apply bodies are full manifests, so the admitted
         # object is what gets merged — fixture-grade approximation of the
@@ -448,6 +542,8 @@ class _Handler(BaseHTTPRequestHandler):
         if name is None:
             self._send(405, _status(405, "MethodNotAllowed", "collection"))
             return
+        if not self._check_rbac("delete", api_version, kind, None):
+            return
         existing = self.kube.get(api_version, kind, name,
                                  namespace=namespace)
         if existing is not None:
@@ -470,11 +566,19 @@ class MiniApiServer:
                  token: str = "test-bearer-token"):
         self.kube = kube or FakeKube()
         self.token = token
+        #: extra bearer tokens -> RBAC subjects, e.g.
+        #: {"sa-token": {"kind": "ServiceAccount", "name": "tpu-operator",
+        #:               "namespace": "tpu-operator-system"}};
+        #: enforced against ClusterRole/Binding objects in the store when
+        #: rbac_enabled (the admin `token` always bypasses)
+        self.token_subjects: dict = {}
+        self.rbac_enabled = False
         self._tmp = tempfile.mkdtemp(prefix="miniapi-")
         self.cert_path, self.key_path = make_self_signed_cert(self._tmp)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
         self.httpd.kube = self.kube
         self.httpd.token = token
+        self.httpd.owner = self
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ctx.load_cert_chain(self.cert_path, self.key_path)
         self.httpd.socket = ctx.wrap_socket(self.httpd.socket,

@@ -1,0 +1,99 @@
+"""OLM bundle consistency (VERDICT r2 #10 / missing #6): annotations,
+scorecard config, bundle.Dockerfile, and manifest set must agree with each
+other and with config/ — the lint an `operator-sdk bundle validate` run
+would do (no operator-sdk in this env). Reference: /root/reference/bundle/,
+bundle.Dockerfile."""
+
+import os
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUNDLE = os.path.join(REPO, "bundle")
+
+
+def _load(path):
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def test_annotations_paths_exist_and_name_package():
+    ann = _load(os.path.join(BUNDLE, "metadata", "annotations.yaml"))
+    a = ann["annotations"]
+    assert a["operators.operatorframework.io.bundle.package.v1"] == \
+        "tpu-operator"
+    for key in ("manifests", "metadata"):
+        rel = a[f"operators.operatorframework.io.bundle.{key}.v1"]
+        assert os.path.isdir(os.path.join(BUNDLE, rel.rstrip("/"))), rel
+    sc = a["operators.operatorframework.io.test.config.v1"]
+    assert os.path.isfile(os.path.join(BUNDLE, sc.rstrip("/"),
+                                       "config.yaml"))
+
+
+def test_bundle_dockerfile_matches_annotations():
+    """Every LABEL in bundle.Dockerfile must equal the corresponding
+    annotation (OLM requires the two to agree), and every COPY source
+    must exist."""
+    ann = _load(os.path.join(BUNDLE, "metadata", "annotations.yaml"))
+    labels = {}
+    with open(os.path.join(REPO, "bundle.Dockerfile")) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("LABEL "):
+                k, _, v = line[len("LABEL "):].partition("=")
+                labels[k] = v
+            elif line.startswith("COPY "):
+                src = line.split()[1]
+                assert os.path.exists(os.path.join(REPO, src)), src
+    for k, v in labels.items():
+        assert ann["annotations"].get(k) == v, k
+
+
+def test_csv_owned_crds_are_shipped():
+    csv = _load(os.path.join(
+        BUNDLE, "manifests", "tpu-operator.clusterserviceversion.yaml"))
+    owned = {c["name"] for c in
+             csv["spec"]["customresourcedefinitions"]["owned"]}
+    shipped = set()
+    for fname in os.listdir(os.path.join(BUNDLE, "manifests")):
+        obj = _load(os.path.join(BUNDLE, "manifests", fname))
+        if obj.get("kind") == "CustomResourceDefinition":
+            shipped.add(obj["metadata"]["name"])
+    assert owned == shipped
+    assert csv["metadata"]["name"].startswith("tpu-operator.v")
+    assert "alm-examples" in csv["metadata"]["annotations"]
+
+
+def test_bundle_crds_match_config_bases():
+    """The bundle ships the SAME CRDs config/crd/bases installs — no
+    drift between `make deploy` and the OLM path."""
+    bases = os.path.join(REPO, "config", "crd", "bases")
+    for fname in os.listdir(bases):
+        bundled = os.path.join(BUNDLE, "manifests", fname)
+        assert os.path.isfile(bundled), f"{fname} missing from bundle"
+        with open(os.path.join(bases, fname)) as a, open(bundled) as b:
+            assert a.read() == b.read(), f"{fname} drifted"
+
+
+def test_scorecard_config_well_formed():
+    cfg = _load(os.path.join(BUNDLE, "tests", "scorecard", "config.yaml"))
+    assert cfg["kind"] == "Configuration"
+    tests = [t for stage in cfg["stages"] for t in stage["tests"]]
+    suites = {t["labels"]["suite"] for t in tests}
+    assert {"basic", "olm"} <= suites
+    for t in tests:
+        assert t["entrypoint"][0] == "scorecard-test"
+        assert t["image"].startswith("quay.io/operator-framework/")
+
+
+def test_bundle_services_consistent_with_config():
+    """The webhook Service in the bundle and in config/webhook must agree
+    on ports (same backing server)."""
+    bundled = _load(os.path.join(
+        BUNDLE, "manifests", "tpu-operator-webhook-service_v1_service.yaml"))
+    with open(os.path.join(REPO, "config", "webhook", "webhook.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    cfg_svc = next(d for d in docs if d.get("kind") == "Service")
+    assert bundled["spec"]["ports"] == cfg_svc["spec"]["ports"]
+    assert (bundled["metadata"]["name"] == cfg_svc["metadata"]["name"]
+            == "tpu-operator-webhook-service")

@@ -56,6 +56,71 @@ def test_ring_program_size_constant_in_axis():
     assert sizes[8][1] < sizes[4][1] * 1.5
 
 
+@pytest.mark.slow
+def test_ring_scales_to_v5p_sized_axis():
+    """VERDICT r2 #8: prove the compile-time claim at scale. A fresh
+    process forces a 64-device host platform (v5p-256-class axis: 64
+    hosts), lowers + compiles + RUNS the ring at n=8 and n=64, and the
+    program must stay constant-size (one collective-permute pair, not
+    n-1) with bounded lowering/compile time."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import os, time, json
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from dpu_operator_tpu.workloads.mesh import make_mesh
+from dpu_operator_tpu.workloads.ring_attention import ring_attention
+
+def measure(n, run=False):
+    mesh = make_mesh(("data", "model"), axis_sizes=(64 // n, n))
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (2, 128, 2, 16), jnp.float32)
+               for kk in keys)
+    fn = ring_attention(mesh, "model")
+    t0 = time.perf_counter()
+    low = jax.jit(fn).lower(q, k, v)
+    txt = low.as_text()
+    out = {"n": n, "permutes": txt.count("collective_permute"),
+           "chars": len(txt), "lower_s": time.perf_counter() - t0}
+    if run:
+        t0 = time.perf_counter()
+        compiled = low.compile()
+        out["compile_s"] = time.perf_counter() - t0
+        result = compiled(q, k, v)
+        result.block_until_ready()
+        out["sum"] = float(jnp.sum(result))
+    return out
+
+print(json.dumps([measure(8), measure(64, run=True)]))
+"""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    n8, n64 = _json.loads(proc.stdout.strip().splitlines()[-1])
+    # ONE logical permute pair (k and v) regardless of ring size — and
+    # not zero (a fully-replicated lowering would be a silent regression)
+    assert 0 < n8["permutes"] == n64["permutes"] <= 4
+    # program size constant in axis size (shape literals only)
+    assert n64["chars"] < n8["chars"] * 1.5
+    # lowering + compile bounded: seconds, not the minutes an unrolled
+    # 63-hop ring would take
+    assert n64["lower_s"] < max(10.0, 20 * n8["lower_s"])
+    assert n64["compile_s"] < 60.0
+    # and it actually executed on the 64-device mesh
+    assert "sum" in n64
+
+
 def test_ring_attention_bf16():
     mesh = make_mesh(("data", "model"), axis_sizes=(1, 8))
     q, k, v = _qkv(dtype=jnp.bfloat16)
