@@ -52,7 +52,18 @@ class TransformerConfig:
     #: trades recompute FLOPs for activation HBM — the standard lever for
     #: fitting longer context per chip
     remat: bool = False
+    #: expert parallelism: >0 makes every `moe_every`-th layer's FFN a
+    #: top-1 routed mixture of that many experts, expert weights sharded
+    #: over "model" (workloads/moe.py)
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
     learning_rate: float = 1e-3
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.moe_experts > 0 and i % self.moe_every == (
+            self.moe_every - 1)
 
     @property
     def d_head(self) -> int:
@@ -60,7 +71,7 @@ class TransformerConfig:
 
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
-    keys = iter(jax.random.split(rng, 4 + 4 * cfg.n_layers))
+    keys = iter(jax.random.split(rng, 4 + 5 * cfg.n_layers))
 
     def dense(key, shape):
         return (jax.random.normal(key, shape, jnp.float32)
@@ -72,36 +83,58 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         "out_norm": jnp.ones((cfg.d_model,), cfg.dtype),
         "layers": [],
     }
-    for _ in range(cfg.n_layers):
-        params["layers"].append({
+    for i in range(cfg.n_layers):
+        layer = {
             "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
             "wqkv": dense(next(keys), (cfg.d_model, 3 * cfg.d_model)),
             "wo": dense(next(keys), (cfg.d_model, cfg.d_model)),
             "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
-            "w1": dense(next(keys), (cfg.d_model, cfg.d_ff)),
-            "w2": dense(next(keys), (cfg.d_ff, cfg.d_model)),
-        })
+        }
+        if cfg.is_moe_layer(i):
+            from .moe import init_moe_params
+            layer["moe"] = init_moe_params(
+                next(keys), cfg.d_model, cfg.d_ff, cfg.moe_experts,
+                dtype=cfg.dtype)
+        else:
+            layer["w1"] = dense(next(keys), (cfg.d_model, cfg.d_ff))
+            layer["w2"] = dense(next(keys), (cfg.d_ff, cfg.d_model))
+        params["layers"].append(layer)
     return params
 
 
 def param_specs(cfg: TransformerConfig) -> dict:
     """Partition specs. Standard: tp shards heads/ff over "model"
     (column-parallel wqkv/w1, row-parallel wo/w2), embeddings shard vocab,
-    norms replicate. Ring mode: params replicate — all of "model" is spent
-    on the sequence dimension (long context)."""
+    norms replicate; MoE layers shard EXPERTS over "model" (ep). Ring
+    mode: params replicate — all of "model" is spent on the sequence
+    dimension (long context)."""
+    from .moe import moe_param_specs
+
     if cfg.attention == "ring":
-        rep = {"ln1": P(), "ln2": P(), "wqkv": P(), "wo": P(),
-               "w1": P(), "w2": P()}
+        layers = []
+        for i in range(cfg.n_layers):
+            rep = {"ln1": P(), "ln2": P(), "wqkv": P(), "wo": P()}
+            if cfg.is_moe_layer(i):
+                rep["moe"] = {k: P() for k in ("wg", "w1", "w2")}
+            else:
+                rep.update({"w1": P(), "w2": P()})
+            layers.append(rep)
         return {"embed": P(), "pos": P(), "out_norm": P(),
-                "layers": [dict(rep) for _ in range(cfg.n_layers)]}
-    layer = {
-        "ln1": P(), "ln2": P(),
-        "wqkv": P(None, "model"), "wo": P("model", None),
-        "w1": P(None, "model"), "w2": P("model", None),
-    }
+                "layers": layers}
+    layers = []
+    for i in range(cfg.n_layers):
+        layer = {
+            "ln1": P(), "ln2": P(),
+            "wqkv": P(None, "model"), "wo": P("model", None),
+        }
+        if cfg.is_moe_layer(i):
+            layer["moe"] = moe_param_specs()
+        else:
+            layer.update({"w1": P(None, "model"), "w2": P("model", None)})
+        layers.append(layer)
     return {
         "embed": P("model", None), "pos": P(), "out_norm": P(),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": layers,
     }
 
 
@@ -154,8 +187,10 @@ def _tp_act(x, mesh):
 
 
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
-            mesh: Mesh | None = None) -> jax.Array:
-    """Logits for next-token prediction. tokens: (B, S) int32."""
+            mesh: Mesh | None = None, return_aux: bool = False):
+    """Logits for next-token prediction. tokens: (B, S) int32.
+    With return_aux, also returns the MoE load-balance loss (0 for dense
+    models)."""
     B, S = tokens.shape
     x = params["embed"][tokens] + params["pos"][:S]
     x = x.astype(cfg.dtype)
@@ -183,22 +218,31 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                            v).reshape(B, S, cfg.d_model)
         x = x + o @ lp["wo"]
         h = _rmsnorm(_sp(x, cfg, mesh), lp["ln2"])
-        return x + (jax.nn.gelu(_tp_act(h @ lp["w1"], mesh)) @ lp["w2"])
+        if "moe" in lp:
+            from .moe import moe_ffn
+            out, aux = moe_ffn(lp["moe"], h, cfg.moe_capacity_factor)
+            return x + out, aux
+        ff = jax.nn.gelu(_tp_act(h @ lp["w1"], mesh)) @ lp["w2"]
+        return x + ff, jnp.zeros((), jnp.float32)
 
     layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    aux_total = jnp.zeros((), jnp.float32)
     for lp in params["layers"]:
-        x = layer_fn(x, lp)
+        x, aux = layer_fn(x, lp)
+        aux_total = aux_total + aux
     x = _rmsnorm(_sp(x, cfg, mesh), params["out_norm"])
-    return (x @ params["embed"].T).astype(jnp.float32)
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return (logits, aux_total) if return_aux else logits
 
 
 def loss_fn(params: dict, batch: dict, cfg: TransformerConfig,
             mesh: Mesh | None = None) -> jax.Array:
-    logits = forward(params, batch["tokens"], cfg, mesh)
+    logits, aux = forward(params, batch["tokens"], cfg, mesh,
+                          return_aux=True)
     targets = batch["targets"]
     logp = jax.nn.log_softmax(logits, -1)
     nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-    return nll.mean()
+    return nll.mean() + cfg.moe_aux_weight * aux
 
 
 def make_example_batch(cfg: TransformerConfig, batch: int = 8,

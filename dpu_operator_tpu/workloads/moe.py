@@ -1,0 +1,99 @@
+"""Mixture-of-experts FFN with expert parallelism (ep).
+
+The flagship workload's MoE variant: a switch-style top-1 router with
+static capacity, dense one-hot dispatch/combine einsums (MXU-friendly, no
+dynamic shapes under jit), and expert weights sharded over the mesh's
+"model" axis — expert parallelism rides the same ICI ring the operator
+programs for tp, with XLA inserting the dispatch all-to-alls.
+
+Reference analog: none — the reference operator carries no ML runtime
+(SURVEY.md §2.7); this is workload-side proof that the advertised slice
+topology supports ep the way it supports dp/tp/sp (BASELINE north star).
+Design follows the public Switch-Transformer/Mesh-TF dense-dispatch recipe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def moe_param_specs() -> dict:
+    """Router replicated; expert weights sharded over "model" on the
+    EXPERT axis (each shard owns n_experts/model_axis whole experts)."""
+    return {"wg": P(), "w1": P("model", None, None),
+            "w2": P("model", None, None)}
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
+                    n_experts: int, dtype=jnp.bfloat16) -> dict:
+    k1, k2, k3 = jax.random.split(rng, 3)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "wg": dense(k1, (d_model, n_experts), d_model),
+        "w1": dense(k2, (n_experts, d_model, d_ff), d_model),
+        "w2": dense(k3, (n_experts, d_ff, d_model), d_ff),
+    }
+
+
+def moe_capacity(n_tokens: int, n_experts: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert token capacity (round up to a multiple of 8 so
+    the (E, C, D) expert batch tiles the MXU sublanes)."""
+    cap = int(np.ceil(n_tokens / n_experts * capacity_factor))
+    return max(8, -(-cap // 8) * 8)
+
+
+def moe_ffn(params: dict, x: jax.Array,
+            capacity_factor: float = 1.25) -> tuple[jax.Array, jax.Array]:
+    """Top-1 routed FFN. x: (B, S, D) -> (out (B, S, D), aux_loss scalar).
+
+    Routing is GROUPED per batch row (the Mesh-TF/Switch group trick):
+    each row of S tokens routes independently with capacity
+    ceil(S/E * cf), so the one-hot dispatch/combine tensors are
+    (B, S, E, C) with C ~ S/E — einsum cost O(B*S^2*cf*D / 1) per layer
+    instead of the O((B*S)^2*D) a flat all-token dispatch would cost.
+    Tokens beyond an expert's capacity are dropped (their residual path
+    carries them — standard switch behavior). aux_loss is the
+    load-balancing term (mean_e frac_tokens_e * mean_prob_e * E).
+    """
+    b, s, d = x.shape
+    e = params["wg"].shape[1]
+    cap = moe_capacity(s, e, capacity_factor)
+
+    # router in fp32 (stability), weights bf16
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
+                        params["wg"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)               # (B, S, E)
+    expert_idx = jnp.argmax(probs, axis=-1)               # (B, S)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (B, S, E)
+    gate = jnp.sum(probs * onehot, axis=-1)               # (B, S)
+
+    # per-row position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=1) * onehot             # (B, S, E) 1-based
+    keep = (pos > 0) & (pos <= cap)
+    pos_oh = jax.nn.one_hot((pos - 1).astype(jnp.int32), cap,
+                            dtype=jnp.float32) * keep[..., None]
+    dispatch = pos_oh                                     # (B, S, E, C)
+    combine = dispatch * gate[..., None, None]            # (B, S, E, C)
+
+    # expert batches (E, B, C, D): E sharded over "model" by the caller's
+    # param specs; XLA emits the dispatch all-to-alls
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch,
+                           x.astype(jnp.float32)).astype(params["w1"].dtype)
+    h = jax.nn.gelu(jnp.einsum("ebcd,edf->ebcf", expert_in, params["w1"]))
+    expert_out = jnp.einsum("ebcf,efd->ebcd", h, params["w2"])
+    out = jnp.einsum("bsec,ebcd->bsd", combine,
+                     expert_out.astype(jnp.float32))
+
+    # load-balance auxiliary (Switch eq. 4): E * sum_e f_e * P_e
+    frac_tokens = jnp.mean(onehot, axis=(0, 1))
+    frac_probs = jnp.mean(probs, axis=(0, 1))
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return out.astype(x.dtype), aux

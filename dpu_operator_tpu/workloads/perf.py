@@ -48,18 +48,40 @@ def peak_tflops(device=None) -> float:
 
 
 def param_count(cfg) -> int:
-    per_layer = (2 * cfg.d_model                       # ln1, ln2
-                 + cfg.d_model * 3 * cfg.d_model       # wqkv
-                 + cfg.d_model * cfg.d_model           # wo
-                 + 2 * cfg.d_model * cfg.d_ff)         # w1, w2
-    return (cfg.vocab * cfg.d_model + cfg.max_seq * cfg.d_model
-            + cfg.d_model + cfg.n_layers * per_layer)
+    attn = (2 * cfg.d_model                            # ln1, ln2
+            + cfg.d_model * 3 * cfg.d_model            # wqkv
+            + cfg.d_model * cfg.d_model)               # wo
+    dense_ffn = 2 * cfg.d_model * cfg.d_ff             # w1, w2
+    total = cfg.vocab * cfg.d_model + cfg.max_seq * cfg.d_model + cfg.d_model
+    for i in range(cfg.n_layers):
+        total += attn
+        if getattr(cfg, "moe_experts", 0) and cfg.is_moe_layer(i):
+            total += (cfg.d_model * cfg.moe_experts        # router
+                      + cfg.moe_experts * dense_ffn)       # expert w1/w2
+        else:
+            total += dense_ffn
+    return total
+
+
+def active_param_count(cfg) -> int:
+    """Params each token actually multiplies against. Equal to
+    param_count for dense models; for top-1 MoE layers only the router
+    plus ONE expert's FFN counts — counting all experts would inflate
+    6*N*T (and MFU) by the expert count, the exact dishonesty this
+    module exists to prevent."""
+    total = param_count(cfg)
+    if getattr(cfg, "moe_experts", 0):
+        dense_ffn = 2 * cfg.d_model * cfg.d_ff
+        n_moe = sum(cfg.is_moe_layer(i) for i in range(cfg.n_layers))
+        total -= n_moe * (cfg.moe_experts - 1) * dense_ffn
+    return total
 
 
 def train_step_flops(cfg, batch: int, seq: int) -> float:
-    """Model FLOPs of one fwd+bwd step with causal-attention accounting."""
+    """Model FLOPs of one fwd+bwd step with causal-attention accounting
+    (and per-token ACTIVE params for MoE — see active_param_count)."""
     tokens = batch * seq
-    matmul = 6.0 * param_count(cfg) * tokens
+    matmul = 6.0 * active_param_count(cfg) * tokens
     attn_causal = 6.0 * cfg.n_layers * batch * seq * seq * cfg.d_model
     return matmul + attn_causal
 

@@ -1,0 +1,220 @@
+"""Pipeline parallelism (pp): GPipe-style microbatch pipelining over a
+"pipe" mesh axis.
+
+Stages are consecutive transformer-layer groups, one per device along
+"pipe"; activations hop stage-to-stage with `jax.lax.ppermute` inside a
+`shard_map`, microbatches streaming through a `lax.scan` over
+M + P - 1 ticks (fill + steady state + drain). Autodiff flows through the
+permutes, so `jax.grad` of the pipelined loss IS pipeline-parallel
+training — no hand-written backward schedule.
+
+The operator-side contract: the "pipe" axis must be laid on an ICI path
+(mesh.py maps logical axes onto the programmed slice topology); each hop
+is one neighbor transfer, which is exactly the wiring the SFC chain
+programs for NF pipelines — the ML-workload twin of chain steering.
+
+Reference analog: none in the reference (no ML runtime, SURVEY.md §2.7);
+this follows the public GPipe/shard_map pipelining recipe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _layer_fwd(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
+    """One dense (non-tp) transformer layer — the per-stage unit (norm
+    shared with the flagship model so the twins cannot drift)."""
+    from .model import _rmsnorm
+
+    b, s, d = x.shape
+    d_head = d // n_heads
+    h = _rmsnorm(x, lp["ln1"])
+    qkv = h @ lp["wqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, n_heads, d_head)
+    k = k.reshape(b, s, n_heads, d_head)
+    v = v.reshape(b, s, n_heads, d_head)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d_head)
+    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
+    att = jnp.where(mask, att, -1e9)
+    att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(b, s, d)
+    x = x + o @ lp["wo"]
+    h = _rmsnorm(x, lp["ln2"])
+    return x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+
+
+def init_pipeline_params(rng: jax.Array, cfg, n_stages: int) -> dict:
+    """Params with per-stage stacking: every layer tensor gets shape
+    (n_stages, layers_per_stage, ...) so spec P("pipe") puts each stage's
+    group on its device."""
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"{cfg.n_layers} layers do not split over {n_stages} stages")
+    lps = cfg.n_layers // n_stages
+    keys = iter(jax.random.split(rng, 2 + 4 * cfg.n_layers))
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(shape[0])).astype(cfg.dtype)
+
+    def stacked(shape):
+        return jnp.stack([
+            jnp.stack([dense(next(keys), shape) for _ in range(lps)])
+            for _ in range(n_stages)])
+
+    d, f = cfg.d_model, cfg.d_ff
+    ones = jnp.ones((n_stages, lps, d), cfg.dtype)
+    return {
+        "embed": dense(next(keys), (cfg.vocab, d)),
+        "pos": dense(next(keys), (cfg.max_seq, d)),
+        "out_norm": jnp.ones((d,), cfg.dtype),
+        "stages": {
+            "ln1": ones, "ln2": ones,
+            "wqkv": stacked((d, 3 * d)), "wo": stacked((d, d)),
+            "w1": stacked((d, f)), "w2": stacked((f, d)),
+        },
+    }
+
+
+def pipeline_param_specs() -> dict:
+    stage = {k: P("pipe") for k in ("ln1", "ln2", "wqkv", "wo", "w1", "w2")}
+    return {"embed": P(), "pos": P(), "out_norm": P(), "stages": stage}
+
+
+def make_pipeline_forward(cfg, mesh: Mesh,
+                          n_micro: int) -> Callable:
+    """(params, tokens (B, S)) -> logits (B, S, V), pipelined over the
+    mesh's "pipe" axis with *n_micro* microbatches (B % n_micro == 0).
+
+    The batch dimension of each microbatch additionally shards over
+    "data" when the mesh has one (pp x dp)."""
+    n_stages = mesh.shape["pipe"]
+    has_data = "data" in mesh.axis_names and mesh.shape["data"] > 1
+
+    def fwd(params, tokens):
+        B, S = tokens.shape
+        if B % n_micro:
+            raise ValueError(
+                f"batch {B} does not split into {n_micro} microbatches")
+        mb = B // n_micro
+        if has_data and mb % mesh.shape["data"]:
+            raise ValueError(
+                f"microbatch size {mb} does not shard over data axis "
+                f"{mesh.shape['data']}")
+        x = params["embed"][tokens] + params["pos"][:S]
+        x = x.astype(cfg.dtype).reshape(n_micro, mb, S, cfg.d_model)
+
+        data_dim = "data" if has_data else None
+        act_spec = P(None, data_dim, None, None)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(pipeline_param_specs()["stages"], act_spec),
+            out_specs=act_spec, check_vma=False)
+        def run(stages, xm):
+            # local stage group: (1, layers_per_stage, ...) -> drop dim 0
+            sp = jax.tree_util.tree_map(lambda t: t[0], stages)
+            stage_id = jax.lax.axis_index("pipe")
+            n_ticks = n_micro + n_stages - 1
+
+            def stage_fn(x_in):
+                def body(x, lp):
+                    return _layer_fwd(lp, x, cfg.n_heads), None
+                out, _ = jax.lax.scan(body, x_in, sp)
+                return out
+
+            zero = jnp.zeros_like(xm[0])
+            fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+            def tick(carry, t):
+                buf = carry
+                m_in = jnp.clip(t, 0, n_micro - 1)
+                x_t = jax.lax.dynamic_index_in_dim(xm, m_in, 0,
+                                                   keepdims=False)
+                inp = jnp.where(stage_id == 0, x_t, buf)
+                y = stage_fn(inp)
+                # hand off to the next stage (stage 0 refills from xm);
+                # a single-stage "pipeline" has no hop — and an empty
+                # ppermute is rejected by some backends
+                buf_next = (jax.lax.ppermute(y, "pipe", fwd_perm)
+                            if fwd_perm else y)
+                return buf_next, y
+
+            _, ys = jax.lax.scan(tick, zero, jnp.arange(n_ticks))
+            # microbatch m leaves the last stage at tick m + P - 1
+            outs = ys[n_stages - 1:]
+            keep = jnp.where(stage_id == n_stages - 1, 1.0, 0.0)
+            outs = (outs.astype(jnp.float32) * keep).astype(ys.dtype)
+            return jax.lax.psum(outs, "pipe")
+
+        out = run(params["stages"], x)
+        from .model import _rmsnorm
+        out = _rmsnorm(out.reshape(B, S, cfg.d_model), params["out_norm"])
+        return (out @ params["embed"].T).astype(jnp.float32)
+
+    return fwd
+
+
+def make_pipeline_train_step(cfg, mesh: Mesh, n_micro: int):
+    """Jitted pipelined (params, opt_state, batch) -> (params, opt_state,
+    loss) — pp over "pipe" (x dp over "data" when present)."""
+    import optax
+
+    tx = optax.adamw(cfg.learning_rate)
+    fwd = make_pipeline_forward(cfg, mesh, n_micro)
+    pshard = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pipeline_param_specs(),
+        is_leaf=lambda s: isinstance(s, P))
+    data_dim = ("data" if "data" in mesh.axis_names
+                and mesh.shape["data"] > 1 else None)
+    bshard = {"tokens": NamedSharding(mesh, P(data_dim, None)),
+              "targets": NamedSharding(mesh, P(data_dim, None))}
+
+    def loss_fn(params, batch):
+        logits = fwd(params, batch["tokens"])
+        logp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(logp, batch["targets"][..., None],
+                                   -1)[..., 0]
+        return nll.mean()
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def init_state(rng):
+        params = jax.device_put(
+            init_pipeline_params(rng, cfg, mesh.shape["pipe"]), pshard)
+        return params, tx.init(params)
+
+    def place(batch):
+        return jax.device_put(batch, bshard)
+
+    return jax.jit(step, donate_argnums=(0, 1)), init_state, place
+
+
+def sequential_forward(cfg, params, tokens):
+    """Reference: the same stacked params applied sequentially (no
+    pipelining) — the correctness oracle for the pipelined forward."""
+    B, S = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:S]
+    x = x.astype(cfg.dtype)
+    stages = params["stages"]
+    n_stages = stages["wqkv"].shape[0]
+    lps = stages["wqkv"].shape[1]
+    for si in range(n_stages):
+        for li in range(lps):
+            lp = jax.tree_util.tree_map(lambda t: t[si, li], stages)
+            x = _layer_fwd(lp, x, cfg.n_heads)
+
+    from .model import _rmsnorm
+    x = _rmsnorm(x, params["out_norm"])
+    return (x @ params["embed"].T).astype(jnp.float32)

@@ -1,0 +1,158 @@
+"""Expert parallelism (MoE) + pipeline parallelism on the virtual mesh.
+
+Completes the parallelism matrix the framework advertises (dp/tp/sp/ring
+were rounds 1-2): ep = switch-style experts sharded over "model"
+(workloads/moe.py), pp = GPipe microbatch pipelining over a "pipe" axis
+with ppermute hops (workloads/pipeline.py). The operator side is
+unchanged — these prove the programmed slice topology carries both.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.workloads.mesh import make_mesh
+from dpu_operator_tpu.workloads.model import TransformerConfig
+from dpu_operator_tpu.workloads import moe
+
+
+def test_single_expert_moe_equals_dense_ffn():
+    """E=1 routes every token to the one expert with gate 1.0, so the MoE
+    FFN must equal the dense FFN with the same weights exactly."""
+    rng = jax.random.key(0)
+    d, f = 16, 32
+    params = moe.init_moe_params(rng, d, f, n_experts=1, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (2, 8, d), jnp.float32)
+    out, aux = moe.moe_ffn(params, x, capacity_factor=1.0)
+    dense = jax.nn.gelu(x @ params["w1"][0]) @ params["w2"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=1e-5, rtol=1e-5)
+    assert float(aux) == pytest.approx(1.0)  # E * f_e * P_e = 1 * 1 * 1
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """Tokens past an expert's static capacity fall back to the residual
+    path (output contribution 0) instead of breaking static shapes."""
+    rng = jax.random.key(0)
+    d, f = 8, 16
+    params = moe.init_moe_params(rng, d, f, n_experts=2, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (1, 64, d), jnp.float32)
+    out, _ = moe.moe_ffn(params, x, capacity_factor=0.25)
+    # expected survivors: per expert, min(routed count, static capacity)
+    cap = moe.moe_capacity(64, 2, 0.25)
+    idx = jnp.argmax(x.reshape(64, d) @ params["wg"], axis=-1)
+    counts = jnp.bincount(idx, length=2)
+    expected = int(jnp.sum(jnp.minimum(counts, cap)))
+    nonzero_rows = int(jnp.sum(jnp.any(out[0] != 0, axis=-1)))
+    assert nonzero_rows == expected
+    assert expected < 64  # the tiny capacity really dropped tokens
+
+
+def test_moe_capacity_is_mxu_aligned():
+    assert moe.moe_capacity(64, 2, 0.25) == 8
+    assert moe.moe_capacity(1000, 8, 1.25) == 160  # ceil(156.25) -> 157 -> 160
+    assert moe.moe_capacity(4, 4, 1.0) == 8        # floor of 8
+
+
+def test_moe_train_step_ep_sharded_loss_decreases():
+    """Full train step with experts sharded over "model" (ep): loss falls
+    and the expert weights really carry the ep spec."""
+    from dpu_operator_tpu.workloads import (make_example_batch,
+                                            make_train_step)
+    from dpu_operator_tpu.workloads.model import param_specs
+    from jax.sharding import PartitionSpec as P
+
+    cfg = TransformerConfig(n_layers=2, d_model=32, n_heads=4, d_ff=64,
+                            max_seq=32, vocab=128, moe_experts=8)
+    specs = param_specs(cfg)
+    assert specs["layers"][1]["moe"]["w1"] == P("model", None, None)
+    assert "w1" not in specs["layers"][1]
+    assert specs["layers"][0]["w1"] == P(None, "model")  # dense layer keeps tp
+
+    mesh = make_mesh(("data", "model"), axis_sizes=(2, 4))
+    step, init_state, place = make_train_step(cfg, mesh)
+    params, opt = init_state(jax.random.key(0))
+    batch = place(make_example_batch(cfg, batch=4))
+    losses = []
+    for _ in range(5):
+        params, opt, loss = step(params, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_moe_ring_mode_replicates_experts():
+    from dpu_operator_tpu.workloads.model import param_specs
+    from jax.sharding import PartitionSpec as P
+
+    cfg = TransformerConfig(n_layers=2, attention="ring", moe_experts=4)
+    specs = param_specs(cfg)
+    assert specs["layers"][1]["moe"]["w1"] == P()
+
+
+# -- pipeline parallelism -----------------------------------------------------
+
+def _pp_cfg(**kw):
+    base = dict(n_layers=4, d_model=32, n_heads=4, d_ff=64, max_seq=16,
+                vocab=64, dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_pipeline_forward_matches_sequential():
+    """The pipelined forward (4 stages x 4 microbatches over ppermute)
+    must equal running the same stacked layers sequentially."""
+    from dpu_operator_tpu.workloads import pipeline
+
+    cfg = _pp_cfg()
+    mesh = make_mesh(("pipe", "data"), axis_sizes=(4, 2))
+    params = pipeline.init_pipeline_params(jax.random.key(0), cfg,
+                                           n_stages=4)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab)
+    fwd = pipeline.make_pipeline_forward(cfg, mesh, n_micro=4)
+    with jax.sharding.use_mesh(mesh) if hasattr(
+            jax.sharding, "use_mesh") else mesh:
+        piped = jax.jit(fwd)(params, tokens)
+    ref = pipeline.sequential_forward(cfg, params, tokens)
+    np.testing.assert_allclose(np.asarray(piped), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_pipeline_train_step_loss_decreases():
+    from dpu_operator_tpu.workloads import make_example_batch, pipeline
+
+    cfg = _pp_cfg(dtype=jnp.bfloat16)
+    mesh = make_mesh(("pipe", "data"), axis_sizes=(4, 2))
+    step, init_state, place = pipeline.make_pipeline_train_step(
+        cfg, mesh, n_micro=4)
+    params, opt = init_state(jax.random.key(0))
+    batch = place(make_example_batch(cfg, batch=8, seq=16))
+    losses = []
+    for _ in range(6):
+        params, opt, loss = step(params, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_rejects_uneven_layer_split():
+    from dpu_operator_tpu.workloads import pipeline
+
+    with pytest.raises(ValueError, match="stages"):
+        pipeline.init_pipeline_params(jax.random.key(0),
+                                      _pp_cfg(n_layers=5), n_stages=4)
+
+
+def test_pipeline_program_one_hop_per_tick():
+    """The lowered pipeline carries ppermute hops (neighbor transfers on
+    the programmed ICI path), not all-gathers of the whole activation
+    set."""
+    from dpu_operator_tpu.workloads import pipeline
+
+    cfg = _pp_cfg()
+    mesh = make_mesh(("pipe", "data"), axis_sizes=(4, 2))
+    params = pipeline.init_pipeline_params(jax.random.key(0), cfg, 4)
+    tokens = jnp.zeros((8, 16), jnp.int32)
+    fwd = pipeline.make_pipeline_forward(cfg, mesh, n_micro=4)
+    txt = jax.jit(fwd).lower(params, tokens).as_text()
+    assert "collective-permute" in txt or "collective_permute" in txt

@@ -62,3 +62,28 @@ def test_mfu_derivation_consistency():
     # band: the number must be O(100ms), not O(1ms) or O(10s))
     ideal_s = flops / (197.0 * 1e12)
     assert 0.01 < ideal_s < 1.0
+
+
+def test_param_count_moe_closed_form():
+    """MoE layers swap the dense FFN for router + E expert FFNs (every
+    moe_every-th layer)."""
+    cfg = TransformerConfig(vocab=100, d_model=8, n_heads=2, n_layers=4,
+                            d_ff=32, max_seq=16, moe_experts=4)
+    attn = 16 + 8 * 24 + 64          # ln1+ln2, wqkv, wo
+    dense_ffn = 8 * 32 + 32 * 8
+    moe_ffn = 8 * 4 + 4 * dense_ffn  # router + 4 experts
+    # moe_every=2 -> layers 1 and 3 are MoE
+    expect = (100 * 8 + 16 * 8 + 8
+              + 2 * (attn + dense_ffn) + 2 * (attn + moe_ffn))
+    assert perf.param_count(cfg) == expect
+
+
+def test_param_count_moe_matches_actual_params():
+    import jax
+
+    from dpu_operator_tpu.workloads.model import init_params
+    cfg = TransformerConfig(vocab=64, d_model=8, n_heads=2, n_layers=2,
+                            d_ff=16, max_seq=16, moe_experts=4)
+    actual = sum(x.size for x in jax.tree_util.tree_leaves(
+        init_params(jax.random.key(0), cfg)))
+    assert perf.param_count(cfg) == actual
