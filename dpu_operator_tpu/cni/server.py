@@ -28,6 +28,10 @@ log = logging.getLogger(__name__)
 class _UnixHTTPServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     daemon_threads = True
     allow_reuse_address = True
+    # kubelet parallelizes CNI ops ACROSS pods: socketserver's default
+    # backlog of 5 makes bursts of connects fail with EAGAIN (the Go
+    # reference listens with somaxconn, cniserver.go:52-67)
+    request_queue_size = 128
 
     def get_request(self):
         request, _ = super().get_request()

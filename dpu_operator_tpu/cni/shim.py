@@ -13,10 +13,12 @@ stdlib only, no package imports.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import socket
 import sys
+import time
 
 _CNI_ENV_KEYS = ("CNI_COMMAND", "CNI_CONTAINERID", "CNI_NETNS", "CNI_IFNAME",
                  "CNI_ARGS", "CNI_PATH")
@@ -24,11 +26,26 @@ _CNI_ENV_KEYS = ("CNI_COMMAND", "CNI_CONTAINERID", "CNI_NETNS", "CNI_IFNAME",
 DEFAULT_SOCKET = "/var/run/tpu-daemon/tpu-cni-server.sock"
 
 
+def _connect(sock, socket_path: str, deadline: float):
+    """connect() on AF_UNIX returns EAGAIN immediately when the server's
+    listen backlog is full (it never blocks like TCP) — retry briefly so
+    bursts of parallel pod ADDs don't fail spuriously."""
+    while True:
+        try:
+            sock.connect(socket_path)
+            return
+        except OSError as e:
+            if (e.errno != errno.EAGAIN
+                    or time.monotonic() >= deadline):
+                raise
+            time.sleep(0.02)
+
+
 def _post(socket_path: str, payload: dict, timeout: float = 120.0) -> dict:
     """Minimal HTTP-over-unix-socket POST (cnishim.go:59-89)."""
     with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
         sock.settimeout(timeout)
-        sock.connect(socket_path)
+        _connect(sock, socket_path, time.monotonic() + timeout)
         body = json.dumps(payload).encode()
         headers = (
             f"POST /cni HTTP/1.1\r\nHost: unix\r\n"

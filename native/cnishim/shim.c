@@ -14,12 +14,14 @@
  *   CNI_COMMAND=CHECK is a no-op success
  */
 
+#define _DEFAULT_SOURCE  /* usleep under -std=c99 */
 #include <errno.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/time.h>
+#include <time.h>
 #include <sys/un.h>
 #include <unistd.h>
 
@@ -247,18 +249,29 @@ int main(void) {
     if (strlen(sock_path) >= sizeof addr.sun_path)
         return die_cni("socket path too long");
     strcpy(addr.sun_path, sock_path);
-    /* deadline BEFORE connect — a wedged daemon with a full listen
-     * backlog blocks AF_UNIX connect() itself (2 min parity:
-     * cniserver.go:226-227; cni/shim.py settimeout-then-connect) */
-    struct timeval tv = {120, 0};
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
-    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
-    if (connect(fd, (struct sockaddr *)&addr, sizeof addr) < 0) {
+    /* Connect phase: a full listen backlog makes a BLOCKING AF_UNIX
+     * connect wait up to sndtimeo before failing EAGAIN, so use a short
+     * per-attempt timeout and bound the whole phase by ONE 2-minute
+     * wall-clock deadline (parity: cniserver.go:226-227; cni/shim.py
+     * deadline-bounded _connect). Bursts of parallel pod ADDs resolve
+     * in a retry or two; a wedged daemon fails at the deadline. */
+    struct timeval tv_conn = {5, 0};
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv_conn, sizeof tv_conn);
+    time_t conn_deadline = time(NULL) + 120;
+    while (connect(fd, (struct sockaddr *)&addr, sizeof addr) < 0) {
+        if (errno == EAGAIN && time(NULL) < conn_deadline) {
+            usleep(20000);
+            continue;
+        }
         char msg[256];
         snprintf(msg, sizeof msg, "connect %s: %s", sock_path,
                  strerror(errno));
         return die_cni(msg);
     }
+    /* request deadline (2 min, kubelet CRI op timeout parity) */
+    struct timeval tv = {120, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
     char hdr[256];
     snprintf(hdr, sizeof hdr,
              "POST /cni HTTP/1.1\r\nHost: unix\r\n"
