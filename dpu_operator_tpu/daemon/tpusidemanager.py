@@ -32,10 +32,35 @@ log = logging.getLogger(__name__)
 
 class _SliceServiceForwarder:
     """Implementation backing the cross-boundary TCP server: forwards
-    slice/NF calls into the VSP (dpusidemanager.go:51 pass-through)."""
+    slice/NF calls into the VSP (dpusidemanager.go:51 pass-through), plus
+    the daemon's admin plane (resize with drain — the path tpuctl
+    resize-chips uses instead of raw SetNumChips)."""
 
-    def __init__(self, vsp):
+    def __init__(self, vsp, manager=None):
         self.vsp = vsp
+        self.manager = manager
+
+    def resize_chips(self, req: dict) -> dict:
+        """LOCAL-NODE-ONLY by design: the cross-boundary port carries no
+        auth (parity with the reference's link-local OPI channel), so a
+        remote caller must not be able to drain arbitrary nodes through
+        this daemon's cluster credentials — the target is always the
+        node this daemon manages, and a mismatching node_name is
+        rejected."""
+        if self.manager is None:
+            raise RuntimeError("admin plane not wired")
+        count = int(req.get("count", -1))
+        if count < 1:
+            raise ValueError(f"invalid chip count {count}: must be >= 1")
+        local = (self.manager.node_name
+                 or os.environ.get("NODE_NAME", ""))
+        want = req.get("node_name", "")
+        if want and local and want != local:
+            raise ValueError(
+                f"resize is local-node only: this daemon manages "
+                f"{local!r}, not {want!r}")
+        evicted = self.manager.resize_chips(count, local or want)
+        return {"evicted": evicted}
 
     def create_slice_attachment(self, req: dict) -> dict:
         return self.vsp.create_slice_attachment(req)
@@ -57,11 +82,15 @@ class _SliceServiceForwarder:
 
 class TpuSideManager:
     def __init__(self, vsp_plugin, path_manager: PathManager, client=None,
-                 workload_image: str = ""):
+                 workload_image: str = "", node_name: str = ""):
         self.vsp = vsp_plugin
         self.path_manager = path_manager
         self.client = client
         self.workload_image = workload_image
+        self.node_name = node_name or os.environ.get("NODE_NAME", "")
+        # one disruptive reconfig at a time: a concurrent resize's
+        # finally-uncordon would reopen the node mid-drain
+        self._resize_lock = threading.Lock()
         self.device_handler = TpuDeviceHandler(self.vsp, tpu_mode=True)
         self.device_plugin = DevicePlugin(
             self.device_handler, resource=v.TPU_RESOURCE_NAME,
@@ -100,7 +129,8 @@ class TpuSideManager:
         # cross-boundary server on the VSP-returned addr (:141-165)
         ip, port = self._addr
         self._slice_server = VspServer(
-            _SliceServiceForwarder(self.vsp), tcp_addr=(ip, port))
+            _SliceServiceForwarder(self.vsp, manager=self),
+            tcp_addr=(ip, port))
         self._slice_server.start()
         self.device_plugin.start()
         self.cni_server.start()
@@ -148,34 +178,35 @@ class TpuSideManager:
         parity pkgs/drain/drain.go:19-43). Growth is non-disruptive and
         skips the drain. Returns evicted pod names. The device plugin's
         ListAndWatch poll pushes the shrunken set to the kubelet."""
-        node_name = node_name or os.environ.get("NODE_NAME", "")
-        current = len(self.device_handler.get_devices())
-        shrink = count < current
-        drainer = None
-        evicted: list = []
-        if shrink and self.client is not None and node_name:
-            from ..utils.drain import Drainer
-            drainer = Drainer(self.client)
-        elif shrink:
-            log.warning(
-                "resize_chips %d->%d: shrinking WITHOUT drain (no kube "
-                "client or node name) — chip-consuming pods are stranded",
-                current, count)
-        try:
-            if drainer is not None:
-                evicted = drainer.drain(node_name)
-                log.info("resize_chips %d->%d: drained %s", current, count,
-                         evicted)
-            self.vsp.set_num_chips(count)
-        finally:
-            if drainer is not None:
-                # never leave the node cordoned, even if eviction or the
-                # VSP call blew up mid-way
-                try:
-                    drainer.uncordon(node_name)
-                except Exception:  # noqa: BLE001 — best-effort restore
-                    log.exception("uncordon %s failed", node_name)
-        return evicted
+        node_name = node_name or self.node_name
+        with self._resize_lock:
+            current = len(self.device_handler.get_devices())
+            shrink = count < current
+            drainer = None
+            evicted: list = []
+            if shrink and self.client is not None and node_name:
+                from ..utils.drain import Drainer
+                drainer = Drainer(self.client)
+            elif shrink:
+                log.warning(
+                    "resize_chips %d->%d: shrinking WITHOUT drain (no "
+                    "kube client or node name) — chip-consuming pods are "
+                    "stranded", current, count)
+            try:
+                if drainer is not None:
+                    evicted = drainer.drain(node_name)
+                    log.info("resize_chips %d->%d: drained %s", current,
+                             count, evicted)
+                self.vsp.set_num_chips(count)
+            finally:
+                if drainer is not None:
+                    # never leave the node cordoned, even if eviction or
+                    # the VSP call blew up mid-way
+                    try:
+                        drainer.uncordon(node_name)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        log.exception("uncordon %s failed", node_name)
+            return evicted
 
     # -- CNI network-function handlers (dpusidemanager.go:104-139) ------------
     def _unwire_quietly(self, ids: tuple, context: str):

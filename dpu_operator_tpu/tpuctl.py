@@ -47,8 +47,15 @@ def _agent_cmds(sub):
 
 def _vsp_cmds(sub):
     sub.add_parser("devices", help="DeviceService.GetDevices")
-    p = sub.add_parser("set-num-chips")
+    p = sub.add_parser("set-num-chips",
+                       help="raw VSP SetNumChips (NO drain; prefer "
+                            "resize-chips against the daemon)")
     p.add_argument("count", type=int)
+    p = sub.add_parser("resize-chips",
+                       help="daemon AdminService.ResizeChips: shrink "
+                            "drains chip-consuming pods first")
+    p.add_argument("count", type=int)
+    p.add_argument("--node", default="", help="node to drain on shrink")
     p = sub.add_parser("create-attachment")
     p.add_argument("name")
     p.add_argument("--chip", type=int, default=None)
@@ -62,6 +69,9 @@ def main(argv=None):
     parser = argparse.ArgumentParser("tpuctl")
     parser.add_argument("--agent-socket", default="")
     parser.add_argument("--vsp-socket", default="")
+    parser.add_argument("--daemon-addr", default="",
+                        help="ip:port of the daemon's cross-boundary "
+                             "server (for resize-chips)")
     sub = parser.add_subparsers(dest="cmd", required=True)
     _agent_cmds(sub)
     _vsp_cmds(sub)
@@ -106,9 +116,25 @@ def run(args) -> dict:
         finally:
             client.close()
 
+    from .vsp.rpc import VspChannel, unix_target
+
+    if args.cmd == "resize-chips":
+        if not args.daemon_addr:
+            raise SystemExit("resize-chips needs --daemon-addr")
+        channel = VspChannel(args.daemon_addr)
+        try:
+            # drain + evictions can legitimately outlast the default 30 s
+            # unary deadline; a premature client timeout would invite a
+            # retry that overlaps the still-running resize
+            return channel.call("AdminService", "ResizeChips",
+                                {"count": args.count,
+                                 "node_name": args.node},
+                                timeout=600.0)
+        finally:
+            channel.close()
+
     if not args.vsp_socket:
         raise SystemExit(f"{args.cmd} needs --vsp-socket")
-    from .vsp.rpc import VspChannel, unix_target
     channel = VspChannel(unix_target(args.vsp_socket))
     try:
         if args.cmd == "devices":

@@ -17,15 +17,6 @@ from typing import Callable, Optional
 
 import grpc
 
-SERVICE_METHODS = {
-    "LifeCycleService": ["Init", "Shutdown"],
-    "DeviceService": ["GetDevices", "SetNumChips"],
-    "SliceService": ["CreateSliceAttachment", "DeleteSliceAttachment"],
-    "NetworkFunctionService": ["CreateNetworkFunction",
-                               "DeleteNetworkFunction"],
-}
-
-
 def _ser(obj: dict) -> bytes:
     return json.dumps(obj or {}).encode()
 
@@ -65,6 +56,7 @@ class VspServer:
             "create_network_function",
         ("NetworkFunctionService", "DeleteNetworkFunction"):
             "delete_network_function",
+        ("AdminService", "ResizeChips"): "resize_chips",
     }
 
     def __init__(self, impl, socket_path: Optional[str] = None,

@@ -61,3 +61,103 @@ def test_tpuctl_vsp_devices(short_tmp):
         assert att["name"] == "host0-1"
     finally:
         server.stop()
+
+
+def test_tpuctl_resize_chips_drains_via_daemon(short_tmp, kube, node_agent):
+    """tpuctl resize-chips hits the daemon's AdminService (cross-boundary
+    TCP), which drains before shrinking — the production caller for
+    TpuSideManager.resize_chips (raw set-num-chips bypasses the drain)."""
+    from dpu_operator_tpu.daemon import TpuSideManager
+    from dpu_operator_tpu.deviceplugin import FakeKubelet
+    from dpu_operator_tpu.platform import TpuDetector
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    from dpu_operator_tpu.vsp import GrpcPlugin
+    from dpu_operator_tpu import tpuctl
+
+    node_agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+    kubelet = FakeKubelet(PathManager(short_tmp), node_agent=node_agent,
+                          node_name="tpu-vm-0")
+    kubelet.start()
+    pm = PathManager(short_tmp)
+    mock = MockTpuVsp(port=0)
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    vsp_server = VspServer(mock, socket_path=sock)
+    vsp_server.start()
+    det = TpuDetector().detection_result(tpu_mode=True, identifier="t")
+    mgr = TpuSideManager(GrpcPlugin(det, path_manager=pm, init_timeout=5.0),
+                         pm, client=kube)
+    mgr.device_plugin.poll_interval = 0.05
+    try:
+        mgr.start_vsp()
+        mgr.setup_devices()
+        mgr.listen()
+        mgr.serve()
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        kube.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "consumer", "namespace": "default"},
+            "spec": {"nodeName": "tpu-vm-0", "containers": [{
+                "name": "w", "image": "img",
+                "resources": {"requests": {"google.com/tpu": "1"}}}]}})
+
+        args = type("A", (), {
+            "cmd": "resize-chips", "count": 2, "node": "tpu-vm-0",
+            "daemon_addr": f"127.0.0.1:{mgr.bound_port}",
+            "agent_socket": "", "vsp_socket": ""})()
+        out = tpuctl.run(args)
+        assert out["evicted"] == ["consumer"]
+        assert kube.get("v1", "Pod", "consumer", namespace="default") is None
+        assert kubelet.wait_for_devices("google.com/tpu", 2)
+        node = kube.get("v1", "Node", "tpu-vm-0")
+        assert node["spec"]["unschedulable"] is False
+    finally:
+        mgr.stop()
+        vsp_server.stop()
+        kubelet.stop()
+
+
+def test_admin_resize_rejects_bad_count_and_foreign_node(short_tmp, kube,
+                                                         node_agent):
+    """The unauthenticated admin plane must not drain arbitrary nodes or
+    accept a zero/absent count (a missing count would otherwise read as
+    shrink-to-0 and evict everything)."""
+    import grpc
+
+    from dpu_operator_tpu.daemon import TpuSideManager
+    from dpu_operator_tpu.platform import TpuDetector
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    from dpu_operator_tpu.vsp import GrpcPlugin
+    from dpu_operator_tpu.vsp.rpc import VspChannel
+
+    node_agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+    pm = PathManager(short_tmp)
+    mock = MockTpuVsp(port=0)
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    vsp_server = VspServer(mock, socket_path=sock)
+    vsp_server.start()
+    det = TpuDetector().detection_result(tpu_mode=True, identifier="t")
+    mgr = TpuSideManager(GrpcPlugin(det, path_manager=pm, init_timeout=5.0),
+                         pm, client=kube, node_name="tpu-vm-0")
+    try:
+        mgr.start_vsp()
+        mgr.setup_devices()
+        mgr.listen()
+        ch = VspChannel(f"127.0.0.1:{mgr.bound_port}")
+        try:
+            with pytest.raises(grpc.RpcError, match="must be >= 1"):
+                ch.call("AdminService", "ResizeChips", {"count": 0})
+            with pytest.raises(grpc.RpcError, match="must be >= 1"):
+                ch.call("AdminService", "ResizeChips", {})
+            with pytest.raises(grpc.RpcError, match="local-node only"):
+                ch.call("AdminService", "ResizeChips",
+                        {"count": 2, "node_name": "some-other-node"})
+            # no drain happened: the node was never cordoned
+            node = kube.get("v1", "Node", "tpu-vm-0")
+            assert not node.get("spec", {}).get("unschedulable")
+        finally:
+            ch.close()
+    finally:
+        mgr.stop()
+        vsp_server.stop()
