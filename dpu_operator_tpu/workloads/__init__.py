@@ -16,13 +16,15 @@ SFC reconciler's NF pods run and the traffic-flow suite measures:
 
 from .mesh import make_mesh, mesh_for_topology
 from .collectives import (psum_allreduce, ring_allreduce,
-                          measure_allreduce_gbps)
+                          measure_all_to_all_gbps, measure_allreduce_gbps,
+                          measure_ppermute_gbps)
 from .model import (TransformerConfig, init_params, forward, loss_fn,
                     make_train_step, make_example_batch)
 
 __all__ = [
     "make_mesh", "mesh_for_topology",
     "psum_allreduce", "ring_allreduce", "measure_allreduce_gbps",
+    "measure_all_to_all_gbps", "measure_ppermute_gbps",
     "TransformerConfig", "init_params", "forward", "loss_fn",
     "make_train_step", "make_example_batch",
 ]

@@ -85,6 +85,79 @@ def ring_allreduce(mesh: Mesh, axis: str = "model"):
     return jax.jit(_ar)
 
 
+def all_to_all_exchange(mesh: Mesh, axis: str = "model"):
+    """All-to-all over *axis*: device i's j-th chunk lands on device j as
+    chunk i — the MoE dispatch collective (ep sends each expert its
+    tokens; workloads/moe.py's einsum dispatch lowers to this under the
+    expert sharding)."""
+    spec = P(axis, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+             check_vma=False)
+    def _a2a(x):
+        # local x: (n, chunk) — one outgoing chunk per peer
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+
+    return jax.jit(_a2a)
+
+
+def ppermute_hop(mesh: Mesh, axis: str = "model"):
+    """One neighbor rotation over *axis* — the unit hop of both the ring
+    attention KV rotation and the pipeline stage handoff; its rate is the
+    single-ICI-link bandwidth."""
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    spec = P(axis)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+             check_vma=False)
+    def _hop(x):
+        return lax.ppermute(x, axis, perm)
+
+    return jax.jit(_hop)
+
+
+def _time_collective(fn, x, iters: int) -> float:
+    fn(x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    out = x
+    for _ in range(iters):
+        out = fn(out)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def measure_all_to_all_gbps(mesh: Mesh, axis: str = "model",
+                            mbytes: float = 64.0,
+                            iters: int = 10) -> dict:
+    """All-to-all bandwidth: each device sends (n-1)/n of its shard."""
+    n = mesh.shape[axis]
+    per_shard = max(n, int(mbytes * 1e6 / 4 / n) // n * n)
+    x = jnp.ones((n * per_shard,), jnp.float32).reshape(n * n,
+                                                        per_shard // n)
+    dt = _time_collective(all_to_all_exchange(mesh, axis), x, iters)
+    payload = x.size * 4
+    algbw = payload / dt / 1e9
+    return {"impl": "all_to_all", "axis_size": n, "bytes": payload,
+            "sec_per_iter": dt, "algbw_gbps": algbw,
+            "busbw_gbps": algbw * (n - 1) / n if n > 1 else algbw}
+
+
+def measure_ppermute_gbps(mesh: Mesh, axis: str = "model",
+                          mbytes: float = 64.0, iters: int = 10) -> dict:
+    """Single-hop neighbor-rotation bandwidth (ring/pipeline unit hop):
+    every byte crosses exactly one link, so algbw IS the link rate."""
+    n = mesh.shape[axis]
+    per_shard = max(1, int(mbytes * 1e6 / 4 / n))
+    x = jnp.ones((n * per_shard,), jnp.float32)
+    dt = _time_collective(ppermute_hop(mesh, axis), x, iters)
+    payload = x.size * 4
+    algbw = payload / dt / 1e9
+    return {"impl": "ppermute_hop", "axis_size": n, "bytes": payload,
+            "sec_per_iter": dt, "algbw_gbps": algbw, "busbw_gbps": algbw}
+
+
 def measure_allreduce_gbps(mesh: Mesh, axis: str = "model",
                            mbytes: float = 64.0, iters: int = 10,
                            impl: str = "psum") -> dict:
@@ -98,12 +171,10 @@ def measure_allreduce_gbps(mesh: Mesh, axis: str = "model",
     per_shard = max(n, per_shard - per_shard % n)  # ring needs n|size
     x = jnp.ones((n * per_shard,), jnp.float32)
     fn = (ring_allreduce if impl == "ring" else psum_allreduce)(mesh, axis)
-    fn(x).block_until_ready()  # compile
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(x)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+    # chained timing (same methodology as the other measure_* fns — the
+    # data dependency defeats async-dispatch overlap); values stay ~n^iters
+    # which is fine in float32 for realistic iter counts
+    dt = _time_collective(fn, x, iters)
     payload = x.size * 4
     algbw = payload / dt / 1e9
     busbw = algbw * 2 * (n - 1) / n if n > 1 else algbw

@@ -39,8 +39,10 @@ def main(argv=None):
     import jax
 
     from dpu_operator_tpu.ici import SliceTopology
-    from dpu_operator_tpu.workloads import (measure_allreduce_gbps,
-                                            mesh_for_topology)
+    from dpu_operator_tpu.workloads import mesh_for_topology
+    from dpu_operator_tpu.workloads.collectives import (
+        measure_all_to_all_gbps, measure_allreduce_gbps,
+        measure_ppermute_gbps)
 
     n_devices = len(jax.devices())
     results = []
@@ -66,6 +68,22 @@ def main(argv=None):
             }
             results.append(row)
             print(json.dumps(row))
+        # the ep dispatch collective (all-to-all) and the unit neighbor
+        # hop (ring attention KV rotation / pipeline stage handoff)
+        if mesh.shape["model"] > 1:
+            for fn in (measure_all_to_all_gbps, measure_ppermute_gbps):
+                r = fn(mesh, "model", mbytes=args.mbytes, iters=args.iters)
+                row = {
+                    "topology": topo.topology,
+                    "impl": r["impl"],
+                    "devices": int(mesh.devices.size),
+                    "degraded": degraded,
+                    "algbw_gbps": round(r["algbw_gbps"], 3),
+                    "busbw_gbps": round(r["busbw_gbps"], 3),
+                    "sec_per_iter": round(r["sec_per_iter"], 6),
+                }
+                results.append(row)
+                print(json.dumps(row))
 
     report = {"n_devices": n_devices,
               "platform": jax.devices()[0].platform,
