@@ -52,6 +52,10 @@ class TransformerConfig:
     #: trades recompute FLOPs for activation HBM — the standard lever for
     #: fitting longer context per chip
     remat: bool = False
+    #: Pallas flash-attention block sizes (clamped to the sequence);
+    #: 512x512 measured best for fwd+bwd on v5e at the flagship shape
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     #: expert parallelism: >0 makes every `moe_every`-th layer's FFN a
     #: top-1 routed mixture of that many experts, expert weights sharded
     #: over "model" (workloads/moe.py)
@@ -145,7 +149,7 @@ def _ring_attn(mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=8)
-def _flash_attn(mesh: Mesh | None):
+def _flash_attn(mesh: Mesh | None, block_q: int, block_k: int):
     """Differentiable flash attention, head-sharded over "model" when a
     mesh is present (heads are independent, so tp shards partition the
     kernel grid; Pallas calls need shard_map — XLA cannot auto-partition
@@ -153,7 +157,7 @@ def _flash_attn(mesh: Mesh | None):
     from ..ops.flash_attention import flash_attention_vjp
 
     def call(q, k, v):
-        return flash_attention_vjp(q, k, v, True)
+        return flash_attention_vjp(q, k, v, True, block_q, block_k)
 
     if mesh is None:
         return call
@@ -208,7 +212,9 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         if cfg.attention == "ring" and mesh is not None:
             o = _ring_attn(mesh)(q, k, v).reshape(B, S, cfg.d_model)
         elif cfg.attention == "flash":
-            o = _flash_attn(mesh)(q, k, v).reshape(B, S, cfg.d_model)
+            o = _flash_attn(mesh, cfg.flash_block_q,
+                            cfg.flash_block_k)(q, k, v).reshape(
+                                B, S, cfg.d_model)
         else:
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(cfg.d_head)
             att = jnp.where(mask, att, -1e9)

@@ -97,3 +97,73 @@ def test_bundle_services_consistent_with_config():
     assert bundled["spec"]["ports"] == cfg_svc["spec"]["ports"]
     assert (bundled["metadata"]["name"] == cfg_svc["metadata"]["name"]
             == "tpu-operator-webhook-service")
+
+
+def test_csv_cluster_permissions_match_role_yaml():
+    """The CSV's inline clusterPermissions must be byte-for-byte the rules
+    config/rbac/role.yaml grants (the rules tests/test_rbac.py enforces) —
+    an OLM install and a `make deploy` must agree."""
+    csv = _load(os.path.join(
+        BUNDLE, "manifests", "tpu-operator.clusterserviceversion.yaml"))
+    role = _load(os.path.join(REPO, "config", "rbac", "role.yaml"))
+    perms = csv["spec"]["install"]["spec"]["clusterPermissions"]
+    assert len(perms) == 1
+    assert perms[0]["serviceAccountName"] == \
+        "tpu-operator-controller-manager"
+    assert perms[0]["rules"] == role["rules"]
+
+
+def test_csv_deployment_matches_manager_yaml():
+    """The OLM deployment must run the SAME manager as `make deploy`:
+    identical command (incl. --leader-elect) and identical image env
+    values — name-only checks would let the two image matrices drift."""
+    from dpu_operator_tpu.images.images import _ENV_VARS
+
+    csv = _load(os.path.join(
+        BUNDLE, "manifests", "tpu-operator.clusterserviceversion.yaml"))
+    dep = csv["spec"]["install"]["spec"]["deployments"][0]
+    csv_container = dep["spec"]["template"]["spec"]["containers"][0]
+
+    with open(os.path.join(REPO, "config", "manager",
+                           "manager.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    mgr = next(d for d in docs if d.get("kind") == "Deployment")
+    mgr_container = mgr["spec"]["template"]["spec"]["containers"][0]
+
+    assert csv_container["command"] == mgr_container["command"]
+    assert csv_container["image"] == mgr_container["image"]
+    csv_env = {e["name"]: e.get("value") for e in csv_container["env"]}
+    mgr_env = {e["name"]: e.get("value") for e in mgr_container["env"]}
+    for env_name in _ENV_VARS.values():
+        assert env_name in csv_env, env_name
+        assert csv_env[env_name] == mgr_env[env_name], env_name
+
+
+def test_csv_webhookdefinitions_match_config_webhook():
+    """CSV webhookdefinitions carry the same rules/paths as the raw
+    config/webhook registration (the wire-tested one)."""
+    csv = _load(os.path.join(
+        BUNDLE, "manifests", "tpu-operator.clusterserviceversion.yaml"))
+    defs = {d["generateName"]: d for d in csv["spec"]["webhookdefinitions"]}
+    with open(os.path.join(REPO, "config", "webhook", "webhook.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    for doc in docs:
+        if doc["kind"] not in ("ValidatingWebhookConfiguration",
+                               "MutatingWebhookConfiguration"):
+            continue
+        for wh in doc["webhooks"]:
+            d = defs[wh["name"]]
+            assert d["rules"] == wh["rules"], wh["name"]
+            assert d["webhookPath"] == wh["clientConfig"]["service"]["path"]
+            expected_type = ("ValidatingAdmissionWebhook"
+                             if doc["kind"].startswith("Validating")
+                             else "MutatingAdmissionWebhook")
+            assert d["type"] == expected_type
+            # availability-critical semantics must match too (a flipped
+            # failurePolicy would change cluster behavior under webhook
+            # outage); absent means the k8s default, Fail
+            assert (d.get("failurePolicy", "Fail")
+                    == wh.get("failurePolicy", "Fail")), wh["name"]
+            assert (d.get("sideEffects") == wh.get("sideEffects")), wh["name"]
+            assert (d.get("admissionReviewVersions")
+                    == wh.get("admissionReviewVersions")), wh["name"]
