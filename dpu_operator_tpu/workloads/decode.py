@@ -1,0 +1,169 @@
+"""Autoregressive decode with a KV cache — the NF serving path.
+
+The SFC reconciler's NF pods serve as well as train (the reference's NF
+pods forward packets both directions; our compute analog is a generate
+loop). Static shapes throughout: the cache is (B, S_max, H, Dh) per layer,
+each step writes position `pos` with dynamic_update_slice and attends over
+the full cache under a `<= pos` mask, so the whole generation is ONE
+compiled `lax.scan` — no per-token retrace, XLA pipelines the steps.
+
+Decode is memory-bandwidth-bound (every step streams all params + cache
+from HBM); tokens/s/batch against HBM bandwidth is the serving metric
+BASELINE.md records.
+
+MoE note: routing capacity is per-group (moe.py); at decode S=1 no token
+ever overflows, so serving never drops tokens. Training-time forward CAN
+drop under capacity pressure — decode matches it exactly whenever the
+capacity factor covers the sequence (tested), and intentionally keeps
+every token otherwise (the standard serving behavior).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .model import TransformerConfig, _rmsnorm
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int) -> list:
+    """Per-layer K/V of (B, S_max, H, Dh), bf16."""
+    shape = (batch, cfg.max_seq, cfg.n_heads, cfg.d_head)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
+                tokens: jax.Array, pos: jax.Array):
+    """One decode step: *tokens* (B,) at position *pos* -> (logits (B, V),
+    updated cache)."""
+    B = tokens.shape[0]
+    x = (params["embed"][tokens]
+         + jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
+                                        keepdims=False))
+    x = x.astype(cfg.dtype)[:, None, :]          # (B, 1, D)
+    positions = jnp.arange(cfg.max_seq)
+    new_cache = []
+    for lp, layer_cache in zip(params["layers"], cache):
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = h @ lp["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, 1, cfg.n_heads, cfg.d_head)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        ck = jax.lax.dynamic_update_slice(
+            layer_cache["k"], k, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            layer_cache["v"], v, (0, pos, 0, 0))
+        new_cache.append({"k": ck, "v": cv})
+
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / np.sqrt(cfg.d_head)
+        att = jnp.where(positions[None, None, None, :] <= pos, att, -1e9)
+        att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(cfg.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", att, cv).reshape(
+            B, 1, cfg.d_model)
+        x = x + o @ lp["wo"]
+        h2 = _rmsnorm(x, lp["ln2"])
+        if "moe" in lp:
+            from .moe import moe_ffn
+            out, _ = moe_ffn(lp["moe"], h2, cfg.moe_capacity_factor)
+            x = x + out
+        else:
+            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    x = _rmsnorm(x, params["out_norm"])
+    logits = (x[:, 0, :] @ params["embed"].T).astype(jnp.float32)
+    return logits, new_cache
+
+
+def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array):
+    """Warm the cache with ONE batched forward over the whole prompt
+    (time-to-first-token costs a single parameter sweep, not P sequential
+    decode steps); returns (cache, last_logits). prompt: (B, P) int32."""
+    B, P = prompt.shape
+    x = (params["embed"][prompt] + params["pos"][:P]).astype(cfg.dtype)
+    mask = jnp.tril(jnp.ones((P, P), jnp.bool_))
+    cache = init_kv_cache(cfg, B)
+    new_cache = []
+    for lp, layer_cache in zip(params["layers"], cache):
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = h @ lp["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, P, cfg.n_heads, cfg.d_head)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        new_cache.append({
+            "k": jax.lax.dynamic_update_slice(layer_cache["k"], k,
+                                              (0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(layer_cache["v"], v,
+                                              (0, 0, 0, 0)),
+        })
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(cfg.d_head)
+        att = jnp.where(mask, att, -1e9)
+        att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(cfg.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, P, cfg.d_model)
+        x = x + o @ lp["wo"]
+        h2 = _rmsnorm(x, lp["ln2"])
+        if "moe" in lp:
+            from .moe import moe_ffn
+            out, _ = moe_ffn(lp["moe"], h2, cfg.moe_capacity_factor)
+            x = x + out
+        else:
+            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    x = _rmsnorm(x, params["out_norm"])
+    last_logits = (x[:, -1, :] @ params["embed"].T).astype(jnp.float32)
+    return new_cache, last_logits
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"))
+def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
+             steps: int) -> jax.Array:
+    """Greedy continuation: (B, P) prompt -> (B, steps) generated ids,
+    one compiled program (prefill scan + decode scan)."""
+    B, P = prompt.shape
+    if P + steps > cfg.max_seq:
+        raise ValueError(
+            f"prompt {P} + steps {steps} exceeds max_seq {cfg.max_seq}")
+    cache, last_logits = prefill(params, cfg, prompt)
+
+    def body(carry, i):
+        cache, logits = carry
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits, cache = _decode_one(params, cfg, cache, token, P + i)
+        return (cache, logits), token
+
+    (_, _), tokens = jax.lax.scan(body, (cache, last_logits),
+                                  jnp.arange(steps))
+    return tokens.T                                    # (B, steps)
+
+
+def measure_decode(cfg: TransformerConfig, batch: int = 8,
+                   prompt_len: int = 16, steps: int = 64,
+                   iters: int = 4) -> dict:
+    """Serving throughput: steady-state decode tokens/s (marginal over two
+    generation lengths so prefill + dispatch costs cancel — the same
+    slope methodology as perf.marginal_time)."""
+    from .model import init_params
+    from .perf import marginal_time
+
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.ones((batch, prompt_len), jnp.int32)
+
+    def make_chained(n):
+        def go():
+            out = generate(params, cfg, prompt, n)
+            float(out[0, -1])
+        return go
+
+    per_step = marginal_time(make_chained, n_short=max(4, steps // 4),
+                             n_long=steps, repeats=iters)
+    return {"batch": batch, "steps": steps,
+            "ms_per_token": per_step * 1e3,
+            "tokens_per_s": batch / per_step}

@@ -147,6 +147,11 @@ def test_csv_webhookdefinitions_match_config_webhook():
     defs = {d["generateName"]: d for d in csv["spec"]["webhookdefinitions"]}
     with open(os.path.join(REPO, "config", "webhook", "webhook.yaml")) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
+    n_config_webhooks = sum(
+        len(doc.get("webhooks") or []) for doc in docs
+        if doc.get("kind", "").endswith("WebhookConfiguration"))
+    # two-way: no extra/stale CSV definition either
+    assert len(defs) == n_config_webhooks
     for doc in docs:
         if doc["kind"] not in ("ValidatingWebhookConfiguration",
                                "MutatingWebhookConfiguration"):
