@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import threading
 from typing import Optional
 
@@ -115,6 +116,12 @@ class TpuSideManager:
         # hops: (ns, sfc, i) -> (out_id, in_id) wired between NF i and i+1
         self._chain_store: dict[tuple, dict] = {}
         self._chain_hops: dict[tuple, tuple] = {}
+        # self-healing: link-state prober (chip -> [{"port","up","wired"}])
+        # wired in serve() when the native agent socket is reachable
+        self.link_prober = None
+        self._repair_stop = threading.Event()
+        self._repair_thread: Optional[threading.Thread] = None
+        self._repair_client = None
         self._manager: Optional[Manager] = None
 
     # -- SideManager lifecycle ------------------------------------------------
@@ -151,8 +158,40 @@ class TpuSideManager:
             self._manager.add_reconciler(
                 SfcReconciler(workload_image=self.workload_image))
             self._manager.start()
+        # self-healing chain repair: probe ICI link state through the
+        # native agent (VSP spawns it next to the vendor-plugin socket —
+        # vsp/__main__.py) and re-steer hops whose port went dark
+        agent_sock = self.path_manager.vendor_plugin_socket() + ".cp-agent"
+        if self.link_prober is None and os.path.exists(agent_sock):
+            from ..vsp.native_dp import AgentClient
+            self._repair_client = AgentClient(agent_sock)
+            self.enable_chain_repair(self._repair_client.link_state)
+
+    def enable_chain_repair(self, prober, interval: float = 5.0):
+        """Start the periodic hop-repair loop (reference has no analog:
+        its chain flow rules stay broken until pod churn; the bar is
+        beat, not match)."""
+        self.link_prober = prober
+        if self._repair_thread is None:
+            self._repair_thread = threading.Thread(
+                target=self._repair_loop, args=(interval,), daemon=True,
+                name="chain-repair")
+            self._repair_thread.start()
+
+    def _repair_loop(self, interval: float):
+        while not self._repair_stop.wait(interval):
+            try:
+                self.repair_chains()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                log.exception("chain repair pass failed")
 
     def stop(self):
+        self._repair_stop.set()
+        if self._repair_client is not None:
+            try:
+                self._repair_client.close()
+            except Exception:  # noqa: BLE001
+                pass
         if self._manager:
             self._manager.stop()
         self.cni_server.stop()
@@ -233,9 +272,22 @@ class TpuSideManager:
         network = req.netconf.name or ""
         ips = ipam_add(ipam_cfg, self.ipam_dir, network,
                        req.sandbox_id, req.ifname)
-        if ips is not None:
-            self.nf_cache.save(req.sandbox_id, req.ifname,
-                               {"ipam": ipam_cfg, "network": network})
+        # always cache: the device id must survive daemon restarts so a
+        # later DEL can release the chip's slice attachment (the VSP and
+        # its attachment table live in a separate long-lived process)
+        self.nf_cache.save(req.sandbox_id, req.ifname, {
+            "ipam": ipam_cfg if ips is not None else None,
+            "network": network, "device": req.device_id})
+        # ensure the consumed chip is ATTACHED in the dataplane (the
+        # dpu-side CNI's netdev-move analog, networkfn.go:36-149): NF
+        # pods' chips must have their ICI ports wired so link health
+        # gates them and chain hops can ride port-level steering.
+        # Idempotent — attachments are keyed by name in the VSP.
+        att_name = self._slice_attachment_name(req.device_id)
+        if att_name:
+            chip_index = int(req.device_id.split("-", 1)[1])
+            self.vsp.create_slice_attachment(
+                {"name": att_name, "chip_index": chip_index})
         pair = None
         with self._attach_lock:
             entry = self._attach_store.setdefault(
@@ -368,6 +420,94 @@ class TpuSideManager:
                 # our wire landed — undo it so nothing leaks
                 self._unwire_quietly(ids, "raced SFC hop")
 
+    #: allocated ici-port endpoint ids look like "ici-<chip>-<port>"
+    #: (ici/topology.py IciLink.id)
+    _ICI_ID_RE = re.compile(r"^ici-(\d+)-(.+)$")
+
+    _CHIP_ID_RE = re.compile(r"^chip-(\d+)$")
+
+    @staticmethod
+    def _slice_attachment_name(device_id) -> Optional[str]:
+        """VSP attachment name for an NF-consumed chip. Deliberately in
+        the NF namespace (nf<worker>-<chip>) so it can never collide with
+        — or overwrite/detach — the host-side manager's host<h>-<chip>
+        attachments for tenant pods sharing the VSP."""
+        m = TpuSideManager._CHIP_ID_RE.match(device_id or "")
+        if not m:
+            return None
+        worker = int(os.environ.get("TPU_WORKER_ID", "0"))
+        return f"nf{worker}-{m.group(1)}"
+
+    def _endpoint_link_down(self, endpoint: str,
+                            probe_cache: dict) -> bool:
+        """True when *endpoint* is a port-addressed id whose physical
+        link is down. Attachment-id endpoints carry no port-level state
+        (never 'down'); prober failures read as healthy — repair must
+        never churn wiring on flaky telemetry."""
+        m = self._ICI_ID_RE.match(endpoint)
+        if not m:
+            return False
+        chip, port = int(m.group(1)), m.group(2)
+        if chip not in probe_cache:
+            try:
+                probe_cache[chip] = {p["port"]: p
+                                     for p in self.link_prober(chip)}
+            except Exception:  # noqa: BLE001 — telemetry, not control
+                probe_cache[chip] = {}
+        state = probe_cache[chip].get(port)
+        # only a WIRED port that lost its link counts as down — unwired
+        # ports idle at up=False (untrained) and endpoints are symbolic
+        # until the attach wires them (chip_links_ok has the same rule)
+        return (state is not None and state.get("wired", False)
+                and not state.get("up", True))
+
+    def repair_chains(self) -> list:
+        """Self-healing steering: re-wire chain hops whose allocated ICI
+        port's link went down, degrading that side to the NF's
+        attachment-id endpoint (topology-level steering) make-before-
+        break. Returns [(hop_key, old_ids, new_ids)]. The reference's
+        chain flow rules have no repair path — broken until pod churn."""
+        if self.link_prober is None:
+            return []
+        probe_cache: dict = {}
+        with self._attach_lock:
+            snapshot = [(hop_key, ids,
+                         self._chain_store.get(hop_key[:2], {}))
+                        for hop_key, ids in self._chain_hops.items()]
+        plans = []
+        for hop_key, ids, chain in snapshot:
+            i = hop_key[2]
+            up_entry, down_entry = chain.get(i), chain.get(i + 1)
+            if up_entry is None or down_entry is None:
+                continue
+            out_id, in_id = ids
+            new_out, new_in = out_id, in_id
+            if self._endpoint_link_down(out_id, probe_cache):
+                new_out = up_entry["out"]
+            if self._endpoint_link_down(in_id, probe_cache):
+                new_in = down_entry["in"]
+            if (new_out, new_in) != ids:
+                plans.append((hop_key, ids, (new_out, new_in)))
+        repaired = []
+        for hop_key, old_ids, new_ids in plans:
+            try:
+                self.vsp.create_network_function(*new_ids)  # make...
+            except Exception:  # noqa: BLE001 — retried next pass
+                log.warning("chain repair wire failed for %s", hop_key)
+                continue
+            with self._attach_lock:
+                if self._chain_hops.get(hop_key) != old_ids:
+                    # teardown or a concurrent repair got here first —
+                    # ours is now the stray wire
+                    self._unwire_quietly(new_ids, "raced chain repair")
+                    continue
+                self._chain_hops[hop_key] = new_ids
+            self._unwire_quietly(old_ids, "chain repair")  # ...break
+            repaired.append((hop_key, old_ids, new_ids))
+            log.warning("re-steered SFC hop %s: %s -> %s (link down)",
+                        hop_key, old_ids, new_ids)
+        return repaired
+
     def _teardown_chain(self, sandbox_id: str):
         """Unwire chain hops touching a departing sandbox."""
         to_unwire = []
@@ -398,12 +538,16 @@ class TpuSideManager:
         # (NAD updated while the pod ran); per-interface DEL frees this
         # ifname, full teardown frees every address the sandbox holds.
         per_if = attachment_id is not None
+        release_atts: list[str] = []
         if per_if:
             cached = self.nf_cache.load(req.sandbox_id, req.ifname) or {}
             ipam_del(cached.get("ipam") or req.netconf.ipam, self.ipam_dir,
                      cached.get("network") or req.netconf.name,
                      req.sandbox_id, req.ifname)
             self.nf_cache.delete(req.sandbox_id, req.ifname)
+            name = self._slice_attachment_name(req.device_id)
+            if name:
+                release_atts.append(name)
         else:
             # Full teardown: the sandbox may hold addresses under several
             # networks/NADs (one cached entry per ifname, each with its own
@@ -424,10 +568,27 @@ class TpuSideManager:
                 ipam_del(req.netconf.ipam, self.ipam_dir, req.netconf.name,
                          req.sandbox_id, None)
             self.nf_cache.delete_sandbox(req.sandbox_id)
+            # full teardown releases EVERY chip attachment the sandbox's
+            # ADDs created — devices from the restart-surviving cache,
+            # plus the in-memory attachment ids as belt-and-braces
+            devices = {c.get("device") for c in cached_all
+                       if c.get("device")}
+            prefix = f"nf-{req.sandbox_id[:12]}-"
+            with self._attach_lock:
+                entry = self._attach_store.get(req.sandbox_id)
+                if entry is not None:
+                    devices.update(a[len(prefix):]
+                                   for a in entry["atts"]
+                                   if a.startswith(prefix))
+            for dev in sorted(devices):
+                name = self._slice_attachment_name(dev)
+                if name:
+                    release_atts.append(name)
         unwire = None
         with self._attach_lock:
             entry = self._attach_store.get(req.sandbox_id)
             if entry is None:
+                self._release_attachments(release_atts)
                 return {}
             if attachment_id is None:
                 if entry["wired"]:
@@ -445,7 +606,18 @@ class TpuSideManager:
         if unwire is not None:
             self._unwire_quietly(unwire, "sandbox DEL")
             self._teardown_chain(req.sandbox_id)
+        self._release_attachments(release_atts)
         return {}
+
+    def _release_attachments(self, names: list):
+        """Best-effort slice-attachment release (chips are exclusively
+        allocated, so the departing sandbox owned them); DEL must make
+        progress even with the VSP down."""
+        for name in names:
+            try:
+                self.vsp.delete_slice_attachment(name)
+            except Exception:  # noqa: BLE001 — defensive DEL path
+                log.warning("slice-attachment release failed for %s", name)
 
     # -- ICI port advertisement ----------------------------------------------
     def enable_ici_ports(self, topology_provider):

@@ -75,8 +75,10 @@ class DebugIciDataplane:
 class GoogleTpuVsp:
     """VSP implementation (serve with :class:`~.rpc.VspServer`)."""
 
-    #: OPI-parity attachment name "host<h>-<chip>" (marvell/main.go:306-343)
-    _ATTACH_RE = re.compile(r"^host(\d+)-(\d+)$")
+    #: OPI-parity attachment name "host<h>-<chip>" (marvell/main.go:306-343);
+    #: "nf<h>-<chip>" is the tpu-side NF namespace (tpusidemanager ADDs) —
+    #: kept distinct so the two managers never overwrite/detach each other
+    _ATTACH_RE = re.compile(r"^(?:host|nf)(\d+)-(\d+)$")
 
     def __init__(self, platform: Platform, dataplane: Optional[IciDataplane]
                  = None, comm_ip: str = "127.0.0.1", comm_port: int = 50151):

@@ -115,6 +115,12 @@ class _DelRacingVsp:
         self.unwired.append((a, b))
 
 
+    def create_slice_attachment(self, att):
+        return att
+
+    def delete_slice_attachment(self, name):
+        pass
+
 def _nf_req(sandbox, dev):
     return PodRequest(command="ADD", pod_namespace="default", pod_name="nf",
                       sandbox_id=sandbox, netns="/proc/1/ns/net",
@@ -171,6 +177,12 @@ class _InterfaceDelRacingVsp:
     def delete_network_function(self, a, b):
         self.unwired.append((a, b))
 
+
+    def create_slice_attachment(self, att):
+        return att
+
+    def delete_slice_attachment(self, name):
+        pass
 
 def test_interface_del_mid_wire_unwinds_and_later_del_safe():
     """A per-interface DEL racing the wire must not leave a wired entry

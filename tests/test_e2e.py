@@ -347,3 +347,82 @@ def test_sfc_resource_exhaustion_n_plus_one(stack):
             break
         time.sleep(0.05)
     assert phase("sfc-3-nf-c") == "Running"
+
+
+def test_chain_self_heals_on_ici_link_failure(stack):
+    """Fault-injected e2e: an SFC hop steered over allocated ici-ports is
+    re-wired through the NATIVE agent when its link is forced down —
+    the wire table swaps to the degraded attachment endpoint and the dead
+    hop disappears (the reference's chain rules have no repair path)."""
+    kube, kubelet = stack["kube"], stack["kubelet"]
+    kube.create(_load_example("tpu.yaml"))
+    assert stack["op_mgr"].wait_idle(10)
+    assert kubelet.wait_for_devices("google.com/tpu", 4)
+
+    from dpu_operator_tpu.ici import SliceTopology
+    n_ports = len(SliceTopology("v5e-16").ici_ports_on_host(0))
+    assert kubelet.wait_for_devices("google.com/ici-port", n_ports)
+
+    kube.create(_load_example("sfc.yaml"))
+    deadline = time.monotonic() + 10
+    pods = []
+    while time.monotonic() < deadline:
+        pods = [p for p in kube.list("v1", "Pod", namespace="default")
+                if p["metadata"].get("labels", {}).get("app")
+                == "tpu-network-function"]
+        if len(pods) == 2 and all(p["status"].get("phase") == "Running"
+                                  for p in pods):
+            break
+        time.sleep(0.05)
+    assert len(pods) == 2
+    pods.sort(key=lambda p: int(
+        p["metadata"]["annotations"]["tpu.openshift.io/sfc-index"]))
+
+    port_ids = sorted(d.ID for d in
+                      kubelet.device_lists["google.com/ici-port"])
+
+    def port_on_chip(c):
+        # topology-aware allocation: each pod's ports live on its OWN
+        # chips (what GetPreferredAllocation steers toward) — far-end
+        # ports of unattached chips are unwired and cannot carry a hop
+        return next(p for p in port_ids if p.startswith(f"ici-{c}-"))
+
+    shim = CniShim(stack["pm"].cni_server_socket())
+    sandboxes, pod_ports = [], []
+    chip = 0
+    for i, pod in enumerate(pods):
+        name = pod["metadata"]["name"]
+        ports = [port_on_chip(chip), port_on_chip(chip + 1)]
+        pod_ports.append(ports)
+        kubelet.allocate("google.com/ici-port", ports)
+        kubelet.allocate("google.com/tpu", [f"chip-{chip}",
+                                            f"chip-{chip + 1}"])
+        sandbox = "sbx-heal-" + name
+        sandboxes.append(sandbox)
+        for ifname, dev in (("net1", f"chip-{chip}"),
+                            ("net2", f"chip-{chip + 1}")):
+            r = _cni_nf(shim, "ADD", sandbox, ifname, dev, name,
+                        ici_ports=ports)
+            assert r.error == ""
+        chip += 2
+
+    agent = stack["agent_client"]
+    hop = (pod_ports[0][1], pod_ports[1][0])
+    assert hop in agent.list_wires()
+
+    # force the upstream egress link down and run a repair pass
+    import re as _re
+    m = _re.match(r"^ici-(\d+)-(.+)$", hop[0])
+    agent.set_link(int(m.group(1)), m.group(2), up=False)
+    mgr = stack["mgr"]
+    mgr.link_prober = agent.link_state
+    repaired = mgr.repair_chains()
+    assert len(repaired) == 1
+
+    wires = agent.list_wires()
+    assert hop not in wires
+    fallback = (f"nf-{sandboxes[0][:12]}-chip-1", hop[1])
+    assert fallback in wires
+
+    # restore for other tests sharing the agent binary
+    agent.set_link(int(m.group(1)), m.group(2), up=True)

@@ -124,6 +124,12 @@ class _QuietVsp:
         self.unwired.append((a, b))
 
 
+    def create_slice_attachment(self, att):
+        return att
+
+    def delete_slice_attachment(self, name):
+        pass
+
 def _nf_manager(tmp_path):
     mgr = TpuSideManager.__new__(TpuSideManager)
     mgr.vsp = _QuietVsp()

@@ -48,6 +48,12 @@ class _RecordingVsp:
         self.unwired.append((a, b))
 
 
+    def create_slice_attachment(self, att):
+        return att
+
+    def delete_slice_attachment(self, name):
+        pass
+
 def _nf_manager(tmp_path, vsp):
     mgr = TpuSideManager.__new__(TpuSideManager)
     mgr.vsp = vsp

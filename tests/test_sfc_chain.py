@@ -13,12 +13,21 @@ class _RecordingVsp:
     def __init__(self):
         self.wired = []
         self.unwired = []
+        self.attached = []
+        self.detached = []
 
     def create_network_function(self, a, b):
         self.wired.append((a, b))
 
     def delete_network_function(self, a, b):
         self.unwired.append((a, b))
+
+    def create_slice_attachment(self, att):
+        self.attached.append(att["name"])
+        return att
+
+    def delete_slice_attachment(self, name):
+        self.detached.append(name)
 
 
 class _Req:
@@ -154,3 +163,107 @@ def test_non_sfc_pod_wires_no_chain(kube, mgr):
     _wire_pod(mgr, "sandboxDDDD", "plain", ["chip-0", "chip-1"])
     assert len(mgr.vsp.wired) == 1
     assert mgr._chain_store == {}
+
+
+def test_repair_resteers_hop_when_port_link_down(kube, mgr):
+    """Self-healing steering: a wired hop whose allocated ici-port's link
+    goes down is re-wired make-before-break onto the NF's attachment-id
+    endpoint; healthy hops are untouched; repair is idempotent."""
+    _nf_pod(kube, "r-sfc-nf-a", "r-sfc", 0)
+    _nf_pod(kube, "r-sfc-nf-b", "r-sfc", 1)
+    _wire_pod_with_ports(mgr, "sandboxAAAA", "r-sfc-nf-a",
+                         ["chip-0", "chip-1"], ["ici-0-x+", "ici-1-x+"])
+    _wire_pod_with_ports(mgr, "sandboxBBBB", "r-sfc-nf-b",
+                         ["chip-2", "chip-3"], ["ici-2-x+", "ici-3-x+"])
+    assert mgr.vsp.wired[-1] == ("ici-1-x+", "ici-2-x+")
+
+    link_state = {1: [{"port": "x+", "up": True, "wired": True}],
+                  2: [{"port": "x+", "up": True, "wired": True}]}
+    mgr.link_prober = lambda chip: link_state.get(chip, [])
+
+    # all links up: nothing to repair
+    assert mgr.repair_chains() == []
+
+    # upstream egress link dies -> that side degrades to the attachment id
+    link_state[1][0]["up"] = False
+    repaired = mgr.repair_chains()
+    assert len(repaired) == 1
+    hop_key, old_ids, new_ids = repaired[0]
+    assert old_ids == ("ici-1-x+", "ici-2-x+")
+    assert new_ids == ("nf-sandboxAAAA-chip-1", "ici-2-x+")
+    # make-before-break: new wired, old unwired
+    assert new_ids in mgr.vsp.wired
+    assert old_ids in mgr.vsp.unwired
+    # idempotent: the repaired hop has no downed ici endpoints left
+    assert mgr.repair_chains() == []
+
+    # teardown unwires the REPAIRED ids, not the stale ones
+    mgr._cni_nf_del(_Req("sandboxBBBB", None, "net1", "r-sfc-nf-b"))
+    assert new_ids in mgr.vsp.unwired
+
+
+def test_repair_survives_prober_failure(kube, mgr):
+    """Flaky telemetry must never churn wiring: a prober that raises
+    reads as healthy."""
+    _nf_pod(kube, "f-nf-a", "f", 0)
+    _nf_pod(kube, "f-nf-b", "f", 1)
+    _wire_pod_with_ports(mgr, "sandboxAAAA", "f-nf-a",
+                         ["chip-0", "chip-1"], ["ici-0-x+", "ici-1-x+"])
+    _wire_pod_with_ports(mgr, "sandboxBBBB", "f-nf-b",
+                         ["chip-2", "chip-3"], ["ici-2-x+", "ici-3-x+"])
+
+    def exploding_prober(chip):
+        raise ConnectionError("agent gone")
+
+    mgr.link_prober = exploding_prober
+    assert mgr.repair_chains() == []
+    assert mgr.vsp.wired[-1] == ("ici-1-x+", "ici-2-x+")
+
+
+def test_nf_add_attaches_chip_and_del_releases(kube, mgr):
+    """NF ADD attaches the consumed chip in the NF namespace (nf0-<chip>,
+    never colliding with host-side host0-<chip> attachments); full
+    teardown releases every attachment the sandbox created."""
+    _nf_pod(kube, "att-nf-a", "att", 0)
+    _wire_pod(mgr, "sandboxAAAA", "att-nf-a", ["chip-0", "chip-1"])
+    assert mgr.vsp.attached == ["nf0-0", "nf0-1"]
+
+    mgr._cni_nf_del(_Req("sandboxAAAA", None, "net1", "att-nf-a"))
+    assert sorted(mgr.vsp.detached) == ["nf0-0", "nf0-1"]
+
+
+def test_attachment_release_survives_daemon_restart(kube, mgr, short_tmp):
+    """The device ids ride the restart-surviving nf_cache: a DEL handled
+    by a FRESH manager (empty attach store) still releases the chip
+    attachments."""
+    _nf_pod(kube, "rs-nf-a", "rs", 0)
+    _wire_pod(mgr, "sandboxAAAA", "rs-nf-a", ["chip-2", "chip-3"])
+
+    # "restart": new manager over the same cache dir, empty memory
+    from dpu_operator_tpu.cni import NetConfCache
+    fresh = TpuSideManager.__new__(TpuSideManager)
+    fresh.vsp = _RecordingVsp()
+    fresh.client = kube
+    fresh.ipam_dir = mgr.ipam_dir
+    fresh.nf_cache = NetConfCache(mgr.nf_cache.cache_dir)
+    fresh._attach_store = {}
+    fresh._attach_lock = threading.Lock()
+    fresh._chain_store = {}
+    fresh._chain_hops = {}
+    fresh._cni_nf_del(_Req("sandboxAAAA", None, "net1", "rs-nf-a"))
+    assert sorted(fresh.vsp.detached) == ["nf0-2", "nf0-3"]
+
+
+def test_google_vsp_accepts_nf_namespace_attachments():
+    from dpu_operator_tpu.platform.platform import FakePlatform
+    from dpu_operator_tpu.vsp.google import GoogleTpuVsp
+
+    vsp = GoogleTpuVsp(FakePlatform(accelerator_type="v5litepod-16"))
+    vsp.init({"tpu_mode": True})
+    att = vsp.create_slice_attachment({"name": "nf0-3", "chip_index": 3})
+    assert att["chip_index"] == 3
+    # distinct namespaces coexist for the same chip
+    vsp.create_slice_attachment({"name": "host0-3", "chip_index": 3})
+    assert {"nf0-3", "host0-3"} <= set(vsp.attachments)
+    vsp.delete_slice_attachment({"name": "nf0-3"})
+    assert "host0-3" in vsp.attachments
