@@ -163,9 +163,15 @@ class TpuSideManager:
         # vsp/__main__.py) and re-steer hops whose port went dark
         agent_sock = self.path_manager.vendor_plugin_socket() + ".cp-agent"
         if self.link_prober is None and os.path.exists(agent_sock):
-            from ..vsp.native_dp import AgentClient
-            self._repair_client = AgentClient(agent_sock)
-            self.enable_chain_repair(self._repair_client.link_state)
+            try:
+                from ..vsp.native_dp import AgentClient
+                self._repair_client = AgentClient(agent_sock)
+                self.enable_chain_repair(self._repair_client.link_state)
+            except Exception:  # noqa: BLE001 — repair is an enhancement
+                # a stale socket file (agent crashed) must not take the
+                # device plugin / CNI / reconciler down with it
+                log.warning("chain repair disabled: agent socket %s not "
+                            "connectable", agent_sock)
 
     def enable_chain_repair(self, prober, interval: float = 5.0):
         """Start the periodic hop-repair loop (reference has no analog:
@@ -283,11 +289,10 @@ class TpuSideManager:
         # pods' chips must have their ICI ports wired so link health
         # gates them and chain hops can ride port-level steering.
         # Idempotent — attachments are keyed by name in the VSP.
-        att_name = self._slice_attachment_name(req.device_id)
-        if att_name:
-            chip_index = int(req.device_id.split("-", 1)[1])
+        att = self._slice_attachment_for(req.device_id)
+        if att:
             self.vsp.create_slice_attachment(
-                {"name": att_name, "chip_index": chip_index})
+                {"name": att[0], "chip_index": att[1]})
         pair = None
         with self._attach_lock:
             entry = self._attach_store.setdefault(
@@ -339,6 +344,16 @@ class TpuSideManager:
                     "in flight")
             wired = True
             self._update_chain(req, pair)
+        if att and self.nf_cache.load(req.sandbox_id, req.ifname) is None:
+            # a full-teardown DEL raced this ADD (our cache entry is
+            # gone, and with it the DEL's ability to release the chip) —
+            # undo the attachment now and fail so kubelet retries against
+            # current state (mirror of the orphaned-wire unwind above)
+            self._release_attachments([att[0]])
+            with self._attach_lock:
+                self._attach_store.pop(req.sandbox_id, None)
+            raise RuntimeError(
+                "sandbox torn down while slice attachment was in flight")
         result = {
             "cniVersion": req.netconf.cni_version,
             "interfaces": [{"name": req.ifname, "sandbox": req.netns}],
@@ -427,16 +442,17 @@ class TpuSideManager:
     _CHIP_ID_RE = re.compile(r"^chip-(\d+)$")
 
     @staticmethod
-    def _slice_attachment_name(device_id) -> Optional[str]:
-        """VSP attachment name for an NF-consumed chip. Deliberately in
-        the NF namespace (nf<worker>-<chip>) so it can never collide with
-        — or overwrite/detach — the host-side manager's host<h>-<chip>
+    def _slice_attachment_for(device_id) -> Optional[tuple]:
+        """(attachment name, chip index) for an NF-consumed chip, or None
+        for non-chip devices. The name is deliberately in the NF
+        namespace (nf<worker>-<chip>) so it can never collide with — or
+        overwrite/detach — the host-side manager's host<h>-<chip>
         attachments for tenant pods sharing the VSP."""
         m = TpuSideManager._CHIP_ID_RE.match(device_id or "")
         if not m:
             return None
         worker = int(os.environ.get("TPU_WORKER_ID", "0"))
-        return f"nf{worker}-{m.group(1)}"
+        return f"nf{worker}-{m.group(1)}", int(m.group(1))
 
     def _endpoint_link_down(self, endpoint: str,
                             probe_cache: dict) -> bool:
@@ -545,9 +561,9 @@ class TpuSideManager:
                      cached.get("network") or req.netconf.name,
                      req.sandbox_id, req.ifname)
             self.nf_cache.delete(req.sandbox_id, req.ifname)
-            name = self._slice_attachment_name(req.device_id)
-            if name:
-                release_atts.append(name)
+            att = self._slice_attachment_for(req.device_id)
+            if att:
+                release_atts.append(att[0])
         else:
             # Full teardown: the sandbox may hold addresses under several
             # networks/NADs (one cached entry per ifname, each with its own
@@ -581,9 +597,9 @@ class TpuSideManager:
                                    for a in entry["atts"]
                                    if a.startswith(prefix))
             for dev in sorted(devices):
-                name = self._slice_attachment_name(dev)
-                if name:
-                    release_atts.append(name)
+                att = self._slice_attachment_for(dev)
+                if att:
+                    release_atts.append(att[0])
         unwire = None
         with self._attach_lock:
             entry = self._attach_store.get(req.sandbox_id)

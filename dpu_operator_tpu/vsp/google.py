@@ -250,7 +250,16 @@ class GoogleTpuVsp:
         name = req.get("name", "")
         att = self.attachments.pop(name, None)
         if att is not None:
-            self.dataplane.detach_chip(int(att.get("chip_index", 0)))
+            chip = int(att.get("chip_index", 0))
+            # per-chip refcount across namespaces: an NF attachment
+            # (nf<h>-<c>) releasing must not detach a chip a host-side
+            # attachment (host<h>-<c>) still references — that would
+            # unwire a live tenant pod's ICI ports
+            still_referenced = any(
+                int(a.get("chip_index", -1)) == chip
+                for a in self.attachments.values())
+            if not still_referenced:
+                self.dataplane.detach_chip(chip)
             peer = att.get("peer_address", "")
             if peer and not any(a.get("peer_address") == peer
                                 for a in self.attachments.values()):

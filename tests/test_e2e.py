@@ -410,19 +410,20 @@ def test_chain_self_heals_on_ici_link_failure(stack):
     hop = (pod_ports[0][1], pod_ports[1][0])
     assert hop in agent.list_wires()
 
-    # force the upstream egress link down and run a repair pass
+    # force the upstream egress link down and run a repair pass; the
+    # agent is shared session state, so ALWAYS restore the link
     import re as _re
     m = _re.match(r"^ici-(\d+)-(.+)$", hop[0])
     agent.set_link(int(m.group(1)), m.group(2), up=False)
-    mgr = stack["mgr"]
-    mgr.link_prober = agent.link_state
-    repaired = mgr.repair_chains()
-    assert len(repaired) == 1
+    try:
+        mgr = stack["mgr"]
+        mgr.link_prober = agent.link_state
+        repaired = mgr.repair_chains()
+        assert len(repaired) == 1
 
-    wires = agent.list_wires()
-    assert hop not in wires
-    fallback = (f"nf-{sandboxes[0][:12]}-chip-1", hop[1])
-    assert fallback in wires
-
-    # restore for other tests sharing the agent binary
-    agent.set_link(int(m.group(1)), m.group(2), up=True)
+        wires = agent.list_wires()
+        assert hop not in wires
+        fallback = (f"nf-{sandboxes[0][:12]}-chip-1", hop[1])
+        assert fallback in wires
+    finally:
+        agent.set_link(int(m.group(1)), m.group(2), up=True)
