@@ -63,6 +63,16 @@ class _SliceServiceForwarder:
         evicted = self.manager.resize_chips(count, local or want)
         return {"evicted": evicted}
 
+    def repair_chains(self, req: dict) -> dict:
+        """Manual repair pass (tpuctl repair-chains) — same logic the
+        periodic loop runs."""
+        if self.manager is None:
+            raise RuntimeError("admin plane not wired")
+        repaired = self.manager.repair_chains()
+        return {"repaired": [
+            {"hop": list(map(str, hop_key)), "old": list(old),
+             "new": list(new)} for hop_key, old, new in repaired]}
+
     def create_slice_attachment(self, req: dict) -> dict:
         return self.vsp.create_slice_attachment(req)
 
@@ -122,6 +132,7 @@ class TpuSideManager:
         self._repair_stop = threading.Event()
         self._repair_thread: Optional[threading.Thread] = None
         self._repair_client = None
+        self._repair_pass_lock = threading.Lock()
         self._manager: Optional[Manager] = None
 
     # -- SideManager lifecycle ------------------------------------------------
@@ -485,6 +496,14 @@ class TpuSideManager:
         chain flow rules have no repair path — broken until pod churn."""
         if self.link_prober is None:
             return []
+        # one repair pass at a time: the periodic loop and the manual
+        # AdminService trigger computing the same plan concurrently would
+        # otherwise race — the loser's stray-wire cleanup could unwire
+        # the winner's freshly installed hop
+        with self._repair_pass_lock:
+            return self._repair_chains_locked()
+
+    def _repair_chains_locked(self) -> list:
         probe_cache: dict = {}
         with self._attach_lock:
             snapshot = [(hop_key, ids,
@@ -512,9 +531,14 @@ class TpuSideManager:
                 log.warning("chain repair wire failed for %s", hop_key)
                 continue
             with self._attach_lock:
-                if self._chain_hops.get(hop_key) != old_ids:
-                    # teardown or a concurrent repair got here first —
-                    # ours is now the stray wire
+                current = self._chain_hops.get(hop_key)
+                if current == new_ids:
+                    # someone already installed exactly our plan: the
+                    # wire is live and ours was a duplicate create
+                    # (idempotent in the dataplane) — do NOT unwire it
+                    continue
+                if current != old_ids:
+                    # teardown got here first — ours is now the stray wire
                     self._unwire_quietly(new_ids, "raced chain repair")
                     continue
                 self._chain_hops[hop_key] = new_ids

@@ -56,6 +56,9 @@ def _vsp_cmds(sub):
                             "drains chip-consuming pods first")
     p.add_argument("count", type=int)
     p.add_argument("--node", default="", help="node to drain on shrink")
+    sub.add_parser("repair-chains",
+                   help="daemon AdminService.RepairChains: re-steer SFC "
+                        "hops whose ICI port link is down")
     p = sub.add_parser("create-attachment")
     p.add_argument("name")
     p.add_argument("--chip", type=int, default=None)
@@ -117,6 +120,15 @@ def run(args) -> dict:
             client.close()
 
     from .vsp.rpc import VspChannel, unix_target
+
+    if args.cmd == "repair-chains":
+        if not args.daemon_addr:
+            raise SystemExit("repair-chains needs --daemon-addr")
+        channel = VspChannel(args.daemon_addr)
+        try:
+            return channel.call("AdminService", "RepairChains", {})
+        finally:
+            channel.close()
 
     if args.cmd == "resize-chips":
         if not args.daemon_addr:

@@ -57,6 +57,7 @@ class VspServer:
         ("NetworkFunctionService", "DeleteNetworkFunction"):
             "delete_network_function",
         ("AdminService", "ResizeChips"): "resize_chips",
+        ("AdminService", "RepairChains"): "repair_chains",
     }
 
     def __init__(self, impl, socket_path: Optional[str] = None,

@@ -122,26 +122,57 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array):
     return new_cache, last_logits
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "top_k", "greedy"))
+def _generate_compiled(params: dict, cfg: TransformerConfig,
+                       prompt: jax.Array, steps: int, temperature,
+                       top_k: int, greedy: bool,
+                       key: jax.Array) -> jax.Array:
+    P = prompt.shape[1]
+    cache, last_logits = prefill(params, cfg, prompt)
+
+    def pick(logits, k):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # temperature rides as a TRACED scalar: per-request temperature
+        # changes must not recompile the whole program
+        scaled = logits / temperature
+        if top_k > 0:
+            # O(V log k) threshold, not a full vocab sort per step
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(k, scaled, axis=-1).astype(jnp.int32)
+
+    def body(carry, i):
+        cache, logits, k = carry
+        k, sub = jax.random.split(k)
+        token = pick(logits, sub)
+        logits, cache = _decode_one(params, cfg, cache, token, P + i)
+        return (cache, logits, k), token
+
+    (_, _, _), tokens = jax.lax.scan(body, (cache, last_logits, key),
+                                     jnp.arange(steps))
+    return tokens.T                                    # (B, steps)
+
+
 def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
-             steps: int) -> jax.Array:
-    """Greedy continuation: (B, P) prompt -> (B, steps) generated ids,
-    one compiled program (prefill scan + decode scan)."""
+             steps: int, temperature: float = 0.0, top_k: int = 0,
+             key: jax.Array | None = None) -> jax.Array:
+    """Autoregressive continuation: (B, P) prompt -> (B, steps) ids, one
+    compiled program (prefill + decode scan). temperature=0 is greedy;
+    otherwise categorical sampling from logits/temperature, optionally
+    truncated to the top_k logits (*key* required when sampling)."""
     B, P = prompt.shape
     if P + steps > cfg.max_seq:
         raise ValueError(
             f"prompt {P} + steps {steps} exceeds max_seq {cfg.max_seq}")
-    cache, last_logits = prefill(params, cfg, prompt)
-
-    def body(carry, i):
-        cache, logits = carry
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits, cache = _decode_one(params, cfg, cache, token, P + i)
-        return (cache, logits), token
-
-    (_, _), tokens = jax.lax.scan(body, (cache, last_logits),
-                                  jnp.arange(steps))
-    return tokens.T                                    # (B, steps)
+    greedy = temperature <= 0.0
+    if not greedy and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if key is None:
+        key = jax.random.key(0)  # unused on the greedy path
+    return _generate_compiled(params, cfg, prompt, steps,
+                              jnp.float32(max(temperature, 1e-6)), top_k,
+                              greedy, key)
 
 
 def measure_decode(cfg: TransformerConfig, batch: int = 8,

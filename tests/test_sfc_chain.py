@@ -69,6 +69,7 @@ def mgr(kube, tmp_path):
     m._attach_lock = threading.Lock()
     m._chain_store = {}
     m._chain_hops = {}
+    m._repair_pass_lock = threading.Lock()
     m.ipam_dir = str(tmp_path / "ipam")
     m.nf_cache = NetConfCache(str(tmp_path / "nf"))
     return m

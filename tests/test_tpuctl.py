@@ -161,3 +161,47 @@ def test_admin_resize_rejects_bad_count_and_foreign_node(short_tmp, kube,
     finally:
         mgr.stop()
         vsp_server.stop()
+
+
+def test_tpuctl_repair_chains_via_daemon(short_tmp, kube, node_agent):
+    """tpuctl repair-chains triggers the daemon's self-healing pass over
+    the admin plane (manual twin of the periodic loop)."""
+    from dpu_operator_tpu.daemon import TpuSideManager
+    from dpu_operator_tpu.platform import TpuDetector
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    from dpu_operator_tpu.vsp import GrpcPlugin
+    from dpu_operator_tpu import tpuctl
+
+    pm = PathManager(short_tmp)
+    mock = MockTpuVsp(port=0)
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    vsp_server = VspServer(mock, socket_path=sock)
+    vsp_server.start()
+    det = TpuDetector().detection_result(tpu_mode=True, identifier="t")
+    mgr = TpuSideManager(GrpcPlugin(det, path_manager=pm, init_timeout=5.0),
+                         pm, client=kube)
+    try:
+        mgr.start_vsp()
+        mgr.setup_devices()
+        mgr.listen()
+        # plant a broken hop + a prober that reports its port down
+        mgr._chain_store[("default", "s")] = {
+            0: {"in": "a-in", "out": "a-out", "sandbox": "sA",
+                "ports": []},
+            1: {"in": "b-in", "out": "b-out", "sandbox": "sB",
+                "ports": []}}
+        mgr._chain_hops[("default", "s", 0)] = ("ici-1-x+", "b-in")
+        mgr.link_prober = lambda chip: [
+            {"port": "x+", "up": False, "wired": True}]
+        args = type("A", (), {
+            "cmd": "repair-chains",
+            "daemon_addr": f"127.0.0.1:{mgr.bound_port}",
+            "agent_socket": "", "vsp_socket": ""})()
+        out = tpuctl.run(args)
+        assert out["repaired"][0]["old"] == ["ici-1-x+", "b-in"]
+        assert out["repaired"][0]["new"] == ["a-out", "b-in"]
+        assert mgr._chain_hops[("default", "s", 0)] == ("a-out", "b-in")
+    finally:
+        mgr.stop()
+        vsp_server.stop()
