@@ -44,7 +44,14 @@ images:
 push:
 	for img in $(IMAGES); do docker push $(REGISTRY)/tpu-$$img:$(TAG); done
 
+# full composition via the default overlay, then hack/setup.py labels
+# nodes, applies the CR, and WAITS for the rendered plumbing to be ready
+# (reference: hack/setup.sh; raw per-dir applies kept as deploy-raw)
 deploy:
+	kubectl apply -k config/default/
+	python hack/setup.py
+
+deploy-raw:
 	kubectl apply -f config/crd/bases/
 	kubectl apply -f config/rbac/
 	kubectl apply -f config/manager/

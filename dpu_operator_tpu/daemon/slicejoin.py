@@ -1,0 +1,89 @@
+"""Multi-slice join: assemble a MultiSliceGroup by walking DCN peers.
+
+The reference's cross-cluster story is one host learning one DPU's OPI
+endpoint from VSP Init and dialing it (marvell/main.go:691-725,
+hostsidemanager.go:145-174). Multi-slice TPU training generalizes that to
+N slices: each slice's daemon serves its cross-boundary address; a slice
+attachment carrying ``peer_address`` (api.proto SliceAttachment) joins two
+slices, and either side — or a cluster-level controller — can dial any
+member's address, read its ``SliceInfo`` (topology + peer list), and walk
+the peer graph into the joint :class:`~..ici.topology.MultiSliceGroup`
+the workload's hierarchical DCN collectives are scheduled over
+(workloads/multislice.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from ..ici import MultiSliceGroup, SliceTopology
+from ..vsp.rpc import VspChannel
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class JoinResult:
+    """Outcome of a peer walk: the group built from every REACHABLE
+    slice, plus the peers that failed discovery — a dead peer degrades
+    the group (the collectives reschedule over the survivors), it does
+    not wedge the join."""
+
+    group: MultiSliceGroup
+    members: list = field(default_factory=list)  # addresses, local first
+    unreachable: list = field(default_factory=list)
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.unreachable)
+
+
+def _fetch_info(address: str, timeout: float) -> dict:
+    channel = VspChannel(address)
+    try:
+        channel.wait_ready(timeout=timeout)
+        return channel.call("SliceService", "GetSliceInfo", {},
+                            timeout=timeout)
+    finally:
+        channel.close()
+
+
+def join_slices(seed_address: str, dial_timeout: float = 5.0,
+                max_slices: int = 64) -> JoinResult:
+    """Walk the DCN peer graph from *seed_address* (any member slice's
+    cross-boundary ``ip:port``) and build the joint group.
+
+    Breadth-first over ``dcn_peers``; addresses are the identity, so a
+    slice joined from both sides (A lists B, B lists A) is visited once.
+    """
+    seen: set[str] = set()
+    order: list[str] = []
+    infos: dict[str, dict] = {}
+    unreachable: list[str] = []
+    queue = [seed_address]
+    while queue and len(order) < max_slices:
+        addr = queue.pop(0)
+        if addr in seen:
+            continue
+        seen.add(addr)
+        try:
+            info = _fetch_info(addr, dial_timeout)
+        except Exception:  # noqa: BLE001 — degrade, don't wedge
+            log.warning("slice peer %s unreachable during join", addr)
+            unreachable.append(addr)
+            continue
+        order.append(addr)
+        infos[addr] = info
+        for peer in info.get("dcn_peers", []):
+            if peer not in seen:
+                queue.append(peer)
+    slices = []
+    for addr in order:
+        topo = infos[addr].get("topology", "")
+        if not topo:
+            log.warning("slice %s reports no topology; skipping", addr)
+            continue
+        slices.append(SliceTopology(topo))
+    return JoinResult(group=MultiSliceGroup(slices), members=order,
+                      unreachable=unreachable)

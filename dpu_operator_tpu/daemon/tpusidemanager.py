@@ -56,11 +56,14 @@ class _SliceServiceForwarder:
         local = (self.manager.node_name
                  or os.environ.get("NODE_NAME", ""))
         want = req.get("node_name", "")
-        if want and local and want != local:
+        if want and want != local:
+            # fail CLOSED: an unknown local identity (NODE_NAME unset)
+            # must not let a remote caller pick the drain target — only
+            # ever drain the node this daemon actually manages
             raise ValueError(
                 f"resize is local-node only: this daemon manages "
-                f"{local!r}, not {want!r}")
-        evicted = self.manager.resize_chips(count, local or want)
+                f"{local or '<unknown>'!r}, not {want!r}")
+        evicted = self.manager.resize_chips(count, local)
         return {"evicted": evicted}
 
     def repair_chains(self, req: dict) -> dict:
@@ -73,8 +76,22 @@ class _SliceServiceForwarder:
             {"hop": list(map(str, hop_key)), "old": list(old),
              "new": list(new)} for hop_key, old, new in repaired]}
 
+    def get_chains(self, req: dict) -> dict:
+        """Chain observability (tpuctl get-chains): every steered chain's
+        hops with degraded markers."""
+        if self.manager is None:
+            raise RuntimeError("admin plane not wired")
+        return self.manager.get_chains()
+
     def create_slice_attachment(self, req: dict) -> dict:
         return self.vsp.create_slice_attachment(req)
+
+    def get_slice_info(self, req: dict) -> dict:
+        """Multi-slice discovery over the cross-boundary plane: peers
+        (and controllers) dial this to learn the slice's topology and
+        which other slices it is joined to (daemon/slicejoin.py walks
+        the peer graph to assemble the MultiSliceGroup)."""
+        return self.vsp.get_slice_info()
 
     def delete_slice_attachment(self, req: dict) -> dict:
         self.vsp.delete_slice_attachment(req.get("name", ""))
@@ -103,9 +120,13 @@ class TpuSideManager:
         # finally-uncordon would reopen the node mid-drain
         self._resize_lock = threading.Lock()
         self.device_handler = TpuDeviceHandler(self.vsp, tpu_mode=True)
+        # newest-first chip ids from recent chip Allocates: the ici-port
+        # plugin's GetPreferredAllocation aligns port picks with them
+        self._recent_chip_allocs: list[str] = []
         self.device_plugin = DevicePlugin(
             self.device_handler, resource=v.TPU_RESOURCE_NAME,
-            path_manager=path_manager)
+            path_manager=path_manager,
+            allocation_listener=self._note_chip_allocation)
         self.ici_device_plugin: Optional[DevicePlugin] = None
         self.cni_server = CniServer(
             path_manager.cni_server_socket(),
@@ -126,6 +147,9 @@ class TpuSideManager:
         # hops: (ns, sfc, i) -> (out_id, in_id) wired between NF i and i+1
         self._chain_store: dict[tuple, dict] = {}
         self._chain_hops: dict[tuple, tuple] = {}
+        # hop keys repair re-steered off their allocated ports — surfaced
+        # on the SFC CR status as ChainDegraded and via GetChains
+        self._degraded_hops: set = set()
         # self-healing: link-state prober (chip -> [{"port","up","wired"}])
         # wired in serve() when the native agent socket is reachable
         self.link_prober = None
@@ -167,7 +191,8 @@ class TpuSideManager:
         if self.client is not None:
             self._manager = Manager(self.client)
             self._manager.add_reconciler(
-                SfcReconciler(workload_image=self.workload_image))
+                SfcReconciler(workload_image=self.workload_image,
+                              chain_status_provider=self.chain_status))
             self._manager.start()
         # self-healing chain repair: probe ICI link state through the
         # native agent (VSP spawns it next to the vendor-plugin socket —
@@ -254,6 +279,12 @@ class TpuSideManager:
                     log.info("resize_chips %d->%d: drained %s", current,
                              count, evicted)
                 self.vsp.set_num_chips(count)
+                if shrink:
+                    # push the shrunken set to the kubelet BEFORE the
+                    # finally-uncordon reopens the node: an evicted pod
+                    # rescheduling against the stale allocatable count
+                    # would be handed a chip that is about to vanish
+                    self._refresh_device_plugins()
             finally:
                 if drainer is not None:
                     # never leave the node cordoned, even if eviction or
@@ -263,6 +294,23 @@ class TpuSideManager:
                     except Exception:  # noqa: BLE001 — best-effort
                         log.exception("uncordon %s failed", node_name)
             return evicted
+
+    def _refresh_device_plugins(self):
+        """Force both device plugins to re-advertise immediately."""
+        for dp in (self.device_plugin, self.ici_device_plugin):
+            if dp is not None:
+                try:
+                    if not dp.refresh():
+                        # barrier unconfirmed (no stream / timeout): the
+                        # uncordon still proceeds — never leave a node
+                        # cordoned — but the race window is real again,
+                        # so make it diagnosable
+                        log.warning(
+                            "%s refresh unconfirmed before uncordon — "
+                            "kubelet may briefly hold a stale device set",
+                            dp.resource)
+                except Exception:  # noqa: BLE001 — best-effort barrier
+                    log.exception("device plugin refresh failed")
 
     # -- CNI network-function handlers (dpusidemanager.go:104-139) ------------
     def _unwire_quietly(self, ids: tuple, context: str):
@@ -426,6 +474,8 @@ class TpuSideManager:
                         and hop_key not in self._chain_hops):
                     ids = self._hop_ids(chain[i], chain[i + 1])
                     self._chain_hops[hop_key] = ids
+                    # a fresh wire rides its allocated ports again
+                    self._degraded_hops.discard(hop_key)
                     to_wire.append((hop_key, ids))
         for hop_key, ids in to_wire:
             try:
@@ -542,11 +592,35 @@ class TpuSideManager:
                     self._unwire_quietly(new_ids, "raced chain repair")
                     continue
                 self._chain_hops[hop_key] = new_ids
+                self._degraded_hops.add(hop_key)
             self._unwire_quietly(old_ids, "chain repair")  # ...break
             repaired.append((hop_key, old_ids, new_ids))
             log.warning("re-steered SFC hop %s: %s -> %s (link down)",
                         hop_key, old_ids, new_ids)
         return repaired
+
+    # -- chain observability --------------------------------------------------
+    def chain_status(self, namespace: str, name: str) -> list:
+        """Live hop list for one chain: {index, input, output, degraded}
+        — the data the SFC CR status and `tpuctl get-chains` surface
+        (backed by the same wire table the native agent programs)."""
+        key = (namespace, name)
+        with self._attach_lock:
+            return [{"index": hop_key[2], "input": ids[0], "output": ids[1],
+                     "degraded": hop_key in self._degraded_hops}
+                    for hop_key, ids in self._chain_hops.items()
+                    if hop_key[:2] == key]
+
+    def get_chains(self) -> dict:
+        """Every chain this daemon steers (AdminService.GetChains)."""
+        with self._attach_lock:
+            keys = sorted({hop_key[:2] for hop_key in self._chain_hops}
+                          | set(self._chain_store))
+        return {"chains": [
+            {"namespace": ns, "name": name,
+             "hops": sorted(self.chain_status(ns, name),
+                            key=lambda h: h["index"])}
+            for ns, name in keys]}
 
     def _teardown_chain(self, sandbox_id: str):
         """Unwire chain hops touching a departing sandbox."""
@@ -559,6 +633,7 @@ class TpuSideManager:
                     del chain[index]
                     for i in (index - 1, index):
                         ids = self._chain_hops.pop(key + (i,), None)
+                        self._degraded_hops.discard(key + (i,))
                         if ids:
                             to_unwire.append(ids)
                 if not chain:
@@ -660,11 +735,31 @@ class TpuSideManager:
                 log.warning("slice-attachment release failed for %s", name)
 
     # -- ICI port advertisement ----------------------------------------------
+    def _note_chip_allocation(self, ids: list):
+        """Record chip Allocates newest-first (bounded) for port affinity."""
+        with self._attach_lock:
+            merged = list(ids) + [c for c in self._recent_chip_allocs
+                                  if c not in ids]
+            self._recent_chip_allocs = merged[:32]
+
+    def _preferred_ports(self, available, must_include, size, devices):
+        from ..deviceplugin.server import preferred_ici_ports
+        with self._attach_lock:
+            recent = list(self._recent_chip_allocs)
+        return preferred_ici_ports(available, must_include, size, devices,
+                                   recent_chips=recent)
+
     def enable_ici_ports(self, topology_provider):
-        """Advertise google.com/ici-port as a second device plugin."""
+        """Advertise google.com/ici-port as a second device plugin. Port
+        health rides the native agent's link state (late-bound: the
+        prober appears when chain repair connects the agent client), and
+        preferred allocation aligns ports with recent chip Allocates."""
         self.ici_device_plugin = DevicePlugin(
-            IciPortDeviceHandler(topology_provider),
+            IciPortDeviceHandler(topology_provider,
+                                 link_prober_provider=lambda:
+                                 self.link_prober),
             resource=v.ICI_RESOURCE_NAME,
-            path_manager=self.path_manager)
+            path_manager=self.path_manager,
+            preferred_fn=self._preferred_ports)
         self.ici_device_plugin.start()
         self.ici_device_plugin.register_with_kubelet()

@@ -86,6 +86,57 @@ def _preferred_chips(available: list, must_include: list, size: int,
     return best or available[:size]
 
 
+def preferred_ici_ports(available: list, must_include: list, size: int,
+                        devices: dict, recent_chips=()) -> list:
+    """GetPreferredAllocation for the ici-port resource: align the pod's
+    port allocation with its chip allocation (VERDICT r3 #3 — nothing
+    previously coordinated the two, so a real kubelet handed out ports in
+    id order regardless of which chips the pod got).
+
+    Kubelet admits one pod at a time; when it allocates the pod's chips
+    before its ports, the chips allocated moments ago are this pod's:
+    round-robin one port per recent chip (newest allocation first) so
+    each chip attachment gets a port on its own chip — an NF pod's
+    ingress rides its first chip, egress its second. Remaining slots
+    cluster by chip index; must_include is always kept.
+
+    KNOWN LIMITATION: within one pod admission, kubelet's device manager
+    iterates resources in map order, so ports can be allocated before
+    chips — the affinity then points at the PREVIOUS pod's chips. That
+    is a degraded pick, not a broken one: previously-allocated chips are
+    attached, so their ports are wired and can carry a hop; the v1beta1
+    Allocate/GetPreferredAllocation API carries no pod identity, so
+    cross-resource affinity cannot be made exact at this seam (the
+    chain-steering CNI path tolerates any wired port)."""
+    must = [d for d in must_include if d in available]
+    if len(must) >= size:
+        return must
+
+    def chip_of(dev_id):
+        return (devices.get(dev_id) or {}).get("chip")
+
+    chosen = list(must)
+    groups = []
+    for chip_id in recent_chips:
+        ports = sorted(d for d in available
+                       if f"chip-{chip_of(d)}" == chip_id
+                       and d not in chosen)
+        if ports:
+            groups.append(ports)
+    while len(chosen) < size and any(groups):
+        for group in groups:
+            if group and len(chosen) < size:
+                chosen.append(group.pop(0))
+    for dev_id in sorted(
+            (d for d in available if d not in chosen),
+            key=lambda d: (chip_of(d) if chip_of(d) is not None
+                           else 1 << 30, d)):
+        if len(chosen) >= size:
+            break
+        chosen.append(dev_id)
+    return chosen
+
+
 def _ser(msg) -> bytes:
     return msg.SerializeToString()
 
@@ -135,16 +186,32 @@ class DevicePlugin:
 
     def __init__(self, device_handler, resource: str = v.TPU_RESOURCE_NAME,
                  path_manager: Optional[PathManager] = None,
-                 libtpu_path: str = "", poll_interval: float = POLL_INTERVAL):
+                 libtpu_path: str = "", poll_interval: float = POLL_INTERVAL,
+                 preferred_fn=None, allocation_listener=None):
         self.device_handler = device_handler
         self.resource = resource
         self.path_manager = path_manager or PathManager()
         self.libtpu_path = libtpu_path or self.path_manager.libtpu_path()
         self.poll_interval = poll_interval
+        #: override for GetPreferredAllocation's selection —
+        #: (available, must_include, size, devices) -> ids; the ici-port
+        #: plugin uses this to co-locate ports with chip allocations
+        self.preferred_fn = preferred_fn
+        #: called with the device-id list of every successful Allocate
+        #: (the chip plugin feeds the port plugin's affinity this way)
+        self.allocation_listener = allocation_listener
         self._server: Optional[grpc.Server] = None
         self._stop = threading.Event()
+        self._poke = threading.Event()
         self._devices: dict[str, dict] = {}
         self._devices_lock = threading.Lock()
+        # refresh barrier state: _refresh_gen bumps per refresh request;
+        # the stream loop records the gen its latest yielded (or
+        # unchanged) snapshot covered in _served_gen
+        self._refresh_cond = threading.Condition()
+        self._refresh_gen = 0
+        self._served_gen = 0
+        self._active_streams = 0
 
     # -- serving --------------------------------------------------------------
     @property
@@ -163,8 +230,31 @@ class DevicePlugin:
         log.info("device plugin %s serving on %s", self.resource,
                  self.socket_path)
 
+    def refresh(self, wait: float = 5.0) -> bool:
+        """Re-snapshot now, wake ListAndWatch, and WAIT until the stream
+        has served a response covering this refresh — the resize barrier:
+        a shrink must reach the kubelet before the node uncordons, or
+        rescheduled pods can be allocated a vanishing chip. Returns True
+        when the stream confirmed serving it (False: no active stream, or
+        timeout). The v1beta1 protocol carries no kubelet-side ack, so
+        kubelet PROCESSING the update stays async — this closes the
+        window to the transport, which is as far as the protocol allows."""
+        with self._refresh_cond:
+            self._refresh_gen += 1
+            want = self._refresh_gen
+            streams = self._active_streams
+        self._snapshot()
+        self._poke.set()
+        if streams == 0:
+            return False
+        with self._refresh_cond:
+            return self._refresh_cond.wait_for(
+                lambda: self._served_gen >= want or self._stop.is_set(),
+                timeout=wait) and self._served_gen >= want
+
     def stop(self):
         self._stop.set()
+        self._poke.set()
         if self._server:
             self._server.stop(0.5).wait()
             self._server = None
@@ -216,14 +306,29 @@ class DevicePlugin:
     def _list_and_watch(self, request, context):
         """Stream device lists; send only on change (deviceplugin.go:92-111)."""
         last = None
-        while not self._stop.is_set() and context.is_active():
-            devs = self._snapshot()
-            key = tuple(sorted((k, bool(d.get("healthy")))
-                               for k, d in devs.items()))
-            if key != last:
-                last = key
-                yield self._to_pb_list(devs)
-            self._stop.wait(self.poll_interval)
+        with self._refresh_cond:
+            self._active_streams += 1
+        try:
+            while not self._stop.is_set() and context.is_active():
+                with self._refresh_cond:
+                    gen = self._refresh_gen
+                devs = self._snapshot()
+                key = tuple(sorted((k, bool(d.get("healthy")))
+                                   for k, d in devs.items()))
+                if key != last:
+                    last = key
+                    yield self._to_pb_list(devs)
+                # this iteration's snapshot covers refresh gen `gen` —
+                # either yielded above or identical to what kubelet has
+                with self._refresh_cond:
+                    self._served_gen = max(self._served_gen, gen)
+                    self._refresh_cond.notify_all()
+                self._poke.wait(self.poll_interval)
+                self._poke.clear()
+        finally:
+            with self._refresh_cond:
+                self._active_streams -= 1
+                self._refresh_cond.notify_all()
 
     def _get_preferred_allocation(self, request, context):
         """Topology-aware chip selection: prefer ICI-adjacent chips so the
@@ -232,9 +337,12 @@ class DevicePlugin:
         neighbor growth by torus coords, best seed wins."""
         with self._devices_lock:
             known = dict(self._devices)
+        if not known:
+            known = self._snapshot()
+        pick_fn = self.preferred_fn or _preferred_chips
         responses = []
         for creq in request.container_requests:
-            picked = _preferred_chips(
+            picked = pick_fn(
                 list(creq.available_deviceIDs),
                 list(creq.must_include_deviceIDs),
                 creq.allocation_size, known)
@@ -288,4 +396,9 @@ class DevicePlugin:
                     host_path=self.libtpu_path, read_only=True))
             responses.append(pb.ContainerAllocateResponse(
                 envs=envs, mounts=mounts, devices=devices))
+            if self.allocation_listener is not None:
+                try:
+                    self.allocation_listener(ids)
+                except Exception:  # noqa: BLE001 — affinity is best-effort
+                    log.exception("allocation listener failed")
         return pb.AllocateResponse(container_responses=responses)

@@ -52,6 +52,11 @@ class Manager:
         self._idle = threading.Event()
         self._idle.set()
         self._inflight_timers = 0
+        #: (id(rec), req) keys with a periodic-resync timer pending —
+        #: dedups requeue_after so watch-event storms (including the
+        #: MODIFIED events a reconciler's own status writes emit) cannot
+        #: stack N parallel resync loops for the same object
+        self._resync_pending: set = set()
 
     def add_reconciler(self, rec: Reconciler):
         self._reconcilers.append(rec)
@@ -96,16 +101,42 @@ class Manager:
     RETRY_MAX = 60.0
 
     def _schedule_retry(self, delay: float, rec, req,
-                        timers: dict) -> None:
+                        timers: dict, counts_as_pending: bool = True) -> None:
+        """*counts_as_pending*=False for periodic resyncs
+        (ReconcileResult.requeue_after): a steady-state resync loop must
+        not hold wait_idle hostage — idle means the queue is drained, not
+        that no reconciler ever wants to look again. Error retries DO
+        count: work that failed is still pending."""
+        fkey = (id(rec), req)
         with self._lock:
-            self._inflight_timers += 1
+            if not counts_as_pending:
+                # one pending resync per (reconciler, request): every
+                # reconcile pass reschedules, so a second timer would
+                # fork a permanent parallel loop
+                if fkey in self._resync_pending:
+                    return
+                self._resync_pending.add(fkey)
+            else:
+                self._inflight_timers += 1
 
         key = object()
 
         def fire():
+            if not counts_as_pending:
+                # drop the resync marker BEFORE enqueueing: if the worker
+                # drains the new item and reschedules before we dropped
+                # it, the next timer would be suppressed and the resync
+                # loop would die (the marker is invisible to wait_idle,
+                # so this order costs nothing there)
+                with self._lock:
+                    self._resync_pending.discard(fkey)
+            # for error retries: enqueue BEFORE decrementing, else
+            # wait_idle can observe a nothing-pending window while the
+            # retry work is still about to be queued
             self._enqueue(rec, req)
-            with self._lock:
-                self._inflight_timers -= 1
+            if counts_as_pending:
+                with self._lock:
+                    self._inflight_timers -= 1
             timers.pop(key, None)
 
         t = threading.Timer(delay, fire)
@@ -143,7 +174,8 @@ class Manager:
                 self._schedule_retry(delay, rec, req, timers)
                 result = ReconcileResult()
             if result.requeue_after:
-                self._schedule_retry(result.requeue_after, rec, req, timers)
+                self._schedule_retry(result.requeue_after, rec, req, timers,
+                                     counts_as_pending=False)
             with self._lock:
                 if (not self._pending and self._queue.empty()
                         and self._inflight_timers == 0):

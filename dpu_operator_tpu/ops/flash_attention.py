@@ -16,6 +16,7 @@ the training path workloads/model.py uses for cfg.attention="flash".
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +24,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
+#: the online softmax runs in the exp2 domain (scores pre-scaled by
+#: log2(e)): the TPU VPU's native transcendental is 2^x, so exp(x) =
+#: 2^(x*log2e) saves a multiply per element on the hot path; the saved
+#: logsumexp converts back to natural-log so the backward is unchanged
+_LOG2E = math.log2(math.e)
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_k: int, causal: bool,
@@ -40,21 +46,22 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_k: int, causal: bool,
     m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
     acc = jnp.zeros((block_q, d), jnp.float32)
+    scale2 = sm_scale * _LOG2E  # exp2-domain softmax (see _LOG2E)
 
     def body(ki, carry):
         m, l, acc = carry
         k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
         v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
         scores = jnp.dot(q, k_blk.T,
-                         preferred_element_type=jnp.float32) * sm_scale
+                         preferred_element_type=jnp.float32) * scale2
         if causal:
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             scores = jnp.where(qpos >= kpos, scores, _NEG_INF)
         blk_max = jnp.max(scores, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
-        p = jnp.exp(scores - new_m)
-        scale = jnp.exp(m - new_m)
+        p = jnp.exp2(scores - new_m)
+        scale = jnp.exp2(m - new_m)
         new_l = l * scale + jnp.sum(p, axis=-1, keepdims=True)
         new_acc = acc * scale + jnp.dot(
             p.astype(v_blk.dtype), v_blk,
@@ -71,9 +78,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_k: int, causal: bool,
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m, l, acc))
     o_ref[:] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
     if refs:  # training path: per-row logsumexp residual for the backward
+        # stored in NATURAL log domain: lse = (m2 + log2(l)) / log2(e),
+        # so the backward's exp(scores*sm_scale - lse) is unchanged
         lse_ref = refs[0]
-        lse_ref[:] = (m + jnp.log(jnp.maximum(l, 1e-20))).reshape(
-            lse_ref.shape)
+        lse_ref[:] = ((m + jnp.log2(jnp.maximum(l, 1e-20)))
+                      / _LOG2E).reshape(lse_ref.shape)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -160,8 +169,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[:]
     do = do_ref[:]
-    lse = lse_ref[:].reshape(block_q, 1)
+    # exp2-domain P recompute: p = 2^(scores*sm_scale*log2e - lse*log2e)
+    lse = lse_ref[:].reshape(block_q, 1) * _LOG2E
     delta = delta_ref[:].reshape(block_q, 1)
+    scale2 = sm_scale * _LOG2E
     qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
     dq = jnp.zeros((block_q, d), jnp.float32)
 
@@ -169,12 +180,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
         v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
         scores = jnp.dot(q, k_blk.T,
-                         preferred_element_type=jnp.float32) * sm_scale
+                         preferred_element_type=jnp.float32) * scale2
         if causal:
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             scores = jnp.where(qpos >= kpos, scores, _NEG_INF)
-        p = jnp.exp(scores - lse)
+        p = jnp.exp2(scores - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * sm_scale).astype(k_blk.dtype)
         return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
@@ -202,20 +213,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
     dk = jnp.zeros((block_k, d), jnp.float32)
     dv = jnp.zeros((block_k, d), jnp.float32)
+    scale2 = sm_scale * _LOG2E  # exp2-domain P recompute (see _LOG2E)
 
     def body(qi, carry):
         dk, dv = carry
         q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
         do_blk = do_ref[pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[pl.ds(qi * block_q, block_q)].reshape(block_q, 1)
+        lse = lse_ref[pl.ds(qi * block_q, block_q)].reshape(
+            block_q, 1) * _LOG2E
         delta = delta_ref[pl.ds(qi * block_q, block_q)].reshape(block_q, 1)
         scores = jnp.dot(q_blk, k_blk.T,
-                         preferred_element_type=jnp.float32) * sm_scale
+                         preferred_element_type=jnp.float32) * scale2
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, 1), 0)
             scores = jnp.where(qpos >= kpos, scores, _NEG_INF)
-        p = jnp.exp(scores - lse)
+        p = jnp.exp2(scores - lse)
         pb = p.astype(do_blk.dtype)
         # dv += P^T dO ; dk += dS^T Q — contract over the q dimension via
         # dot_general instead of materializing transposes

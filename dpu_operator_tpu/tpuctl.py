@@ -59,6 +59,12 @@ def _vsp_cmds(sub):
     sub.add_parser("repair-chains",
                    help="daemon AdminService.RepairChains: re-steer SFC "
                         "hops whose ICI port link is down")
+    sub.add_parser("get-chains",
+                   help="daemon AdminService.GetChains: steered SFC "
+                        "chains, hop endpoints, degraded markers")
+    sub.add_parser("slice-group",
+                   help="walk DCN peers from --daemon-addr and print the "
+                        "joint multi-slice group")
     p = sub.add_parser("create-attachment")
     p.add_argument("name")
     p.add_argument("--chip", type=int, default=None)
@@ -129,6 +135,28 @@ def run(args) -> dict:
             return channel.call("AdminService", "RepairChains", {})
         finally:
             channel.close()
+
+    if args.cmd == "get-chains":
+        if not args.daemon_addr:
+            raise SystemExit("get-chains needs --daemon-addr")
+        channel = VspChannel(args.daemon_addr)
+        try:
+            return channel.call("AdminService", "GetChains", {})
+        finally:
+            channel.close()
+
+    if args.cmd == "slice-group":
+        if not args.daemon_addr:
+            raise SystemExit("slice-group needs --daemon-addr")
+        from .daemon.slicejoin import join_slices
+        result = join_slices(args.daemon_addr)
+        return {"members": result.members,
+                "unreachable": result.unreachable,
+                "degraded": result.degraded,
+                "numChips": result.group.num_chips,
+                "slices": [s.topology for s in result.group.slices],
+                "dcnAllreduceAlgbwGbps":
+                    result.group.dcn_allreduce_algbw_gbps()}
 
     if args.cmd == "resize-chips":
         if not args.daemon_addr:

@@ -266,6 +266,17 @@ class GoogleTpuVsp:
                 self.dcn_peers.discard(peer)
         return {}
 
+    def get_slice_info(self, req: dict) -> dict:
+        """Multi-slice discovery: this slice's topology + the DCN peers
+        its attachments joined (api.proto SliceInfo). Peers' own info is
+        fetched by dialing their cross-boundary addresses — see
+        daemon/slicejoin.py."""
+        return {
+            "topology": self.topology.topology if self.topology else "",
+            "num_chips": self.topology.num_chips if self.topology else 0,
+            "dcn_peers": sorted(self.dcn_peers),
+        }
+
     # -- NetworkFunctionService ----------------------------------------------
     def create_network_function(self, req: dict) -> dict:
         self.dataplane.wire_network_function(

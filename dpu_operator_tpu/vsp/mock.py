@@ -70,6 +70,14 @@ class MockTpuVsp:
             self.slice_attachments.pop(req.get("name", ""), None)
         return {}
 
+    def get_slice_info(self, req: dict) -> dict:
+        with self._lock:
+            peers = sorted({a.get("peer_address")
+                            for a in self.slice_attachments.values()
+                            if a.get("peer_address")})
+        return {"topology": self._slice.topology,
+                "num_chips": self._slice.num_chips, "dcn_peers": peers}
+
     # -- NetworkFunctionService ----------------------------------------------
     def create_network_function(self, req: dict) -> dict:
         with self._lock:

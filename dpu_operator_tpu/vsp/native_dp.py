@@ -49,7 +49,7 @@ _DETACH_REQ = struct.Struct("<I")
 _WIRE_REQ = struct.Struct("<64s64s")
 _LINK_REQ = struct.Struct("<I")
 _SET_LINK_REQ = struct.Struct("<I4sB3x")
-_PORT_STATE = struct.Struct("<4sBBH")
+_PORT_STATE = struct.Struct("<4sBBBx")
 _LINK_RESP_HEAD = struct.Struct("<iI")
 _WIRE_LIST_HEAD = struct.Struct("<iI")
 
@@ -172,11 +172,11 @@ class AgentClient:
         ports = []
         off = _LINK_RESP_HEAD.size
         for _ in range(min(nports, MAX_PORTS)):
-            name, up, wired, _pad = _PORT_STATE.unpack(
+            name, up, wired, fault = _PORT_STATE.unpack(
                 data[off:off + _PORT_STATE.size])
             off += _PORT_STATE.size
             ports.append({"port": _cstr(name), "up": bool(up),
-                          "wired": bool(wired)})
+                          "wired": bool(wired), "fault": bool(fault)})
         return ports
 
     def list_wires(self) -> list[tuple[str, str]]:

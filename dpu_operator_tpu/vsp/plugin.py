@@ -114,6 +114,9 @@ class GrpcPlugin:
     def delete_slice_attachment(self, name: str) -> None:
         self._call("SliceService", "DeleteSliceAttachment", {"name": name})
 
+    def get_slice_info(self) -> dict:
+        return self._call("SliceService", "GetSliceInfo", {})
+
     def create_network_function(self, input_id: str, output_id: str) -> None:
         self._call("NetworkFunctionService", "CreateNetworkFunction",
                    {"input": input_id, "output": output_id})

@@ -52,12 +52,14 @@ class VspServer:
         ("DeviceService", "SetNumChips"): "set_num_chips",
         ("SliceService", "CreateSliceAttachment"): "create_slice_attachment",
         ("SliceService", "DeleteSliceAttachment"): "delete_slice_attachment",
+        ("SliceService", "GetSliceInfo"): "get_slice_info",
         ("NetworkFunctionService", "CreateNetworkFunction"):
             "create_network_function",
         ("NetworkFunctionService", "DeleteNetworkFunction"):
             "delete_network_function",
         ("AdminService", "ResizeChips"): "resize_chips",
         ("AdminService", "RepairChains"): "repair_chains",
+        ("AdminService", "GetChains"): "get_chains",
     }
 
     def __init__(self, impl, socket_path: Optional[str] = None,

@@ -177,12 +177,17 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
 
 def measure_decode(cfg: TransformerConfig, batch: int = 8,
                    prompt_len: int = 16, steps: int = 64,
-                   iters: int = 4) -> dict:
+                   iters: int = 4, best_of: int = 3) -> dict:
     """Serving throughput: steady-state decode tokens/s (marginal over two
     generation lengths so prefill + dispatch costs cancel — the same
-    slope methodology as perf.marginal_time)."""
+    slope methodology as perf.marginal_time; best-of for the tunnel's
+    contention phases, perf.best_marginal_time).
+
+    Also reports the HBM roofline fraction: a decode step must stream
+    every weight byte (bf16) plus the batch's KV cache from HBM, so
+    ``min_ms = (2N + kv_bytes) / HBM_BW`` bounds ms/token from below."""
     from .model import init_params
-    from .perf import marginal_time
+    from .perf import best_marginal_time, hbm_bandwidth_gbps, param_count
 
     params = init_params(jax.random.key(0), cfg)
     prompt = jnp.ones((batch, prompt_len), jnp.int32)
@@ -193,8 +198,13 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
             float(out[0, -1])
         return go
 
-    per_step = marginal_time(make_chained, n_short=max(4, steps // 4),
-                             n_long=steps, repeats=iters)
+    per_step = best_marginal_time(make_chained, n_short=max(4, steps // 4),
+                                  n_long=steps, repeats=iters,
+                                  best_of=best_of)
+    weight_bytes = 2.0 * param_count(cfg)
+    kv_bytes = 2.0 * cfg.n_layers * cfg.max_seq * cfg.d_model * 2.0 * batch
+    min_s = (weight_bytes + kv_bytes) / hbm_bandwidth_gbps() / 1e9
     return {"batch": batch, "steps": steps,
             "ms_per_token": per_step * 1e3,
-            "tokens_per_s": batch / per_step}
+            "tokens_per_s": batch / per_step,
+            "hbm_frac": min_s / per_step}

@@ -34,6 +34,28 @@ PEAK_TFLOPS_BF16 = {
 }
 _CPU_FALLBACK_TFLOPS = 0.2  # only so CPU CI runs produce finite ratios
 
+#: chip kind -> HBM bandwidth GB/s (public spec-sheet numbers); feeds the
+#: decode roofline. Conservative CPU fallback mirrors peak_tflops().
+HBM_GBPS = {
+    "tpu v4": 1228.0,
+    "tpu v5 lite": 819.0,   # v5e
+    "tpu v5e": 819.0,
+    "tpu v5": 2765.0,       # v5p
+    "tpu v5p": 2765.0,
+    "tpu v6 lite": 1640.0,  # v6e / Trillium
+    "tpu v6e": 1640.0,
+}
+_CPU_FALLBACK_HBM_GBPS = 20.0
+
+
+def hbm_bandwidth_gbps(device=None) -> float:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in HBM_GBPS.items():
+        if kind.startswith(key):
+            return val
+    return _CPU_FALLBACK_HBM_GBPS
+
 
 def peak_tflops(device=None) -> float:
     device = device or jax.devices()[0]
@@ -130,6 +152,22 @@ def marginal_time(make_chained, n_short: int = 10, n_long: int = 50,
     return max((min(longs) - min(shorts)) / (n_long - n_short), 1e-9)
 
 
+def best_marginal_time(make_chained, n_short: int = 10, n_long: int = 50,
+                       repeats: int = 5, best_of: int = 3) -> float:
+    """Min of *best_of* independent marginal_time measurements.
+
+    The tunnel is time-shared in PHASES longer than one marginal_time
+    call: a contended phase steals chip time *proportionally to chain
+    length*, inflating the slope itself (not just the fixed offset the
+    slope method cancels). Round 3 published flash 0.427 ms from one
+    such phase while the same binary measures 0.25-0.38 ms across
+    repeats — the spread is contention, not the kernel. The minimum
+    over several spaced measurements is the demonstrated hardware
+    capability and is what we report; BASELINE.md records the spread."""
+    return min(marginal_time(make_chained, n_short=n_short, n_long=n_long,
+                             repeats=repeats) for _ in range(max(1, best_of)))
+
+
 @dataclass
 class TrainPerf:
     step_ms: float
@@ -142,7 +180,7 @@ class TrainPerf:
 
 
 def measure_train(cfg, mesh, batch: int = 8, steps: int = 50,
-                  warmup: int = 0) -> TrainPerf:
+                  warmup: int = 0, best_of: int = 3) -> TrainPerf:
     """Steady-state train-step timing via marginal_time: the step is
     scanned on-device (donated carry, reused batch) so the tunnel's fixed
     dispatch cost cancels out of the reported per-step number. (Round 1
@@ -177,7 +215,8 @@ def measure_train(cfg, mesh, batch: int = 8, steps: int = 50,
         return go
 
     steps_short = max(2, steps // 5)
-    dt = marginal_time(make_chained, n_short=steps_short, n_long=steps)
+    dt = best_marginal_time(make_chained, n_short=steps_short, n_long=steps,
+                            best_of=best_of)
     seq = cfg.max_seq
     flops = train_step_flops(cfg, batch, seq)
     peak = peak_tflops()
@@ -205,7 +244,8 @@ def measure_flash_attention(b: int = 4, s: int = 2048, h: int = 8,
                             d: int = 128, causal: bool = True,
                             iters: int = 400, warmup: int = 0,
                             block_q: int = 512,
-                            block_k: int = 512) -> FlashPerf:
+                            block_k: int = 512,
+                            best_of: int = 3) -> FlashPerf:
     """Pallas flash-attention forward with honest causal-FLOP accounting
     (round 1 reported 194 "effective" TFLOPS by counting full S^2 FLOPs
     for a causal kernel — the causal number is ~half) and tunnel-proof
@@ -233,8 +273,8 @@ def measure_flash_attention(b: int = 4, s: int = 2048, h: int = 8,
             float(jnp.sum(run_n(q, k, v, n)))
         return go
 
-    dt = marginal_time(make_chained, n_short=max(2, iters // 5),
-                       n_long=iters)
+    dt = best_marginal_time(make_chained, n_short=max(2, iters // 5),
+                            n_long=iters, best_of=best_of)
     flops = attention_flops(b, s, h, d, causal)
     peak = peak_tflops()
     tf = flops / dt / 1e12
@@ -243,15 +283,19 @@ def measure_flash_attention(b: int = 4, s: int = 2048, h: int = 8,
 
 
 def flagship_config():
-    """The config bench.py times on the real chip: GPT-2-small-shaped so
-    the step is compute-bound, not dispatch- or vocab-bound; attention is
-    the Pallas flash kernel (fwd+bwd) — the (S,S)-materializing standard
-    path is the comparison baseline, not the flagship."""
+    """The config bench.py times on the real chip: ~390M params
+    (d_model 1536, 12 layers, d_head 128) — VERDICT r3 #1: the round-3
+    111M/d768 flagship underfed the v5e MXU and pinned MFU at ~0.50;
+    d_model 1536 matmuls are MXU-efficient and the attention fraction
+    drops. Attention is the Pallas flash kernel (fwd+bwd) — the
+    (S,S)-materializing standard path is the comparison baseline, not
+    the flagship."""
     from .model import TransformerConfig
     return TransformerConfig(
-        vocab=32768, d_model=768, n_heads=12, n_layers=12, d_ff=3072,
+        vocab=32768, d_model=1536, n_heads=12, n_layers=12, d_ff=6144,
         max_seq=1024, remat=False, attention="flash")
 
 
-FLAGSHIP_BATCH = 16  # B16 S1024: round-3 measured MFU on one v5e chip is
-# recorded in BASELINE.md; B32 OOMs without remat
+FLAGSHIP_BATCH = 8  # round-4 ladder on one v5e chip (BASELINE.md): B8
+# 0.716 MFU > B16 0.702 > B24 0.649 — activation pressure past B8 costs
+# more than the larger batch recovers at 390M params

@@ -1,0 +1,92 @@
+"""Flash-kernel experiment bench: measure fwd/bwd variants on the real chip.
+
+Usage: python hack/flash_lab.py [fwd|bwd|step]
+Not part of the test suite — a measurement harness for kernel tuning
+(results land in BASELINE.md)."""
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from dpu_operator_tpu.workloads.perf import (attention_flops, marginal_time,
+                                             peak_tflops)
+
+
+def measure_fwd(fn, b=4, s=2048, h=8, d=128, iters=400, causal=True):
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+               for kk in keys)
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def run_n(q, k, v, n):
+        def body(qc, _):
+            return fn(qc, k, v), None
+        out, _ = jax.lax.scan(body, q, None, length=n)
+        return out
+
+    def make_chained(n):
+        def go():
+            float(jnp.sum(run_n(q, k, v, n)))
+        return go
+
+    dt = marginal_time(make_chained, n_short=max(2, iters // 5), n_long=iters)
+    tf = attention_flops(b, s, h, d, causal) / dt / 1e12
+    return dt * 1e3, tf, tf / peak_tflops()
+
+
+def measure_bwd(fn, b=4, s=2048, h=8, d=128, iters=100, causal=True):
+    """fwd+bwd of sum(attn) — FLOPs ≈ 3.5x fwd for causal (fwd 1x, bwd 2.5x)."""
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+               for kk in keys)
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def run_n(q, k, v, n):
+        def body(qc, _):
+            dq, dk, dv = grad(qc, k, v)
+            return qc + dq.astype(qc.dtype) * 0, dk[0, 0, 0, 0]
+        out, dks = jax.lax.scan(body, q, None, length=n)
+        return out, dks
+
+    def make_chained(n):
+        def go():
+            out, dks = run_n(q, k, v, n)
+            float(jnp.sum(out) + jnp.sum(dks))
+        return go
+
+    dt = marginal_time(make_chained, n_short=max(2, iters // 5), n_long=iters)
+    flops = attention_flops(b, s, h, d, causal) * 3.5
+    tf = flops / dt / 1e12
+    return dt * 1e3, tf, tf / peak_tflops()
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "fwd"
+    import importlib
+    fa = importlib.import_module("dpu_operator_tpu.ops.flash_attention")
+    blocks = [(512, 512), (512, 1024), (1024, 512), (256, 512)]
+    if mode == "fwd":
+        for bq, bk in blocks:
+            fn = functools.partial(fa.flash_attention, causal=True,
+                                   block_q=bq, block_k=bk)
+            ms, tf, frac = measure_fwd(fn)
+            print(f"fwd {bq}x{bk}: {ms:.3f} ms  {tf:.1f} TF  "
+                  f"{frac:.4f} of peak")
+    elif mode == "bwd":
+        for bq, bk in blocks[:2]:
+            fn = functools.partial(fa.flash_attention_vjp, True, bq, bk)
+
+            def wrapped(q, k, v, _fn=fa.flash_attention_vjp, bq=bq, bk=bk):
+                return _fn(q, k, v, True, bq, bk)
+            ms, tf, frac = measure_bwd(wrapped)
+            print(f"fwd+bwd {bq}x{bk}: {ms:.3f} ms  {tf:.1f} TF  "
+                  f"{frac:.4f} of peak")

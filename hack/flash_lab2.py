@@ -1,0 +1,122 @@
+"""Variant kernels for the flash fwd: two-phase causal loop + exp2.
+
+Measures correctness (vs current kernel) and speed on the real chip."""
+
+import functools
+import math
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from hack.flash_lab import measure_fwd
+
+_NEG_INF = -1e30
+_LOG2E = math.log2(math.e)
+
+
+def _kernel_v2(q_ref, k_ref, v_ref, o_ref, *refs, block_k: int, causal: bool,
+               sm_scale: float):
+    """Two-phase causal walk: fully-unmasked KV blocks skip the iota+mask;
+    only diagonal-crossing blocks pay for masking. exp2 instead of exp."""
+    block_q, d = q_ref.shape
+    s = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[:]
+
+    m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    scale2 = sm_scale * _LOG2E
+
+    def body(ki, carry, masked):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
+        scores = jnp.dot(q, k_blk.T,
+                         preferred_element_type=jnp.float32) * scale2
+        if masked:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            scores = jnp.where(qpos >= kpos, scores, _NEG_INF)
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp2(scores - new_m)
+        scale = jnp.exp2(m - new_m)
+        new_l = l * scale + jnp.sum(p, axis=-1, keepdims=True)
+        new_acc = acc * scale + jnp.dot(
+            p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        return new_m, new_l, new_acc
+
+    nk = s // block_k
+    if causal:
+        n_full = (qi * block_q) // block_k
+        last_row = (qi + 1) * block_q
+        nk_eff = jnp.clip((last_row + block_k - 1) // block_k, 1, nk)
+        carry = jax.lax.fori_loop(
+            0, n_full, functools.partial(body, masked=False), (m, l, acc))
+        m, l, acc = jax.lax.fori_loop(
+            n_full, nk_eff, functools.partial(body, masked=True), carry)
+    else:
+        m, l, acc = jax.lax.fori_loop(
+            0, nk, functools.partial(body, masked=False), (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    if refs:
+        lse_ref = refs[0]
+        lse_ref[:] = ((m + jnp.log2(jnp.maximum(l, 1e-20))) / _LOG2E).reshape(
+            lse_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_v2(q, k, v, causal=True, block_q=512, block_k=512):
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    sm_scale = 1.0 / np.sqrt(d)
+
+    def reshaped(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qr, kr, vr = reshaped(q), reshaped(k), reshaped(v)
+    kernel = functools.partial(_kernel_v2, block_k=block_k, causal=causal,
+                               sm_scale=sm_scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=False,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+if __name__ == "__main__":
+    import importlib
+    fa = importlib.import_module("dpu_operator_tpu.ops.flash_attention")
+    keys = jax.random.split(jax.random.key(1), 3)
+    q, k, v = (jax.random.normal(kk, (2, 1024, 4, 128), jnp.bfloat16)
+               for kk in keys)
+    ref = fa.flash_attention(q, k, v, causal=True)
+    got = flash_v2(q, k, v, causal=True)
+    err = float(jnp.max(jnp.abs(ref.astype(jnp.float32)
+                                - got.astype(jnp.float32))))
+    print("max abs diff v2 vs current:", err)
+    for bq, bk in [(512, 512), (256, 512), (512, 256), (1024, 1024)]:
+        fn = functools.partial(flash_v2, causal=True, block_q=bq, block_k=bk)
+        ms, tf, frac = measure_fwd(fn)
+        print(f"v2 fwd {bq}x{bk}: {ms:.3f} ms  {tf:.1f} TF  "
+              f"{frac:.4f} of peak")

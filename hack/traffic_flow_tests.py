@@ -85,9 +85,46 @@ def main(argv=None):
                 results.append(row)
                 print(json.dumps(row))
 
+    # multi-slice: the hierarchical DCN schedule over a 2-slice joint
+    # group — measured both ways, plus the compiled-schedule byte model
+    # (DCN carries 1/n_ici the flat bytes; tests/test_multislice_e2e.py
+    # proves the same ratio on the compiled HLO of a wire-joined group)
+    multislice = []
+    if n_devices >= 4 and n_devices % 2 == 0:
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from dpu_operator_tpu.workloads.multislice import (
+            dcn_bytes_per_host, flat_allreduce, hierarchical_allreduce,
+            make_multislice_mesh)
+        mesh = make_multislice_mesh(2, devices=jax.devices()[:n_devices])
+        n_ici = mesh.shape["model"]
+        n = int(args.mbytes * 1e6 / 4)
+        x = jnp.ones((max(n, 4),), jnp.float32)
+        payload = x.size * 4
+        for name, fn in (("hierarchical", hierarchical_allreduce(mesh)),
+                         ("flat", flat_allreduce(mesh))):
+            fn(x).block_until_ready()  # compile
+            t0 = _time.perf_counter()
+            for _ in range(args.iters):
+                out = fn(x)
+            out.block_until_ready()
+            dt = (_time.perf_counter() - t0) / args.iters
+            multislice.append({
+                "impl": f"multislice-{name}",
+                "n_slices": 2, "n_ici": n_ici,
+                "sec_per_iter": round(dt, 6),
+                "algbw_gbps": round(payload / dt / 1e9, 3),
+                "dcn_bytes_per_host": dcn_bytes_per_host(
+                    payload, n_ici, 2, hierarchical=(name == "hierarchical")),
+            })
+            print(json.dumps(multislice[-1]))
+
     report = {"n_devices": n_devices,
               "platform": jax.devices()[0].platform,
-              "results": results}
+              "results": results,
+              "multislice": multislice}
     with open(args.report, "w") as f:
         json.dump(report, f, indent=2)
     print(f"wrote {args.report} ({len(results)} rows)", file=sys.stderr)

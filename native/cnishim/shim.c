@@ -215,11 +215,15 @@ int main(void) {
     struct buf conf = {0};
     char tmp[8192];
     ssize_t n;
-    while ((n = read(STDIN_FILENO, tmp, sizeof tmp)) > 0)
+    while ((n = read(STDIN_FILENO, tmp, sizeof tmp)) > 0) {
+        /* bound the heap BEFORE buffering: an endless stdin stream must
+         * be rejected at the limit, not after it has been swallowed */
+        if (conf.len + (size_t)n > MAX_BODY)
+            return die_cni("netconf too large");
         if (buf_put(&conf, tmp, (size_t)n)) return die_cni("out of memory");
+    }
     if (n < 0) return die_cni("reading stdin failed");
     if (conf.len == 0) buf_str(&conf, "{}");
-    if (conf.len > MAX_BODY) return die_cni("netconf too large");
 
     /* request body */
     struct buf body = {0};

@@ -242,6 +242,10 @@ bool Handle(Agent& agent, int fd, const Header& h,
         ps.wired = chip.attached && chip.wired_ports.count(p) ? 1 : 0;
         // link trains when wired, unless fault-injected down
         ps.up = (ps.wired && agent.db.LinkUp(req.chip, p)) ? 1 : 0;
+        // fault is the raw injected state, reported whether or not the
+        // port is wired — an unwired-but-dark port must leave kubelet's
+        // allocatable set before an SFC pod can be handed it
+        ps.fault = agent.db.LinkUp(req.chip, p) ? 0 : 1;
       }
       return SendResp(fd, h.type, h.seq, &resp, sizeof(resp));
     }

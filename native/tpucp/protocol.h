@@ -110,7 +110,9 @@ struct PortState {
   char port[4];
   uint8_t up;      // attached → links trained
   uint8_t wired;
-  uint16_t pad;
+  uint8_t fault;   // fault-injected dark, independent of wiring — the
+                   // device plugin excludes faulted ports from allocatable
+  uint8_t pad;
 };
 
 struct LinkStateResp {

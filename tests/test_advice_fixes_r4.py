@@ -1,0 +1,244 @@
+"""Regression tests for round-3 ADVICE findings: the admin-plane resize
+guard fails closed on an unknown local node, the daemon forwards its
+node identity (and workload image) into the TPU-side manager, a shrink
+pushes the shrunken device set to the kubelet before uncordoning, and
+the static CNI shim bounds stdin buffering at MAX_BODY inside the read
+loop (not after swallowing the stream)."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+from dpu_operator_tpu.daemon import Daemon, TpuSideManager
+from dpu_operator_tpu.daemon.tpusidemanager import _SliceServiceForwarder
+from dpu_operator_tpu.images import DummyImageManager
+from dpu_operator_tpu.platform import FakePlatform
+from dpu_operator_tpu.utils.path_manager import PathManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHIM_BIN = os.path.join(REPO, "native", "build", "tpu-cni")
+
+
+class _RecordingManager:
+    """Stub with the forwarder-facing surface of TpuSideManager."""
+
+    def __init__(self, node_name=""):
+        self.node_name = node_name
+        self.calls = []
+
+    def resize_chips(self, count, node_name=""):
+        self.calls.append((count, node_name))
+        return []
+
+
+def test_resize_guard_fails_closed_without_local_identity(monkeypatch):
+    """ADVICE r3 #1 (medium): with NODE_NAME unset and no configured node
+    name, a request naming ANY node must be rejected — previously the
+    empty-local case fell through and drained the caller's target."""
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    mgr = _RecordingManager(node_name="")
+    fwd = _SliceServiceForwarder(vsp=None, manager=mgr)
+    with pytest.raises(ValueError, match="local-node only"):
+        fwd.resize_chips({"count": 2, "node_name": "victim-node"})
+    assert mgr.calls == []
+
+
+def test_resize_guard_never_forwards_caller_node(monkeypatch):
+    """Even on a match, only the daemon's own identity reaches
+    resize_chips — the caller-supplied string is never trusted."""
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    mgr = _RecordingManager(node_name="tpu-vm-7")
+    fwd = _SliceServiceForwarder(vsp=None, manager=mgr)
+    fwd.resize_chips({"count": 2, "node_name": "tpu-vm-7"})
+    # and with no node named at all, local is still what lands
+    fwd.resize_chips({"count": 3})
+    assert mgr.calls == [(2, "tpu-vm-7"), (3, "tpu-vm-7")]
+
+
+def test_resize_guard_rejects_foreign_node(monkeypatch):
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    mgr = _RecordingManager(node_name="tpu-vm-7")
+    fwd = _SliceServiceForwarder(vsp=None, manager=mgr)
+    with pytest.raises(ValueError, match="local-node only"):
+        fwd.resize_chips({"count": 2, "node_name": "other-node"})
+    assert mgr.calls == []
+
+
+def test_daemon_forwards_node_name_and_workload_image(short_tmp,
+                                                      monkeypatch):
+    """ADVICE r3 #2 (medium): the Daemon's configured node_name (single
+    source of truth) must reach the TpuSideManager — the env-var
+    fallback alone silently loses drain-on-shrink when NODE_NAME is
+    unset in the manager's environment."""
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    daemon = Daemon(FakePlatform(accel=["/dev/accel0"]),
+                    path_manager=PathManager(short_tmp),
+                    image_manager=DummyImageManager(),
+                    node_name="tpu-vm-3",
+                    vsp_plugin_factory=lambda det: object())
+    detection = daemon.detect_once()
+    assert detection is not None and detection.tpu_mode
+    mgr = daemon._create_manager(detection)
+    assert isinstance(mgr, TpuSideManager)
+    assert mgr.node_name == "tpu-vm-3"
+    assert mgr.workload_image == "TpuWorkloadImage-mock-image"
+
+
+def test_daemon_tolerates_missing_workload_image(short_tmp, monkeypatch):
+    """Dev/standalone daemons without the image env still come up; SFC
+    NFs must then name their image explicitly."""
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    monkeypatch.delenv("TPU_WORKLOAD_IMAGE", raising=False)
+    from dpu_operator_tpu.images import EnvImageManager
+    daemon = Daemon(FakePlatform(accel=["/dev/accel0"]),
+                    path_manager=PathManager(short_tmp),
+                    image_manager=EnvImageManager(),
+                    node_name="tpu-vm-3",
+                    vsp_plugin_factory=lambda det: object())
+    mgr = daemon._create_manager(daemon.detect_once())
+    assert mgr.workload_image == ""
+    assert mgr.node_name == "tpu-vm-3"
+
+
+def test_shrink_refreshes_device_plugins_before_uncordon(short_tmp,
+                                                         monkeypatch):
+    """ADVICE r3 #3 (low): after SetNumChips on a shrink, the kubelet
+    must see the shrunken set BEFORE the finally-uncordon reopens the
+    node — otherwise rescheduled pods can be allocated a vanishing
+    chip. Asserted by call ordering."""
+    events = []
+
+    class _Vsp:
+        def set_num_chips(self, n):
+            events.append(("set_num_chips", n))
+
+        def get_devices(self):
+            return {f"chip-{i}": {"healthy": True} for i in range(4)}
+
+        def close(self):
+            pass
+
+    class _Drainer:
+        def __init__(self, client):
+            pass
+
+        def drain(self, node):
+            events.append(("drain", node))
+            return ["victim-pod"]
+
+        def uncordon(self, node):
+            events.append(("uncordon", node))
+
+    import dpu_operator_tpu.utils.drain as drain_mod
+    monkeypatch.setattr(drain_mod, "Drainer", _Drainer)
+    mgr = TpuSideManager(_Vsp(), PathManager(short_tmp),
+                         client=object(), node_name="tpu-vm-0")
+    monkeypatch.setattr(
+        mgr.device_plugin, "refresh",
+        lambda: events.append(("refresh", mgr.device_plugin.resource)))
+    mgr.device_handler._setup_done.set()
+    evicted = mgr.resize_chips(2)
+    assert evicted == ["victim-pod"]
+    assert events == [("drain", "tpu-vm-0"), ("set_num_chips", 2),
+                      ("refresh", "google.com/tpu"),
+                      ("uncordon", "tpu-vm-0")]
+    # growth neither drains nor needs the barrier
+    events.clear()
+    mgr.resize_chips(8)
+    assert events == [("set_num_chips", 8)]
+
+
+def test_device_plugin_refresh_wakes_list_and_watch(short_tmp):
+    """refresh() must both re-snapshot (Allocate's cached view) and wake
+    the ListAndWatch stream without waiting out the poll interval."""
+    import threading
+    import time
+
+    from dpu_operator_tpu.deviceplugin import DevicePlugin
+
+    devs = {f"chip-{i}": {"healthy": True, "dev_path": ""}
+            for i in range(4)}
+
+    class _Handler:
+        def get_devices(self):
+            return dict(devs)
+
+    dp = DevicePlugin(_Handler(), path_manager=PathManager(short_tmp),
+                      poll_interval=30.0)  # long: only refresh() can wake it
+
+    class _Ctx:
+        def is_active(self):
+            return True
+
+    seen = []
+
+    def consume():
+        for resp in dp._list_and_watch(None, _Ctx()):
+            seen.append(len(resp.devices))
+            if len(seen) == 2:
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while not seen and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen == [4]
+    del devs["chip-3"]
+    dp.refresh()
+    t.join(timeout=5)
+    assert seen == [4, 3], "refresh did not push the shrunken set promptly"
+    dp._stop.set()
+    dp._poke.set()
+
+
+@pytest.fixture(scope="module")
+def shim_binary():
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")], check=True,
+                   capture_output=True)
+    return SHIM_BIN
+
+
+def test_shim_rejects_oversized_stdin_early(shim_binary):
+    """ADVICE r3 #4 (low): MAX_BODY (1 MiB) is enforced inside the read
+    loop — an oversized netconf is rejected as CNI error JSON without
+    the shim buffering the whole stream first."""
+    big = b'{"pad": "' + b"x" * (4 << 20) + b'"}'
+    env = {"PATH": "", "TPU_CNI_SOCKET": "/nonexistent.sock",
+           "CNI_COMMAND": "ADD", "CNI_CONTAINERID": "sbx",
+           "CNI_NETNS": "/var/run/netns/x", "CNI_IFNAME": "net1"}
+    proc = subprocess.run([shim_binary], input=big, env=env, cwd="/",
+                          capture_output=True, timeout=30)
+    assert proc.returncode != 0
+    err = json.loads(proc.stdout)
+    assert "too large" in err.get("msg", "")
+
+
+def test_shim_still_accepts_body_at_limit(shim_binary, short_tmp):
+    """Exactly-at-limit bodies still parse (no off-by-one regression)."""
+    from dpu_operator_tpu.cni import CniServer
+    got = []
+
+    def add(req):
+        got.append(req)
+        return {"cniVersion": "0.4.0", "ok": True}
+
+    sock = short_tmp + "/cni.sock"
+    srv = CniServer(sock, add_handler=add, del_handler=lambda r: {})
+    srv.start()
+    try:
+        pad = "x" * ((1 << 20) - 64)
+        conf = json.dumps({"cniVersion": "0.4.0", "type": "tpu-cni",
+                           "pad": pad})
+        assert len(conf) <= (1 << 20)
+        env = {"PATH": "", "TPU_CNI_SOCKET": sock,
+               "CNI_COMMAND": "ADD", "CNI_CONTAINERID": "sbx",
+               "CNI_NETNS": "/var/run/netns/x", "CNI_IFNAME": "net1"}
+        proc = subprocess.run([shim_binary], input=conf.encode(), env=env,
+                              cwd="/", capture_output=True, timeout=30)
+        assert proc.returncode == 0, proc.stderr
+        assert got, "server never saw the at-limit ADD"
+    finally:
+        srv.stop()

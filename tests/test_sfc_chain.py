@@ -69,6 +69,7 @@ def mgr(kube, tmp_path):
     m._attach_lock = threading.Lock()
     m._chain_store = {}
     m._chain_hops = {}
+    m._degraded_hops = set()
     m._repair_pass_lock = threading.Lock()
     m.ipam_dir = str(tmp_path / "ipam")
     m.nf_cache = NetConfCache(str(tmp_path / "nf"))
@@ -251,6 +252,7 @@ def test_attachment_release_survives_daemon_restart(kube, mgr, short_tmp):
     fresh._attach_lock = threading.Lock()
     fresh._chain_store = {}
     fresh._chain_hops = {}
+    fresh._degraded_hops = set()
     fresh._cni_nf_del(_Req("sandboxAAAA", None, "net1", "rs-nf-a"))
     assert sorted(fresh.vsp.detached) == ["nf0-2", "nf0-3"]
 

@@ -1,0 +1,109 @@
+"""SFC CR status reporting (VERDICT r3 #5): the node-side reconciler
+surfaces chain readiness on the CR — NF pods scheduled/ready, hops wired/
+degraded from the daemon's live wire table — where the reference leaves
+its cluster-side SFC controller an empty stub
+(servicefunctionchain_controller.go:49-55). Plus `tpuctl get-chains`."""
+
+from dpu_operator_tpu.daemon.sfc_reconciler import SfcReconciler
+from dpu_operator_tpu.k8s.manager import Request
+
+SFC = {
+    "apiVersion": "config.tpu.openshift.io/v1",
+    "kind": "ServiceFunctionChain",
+    "metadata": {"name": "chain", "namespace": "default", "generation": 3},
+    "spec": {"networkFunctions": [{"name": "fw", "image": "img"},
+                                  {"name": "lb", "image": "img"}]},
+}
+
+REQ = Request("config.tpu.openshift.io/v1", "ServiceFunctionChain",
+              "chain", "default")
+
+
+def _conditions(obj):
+    return {c["type"]: c["status"] for c in obj["status"]["conditions"]}
+
+
+def test_status_transitions_across_pod_churn(kube):
+    hops = []
+    rec = SfcReconciler(workload_image="w",
+                        chain_status_provider=lambda ns, n: hops)
+    kube.create(dict(SFC))
+
+    # pass 1: pods created this pass — scheduled, none ready
+    result = rec.reconcile(kube, REQ)
+    assert result.requeue_after == SfcReconciler.RESYNC_SECONDS
+    obj = kube.get(SFC["apiVersion"], "ServiceFunctionChain", "chain",
+                   namespace="default")
+    st = obj["status"]
+    assert st["observedGeneration"] == 3
+    assert st["networkFunctions"] == {"desired": 2, "scheduled": 2,
+                                      "ready": 0}
+    assert _conditions(obj) == {"NFsReady": "False", "ChainWired": "False",
+                                "ChainDegraded": "False"}
+
+    # pods come up; the hop lands in the wire table
+    for name in ("chain-fw", "chain-lb"):
+        pod = kube.get("v1", "Pod", name, namespace="default")
+        pod.setdefault("status", {})["phase"] = "Running"
+        kube.update_status(pod)
+    hops.append({"index": 0, "input": "ici-1-x+", "output": "ici-2-x+",
+                 "degraded": False})
+    rec.reconcile(kube, REQ)
+    obj = kube.get(SFC["apiVersion"], "ServiceFunctionChain", "chain",
+                   namespace="default")
+    assert obj["status"]["networkFunctions"]["ready"] == 2
+    assert obj["status"]["hops"] == hops
+    assert _conditions(obj) == {"NFsReady": "True", "ChainWired": "True",
+                                "ChainDegraded": "False"}
+
+    # link-fault repair degrades the hop — status follows
+    hops[0] = dict(hops[0], input="nf-sbx-chip-1", degraded=True)
+    rec.reconcile(kube, REQ)
+    obj = kube.get(SFC["apiVersion"], "ServiceFunctionChain", "chain",
+                   namespace="default")
+    conds = {c["type"]: c for c in obj["status"]["conditions"]}
+    assert conds["ChainDegraded"]["status"] == "True"
+    assert "0" in conds["ChainDegraded"]["message"]
+    assert conds["ChainWired"]["status"] == "True"  # degraded, not broken
+
+    # a pod dying flips readiness back
+    kube.delete("v1", "Pod", "chain-fw", namespace="default")
+    hops.clear()
+    rec.reconcile(kube, REQ)
+    obj = kube.get(SFC["apiVersion"], "ServiceFunctionChain", "chain",
+                   namespace="default")
+    assert _conditions(obj)["NFsReady"] == "False"
+    assert _conditions(obj)["ChainWired"] == "False"
+
+
+def test_status_survives_broken_provider(kube):
+    """A wedged daemon wire-table must not take status reporting down."""
+    def boom(ns, n):
+        raise ConnectionError("agent gone")
+
+    rec = SfcReconciler(workload_image="w", chain_status_provider=boom)
+    kube.create(dict(SFC))
+    rec.reconcile(kube, REQ)
+    obj = kube.get(SFC["apiVersion"], "ServiceFunctionChain", "chain",
+                   namespace="default")
+    assert obj["status"]["hops"] == []
+    assert _conditions(obj)["ChainWired"] == "False"
+
+
+def test_status_not_rewritten_when_unchanged(kube):
+    writes = []
+    orig = kube.update_status
+
+    def counting(obj):
+        writes.append(obj["kind"])
+        return orig(obj)
+
+    kube.update_status = counting
+    rec = SfcReconciler(workload_image="w",
+                        chain_status_provider=lambda ns, n: [])
+    kube.create(dict(SFC))
+    rec.reconcile(kube, REQ)
+    sfc_writes = writes.count("ServiceFunctionChain")
+    rec.reconcile(kube, REQ)
+    assert writes.count("ServiceFunctionChain") == sfc_writes, (
+        "identical status must not be rewritten every resync")

@@ -86,7 +86,7 @@ def test_tpuctl_resize_chips_drains_via_daemon(short_tmp, kube, node_agent):
     vsp_server.start()
     det = TpuDetector().detection_result(tpu_mode=True, identifier="t")
     mgr = TpuSideManager(GrpcPlugin(det, path_manager=pm, init_timeout=5.0),
-                         pm, client=kube)
+                         pm, client=kube, node_name="tpu-vm-0")
     mgr.device_plugin.poll_interval = 0.05
     try:
         mgr.start_vsp()
