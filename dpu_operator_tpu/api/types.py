@@ -106,6 +106,13 @@ class ServiceFunctionChain:
     namespace: str = "default"
     network_functions: list = field(default_factory=list)
     uid: str = ""
+    #: boundary binding (external-traffic analog of the reference's
+    #: pod↔NF↔external e2e, e2e_test.go:348-513): slice-attachment names
+    #: traffic enters the chain from / leaves it into — typically the
+    #: host-side attachments of tenant workload pods. Empty = the chain
+    #: floats (NF-to-NF steering only).
+    ingress: str = ""
+    egress: str = ""
 
     KIND = "ServiceFunctionChain"
 
@@ -113,16 +120,21 @@ class ServiceFunctionChain:
         md = {"name": self.name, "namespace": self.namespace}
         if self.uid:
             md["uid"] = self.uid
+        spec = {
+            "networkFunctions": [
+                nf.to_dict() if isinstance(nf, NetworkFunction) else nf
+                for nf in self.network_functions
+            ],
+        }
+        if self.ingress:
+            spec["ingress"] = self.ingress
+        if self.egress:
+            spec["egress"] = self.egress
         return {
             "apiVersion": API_VERSION,
             "kind": self.KIND,
             "metadata": md,
-            "spec": {
-                "networkFunctions": [
-                    nf.to_dict() if isinstance(nf, NetworkFunction) else nf
-                    for nf in self.network_functions
-                ],
-            },
+            "spec": spec,
         }
 
     @classmethod
@@ -136,4 +148,6 @@ class ServiceFunctionChain:
             namespace=obj.get("metadata", {}).get("namespace", "default"),
             network_functions=nfs,
             uid=obj.get("metadata", {}).get("uid", ""),
+            ingress=obj.get("spec", {}).get("ingress", ""),
+            egress=obj.get("spec", {}).get("egress", ""),
         )

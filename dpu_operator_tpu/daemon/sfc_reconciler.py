@@ -32,12 +32,17 @@ class SfcReconciler:
     RESYNC_SECONDS = 5.0
 
     def __init__(self, workload_image: str = "",
-                 chain_status_provider=None):
+                 chain_status_provider=None, boundary_sync=None):
         """*chain_status_provider*: callable (namespace, name) -> list of
         hop dicts ({index, input, output, degraded}) from the live wire
-        table — the TpuSideManager passes its own (chain_status)."""
+        table — the TpuSideManager passes its own (chain_status).
+        *boundary_sync*: callable (namespace, name, ingress, egress,
+        n_nfs) converging spec.ingress/egress boundary hops — lets a
+        live spec edit take effect on the next resync, without pod
+        churn."""
         self.workload_image = workload_image
         self.chain_status_provider = chain_status_provider
+        self.boundary_sync = boundary_sync
 
     def _network_function_pod(self, sfc: ServiceFunctionChain, nf,
                               index: int = 0) -> dict:
@@ -114,6 +119,13 @@ class SfcReconciler:
         :49-55 — this is a beat-not-match feature): NF pods scheduled/
         ready, hops wired/degraded from the daemon's live wire table."""
         desired = len(sfc.network_functions)
+        if self.boundary_sync is not None:
+            try:
+                self.boundary_sync(sfc.namespace, sfc.name, sfc.ingress,
+                                   sfc.egress, desired)
+            except Exception:  # noqa: BLE001 — next resync retries
+                log.exception("boundary sync failed for %s/%s",
+                              sfc.namespace, sfc.name)
         hops = []
         if self.chain_status_provider is not None:
             try:
@@ -123,6 +135,8 @@ class SfcReconciler:
                 log.exception("chain status provider failed for %s/%s",
                               sfc.namespace, sfc.name)
         want_hops = max(desired - 1, 0)
+        if desired:  # boundary hops count when the chain binds them
+            want_hops += int(bool(sfc.ingress)) + int(bool(sfc.egress))
         wired = len(hops) >= want_hops and ready == desired
         degraded = [h for h in hops if h.get("degraded")]
         status = {

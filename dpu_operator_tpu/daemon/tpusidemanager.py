@@ -192,7 +192,8 @@ class TpuSideManager:
             self._manager = Manager(self.client)
             self._manager.add_reconciler(
                 SfcReconciler(workload_image=self.workload_image,
-                              chain_status_provider=self.chain_status))
+                              chain_status_provider=self.chain_status,
+                              boundary_sync=self.sync_chain_boundaries))
             self._manager.start()
         # self-healing chain repair: probe ICI link state through the
         # native agent (VSP spawns it next to the vendor-plugin socket —
@@ -440,7 +441,11 @@ class TpuSideManager:
         """After a pod's own NF is wired, steer the chain: wire this NF's
         egress to the next NF's ingress (and previous egress to this
         ingress) once both sides exist — the ICI analog of the reference's
-        chain flow rules (marvell/main.go:544-560 uplink/hairpin rules)."""
+        chain flow rules (marvell/main.go:544-560 uplink/hairpin rules).
+        Chains with spec.ingress/egress also get their boundary hops:
+        traffic enters NF0 from (and leaves NF-last into) the named slice
+        attachments — the external-traffic steering of the reference's
+        pod↔NF↔external e2e (e2e_test.go:348-513)."""
         if self.client is None or not req.pod_name:
             return
         pod = self.client.get("v1", "Pod", req.pod_name,
@@ -455,7 +460,20 @@ class TpuSideManager:
             index = int(ann.get("tpu.openshift.io/sfc-index", ""))
         except ValueError:
             return
-        key = (req.pod_namespace or "default", sfc)
+        ns = req.pod_namespace or "default"
+        ingress = egress = ""
+        last_index = None
+        from ..api.types import API_VERSION
+        sfc_obj = self.client.get(API_VERSION, "ServiceFunctionChain",
+                                  sfc, namespace=ns)
+        if sfc_obj is not None:
+            spec = sfc_obj.get("spec", {}) or {}
+            ingress = spec.get("ingress", "")
+            egress = spec.get("egress", "")
+            nfs = spec.get("networkFunctions") or []
+            if nfs:
+                last_index = len(nfs) - 1
+        key = (ns, sfc)
         to_wire = []
         with self._attach_lock:
             entry = self._attach_store.get(req.sandbox_id)
@@ -495,6 +513,91 @@ class TpuSideManager:
                 # teardown raced us and already "unwired" the hop before
                 # our wire landed — undo it so nothing leaks
                 self._unwire_quietly(ids, "raced SFC hop")
+        # boundary binding (spec.ingress/egress) reconciles separately so
+        # a live spec edit converges too (the reconciler resync calls the
+        # same method)
+        if ingress or egress:
+            self.sync_chain_boundaries(ns, sfc, ingress, egress,
+                                       n_nfs=(last_index + 1
+                                              if last_index is not None
+                                              else 0))
+
+    #: boundary hop indices: ingress attachment -> NF0 rides -1 (popped
+    #: naturally with NF0: teardown pops index-1); NF-last -> egress
+    #: attachment rides -2 — DISTINCT from the NF-NF index space, which
+    #: runs 0..n-2 and grows when the chain is scaled up
+    INGRESS_HOP = -1
+    EGRESS_HOP = -2
+
+    def _desired_boundary_hops(self, chain: dict, ingress: str,
+                               egress: str, last_index) -> dict:
+        """Boundary hops the current chain state calls for (lock held)."""
+        desired = {}
+        if ingress and 0 in chain:
+            entry = chain[0]
+            ports = entry.get("ports") or []
+            desired[self.INGRESS_HOP] = (
+                ingress, ports[0] if ports else entry["in"])
+        if egress and last_index is not None and last_index in chain:
+            entry = chain[last_index]
+            ports = entry.get("ports") or []
+            desired[self.EGRESS_HOP] = (
+                ports[-1] if ports else entry["out"], egress)
+        return desired
+
+    def sync_chain_boundaries(self, namespace: str, name: str,
+                              ingress: str = "", egress: str = "",
+                              n_nfs: int = 0) -> None:
+        """Converge the chain's boundary hops onto the spec: wire missing
+        ones, re-steer an egress hop stranded on a former last NF after a
+        scale-up, drop hops whose binding (or NF) went away. Called from
+        the CNI wire path AND the reconciler's resync, so editing
+        spec.ingress/egress on a live chain converges without pod churn.
+        Make-before-break like repair; degraded hops are left to the
+        repair loop (rewiring them here would fight it every resync)."""
+        key = (namespace, name)
+        last_index = n_nfs - 1 if n_nfs else None
+        to_wire, to_unwire = [], []
+        with self._attach_lock:
+            chain = self._chain_store.get(key, {})
+            desired = self._desired_boundary_hops(chain, ingress, egress,
+                                                  last_index)
+            for bkey in (self.INGRESS_HOP, self.EGRESS_HOP):
+                hop_key = key + (bkey,)
+                current = self._chain_hops.get(hop_key)
+                want = desired.get(bkey)
+                if want == current:
+                    continue
+                att_side = 0 if bkey == self.INGRESS_HOP else 1
+                if (current is not None and want is not None
+                        and hop_key in self._degraded_hops
+                        and want[att_side] == current[att_side]):
+                    # repair owns the NF-side endpoint while its link is
+                    # dark — but an ATTACHMENT-side change (spec edited
+                    # to a different boundary) must still converge, so
+                    # only skip when the attachment side is unchanged
+                    continue
+                if want is not None:
+                    self._chain_hops[hop_key] = want
+                    self._degraded_hops.discard(hop_key)
+                    to_wire.append((hop_key, want))
+                else:
+                    self._chain_hops.pop(hop_key, None)
+                    self._degraded_hops.discard(hop_key)
+                if current is not None:
+                    to_unwire.append(current)
+        for hop_key, ids in to_wire:
+            try:
+                self.vsp.create_network_function(*ids)  # make...
+                log.info("wired SFC boundary hop %s: %s -> %s",
+                         hop_key, *ids)
+            except Exception:  # noqa: BLE001 — next sync retries
+                with self._attach_lock:
+                    if self._chain_hops.get(hop_key) == ids:
+                        self._chain_hops.pop(hop_key)
+                log.warning("SFC boundary hop wire failed for %s", hop_key)
+        for ids in to_unwire:
+            self._unwire_quietly(ids, "boundary sync")  # ...break
 
     #: allocated ici-port endpoint ids look like "ici-<chip>-<port>"
     #: (ici/topology.py IciLink.id)
@@ -562,14 +665,23 @@ class TpuSideManager:
         plans = []
         for hop_key, ids, chain in snapshot:
             i = hop_key[2]
-            up_entry, down_entry = chain.get(i), chain.get(i + 1)
-            if up_entry is None or down_entry is None:
-                continue
+            # boundary hops (spec.ingress/egress) have an NF entry on one
+            # side only; the attachment-id boundary side never reads down
+            if i == self.EGRESS_HOP:
+                # egress rides its own key: its NF side is the chain's
+                # LAST entry (for ingress, chain.get(i+1)=chain.get(0)
+                # already resolves naturally)
+                up_entry = chain[max(chain)] if chain else None
+                down_entry = None
+            else:
+                up_entry, down_entry = chain.get(i), chain.get(i + 1)
             out_id, in_id = ids
             new_out, new_in = out_id, in_id
-            if self._endpoint_link_down(out_id, probe_cache):
+            if up_entry is not None and self._endpoint_link_down(
+                    out_id, probe_cache):
                 new_out = up_entry["out"]
-            if self._endpoint_link_down(in_id, probe_cache):
+            if down_entry is not None and self._endpoint_link_down(
+                    in_id, probe_cache):
                 new_in = down_entry["in"]
             if (new_out, new_in) != ids:
                 plans.append((hop_key, ids, (new_out, new_in)))
@@ -636,6 +748,16 @@ class TpuSideManager:
                         self._degraded_hops.discard(key + (i,))
                         if ids:
                             to_unwire.append(ids)
+                    # the egress boundary hop rides its own key (-2);
+                    # drop it when ITS upstream endpoint was this entry
+                    eg_key = key + (self.EGRESS_HOP,)
+                    eg_ids = self._chain_hops.get(eg_key)
+                    if eg_ids and (eg_ids[0] == entry.get("out")
+                                   or eg_ids[0] in (entry.get("ports")
+                                                    or [])):
+                        self._chain_hops.pop(eg_key)
+                        self._degraded_hops.discard(eg_key)
+                        to_unwire.append(eg_ids)
                 if not chain:
                     self._chain_store.pop(key, None)
         for ids in to_unwire:

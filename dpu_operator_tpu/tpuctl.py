@@ -148,15 +148,19 @@ def run(args) -> dict:
     if args.cmd == "slice-group":
         if not args.daemon_addr:
             raise SystemExit("slice-group needs --daemon-addr")
+        import math
+
         from .daemon.slicejoin import join_slices
         result = join_slices(args.daemon_addr)
+        algbw = result.group.dcn_allreduce_algbw_gbps()
         return {"members": result.members,
                 "unreachable": result.unreachable,
                 "degraded": result.degraded,
                 "numChips": result.group.num_chips,
                 "slices": [s.topology for s in result.group.slices],
+                # single slice -> no DCN bound; inf is not valid JSON
                 "dcnAllreduceAlgbwGbps":
-                    result.group.dcn_allreduce_algbw_gbps()}
+                    algbw if math.isfinite(algbw) else None}
 
     if args.cmd == "resize-chips":
         if not args.daemon_addr:

@@ -172,3 +172,70 @@ def test_server_healthz(webhook):
     with urllib.request.urlopen(
             f"http://127.0.0.1:{webhook.port}/healthz", timeout=5) as r:
         assert json.loads(r.read())["ok"] is True
+
+
+def _two_container_pod(annotations=None):
+    return {
+        "metadata": {"name": "nf", "namespace": "default",
+                     "annotations": dict({
+                         "k8s.v1.cni.cncf.io/networks":
+                             "tpunfcni-conf, tpunfcni-conf"},
+                         **(annotations or {}))},
+        "spec": {"containers": [
+            {"name": "sidecar", "resources": {}},
+            {"name": "worker", "resources": {}},
+        ]},
+    }
+
+
+def _nad(ns, name):
+    return "google.com/tpu"
+
+
+def test_injects_into_annotated_container():
+    """VERDICT r3 weak #8: a multi-container NF pod names its consuming
+    container; the resource lands there, not on the first container."""
+    from dpu_operator_tpu.webhook.injector import mutate_pod
+    pod = _two_container_pod(
+        {"tpu.openshift.io/inject-container": "worker"})
+    patches = mutate_pod(pod, _nad)
+    paths = {p["path"] for p in patches}
+    assert all("/spec/containers/1/" in p for p in paths), paths
+    req = next(p for p in patches
+               if p["path"].endswith("/1/resources/requests"))
+    assert req["value"] == {"google.com/tpu": "2"}
+
+
+def test_injects_into_container_already_requesting_resource():
+    """Without the annotation, a container already holding a partial
+    request for the resource is the consumer — top it up there."""
+    from dpu_operator_tpu.webhook.injector import mutate_pod
+    pod = _two_container_pod()
+    pod["spec"]["containers"][1]["resources"] = {
+        "requests": {"google.com/tpu": "1"}}
+    patches = mutate_pod(pod, _nad)
+    req = next(p for p in patches
+               if p["path"].endswith("/1/resources/requests"))
+    assert req["value"] == {"google.com/tpu": "2"}
+    assert not any("/containers/0/" in p["path"] for p in patches)
+
+
+def test_unknown_target_container_is_an_error():
+    import pytest
+
+    from dpu_operator_tpu.webhook.injector import mutate_pod
+    pod = _two_container_pod(
+        {"tpu.openshift.io/inject-container": "nope"})
+    with pytest.raises(ValueError, match="names no container"):
+        mutate_pod(pod, _nad)
+
+
+def test_detects_consumer_by_limits_only():
+    """Extended resources are commonly written limits-only; the consumer
+    scan must see them (apiserver defaulting copies limits to requests)."""
+    from dpu_operator_tpu.webhook.injector import mutate_pod
+    pod = _two_container_pod()
+    pod["spec"]["containers"][1]["resources"] = {
+        "limits": {"google.com/tpu": "1"}}
+    patches = mutate_pod(pod, _nad)
+    assert all("/containers/1/" in p["path"] for p in patches), patches

@@ -270,3 +270,56 @@ def test_google_vsp_accepts_nf_namespace_attachments():
     assert {"nf0-3", "host0-3"} <= set(vsp.attachments)
     vsp.delete_slice_attachment({"name": "nf0-3"})
     assert "host0-3" in vsp.attachments
+
+
+def test_egress_boundary_hop_repairs_and_spec_edit_converges(kube, mgr):
+    """The egress boundary hop (its own key, -2) is covered by the
+    self-healing pass — its NF side resolves to the chain's LAST entry —
+    and an attachment-side spec edit converges even while the hop is
+    degraded (repair owns only the NF-side endpoint)."""
+    kube.create({
+        "apiVersion": "config.tpu.openshift.io/v1",
+        "kind": "ServiceFunctionChain",
+        "metadata": {"name": "b-sfc", "namespace": "default"},
+        "spec": {"ingress": "host0-0", "egress": "host0-1",
+                 "networkFunctions": [{"name": "a", "image": "i"},
+                                      {"name": "b", "image": "i"}]}})
+    _nf_pod(kube, "b-sfc-nf-a", "b-sfc", 0)
+    _nf_pod(kube, "b-sfc-nf-b", "b-sfc", 1)
+    _wire_pod_with_ports(mgr, "sandboxAAAA", "b-sfc-nf-a",
+                         ["chip-0", "chip-1"], ["ici-0-x+", "ici-1-x+"])
+    _wire_pod_with_ports(mgr, "sandboxBBBB", "b-sfc-nf-b",
+                         ["chip-2", "chip-3"], ["ici-2-x+", "ici-3-x+"])
+    status = {h["index"]: h for h in mgr.chain_status("default", "b-sfc")}
+    assert sorted(status) == [-2, -1, 0]
+    assert status[-2]["input"] == "ici-3-x+"
+    assert status[-2]["output"] == "host0-1"
+    assert ("ici-3-x+", "host0-1") in mgr.vsp.wired
+
+    # the last NF's egress port goes dark: repair must re-steer the
+    # EGRESS boundary hop too (previously invisible to the pass)
+    link_state = {3: [{"port": "x+", "up": False, "wired": True}]}
+    mgr.link_prober = lambda chip: link_state.get(
+        chip, [{"port": "x+", "up": True, "wired": True}])
+    repaired = mgr.repair_chains()
+    keys = [k for k, _, _ in repaired]
+    assert ("default", "b-sfc", -2) in keys
+    status = {h["index"]: h for h in mgr.chain_status("default", "b-sfc")}
+    assert status[-2]["degraded"] is True
+    assert status[-2]["input"] == "nf-sandboxBBBB-chip-3"
+
+    # live spec edit to a DIFFERENT egress attachment while degraded:
+    # the attachment side still converges
+    mgr.sync_chain_boundaries("default", "b-sfc", ingress="host0-0",
+                              egress="host0-9", n_nfs=2)
+    status = {h["index"]: h for h in mgr.chain_status("default", "b-sfc")}
+    assert status[-2]["output"] == "host0-9"
+    # and an unchanged-attachment sync while degraded is a no-op (repair
+    # owns the NF side); first re-mark it degraded via another pass
+    mgr.repair_chains()
+    status = {h["index"]: h for h in mgr.chain_status("default", "b-sfc")}
+    assert status[-2]["degraded"] is True
+    before = list(mgr.vsp.wired)
+    mgr.sync_chain_boundaries("default", "b-sfc", ingress="host0-0",
+                              egress="host0-9", n_nfs=2)
+    assert mgr.vsp.wired == before
