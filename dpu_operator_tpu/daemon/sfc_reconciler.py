@@ -109,6 +109,17 @@ class SfcReconciler:
             scheduled += 1
             if (existing.get("status", {}).get("phase")) == "Running":
                 ready += 1
+        # boundary convergence is a reconcile ACTION (dataplane
+        # mutation), not status reporting — it runs here so a future
+        # status-suppression path cannot silently disable it
+        if self.boundary_sync is not None:
+            try:
+                self.boundary_sync(sfc.namespace, sfc.name, sfc.ingress,
+                                   sfc.egress,
+                                   len(sfc.network_functions))
+            except Exception:  # noqa: BLE001 — next resync retries
+                log.exception("boundary sync failed for %s/%s",
+                              sfc.namespace, sfc.name)
         self._write_status(client, obj, sfc, scheduled, ready)
         return ReconcileResult(requeue_after=self.RESYNC_SECONDS)
 
@@ -119,13 +130,6 @@ class SfcReconciler:
         :49-55 — this is a beat-not-match feature): NF pods scheduled/
         ready, hops wired/degraded from the daemon's live wire table."""
         desired = len(sfc.network_functions)
-        if self.boundary_sync is not None:
-            try:
-                self.boundary_sync(sfc.namespace, sfc.name, sfc.ingress,
-                                   sfc.egress, desired)
-            except Exception:  # noqa: BLE001 — next resync retries
-                log.exception("boundary sync failed for %s/%s",
-                              sfc.namespace, sfc.name)
         hops = []
         if self.chain_status_provider is not None:
             try:

@@ -557,7 +557,7 @@ class TpuSideManager:
         repair loop (rewiring them here would fight it every resync)."""
         key = (namespace, name)
         last_index = n_nfs - 1 if n_nfs else None
-        to_wire, to_unwire = [], []
+        plans = []  # (hop_key, want, old) — old unwired only on success
         with self._attach_lock:
             chain = self._chain_store.get(key, {})
             desired = self._desired_boundary_hops(chain, ingress, egress,
@@ -580,24 +580,32 @@ class TpuSideManager:
                 if want is not None:
                     self._chain_hops[hop_key] = want
                     self._degraded_hops.discard(hop_key)
-                    to_wire.append((hop_key, want))
                 else:
                     self._chain_hops.pop(hop_key, None)
                     self._degraded_hops.discard(hop_key)
-                if current is not None:
-                    to_unwire.append(current)
-        for hop_key, ids in to_wire:
-            try:
-                self.vsp.create_network_function(*ids)  # make...
-                log.info("wired SFC boundary hop %s: %s -> %s",
-                         hop_key, *ids)
-            except Exception:  # noqa: BLE001 — next sync retries
-                with self._attach_lock:
-                    if self._chain_hops.get(hop_key) == ids:
-                        self._chain_hops.pop(hop_key)
-                log.warning("SFC boundary hop wire failed for %s", hop_key)
-        for ids in to_unwire:
-            self._unwire_quietly(ids, "boundary sync")  # ...break
+                plans.append((hop_key, want, current))
+        for hop_key, want, old in plans:
+            if want is not None:
+                try:
+                    self.vsp.create_network_function(*want)  # make...
+                    log.info("wired SFC boundary hop %s: %s -> %s",
+                             hop_key, *want)
+                except Exception:  # noqa: BLE001 — next sync retries
+                    # the NEW wire failed: roll the bookkeeping back to
+                    # the old ids and do NOT break the still-working old
+                    # wire (make-before-break means the break only
+                    # happens after a successful make)
+                    with self._attach_lock:
+                        if self._chain_hops.get(hop_key) == want:
+                            if old is not None:
+                                self._chain_hops[hop_key] = old
+                            else:
+                                self._chain_hops.pop(hop_key, None)
+                    log.warning("SFC boundary hop wire failed for %s",
+                                hop_key)
+                    continue
+            if old is not None:
+                self._unwire_quietly(old, "boundary sync")  # ...break
 
     #: allocated ici-port endpoint ids look like "ici-<chip>-<port>"
     #: (ici/topology.py IciLink.id)

@@ -161,7 +161,7 @@ def _flash_attn(mesh: Mesh | None, block_q: int, block_k: int):
 
     if mesh is None:
         return call
-    spec = P("data", None, "model", None)
+    spec = P(_batch_axes(mesh), None, "model", None)
     # check_vma=False: pallas_call's ShapeDtypeStruct outputs carry no vma
     # annotation, which the default varying-mesh-axes check rejects
     return jax.shard_map(call, mesh=mesh, in_specs=(spec, spec, spec),
@@ -173,13 +173,25 @@ def _rmsnorm(x, scale):
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale
 
 
+def _batch_axes(mesh):
+    """Mesh axes carrying the batch dimension: plain data-parallel uses
+    "data"; a mesh with a leading "dcn" axis (multi-slice groups joined
+    over the datacenter network, workloads/multislice.py) shards batch
+    over BOTH — each slice takes a batch shard, and XLA's gradient
+    allreduce spans dcn+ici (the hierarchical schedule keeps the DCN leg
+    at 1/n_ici the bytes)."""
+    if mesh is not None and "dcn" in mesh.axis_names:
+        return ("dcn", "data")
+    return "data"
+
+
 def _sp(x, cfg: TransformerConfig, mesh):
     """Sequence-parallel region: residual stream sharded (data, model) on
     (batch, seq). A no-op without a mesh (single-device compile checks)."""
     if mesh is None or not cfg.sequence_parallel:
         return x
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P("data", "model", None)))
+        x, NamedSharding(mesh, P(_batch_axes(mesh), "model", None)))
 
 
 def _tp_act(x, mesh):
@@ -187,7 +199,7 @@ def _tp_act(x, mesh):
     if mesh is None:
         return x
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P("data", None, "model")))
+        x, NamedSharding(mesh, P(_batch_axes(mesh), None, "model")))
 
 
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
@@ -268,8 +280,9 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh):
     pshard = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda s: isinstance(s, P))
-    bshard = {"tokens": NamedSharding(mesh, P("data", None)),
-              "targets": NamedSharding(mesh, P("data", None))}
+    batch_spec = P(_batch_axes(mesh), None)
+    bshard = {"tokens": NamedSharding(mesh, batch_spec),
+              "targets": NamedSharding(mesh, batch_spec)}
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
