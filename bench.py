@@ -207,6 +207,7 @@ def bench_compute():
                                best_of=best_of)
     flash = perf.measure_flash_attention(causal=True, **flash_kw)
     decode = measure_decode(cfg, **decode_kw)
+    decode_q = measure_decode(cfg, quantized=True, **decode_kw)
     # marginal_time clamps a degenerate (non-positive) slope to 1e-9 s;
     # refuse to publish the resulting absurd MFU as a real number. >1.0
     # of peak is physically impossible on TPU (CPU gets slack because
@@ -218,20 +219,22 @@ def bench_compute():
     # ~1e6 and must still be refused (same failure mode as mfu)
     for name, frac in (("mfu", train.mfu),
                        ("flash_frac_of_peak", flash.frac_of_peak),
-                       ("decode_hbm_frac", decode["hbm_frac"] / 1.15)):
+                       ("decode_hbm_frac", decode["hbm_frac"] / 1.15),
+                       ("decode_hbm_frac_int8",
+                        decode_q["hbm_frac"] / 1.15)):
         if not 0.0 < frac <= cap:
             raise RuntimeError(
                 f"degenerate measurement: {name}={frac:.3g} outside "
                 f"(0, {cap}] — slope timing collapsed (tunnel contention "
                 "or too few steps); rerun with more steps/iters")
-    return train, flash, decode, dev
+    return train, flash, decode, decode_q, dev
 
 
 def main():
     n_pods = int(os.environ["TPU_BENCH_PODS"])
     latencies = bench_pod_ready(n_pods)
     wire_latencies = bench_pod_ready(n_pods, wire=True)
-    train, flash, decode, dev = bench_compute()
+    train, flash, decode, decode_q, dev = bench_compute()
     p50 = statistics.median(latencies)
     p50_wire = statistics.median(wire_latencies)
     # The reference publishes no compute numbers (SURVEY.md §6); the only
@@ -258,6 +261,8 @@ def main():
         "decode_tok_s_b1": round(decode["tokens_per_s"], 1),
         "decode_ms_per_tok_b1": round(decode["ms_per_token"], 4),
         "decode_hbm_frac": round(decode["hbm_frac"], 4),
+        "decode_tok_s_b1_int8": round(decode_q["tokens_per_s"], 1),
+        "decode_hbm_frac_int8": round(decode_q["hbm_frac"], 4),
         "pod_schedule_to_ready_p50_wire": round(p50_wire, 4),
         "pod_schedule_to_ready_p50": round(p50, 4),
     }))

@@ -18,6 +18,7 @@ import logging
 from dataclasses import dataclass, field
 
 from ..ici import MultiSliceGroup, SliceTopology
+from ..utils import metrics
 from ..vsp.rpc import VspChannel
 
 log = logging.getLogger(__name__)
@@ -85,5 +86,7 @@ def join_slices(seed_address: str, dial_timeout: float = 5.0,
             log.warning("slice %s reports no topology; skipping", addr)
             continue
         slices.append(SliceTopology(topo))
+    metrics.SLICE_JOINS.inc(
+        outcome="degraded" if unreachable else "ok")
     return JoinResult(group=MultiSliceGroup(slices), members=order,
                       unreachable=unreachable)

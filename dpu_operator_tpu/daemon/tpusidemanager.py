@@ -19,6 +19,7 @@ from typing import Optional
 
 from ..cni import CniServer
 from ..cni.ipam import ipam_add, ipam_del
+from ..utils import metrics
 from ..cni.types import PodRequest
 from ..deviceplugin import DevicePlugin
 from ..k8s.manager import Manager
@@ -495,6 +496,7 @@ class TpuSideManager:
                     # a fresh wire rides its allocated ports again
                     self._degraded_hops.discard(hop_key)
                     to_wire.append((hop_key, ids))
+            self._update_hop_gauge_locked()
         for hop_key, ids in to_wire:
             try:
                 self.vsp.create_network_function(*ids)
@@ -577,17 +579,20 @@ class TpuSideManager:
                     # to a different boundary) must still converge, so
                     # only skip when the attachment side is unchanged
                     continue
+                was_degraded = hop_key in self._degraded_hops
                 if want is not None:
                     self._chain_hops[hop_key] = want
                     self._degraded_hops.discard(hop_key)
                 else:
                     self._chain_hops.pop(hop_key, None)
                     self._degraded_hops.discard(hop_key)
-                plans.append((hop_key, want, current))
-        for hop_key, want, old in plans:
+                plans.append((hop_key, want, current, was_degraded))
+            self._update_hop_gauge_locked()
+        for hop_key, want, old, was_degraded in plans:
             if want is not None:
                 try:
                     self.vsp.create_network_function(*want)  # make...
+                    metrics.BOUNDARY_SYNCS.inc(result="wired")
                     log.info("wired SFC boundary hop %s: %s -> %s",
                              hop_key, *want)
                 except Exception:  # noqa: BLE001 — next sync retries
@@ -601,6 +606,7 @@ class TpuSideManager:
                                 self._chain_hops[hop_key] = old
                             else:
                                 self._chain_hops.pop(hop_key, None)
+                    metrics.BOUNDARY_SYNCS.inc(result="wire_failed")
                     log.warning("SFC boundary hop wire failed for %s",
                                 hop_key)
                     continue
@@ -713,11 +719,18 @@ class TpuSideManager:
                     continue
                 self._chain_hops[hop_key] = new_ids
                 self._degraded_hops.add(hop_key)
+                self._update_hop_gauge_locked()
             self._unwire_quietly(old_ids, "chain repair")  # ...break
+            metrics.CHAIN_REPAIRS.inc()
             repaired.append((hop_key, old_ids, new_ids))
             log.warning("re-steered SFC hop %s: %s -> %s (link down)",
                         hop_key, old_ids, new_ids)
         return repaired
+
+    def _update_hop_gauge_locked(self):
+        """Keep the wire-table gauge fresh at every MUTATION site (a
+        gauge only set on admin reads would serve stale /metrics)."""
+        metrics.CHAIN_HOPS.set(len(self._chain_hops))
 
     # -- chain observability --------------------------------------------------
     def chain_status(self, namespace: str, name: str) -> list:
@@ -768,6 +781,7 @@ class TpuSideManager:
                         to_unwire.append(eg_ids)
                 if not chain:
                     self._chain_store.pop(key, None)
+            self._update_hop_gauge_locked()
         for ids in to_unwire:
             self._unwire_quietly(ids, "chain teardown")
 

@@ -162,6 +162,17 @@ CNI_SECONDS = REGISTRY.histogram(
     "tpu_daemon_cni_seconds", "CNI handler latency")
 DEVICES_ADVERTISED = REGISTRY.gauge(
     "tpu_daemon_devices_advertised", "Devices advertised to kubelet")
+CHAIN_REPAIRS = REGISTRY.counter(
+    "tpu_daemon_chain_repairs_total",
+    "SFC hops re-steered off dark ICI links by the self-healing pass")
+CHAIN_HOPS = REGISTRY.gauge(
+    "tpu_daemon_chain_hops", "SFC hops currently in the wire table")
+BOUNDARY_SYNCS = REGISTRY.counter(
+    "tpu_daemon_boundary_syncs_total",
+    "Boundary-hop convergence actions (spec.ingress/egress) by result")
+SLICE_JOINS = REGISTRY.counter(
+    "tpu_daemon_slice_joins_total",
+    "Multi-slice peer walks by outcome (ok/degraded)")
 
 
 class MetricsServer:

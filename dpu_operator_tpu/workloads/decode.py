@@ -29,6 +29,89 @@ import numpy as np
 from .model import TransformerConfig, _rmsnorm
 
 
+# -- int8 weight quantization (serving) --------------------------------------
+#
+# Decode at small batch is HBM-bound on WEIGHT bytes (BASELINE.md: the
+# bf16 392M flagship measures at ~1.0x the roofline), so the only lever
+# left is shrinking the bytes: per-output-channel symmetric int8 weights
+# with dynamic per-token activation quantization (W8A8). The int8 dot
+# lands on the MXU (s8xs8->s32) and HBM streams half the bytes -> up to
+# 2x tokens/s at B1. Quality: per-channel scales keep logits close
+# (tested against the bf16 path); KV cache stays bf16.
+
+def _quantize_weight(w, axis: int = 0) -> dict:
+    """Symmetric per-channel int8: scale over *axis* (the contraction
+    axis), so dequant is a per-output-column (or per-row) multiply."""
+    w32 = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(w32), axis=axis, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def quantize_decode_params(params: dict) -> dict:
+    """Params tree for the quantized serving path: 2D projection weights
+    and the embedding become int8+scale dicts; norms/positions stay
+    bf16; MoE expert weights are left unquantized (routed activations
+    are too spiky for static per-channel scales)."""
+    out = {"embed": _quantize_weight(params["embed"], axis=1),
+           "pos": params["pos"], "out_norm": params["out_norm"],
+           "layers": []}
+    for lp in params["layers"]:
+        ql = {"ln1": lp["ln1"], "ln2": lp["ln2"],
+              "wqkv": _quantize_weight(lp["wqkv"]),
+              "wo": _quantize_weight(lp["wo"])}
+        if "moe" in lp:
+            ql["moe"] = lp["moe"]
+        else:
+            ql["w1"] = _quantize_weight(lp["w1"])
+            ql["w2"] = _quantize_weight(lp["w2"])
+        out["layers"].append(ql)
+    return out
+
+
+def _is_q(w) -> bool:
+    return isinstance(w, dict) and "q" in w
+
+
+def _act_quant(x):
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    xs = jnp.maximum(amax, 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / xs),
+                  -127, 127).astype(jnp.int8)
+    return xq, xs
+
+
+def _mm(x, w):
+    """x @ w for plain bf16 weights OR the W8A8 path for quantized ones
+    (int8 MXU dot, rescale by activation x weight scales)."""
+    if not _is_q(w):
+        return x @ w
+    xq, xs = _act_quant(x)
+    acc = jax.lax.dot_general(
+        xq, w["q"], (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * xs * w["scale"]).astype(x.dtype)
+
+
+def _embed_rows(embed, tokens):
+    if not _is_q(embed):
+        return embed[tokens]
+    return embed["q"][tokens].astype(jnp.float32) * embed["scale"][tokens]
+
+
+def _logits(x, embed):
+    """x @ embed.T — for quantized embeds, contract over d (axis 1 of q)
+    and rescale by the per-vocab-row scales."""
+    if not _is_q(embed):
+        return (x @ embed.T).astype(jnp.float32)
+    xq, xs = _act_quant(x)
+    acc = jax.lax.dot_general(
+        xq, embed["q"], (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * xs * embed["scale"][:, 0]
+
+
 def init_kv_cache(cfg: TransformerConfig, batch: int) -> list:
     """Per-layer K/V of (B, S_max, H, Dh), bf16."""
     shape = (batch, cfg.max_seq, cfg.n_heads, cfg.d_head)
@@ -42,7 +125,7 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
     """One decode step: *tokens* (B,) at position *pos* -> (logits (B, V),
     updated cache)."""
     B = tokens.shape[0]
-    x = (params["embed"][tokens]
+    x = (_embed_rows(params["embed"], tokens)
          + jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
                                         keepdims=False))
     x = x.astype(cfg.dtype)[:, None, :]          # (B, 1, D)
@@ -50,7 +133,7 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
     new_cache = []
     for lp, layer_cache in zip(params["layers"], cache):
         h = _rmsnorm(x, lp["ln1"])
-        qkv = h @ lp["wqkv"]
+        qkv = _mm(h, lp["wqkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
@@ -68,16 +151,16 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
         att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(cfg.dtype)
         o = jnp.einsum("bhqk,bkhd->bqhd", att, cv).reshape(
             B, 1, cfg.d_model)
-        x = x + o @ lp["wo"]
+        x = x + _mm(o, lp["wo"])
         h2 = _rmsnorm(x, lp["ln2"])
         if "moe" in lp:
             from .moe import moe_ffn
             out, _ = moe_ffn(lp["moe"], h2, cfg.moe_capacity_factor)
             x = x + out
         else:
-            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            x = x + _mm(jax.nn.gelu(_mm(h2, lp["w1"])), lp["w2"])
     x = _rmsnorm(x, params["out_norm"])
-    logits = (x[:, 0, :] @ params["embed"].T).astype(jnp.float32)
+    logits = _logits(x[:, 0, :], params["embed"])
     return logits, new_cache
 
 
@@ -86,13 +169,14 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array):
     (time-to-first-token costs a single parameter sweep, not P sequential
     decode steps); returns (cache, last_logits). prompt: (B, P) int32."""
     B, P = prompt.shape
-    x = (params["embed"][prompt] + params["pos"][:P]).astype(cfg.dtype)
+    x = (_embed_rows(params["embed"], prompt)
+         + params["pos"][:P]).astype(cfg.dtype)
     mask = jnp.tril(jnp.ones((P, P), jnp.bool_))
     cache = init_kv_cache(cfg, B)
     new_cache = []
     for lp, layer_cache in zip(params["layers"], cache):
         h = _rmsnorm(x, lp["ln1"])
-        qkv = h @ lp["wqkv"]
+        qkv = _mm(h, lp["wqkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
@@ -109,16 +193,16 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array):
         att = jnp.where(mask, att, -1e9)
         att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(cfg.dtype)
         o = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, P, cfg.d_model)
-        x = x + o @ lp["wo"]
+        x = x + _mm(o, lp["wo"])
         h2 = _rmsnorm(x, lp["ln2"])
         if "moe" in lp:
             from .moe import moe_ffn
             out, _ = moe_ffn(lp["moe"], h2, cfg.moe_capacity_factor)
             x = x + out
         else:
-            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+            x = x + _mm(jax.nn.gelu(_mm(h2, lp["w1"])), lp["w2"])
     x = _rmsnorm(x, params["out_norm"])
-    last_logits = (x[:, -1, :] @ params["embed"].T).astype(jnp.float32)
+    last_logits = _logits(x[:, -1, :], params["embed"])
     return new_cache, last_logits
 
 
@@ -177,7 +261,8 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
 
 def measure_decode(cfg: TransformerConfig, batch: int = 8,
                    prompt_len: int = 16, steps: int = 64,
-                   iters: int = 4, best_of: int = 3) -> dict:
+                   iters: int = 4, best_of: int = 3,
+                   quantized: bool = False) -> dict:
     """Serving throughput: steady-state decode tokens/s (marginal over two
     generation lengths so prefill + dispatch costs cancel — the same
     slope methodology as perf.marginal_time; best-of for the tunnel's
@@ -187,9 +272,11 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
     every weight byte (bf16) plus the batch's KV cache from HBM, so
     ``min_ms = (2N + kv_bytes) / HBM_BW`` bounds ms/token from below."""
     from .model import init_params
-    from .perf import best_marginal_time, hbm_bandwidth_gbps, param_count
+    from .perf import best_marginal_time, hbm_bandwidth_gbps
 
     params = init_params(jax.random.key(0), cfg)
+    if quantized:
+        params = quantize_decode_params(params)
     prompt = jnp.ones((batch, prompt_len), jnp.int32)
 
     def make_chained(n):
@@ -201,7 +288,11 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
     per_step = best_marginal_time(make_chained, n_short=max(4, steps // 4),
                                   n_long=steps, repeats=iters,
                                   best_of=best_of)
-    weight_bytes = 2.0 * param_count(cfg)
+    # charge the bytes ACTUALLY streamed per step: the stored params
+    # tree (int8 weights + fp32 scales when quantized; any unquantized
+    # leaves — norms, pos, MoE experts — at their real width)
+    weight_bytes = float(sum(leaf.nbytes
+                             for leaf in jax.tree_util.tree_leaves(params)))
     kv_bytes = 2.0 * cfg.n_layers * cfg.max_seq * cfg.d_model * 2.0 * batch
     min_s = (weight_bytes + kv_bytes) / hbm_bandwidth_gbps() / 1e9
     return {"batch": batch, "steps": steps,
