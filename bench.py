@@ -190,7 +190,11 @@ def bench_compute():
         flash_kw = dict(b=4, s=2048, h=8, d=128, iters=int(
             os.environ.get("TPU_BENCH_FLASH_ITERS", "400")),
             best_of=max(best_of, 5))
-        decode_kw = dict(batch=1, steps=64, iters=3, best_of=best_of)
+        # decode chains must be LONG: at ~1 ms/token a 64-step chain is
+        # smaller than tunnel jitter and the min-of-slopes estimator
+        # biases low (decode once "beat" the HBM roofline 2x); 256 steps
+        # puts the short/long delta (~200 ms) well above the noise
+        decode_kw = dict(batch=1, steps=256, iters=4, best_of=best_of)
     else:
         # CPU CI fallback: same code path, toy sizes (numbers are smoke
         # signals against _CPU_FALLBACK_TFLOPS, not chip claims);
@@ -203,30 +207,43 @@ def bench_compute():
         flash_kw = dict(b=1, s=256, h=2, d=64, iters=6,
                         block_q=128, block_k=128, best_of=1)
         decode_kw = dict(batch=1, steps=8, iters=2, best_of=1)
-    train = perf.measure_train(cfg, mesh, batch=batch, steps=steps,
-                               best_of=best_of)
-    flash = perf.measure_flash_attention(causal=True, **flash_kw)
-    decode = measure_decode(cfg, **decode_kw)
-    decode_q = measure_decode(cfg, quantized=True, **decode_kw)
-    # marginal_time clamps a degenerate (non-positive) slope to 1e-9 s;
-    # refuse to publish the resulting absurd MFU as a real number. >1.0
-    # of peak is physically impossible on TPU (CPU gets slack because
-    # _CPU_FALLBACK_TFLOPS is deliberately conservative).
+    # marginal timing through the time-shared tunnel can collapse (a
+    # contended phase inflating min(shorts) makes the slope too steep or
+    # negative); rather than publishing an absurd number OR dying on one
+    # bad window, re-measure the offending metric up to twice. >cap
+    # remains a hard failure after retries. decode's roofline fraction
+    # gets ~15% slop: the byte model is a lower bound and the flagship
+    # measures AT the roofline, so legitimate runs land just over 1.0.
     cap = 1.0 if on_tpu else 10.0
-    # decode's roofline fraction gets ~15% slop above cap: the byte model
-    # is a lower bound and the 390M flagship measures AT the roofline, so
-    # legitimate runs land just over 1.0 — but a collapsed slope prints
-    # ~1e6 and must still be refused (same failure mode as mfu)
-    for name, frac in (("mfu", train.mfu),
-                       ("flash_frac_of_peak", flash.frac_of_peak),
-                       ("decode_hbm_frac", decode["hbm_frac"] / 1.15),
-                       ("decode_hbm_frac_int8",
-                        decode_q["hbm_frac"] / 1.15)):
-        if not 0.0 < frac <= cap:
-            raise RuntimeError(
-                f"degenerate measurement: {name}={frac:.3g} outside "
-                f"(0, {cap}] — slope timing collapsed (tunnel contention "
-                "or too few steps); rerun with more steps/iters")
+
+    def measured(fn, frac_of, name):
+        last = None
+        for attempt in range(3):
+            result = fn()
+            frac = frac_of(result)
+            if 0.0 < frac <= cap:
+                return result
+            last = frac
+            print(f"degenerate {name}={frac:.3g} (attempt "
+                  f"{attempt + 1}); remeasuring", file=sys.stderr)
+        raise RuntimeError(
+            f"degenerate measurement: {name}={last:.3g} outside "
+            f"(0, {cap}] after retries — slope timing collapsed "
+            "(tunnel contention or too few steps)")
+
+    train = measured(
+        lambda: perf.measure_train(cfg, mesh, batch=batch, steps=steps,
+                                   best_of=best_of),
+        lambda t: t.mfu, "mfu")
+    flash = measured(
+        lambda: perf.measure_flash_attention(causal=True, **flash_kw),
+        lambda f: f.frac_of_peak, "flash_frac_of_peak")
+    decode = measured(
+        lambda: measure_decode(cfg, **decode_kw),
+        lambda d: d["hbm_frac"] / 1.15, "decode_hbm_frac")
+    decode_q = measured(
+        lambda: measure_decode(cfg, quantized=True, **decode_kw),
+        lambda d: d["hbm_frac"] / 1.15, "decode_hbm_frac_int8")
     return train, flash, decode, decode_q, dev
 
 

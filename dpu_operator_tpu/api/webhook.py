@@ -113,3 +113,35 @@ def validate_tpu_operator_config(obj: dict) -> None:
                     ipaddress.ip_interface(a["address"])
                 except ValueError as e:
                     raise ValidationError(f"invalid nfIpam: {e}") from e
+
+
+#: boundary attachments follow the slice-attachment naming contract the
+#: VSP enforces — one shared pattern, no drift (utils/vars.py)
+_ATTACHMENT_RE = re.compile(v.ATTACHMENT_NAME_PATTERN)
+
+
+def validate_service_function_chain(obj: dict) -> None:
+    """SFC admission: NF names present + unique; spec.ingress/egress (the
+    boundary binding) must be well-formed slice-attachment names — a typo
+    here would otherwise sit silently as a never-converging boundary hop."""
+    if not isinstance(obj, dict):
+        raise ValidationError(
+            f"object must be a mapping, got {type(obj).__name__}")
+    spec = obj.get("spec") or {}
+    if not isinstance(spec, dict):
+        raise ValidationError("spec must be a mapping")
+    nfs = spec.get("networkFunctions") or []
+    names = [nf.get("name", "") for nf in nfs if isinstance(nf, dict)]
+    if len(names) != len(nfs) or any(not n for n in names):
+        raise ValidationError("every networkFunction needs a name")
+    if len(set(names)) != len(names):
+        raise ValidationError(
+            f"networkFunction names must be unique, got {names}")
+    for field in ("ingress", "egress"):
+        value = spec.get(field, "")
+        if not value:
+            continue
+        if not isinstance(value, str) or not _ATTACHMENT_RE.match(value):
+            raise ValidationError(
+                f"invalid {field} {value!r}: want a slice-attachment name "
+                f"like host0-1")

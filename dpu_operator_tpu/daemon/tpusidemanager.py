@@ -507,6 +507,7 @@ class TpuSideManager:
                     # it and a new pod re-registered the same hop key
                     if self._chain_hops.get(hop_key) == ids:
                         self._chain_hops.pop(hop_key)
+                    self._update_hop_gauge_locked()
                 log.warning("SFC hop wire failed for %s", hop_key)
                 continue
             with self._attach_lock:
@@ -604,8 +605,14 @@ class TpuSideManager:
                         if self._chain_hops.get(hop_key) == want:
                             if old is not None:
                                 self._chain_hops[hop_key] = old
+                                if was_degraded:
+                                    # the restored ids are the repair
+                                    # fallback — keep reporting (and
+                                    # skip-guarding) degraded
+                                    self._degraded_hops.add(hop_key)
                             else:
                                 self._chain_hops.pop(hop_key, None)
+                            self._update_hop_gauge_locked()
                     metrics.BOUNDARY_SYNCS.inc(result="wire_failed")
                     log.warning("SFC boundary hop wire failed for %s",
                                 hop_key)

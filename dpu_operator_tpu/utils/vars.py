@@ -27,3 +27,8 @@ ICI_RESOURCE_NAME = "google.com/ici-port"
 # (reference: internal/controller/bindata/daemon/99.daemonset.yaml:20-21 "dpu=true").
 NODE_LABEL_KEY = "tpu"
 NODE_LABEL_VALUE = "true"
+
+#: slice-attachment naming contract shared by the VSP (which enforces it
+#: on CreateSliceAttachment) and SFC admission (which validates
+#: spec.ingress/egress against it): host<h>-<chip> / nf<h>-<chip>
+ATTACHMENT_NAME_PATTERN = r"^(?:host|nf)(\d+)-(\d+)$"

@@ -21,6 +21,7 @@ from typing import Optional, Protocol
 
 from ..ici import SliceTopology
 from ..platform.platform import Platform
+from ..utils import vars as _vars
 from ..platform.vendordetector import GOOGLE_VENDOR_ID, TPU_DEVICE_IDS
 
 log = logging.getLogger(__name__)
@@ -77,8 +78,9 @@ class GoogleTpuVsp:
 
     #: OPI-parity attachment name "host<h>-<chip>" (marvell/main.go:306-343);
     #: "nf<h>-<chip>" is the tpu-side NF namespace (tpusidemanager ADDs) —
-    #: kept distinct so the two managers never overwrite/detach each other
-    _ATTACH_RE = re.compile(r"^(?:host|nf)(\d+)-(\d+)$")
+    #: kept distinct so the two managers never overwrite/detach each other.
+    #: Pattern shared with SFC admission (utils/vars.py).
+    _ATTACH_RE = re.compile(_vars.ATTACHMENT_NAME_PATTERN)
 
     def __init__(self, platform: Platform, dataplane: Optional[IciDataplane]
                  = None, comm_ip: str = "127.0.0.1", comm_port: int = 50151):

@@ -18,7 +18,9 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-from ..api.webhook import ValidationError, validate_tpu_operator_config
+from ..api.webhook import (ValidationError,
+                           validate_service_function_chain,
+                           validate_tpu_operator_config)
 from ..utils import vars as v
 from .injector import RESOURCE_NAME_ANNOTATION, mutate_pod
 
@@ -83,8 +85,12 @@ class WebhookServer:
         uid = req.get("uid", "")
         if req.get("operation") == "DELETE":
             return _response(uid, allowed=True)
+        obj = req.get("object") or {}
         try:
-            validate_tpu_operator_config(req.get("object") or {})
+            if obj.get("kind") == "ServiceFunctionChain":
+                validate_service_function_chain(obj)
+            else:
+                validate_tpu_operator_config(obj)
         except ValidationError as e:
             return _response(uid, allowed=False, message=str(e))
         return _response(uid, allowed=True)

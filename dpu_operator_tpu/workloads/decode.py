@@ -288,6 +288,10 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
     per_step = best_marginal_time(make_chained, n_short=max(4, steps // 4),
                                   n_long=steps, repeats=iters,
                                   best_of=best_of)
+    # the roofline bounds per-token time from below; a slope measurably
+    # beating it means the estimator got swallowed by dispatch jitter
+    # (chains too short relative to the tunnel's noise) — callers should
+    # raise *steps* (see bench.py); hbm_frac carries the evidence
     # charge the bytes ACTUALLY streamed per step: the stored params
     # tree (int8 weights + fp32 scales when quantized; any unquantized
     # leaves — norms, pos, MoE experts — at their real width)

@@ -46,7 +46,9 @@ class TransformerConfig:
     #: the Pallas flash kernel fwd+bwd (no (S,S) matrix in HBM — the
     #: training hot path on real chips); "ring" = long-context mode —
     #: params replicated, sequence sharded over "model", attention rotates
-    #: KV blocks around the ICI ring (ring_attention.py)
+    #: KV blocks around the ICI ring (ring_attention.py); "ulysses" =
+    #: long-context via TWO all-to-alls per layer (sequence->heads
+    #: re-shard, local flash kernel, re-shard back — ulysses.py)
     attention: str = "standard"
     #: rematerialize each layer on the backward pass (jax.checkpoint):
     #: trades recompute FLOPs for activation HBM — the standard lever for
@@ -114,7 +116,7 @@ def param_specs(cfg: TransformerConfig) -> dict:
     dimension (long context)."""
     from .moe import moe_param_specs
 
-    if cfg.attention == "ring":
+    if cfg.attention in ("ring", "ulysses"):
         layers = []
         for i in range(cfg.n_layers):
             rep = {"ln1": P(), "ln2": P(), "wqkv": P(), "wo": P()}
@@ -146,6 +148,13 @@ def param_specs(cfg: TransformerConfig) -> dict:
 def _ring_attn(mesh: Mesh):
     from .ring_attention import ring_attention
     return ring_attention(mesh, "model", causal=True)
+
+
+@functools.lru_cache(maxsize=8)
+def _ulysses_attn(mesh: Mesh, block_q: int, block_k: int):
+    from .ulysses import ulysses_attention
+    return ulysses_attention(mesh, "model", causal=True,
+                             block_q=block_q, block_k=block_k)
 
 
 @functools.lru_cache(maxsize=8)
@@ -223,6 +232,10 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         q, k, v = heads(q), heads(k), heads(v)
         if cfg.attention == "ring" and mesh is not None:
             o = _ring_attn(mesh)(q, k, v).reshape(B, S, cfg.d_model)
+        elif cfg.attention == "ulysses" and mesh is not None:
+            o = _ulysses_attn(mesh, cfg.flash_block_q,
+                              cfg.flash_block_k)(q, k, v).reshape(
+                                  B, S, cfg.d_model)
         elif cfg.attention == "flash":
             o = _flash_attn(mesh, cfg.flash_block_q,
                             cfg.flash_block_k)(q, k, v).reshape(

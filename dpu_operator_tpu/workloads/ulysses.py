@@ -1,0 +1,62 @@
+"""Ulysses-style all-to-all sequence parallelism for long context.
+
+The second of the two long-context schemes the framework supports
+(workloads/ring_attention.py is the other): activations arrive sequence-
+sharded (S/n per device, all heads); an all-to-all re-shards to
+heads-sharded (full sequence, H/n heads), the Pallas flash kernel runs
+locally per head group — full causal attention, no (S, S)
+materialization — and a second all-to-all restores sequence sharding.
+
+Versus the ring: two all-to-alls per layer instead of n ppermute hops,
+and the attention itself is the SAME differentiable flash kernel the tp
+path uses (ops/flash_attention.py carries a custom VJP), so this mode
+trains — the ring path's online-softmax accumulation is pure XLA and
+also trains, but its per-hop (S/n)^2 score blocks cost more memory.
+Requires n_heads % axis_size == 0 and S % axis_size == 0.
+
+Public technique: DeepSpeed-Ulysses sequence parallelism; implementation
+is shard_map + lax.all_to_all over the mesh axis, XLA-native.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ulysses_attention(mesh: Mesh, axis: str = "model",
+                      causal: bool = True, block_q: int = 512,
+                      block_k: int = 512):
+    """Jitted (q, k, v) -> attention with sequence sharded on *axis*.
+
+    q/k/v: (B, S, H, D) global, sequence-sharded on entry and exit; heads
+    are sharded only transiently inside the all-to-all sandwich."""
+    from ..ops.flash_attention import flash_attention_vjp
+
+    n = mesh.shape[axis]
+    spec = P(None, axis, None, None)  # (B, S/n, H, D) per device
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def _attn(q, k, v):
+        if n == 1:
+            return flash_attention_vjp(q, k, v, causal, block_q, block_k)
+
+        def seq_to_heads(t):
+            # (B, S/n, H, D) -> all-to-all: scatter heads, gather seq
+            # -> (B, S, H/n, D)
+            return lax.all_to_all(t, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        def heads_to_seq(t):
+            return lax.all_to_all(t, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        out = flash_attention_vjp(qh, kh, vh, causal, block_q, block_k)
+        return heads_to_seq(out)
+
+    return jax.jit(_attn)

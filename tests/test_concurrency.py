@@ -282,3 +282,129 @@ def test_device_plugin_allocate_vs_health_storm(short_tmp):
             assert all(len(v) == 1 for v in views)
     finally:
         flipper.join()
+
+
+@pytest.fixture
+def chain_manager(short_tmp, kube):
+    """nf_manager plus the chain-steering state the repair/boundary/
+    status paths touch."""
+    from dpu_operator_tpu.cni import NetConfCache
+    from dpu_operator_tpu.daemon import TpuSideManager
+    from dpu_operator_tpu.utils.path_manager import PathManager
+
+    mgr = TpuSideManager.__new__(TpuSideManager)
+    pm = PathManager(short_tmp)
+    mgr.vsp = _CountingVsp()
+    mgr.path_manager = pm
+    mgr.client = kube
+    mgr.ipam_dir = pm.cni_cache_dir() + "/ipam"
+    mgr.nf_cache = NetConfCache(pm.cni_cache_dir() + "/nf")
+    mgr._attach_store = {}
+    mgr._attach_lock = threading.Lock()
+    mgr._chain_store = {}
+    mgr._chain_hops = {}
+    mgr._degraded_hops = set()
+    mgr._repair_pass_lock = threading.Lock()
+    mgr.link_prober = None
+    return mgr
+
+
+def _annotated_nf_pod(kube, name, sfc, index):
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {"tpu.openshift.io/sfc": sfc,
+                                     "tpu.openshift.io/sfc-index":
+                                         str(index)}},
+        "spec": {"containers": [{"name": "c"}]}})
+
+
+def _chain_req(sandbox, device, ifname, pod, ports):
+    from dpu_operator_tpu.cni.types import NetConf
+
+    class Req:
+        pass
+
+    r = Req()
+    r.sandbox_id = sandbox
+    r.device_id = device
+    r.ifname = ifname
+    r.netns = "/var/run/netns/x"
+    r.pod_name = pod
+    r.pod_namespace = "default"
+    r.netconf = NetConf(cni_version="0.4.0", name="",
+                        mode="network-function", device_id=device)
+    r.netconf.ici_ports = list(ports)
+    return r
+
+
+def test_chain_repair_sync_status_storm_no_orphan_wires(chain_manager,
+                                                        kube):
+    """Repair passes (with flickering link state), boundary-sync spec
+    churn, status readers, and sandbox teardowns all racing on one
+    chain: after quiescence + full teardown, every wire that ever hit
+    the dataplane is also unwired (no orphan steering state) and the
+    hop table is empty."""
+    import random
+
+    mgr = chain_manager
+    kube.create({
+        "apiVersion": "config.tpu.openshift.io/v1",
+        "kind": "ServiceFunctionChain",
+        "metadata": {"name": "storm", "namespace": "default"},
+        "spec": {"ingress": "host0-0", "egress": "host0-1",
+                 "networkFunctions": [{"name": "a", "image": "i"},
+                                      {"name": "b", "image": "i"}]}})
+    _annotated_nf_pod(kube, "storm-a", "storm", 0)
+    _annotated_nf_pod(kube, "storm-b", "storm", 1)
+
+    flicker = {"down": False}
+
+    def prober(chip):
+        return [{"port": "x+", "up": not flicker["down"], "wired": True,
+                 "fault": flicker["down"]}]
+
+    mgr.link_prober = prober
+
+    def wire_chain(round_id):
+        a, b = f"sA{round_id:03d}00000", f"sB{round_id:03d}00000"
+        for sbx, pod, chips, ports in (
+                (a, "storm-a", ("chip-0", "chip-1"),
+                 ["ici-0-x+", "ici-1-x+"]),
+                (b, "storm-b", ("chip-2", "chip-3"),
+                 ["ici-2-x+", "ici-3-x+"])):
+            mgr._cni_nf_add(_chain_req(sbx, chips[0], "net1", pod, ports))
+            mgr._cni_nf_add(_chain_req(sbx, chips[1], "net2", pod, ports))
+        return a, b
+
+    for round_id in range(3):
+        a, b = wire_chain(round_id)
+
+        def op(i):
+            kind = i % 4
+            if kind == 0:
+                flicker["down"] = bool(random.getrandbits(1))
+                mgr.repair_chains()
+            elif kind == 1:
+                egress = "host0-1" if i % 8 < 4 else "host0-9"
+                mgr.sync_chain_boundaries("default", "storm",
+                                          ingress="host0-0",
+                                          egress=egress, n_nfs=2)
+            elif kind == 2:
+                mgr.chain_status("default", "storm")
+                mgr.get_chains()
+            else:
+                mgr.repair_chains()
+
+        _storm(12, op)
+        flicker["down"] = False
+        mgr._cni_nf_del(_chain_req(a, None, "", "storm-a", []))
+        mgr._cni_nf_del(_chain_req(b, None, "", "storm-b", []))
+        # boundary hops referencing the departed entries drain on the
+        # next sync (the reconciler's resync in production)
+        mgr.sync_chain_boundaries("default", "storm", ingress="host0-0",
+                                  egress="host0-1", n_nfs=2)
+
+    assert mgr._chain_hops == {}, mgr._chain_hops
+    orphans = set(mgr.vsp.wired) - set(mgr.vsp.unwired)
+    assert not orphans, orphans

@@ -58,3 +58,46 @@ def test_bad_log_level():
     obj["spec"]["logLevel"] = -1
     with pytest.raises(ValidationError, match="logLevel"):
         validate_tpu_operator_config(obj)
+
+
+def test_sfc_validation_matrix():
+    """SFC admission: unique NF names required; boundary bindings must be
+    well-formed slice-attachment names (a typo would otherwise sit as a
+    never-converging boundary hop)."""
+    import pytest
+
+    from dpu_operator_tpu.api.webhook import (
+        ValidationError, validate_service_function_chain)
+
+    ok = {"kind": "ServiceFunctionChain",
+          "spec": {"ingress": "host0-0", "egress": "nf0-3",
+                   "networkFunctions": [{"name": "a"}, {"name": "b"}]}}
+    validate_service_function_chain(ok)  # no raise
+
+    for mutate, match in (
+            (lambda s: s.update(ingress="bogus"), "invalid ingress"),
+            (lambda s: s.update(egress="host-1"), "invalid egress"),
+            (lambda s: s.update(networkFunctions=[{"name": "a"},
+                                                  {"name": "a"}]),
+             "unique"),
+            (lambda s: s.update(networkFunctions=[{"name": ""}]),
+             "needs a name")):
+        bad = {"kind": "ServiceFunctionChain",
+               "spec": {"networkFunctions": [{"name": "a"}]}}
+        mutate(bad["spec"])
+        with pytest.raises(ValidationError, match=match):
+            validate_service_function_chain(bad)
+
+
+def test_sfc_validation_dispatched_by_kind(kube):
+    """The webhook server routes SFC objects to the SFC validator."""
+    from dpu_operator_tpu.webhook import WebhookServer
+
+    wh = WebhookServer(kube, switch_poll_interval=60.0)
+    resp = wh.review_validate({"request": {
+        "uid": "u", "operation": "CREATE",
+        "object": {"kind": "ServiceFunctionChain",
+                   "spec": {"ingress": "not-an-attachment",
+                            "networkFunctions": [{"name": "a"}]}}}})
+    assert resp["response"]["allowed"] is False
+    assert "invalid ingress" in resp["response"]["status"]["message"]
