@@ -189,7 +189,7 @@ def bench_compute():
         best_of = int(os.environ.get("TPU_BENCH_BEST_OF", "3"))
         flash_kw = dict(b=4, s=2048, h=8, d=128, iters=int(
             os.environ.get("TPU_BENCH_FLASH_ITERS", "400")),
-            best_of=max(best_of, 5))
+            best_of=max(best_of, 8))
         # decode chains must be LONG: at ~1 ms/token a 64-step chain is
         # smaller than tunnel jitter and the min-of-slopes estimator
         # biases low (decode once "beat" the HBM roofline 2x); 256 steps

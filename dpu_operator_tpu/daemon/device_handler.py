@@ -25,10 +25,19 @@ _PCI_RE = re.compile(
 
 class TpuDeviceHandler:
     def __init__(self, vsp, tpu_mode: bool,
-                 num_chips: int = DEFAULT_NUM_CHIPS):
+                 num_chips: int = DEFAULT_NUM_CHIPS,
+                 topology_provider=None):
+        """*topology_provider*: optional callable -> SliceTopology | None.
+        Host-side devices arrive with a stable ``chip_index`` but no
+        torus coords (the host VSP enumerates PCIe functions, not the
+        mesh); when the provider can name the slice topology — the host
+        manager learns it from the TPU-side daemon over the
+        cross-boundary plane — coords are decorated in so
+        GetPreferredAllocation is topology-aware on the host too."""
         self.vsp = vsp
         self.tpu_mode = tpu_mode
         self.num_chips = num_chips
+        self.topology_provider = topology_provider
         self._setup_done = threading.Event()
 
     def setup_devices(self):
@@ -54,7 +63,18 @@ class TpuDeviceHandler:
             if bad:
                 raise ValueError(
                     f"host-side device ids must be PCI addresses, got {bad}")
+            self._decorate_coords(devs)
         return devs
+
+    def _decorate_coords(self, devs: dict):
+        topo = self.topology_provider() if self.topology_provider else None
+        if topo is None:
+            return
+        for info in devs.values():
+            ci = info.get("chip_index")
+            if (ci is not None and not info.get("coords")
+                    and 0 <= int(ci) < topo.num_chips):
+                info["coords"] = list(topo.chips[int(ci)].coords)
 
 
 class IciPortDeviceHandler:

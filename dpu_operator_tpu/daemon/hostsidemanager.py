@@ -41,7 +41,12 @@ class HostSideManager:
         self.client = client
         self.dial_retries = dial_retries
         self.dial_backoff = dial_backoff
-        self.device_handler = TpuDeviceHandler(self.vsp, tpu_mode=False)
+        self._slice_topology = None
+        self._topology_ok_at = 0.0       # last successful fetch
+        self._topology_attempt_at = -1e9  # last attempt (cooldown)
+        self.device_handler = TpuDeviceHandler(
+            self.vsp, tpu_mode=False,
+            topology_provider=self._fetch_slice_topology)
         self.device_plugin = DevicePlugin(
             self.device_handler, resource=v.TPU_RESOURCE_NAME,
             path_manager=path_manager)
@@ -110,6 +115,42 @@ class HostSideManager:
         raise ConnectionError(
             f"tpu-side daemon unreachable after {self.dial_retries} tries: "
             f"{last}")
+
+    #: re-confirm the learned topology this often (a restarted tpu-side
+    #: daemon can come back on a differently-shaped slice — stale coords
+    #: would silently co-locate non-adjacent chips)
+    TOPOLOGY_TTL = 60.0
+    #: after a failed/empty fetch, do not re-dial for this long — a
+    #: blackholed tpu side must not add the 2 s deadline to every
+    #: ListAndWatch poll and CNI ADD
+    TOPOLOGY_RETRY_COOLDOWN = 5.0
+
+    def _fetch_slice_topology(self):
+        """Slice topology for host-side coords decoration, learned from
+        the TPU-side daemon's GetSliceInfo over the cross-boundary plane.
+        ONE dial attempt with a short deadline, TTL'd on success,
+        cooldown'd on failure; a failed refresh keeps serving the last
+        known topology (stale coords beat none until the next success)."""
+        now = time.monotonic()
+        fresh = (self._slice_topology is not None
+                 and now - self._topology_ok_at < self.TOPOLOGY_TTL)
+        in_cooldown = (now - self._topology_attempt_at
+                       < self.TOPOLOGY_RETRY_COOLDOWN)
+        if fresh or in_cooldown or self._tpu_daemon_addr is None:
+            return self._slice_topology
+        self._topology_attempt_at = now
+        ip, port = self._tpu_daemon_addr
+        try:
+            from .slicejoin import fetch_slice_info
+            info = fetch_slice_info(f"{ip}:{port}", timeout=2.0)
+            topo = info.get("topology", "")
+            if topo:
+                from ..ici import SliceTopology
+                self._slice_topology = SliceTopology(topo)
+                self._topology_ok_at = now
+        except Exception:  # noqa: BLE001 — decoration is best-effort
+            pass
+        return self._slice_topology
 
     def create_slice_attachment(self, host: int, chip: int,
                                 topology: str = "") -> dict:

@@ -40,7 +40,10 @@ class JoinResult:
         return bool(self.unreachable)
 
 
-def _fetch_info(address: str, timeout: float) -> dict:
+def fetch_slice_info(address: str, timeout: float = 5.0) -> dict:
+    """One GetSliceInfo round-trip to a cross-boundary address — the
+    shared plumbing for the peer walk below and the host daemon's
+    topology learning (hostsidemanager._fetch_slice_topology)."""
     channel = VspChannel(address)
     try:
         channel.wait_ready(timeout=timeout)
@@ -69,7 +72,7 @@ def join_slices(seed_address: str, dial_timeout: float = 5.0,
             continue
         seen.add(addr)
         try:
-            info = _fetch_info(addr, dial_timeout)
+            info = fetch_slice_info(addr, dial_timeout)
         except Exception:  # noqa: BLE001 — degrade, don't wedge
             log.warning("slice peer %s unreachable during join", addr)
             unreachable.append(addr)

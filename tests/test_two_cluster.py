@@ -534,3 +534,50 @@ def test_live_spec_edit_converges_boundary_hops(cluster):
                   mgr.chain_status("default", "live")) == [0]
     wires = cluster.cp_client.list_wires()
     assert not any("host0-" in e for w in wires for e in w)
+
+
+def test_host_side_learns_topology_for_preferred_allocation(cluster):
+    """The host daemon learns the slice topology from the TPU-side
+    daemon over the cross-boundary plane and decorates its PCIe devices
+    with torus coords — host-side GetPreferredAllocation becomes
+    topology-aware instead of degenerating to id order."""
+    devs = cluster.host_mgr.device_handler.get_devices()
+    # v5e-8 is a 2x4 grid: chip_index i -> coords (i//4, i%4)
+    for dev_id, info in devs.items():
+        ci = info["chip_index"]
+        assert info["coords"] == [ci // 4, ci % 4], (dev_id, info)
+
+    # adjacency-aware pick from a genuinely SCATTERED subset: indices
+    # 0, 2, 3, 7 — id order would pick 0 (0,0) and 2 (0,2), distance 2;
+    # only real coords find an adjacent pair (2-3 or 3-7)
+    from dpu_operator_tpu.deviceplugin.server import _preferred_chips
+    by_index = {info["chip_index"]: dev_id
+                for dev_id, info in devs.items()}
+    available = [by_index[i] for i in (0, 2, 3, 7)]
+    picked = _preferred_chips(available, [], 2, devs)
+    assert sorted(picked) != sorted(available[:2]), (
+        "picked the id-order pair — coords were ignored")
+    c0 = devs[picked[0]]["coords"]
+    c1 = devs[picked[1]]["coords"]
+    assert abs(c0[0] - c1[0]) + abs(c0[1] - c1[1]) == 1, (picked, c0, c1)
+
+
+def test_host_topology_fetch_tolerates_tpu_side_down(short_tmp,
+                                                     agent_binary):
+    """With the cross-boundary plane dead, device enumeration still
+    works (coords just stay absent) — decoration is best-effort and
+    must not stall the ListAndWatch poll behind the dial retry budget."""
+    import time
+
+    cluster = _TwoCluster(short_tmp + "/t", agent_binary, dial_retries=2,
+                          dial_backoff=0.01)
+    try:
+        cluster.tpu_mgr._slice_server.stop()
+        t0 = time.monotonic()
+        devs = cluster.host_mgr.device_handler.get_devices()
+        elapsed = time.monotonic() - t0
+        assert len(devs) == cluster.N_DEVICES
+        assert all(not d["coords"] for d in devs.values())
+        assert elapsed < 4.0, f"device poll stalled {elapsed:.1f}s"
+    finally:
+        cluster.stop()
