@@ -179,7 +179,6 @@ class TpuSideManager:
         self.cni_server.start()
 
     def serve(self):
-        self.device_plugin.register_with_kubelet()
         # advertise google.com/ici-port once the VSP reported its slice
         # topology (the BASELINE north-star: ICI links schedulable
         # alongside chips); worker index from the TPU VM environment
@@ -188,7 +187,23 @@ class TpuSideManager:
             from ..ici import SliceTopology
             topo = SliceTopology(topology)
             worker = int(os.environ.get("TPU_WORKER_ID", "0"))
+            # bootstrap contract: Allocate exports the facts the OPERATOR
+            # owns — this host's index in the slice and the slice shape.
+            # Job-level facts (process count, coordinator address) belong
+            # to the JOB that spans hosts and ride the pod spec; the
+            # workload merges both (workloads/bootstrap.py). Exporting a
+            # slice-wide count here would tell a lone single-host pod to
+            # wait for peers that do not exist. Set BEFORE kubelet
+            # registration: an Allocate racing serve() must not miss it.
+            self.device_plugin.extra_env_provider = lambda: {
+                "TPU_WORKER_ID": str(worker),
+                "TPU_HOSTS_PER_SLICE": str(topo.num_hosts),
+                "TPU_SLICE_TOPOLOGY": topo.topology,
+            }
+            self.device_plugin.register_with_kubelet()
             self.enable_ici_ports(lambda: (topo, worker))
+        else:
+            self.device_plugin.register_with_kubelet()
         if self.client is not None:
             self._manager = Manager(self.client)
             self._manager.add_reconciler(

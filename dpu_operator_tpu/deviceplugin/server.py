@@ -187,7 +187,8 @@ class DevicePlugin:
     def __init__(self, device_handler, resource: str = v.TPU_RESOURCE_NAME,
                  path_manager: Optional[PathManager] = None,
                  libtpu_path: str = "", poll_interval: float = POLL_INTERVAL,
-                 preferred_fn=None, allocation_listener=None):
+                 preferred_fn=None, allocation_listener=None,
+                 extra_env_provider=None):
         self.device_handler = device_handler
         self.resource = resource
         self.path_manager = path_manager or PathManager()
@@ -200,6 +201,11 @@ class DevicePlugin:
         #: called with the device-id list of every successful Allocate
         #: (the chip plugin feeds the port plugin's affinity this way)
         self.allocation_listener = allocation_listener
+        #: callable -> dict of extra env to export on every Allocate —
+        #: the multi-host bootstrap contract (TPU_WORKER_ID/COUNT,
+        #: TPU_COORDINATOR_ADDRESS) the workload's
+        #: bootstrap.initialize_from_operator_env consumes
+        self.extra_env_provider = extra_env_provider
         self._server: Optional[grpc.Server] = None
         self._stop = threading.Event()
         self._poke = threading.Event()
@@ -373,6 +379,11 @@ class DevicePlugin:
                 "TPU_DEVICE_IDS": ",".join(ids),
                 "TPU_CHIPS_PER_PROCESS_BOUNDS": str(len(ids)),
             }
+            if self.extra_env_provider is not None:
+                try:
+                    envs.update(self.extra_env_provider() or {})
+                except Exception:  # noqa: BLE001 — bootstrap env is
+                    log.exception("extra env provider failed")  # optional
             if self.resource == v.ICI_RESOURCE_NAME:
                 # the ici-port personality: the allocated port ids are the
                 # chain-steering input the CNI consumes (VERDICT r2 #2 —

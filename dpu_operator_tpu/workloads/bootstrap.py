@@ -1,0 +1,68 @@
+"""Multi-host runtime bootstrap from operator-provided environment.
+
+Two parties contribute, each the facts it owns:
+
+- the OPERATOR's device plugin exports this host's slice position on
+  every chip Allocate (deviceplugin/server.py): TPU_WORKER_ID,
+  TPU_HOSTS_PER_SLICE, TPU_SLICE_TOPOLOGY;
+- the JOB that spans hosts (JobSet/StatefulSet-style — one pod per
+  host) sets TPU_WORKER_COUNT and TPU_COORDINATOR_ADDRESS (a headless
+  service for its pod 0) in the pod spec.
+
+A workload entrypoint calls :func:`initialize_from_operator_env` before
+touching ``jax.devices()``: with both halves present the JAX
+multi-controller runtime forms across the job's hosts; a lone pod (no
+job env) stays single-host — the operator deliberately never exports a
+process COUNT, because a slice-wide count would tell a 1-pod allocation
+to wait for peers that do not exist.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def distributed_env(environ=None) -> Optional[dict]:
+    """`jax.distributed.initialize` kwargs from the merged operator+job
+    env, or None for a single-host workload (initialize must NOT be
+    called then — a one-process "cluster" would wedge waiting on a
+    coordinator). TPU_WORKER_COUNT comes from the JOB spec; the
+    operator-exported TPU_WORKER_ID supplies the process id."""
+    environ = os.environ if environ is None else environ
+    count = int(environ.get("TPU_WORKER_COUNT", "1") or 1)
+    if count <= 1:
+        return None
+    coordinator = environ.get("TPU_COORDINATOR_ADDRESS", "")
+    if not coordinator:
+        raise RuntimeError(
+            "TPU_WORKER_COUNT > 1 but TPU_COORDINATOR_ADDRESS unset — "
+            "the device plugin exports both on Allocate; is this pod "
+            "consuming google.com/tpu?")
+    return {
+        "coordinator_address": coordinator,
+        "num_processes": count,
+        "process_id": int(environ.get("TPU_WORKER_ID", "0") or 0),
+    }
+
+
+def initialize_from_operator_env(environ=None,
+                                 initialize=None) -> Optional[dict]:
+    """Bring up the multi-host runtime when the env says so; returns the
+    kwargs used (None = single-host, nothing to do). *initialize* is
+    injectable for tests; defaults to ``jax.distributed.initialize``."""
+    kwargs = distributed_env(environ)
+    if kwargs is None:
+        log.info("single-host allocation; skipping distributed init")
+        return None
+    if initialize is None:
+        import jax
+        initialize = jax.distributed.initialize
+    log.info("initializing multi-host runtime: process %d/%d via %s",
+             kwargs["process_id"], kwargs["num_processes"],
+             kwargs["coordinator_address"])
+    initialize(**kwargs)
+    return kwargs

@@ -1,0 +1,96 @@
+"""Multi-host bootstrap contract: the device plugin exports the slice
+position on Allocate, and workloads/bootstrap.py turns that env into
+jax.distributed.initialize arguments — the glue between "pod got chips"
+and "the multi-controller runtime is up"."""
+
+import pytest
+
+from dpu_operator_tpu.workloads.bootstrap import (
+    distributed_env, initialize_from_operator_env)
+
+
+def test_distributed_env_single_host_is_none():
+    assert distributed_env({}) is None
+    assert distributed_env({"TPU_WORKER_COUNT": "1"}) is None
+
+
+def test_distributed_env_multi_host():
+    env = {"TPU_WORKER_COUNT": "4", "TPU_WORKER_ID": "2",
+           "TPU_COORDINATOR_ADDRESS": "10.0.0.1:8476"}
+    assert distributed_env(env) == {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 4, "process_id": 2}
+
+
+def test_distributed_env_missing_coordinator_is_loud():
+    with pytest.raises(RuntimeError, match="TPU_COORDINATOR_ADDRESS"):
+        distributed_env({"TPU_WORKER_COUNT": "2"})
+
+
+def test_initialize_called_with_env_args():
+    calls = []
+    env = {"TPU_WORKER_COUNT": "2", "TPU_WORKER_ID": "1",
+           "TPU_COORDINATOR_ADDRESS": "coord:8476"}
+    out = initialize_from_operator_env(env, initialize=lambda **kw:
+                                       calls.append(kw))
+    assert calls == [out] == [{"coordinator_address": "coord:8476",
+                               "num_processes": 2, "process_id": 1}]
+    # single-host never calls initialize (it would wedge on a
+    # coordinator that does not exist)
+    assert initialize_from_operator_env({}, initialize=lambda **kw:
+                                        calls.append(kw)) is None
+    assert len(calls) == 1
+
+
+def test_allocate_exports_bootstrap_env(short_tmp, kube, node_agent):
+    """e2e: a chip Allocate on a MULTI-HOST slice (v5e-16 = 2 hosts)
+    carries the worker's position + coordinator — exactly what
+    initialize_from_operator_env consumes inside the pod."""
+    from dpu_operator_tpu.daemon import TpuSideManager
+    from dpu_operator_tpu.deviceplugin.fake_kubelet import FakeKubelet
+    from dpu_operator_tpu.platform.vendordetector import TpuDetector
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    from dpu_operator_tpu.vsp.mock import MockTpuVsp
+    from dpu_operator_tpu.vsp.plugin import GrpcPlugin
+    from dpu_operator_tpu.vsp.rpc import VspServer
+
+    pm = PathManager(short_tmp)
+    node_agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+    kubelet = FakeKubelet(pm, node_agent=node_agent, node_name="tpu-vm-0")
+    kubelet.start()
+    mock = MockTpuVsp(topology="v5e-16", port=0)
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    srv = VspServer(mock, socket_path=sock)
+    srv.start()
+    det = TpuDetector().detection_result(tpu_mode=True, identifier="b")
+    mgr = TpuSideManager(GrpcPlugin(det, path_manager=pm,
+                                    init_timeout=5.0), pm, client=kube)
+    mgr.device_plugin.poll_interval = 0.1
+    try:
+        mgr.start_vsp()
+        mgr.setup_devices()
+        mgr.listen()
+        mgr.serve()
+        # setup pins SetNumChips(8) — one host of the v5e-16
+        assert kubelet.wait_for_devices("google.com/tpu", 8)
+        resp = kubelet.allocate("google.com/tpu", ["chip-0", "chip-1"])
+        envs = dict(resp.container_responses[0].envs)
+        assert envs["TPU_WORKER_ID"] == "0"
+        assert envs["TPU_HOSTS_PER_SLICE"] == "2"  # v5e-16 = 2 hosts
+        assert envs["TPU_SLICE_TOPOLOGY"] == "v5e-16"
+        # the operator NEVER exports a process count or coordinator —
+        # a lone pod must stay single-host (no peers to wait for)
+        assert "TPU_WORKER_COUNT" not in envs
+        assert distributed_env(envs) is None
+        # a host-spanning JOB adds its half in the pod spec; merged,
+        # the workload initializes with the operator-provided rank
+        job_env = dict(envs, TPU_WORKER_COUNT="2",
+                       TPU_COORDINATOR_ADDRESS="job-0.coord:8476")
+        kwargs = distributed_env(job_env)
+        assert kwargs == {"coordinator_address": "job-0.coord:8476",
+                          "num_processes": 2, "process_id": 0}
+    finally:
+        mgr.stop()
+        srv.stop()
+        kubelet.stop()

@@ -134,6 +134,7 @@ def test_state_survives_restart(agent_binary, short_tmp):
     client.init("v5e-8")
     client.attach(3)
     client.wire_nf("in0", "out0")
+    client.set_link(3, "x+", up=False)  # injected fault must survive too
     client.close()
     proc.stop()
 
@@ -145,6 +146,11 @@ def test_state_survives_restart(agent_binary, short_tmp):
     assert chips[3]["attached"] is True
     with pytest.raises(AgentError):
         client2.wire_nf("in0", "out0")  # wire persisted → duplicate
+    # the fault state replayed: the dark port is still dark (and still
+    # reported faulted to the device plugin), its neighbors still up
+    states = {p["port"]: p for p in client2.link_state(3)}
+    assert states["x+"]["fault"] and not states["x+"]["up"]
+    assert not states["y+"]["fault"]
     client2.close()
     proc2.stop()
 
