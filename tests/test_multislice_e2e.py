@@ -272,3 +272,33 @@ def test_multislice_train_step_shards_batch_over_dcn():
     _, _, loss = step(params, opt, batch)
     assert jnp.isfinite(loss)
     assert float(loss) > 0
+
+
+def test_tpuctl_slice_group_cli(two_slices):
+    """`tpuctl slice-group --daemon-addr` prints the joint group as
+    strict JSON (single-slice dcn bound serializes as null, never the
+    invalid bare Infinity)."""
+    import json
+
+    from dpu_operator_tpu import tpuctl
+
+    a, b = two_slices
+
+    def run(addr):
+        args = type("A", (), {"cmd": "slice-group", "daemon_addr": addr,
+                              "agent_socket": "", "vsp_socket": ""})()
+        out = tpuctl.run(args)
+        json.loads(json.dumps(out, allow_nan=False))  # strict-JSON safe
+        return out
+
+    solo = run(a.address)
+    assert solo["numChips"] == 4
+    assert solo["dcnAllreduceAlgbwGbps"] is None  # no DCN leg yet
+
+    _join(a.address, b.address, "host0-0")
+    _join(b.address, a.address, "host0-0")
+    joined = run(b.address)
+    assert joined["numChips"] == 8
+    assert joined["slices"] == ["v5e-4", "v5e-4"]
+    assert joined["degraded"] is False
+    assert joined["dcnAllreduceAlgbwGbps"] > 0
