@@ -14,7 +14,6 @@ expected slice topology (e.g. "v5e-16") that detection validates against.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
 
 from ..utils import vars as v
 

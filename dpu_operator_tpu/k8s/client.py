@@ -13,7 +13,7 @@ spec/status) — the unstructured style the reference's render engine uses
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Optional, Protocol
+from typing import Callable, Optional, Protocol
 
 
 def gvk_key(api_version: str, kind: str) -> str:

@@ -16,7 +16,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from ..ici.topology import SliceTopology, slice_shape
+from ..ici.topology import SliceTopology
 
 
 def _balanced_factor(n: int, k: int) -> tuple[int, ...]:

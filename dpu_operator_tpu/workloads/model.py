@@ -22,7 +22,6 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 import functools
-from functools import partial
 from typing import Any
 
 import jax

@@ -14,7 +14,6 @@ collective schedule is identical.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import Optional, Sequence
 
