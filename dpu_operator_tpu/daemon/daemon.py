@@ -115,19 +115,20 @@ class Daemon:
 
     def _create_manager(self, detection):
         vsp = self.vsp_plugin_factory(detection)
+        workload_image = ""
+        if self.image_manager is not None:
+            from ..images import TPU_WORKLOAD_IMAGE
+            try:
+                workload_image = self.image_manager.get_image(
+                    TPU_WORKLOAD_IMAGE)
+            except KeyError:
+                pass  # dev/standalone: SFC NFs must name their image
         if detection.tpu_mode:
-            workload_image = ""
-            if self.image_manager is not None:
-                from ..images import TPU_WORKLOAD_IMAGE
-                try:
-                    workload_image = self.image_manager.get_image(
-                        TPU_WORKLOAD_IMAGE)
-                except KeyError:
-                    pass  # dev/standalone: SFC NFs must name their image
             return TpuSideManager(vsp, self.path_manager, client=self.client,
                                   workload_image=workload_image,
                                   node_name=self.node_name)
-        return HostSideManager(vsp, self.path_manager, client=self.client)
+        return HostSideManager(vsp, self.path_manager, client=self.client,
+                               workload_image=workload_image)
 
     def _run_manager(self, mgr):
         try:

@@ -35,12 +35,13 @@ log = logging.getLogger(__name__)
 class HostSideManager:
     def __init__(self, vsp_plugin, path_manager: PathManager,
                  client=None, dial_retries: int = 8,
-                 dial_backoff: float = 0.25):
+                 dial_backoff: float = 0.25, workload_image: str = ""):
         self.vsp = vsp_plugin
         self.path_manager = path_manager
         self.client = client
         self.dial_retries = dial_retries
         self.dial_backoff = dial_backoff
+        self.workload_image = workload_image
         self._slice_topology = None
         self._topology_ok_at = 0.0       # last successful fetch
         self._topology_attempt_at = -1e9  # last attempt (cooldown)
@@ -76,7 +77,8 @@ class HostSideManager:
         self.device_plugin.register_with_kubelet()
         if self.client is not None:
             self._manager = Manager(self.client)
-            self._manager.add_reconciler(SfcReconciler())
+            self._manager.add_reconciler(
+                SfcReconciler(workload_image=self.workload_image))
             self._manager.start()
 
     def stop(self):

@@ -375,3 +375,30 @@ def test_tls_cert_hot_reload_serves_new_cert(apiserver, real_kube, webhook,
         webhook.certfile)
     real_kube.update(cfg)
     real_kube.create(_cfg(mode="tpu"))
+
+
+def test_sfc_validated_through_the_wire(apiserver, real_kube, webhook):
+    """SFC admission over genuine HTTPS: the production webhook rule set
+    (servicefunctionchains in the resources list, matching
+    config/webhook/webhook.yaml) routes SFC creates to /validate, which
+    denies a malformed boundary binding and admits a clean chain."""
+    cfg = _validating_config(webhook)
+    cfg["webhooks"][0]["rules"][0]["resources"] = [
+        "tpuoperatorconfigs", "servicefunctionchains"]
+    real_kube.create(cfg)
+
+    bad = {"apiVersion": API_VERSION, "kind": "ServiceFunctionChain",
+           "metadata": {"name": "bad", "namespace": "default"},
+           "spec": {"ingress": "not-an-attachment",
+                    "networkFunctions": [{"name": "a", "image": "i"}]}}
+    with pytest.raises(requests.HTTPError) as exc:
+        real_kube.create(bad)
+    assert exc.value.response.status_code == 403
+    assert "invalid ingress" in exc.value.response.text
+
+    good = {"apiVersion": API_VERSION, "kind": "ServiceFunctionChain",
+            "metadata": {"name": "good", "namespace": "default"},
+            "spec": {"ingress": "host0-0", "egress": "host0-1",
+                     "networkFunctions": [{"name": "a", "image": "i"}]}}
+    created = real_kube.create(good)
+    assert created["metadata"]["name"] == "good"
