@@ -5,6 +5,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
 
 from ..images import EnvImageManager
 from ..platform import HardwarePlatform
@@ -49,6 +50,12 @@ def main(argv=None):
         node_name=os.environ.get("NODE_NAME", ""),
         flavour=args.flavour,
     )
+    # graceful termination (reference: ctrl.SetupSignalHandler via
+    # utils/ctrl.go): kubelet sends SIGTERM on pod deletion; a hard kill
+    # mid-resize could leave the node cordoned or sockets stale —
+    # daemon.stop() runs the managers' orderly teardown instead
+    signal.signal(signal.SIGTERM, lambda *_: daemon.stop())
+    signal.signal(signal.SIGINT, lambda *_: daemon.stop())
     daemon.prepare_and_serve()
 
 

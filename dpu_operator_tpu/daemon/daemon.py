@@ -75,6 +75,10 @@ class Daemon:
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
         self._serve_thread: Optional[threading.Thread] = None
+        # manager teardown must run exactly once, whichever of the
+        # signal handler / serve-loop exit gets there first
+        self._mgr_stop_lock = threading.Lock()
+        self._mgr_stopped = False
 
     # -- prepare (daemon.go:69, :195-209) -------------------------------------
     def prepare(self):
@@ -150,6 +154,11 @@ class Daemon:
                              detection.vendor, detection.tpu_mode,
                              detection.identifier)
                     self.manager = self._create_manager(detection)
+                    if self._stop.is_set():
+                        # SIGTERM raced detection: never start a manager
+                        # the shutdown path has already run past — the
+                        # loop exit below tears it down instead
+                        break
                     self._serve_thread = threading.Thread(
                         target=self._run_manager, args=(self.manager,),
                         daemon=True, name="side-manager")
@@ -159,6 +168,7 @@ class Daemon:
             if not block:
                 return
             self._stop.wait(self.detect_interval)
+        self._stop_manager()  # idempotent; covers the raced-SIGTERM path
         if self._error is not None:
             raise RuntimeError("side manager failed") from self._error
 
@@ -179,9 +189,15 @@ class Daemon:
             time.sleep(0.05)
         return False
 
+    def _stop_manager(self):
+        with self._mgr_stop_lock:
+            if self._mgr_stopped or self.manager is None:
+                return
+            self._mgr_stopped = True
+        self.manager.stop()
+
     def stop(self):
         self._stop.set()
-        if self.manager is not None:
-            self.manager.stop()
+        self._stop_manager()
         if self._serve_thread:
             self._serve_thread.join(timeout=5)
