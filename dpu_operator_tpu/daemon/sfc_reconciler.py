@@ -32,17 +32,21 @@ class SfcReconciler:
     RESYNC_SECONDS = 5.0
 
     def __init__(self, workload_image: str = "",
-                 chain_status_provider=None, boundary_sync=None):
+                 chain_status_provider=None, boundary_sync=None,
+                 cross_host_sync=None):
         """*chain_status_provider*: callable (namespace, name) -> list of
         hop dicts ({index, input, output, degraded}) from the live wire
         table — the TpuSideManager passes its own (chain_status).
         *boundary_sync*: callable (namespace, name, ingress, egress,
         n_nfs) converging spec.ingress/egress boundary hops — lets a
         live spec edit take effect on the next resync, without pod
-        churn."""
+        churn. *cross_host_sync*: callable (namespace, name) converging
+        hops whose downstream NF lives under another daemon (a neighbor
+        that wires after this host's NF lands within one resync)."""
         self.workload_image = workload_image
         self.chain_status_provider = chain_status_provider
         self.boundary_sync = boundary_sync
+        self.cross_host_sync = cross_host_sync
 
     def _network_function_pod(self, sfc: ServiceFunctionChain, nf,
                               index: int = 0) -> dict:
@@ -119,6 +123,14 @@ class SfcReconciler:
                                    len(sfc.network_functions))
             except Exception:  # noqa: BLE001 — next resync retries
                 log.exception("boundary sync failed for %s/%s",
+                              sfc.namespace, sfc.name)
+        if self.cross_host_sync is not None:
+            try:
+                # pass the already-fetched object: the sync must not
+                # re-GET it on every 5 s resync
+                self.cross_host_sync(sfc.namespace, sfc.name, obj)
+            except Exception:  # noqa: BLE001 — next resync retries
+                log.exception("cross-host sync failed for %s/%s",
                               sfc.namespace, sfc.name)
         self._write_status(client, obj, sfc, scheduled, ready)
         return ReconcileResult(requeue_after=self.RESYNC_SECONDS)

@@ -32,3 +32,10 @@ NODE_LABEL_VALUE = "true"
 #: on CreateSliceAttachment) and SFC admission (which validates
 #: spec.ingress/egress against it): host<h>-<chip> / nf<h>-<chip>
 ATTACHMENT_NAME_PATTERN = r"^(?:host|nf)(\d+)-(\d+)$"
+
+#: Node annotation where each tpu-side daemon publishes its cross-boundary
+#: server address (ip:port). Peers use it to steer SFC hops whose
+#: consecutive NFs landed on different hosts of a multi-host slice — the
+#: generalization of the reference's one-host-one-DPU OPI endpoint learned
+#: from VSP Init (marvell/main.go:691-725).
+CROSS_BOUNDARY_ADDR_ANNOTATION = "tpu.openshift.io/cross-boundary-addr"

@@ -47,6 +47,9 @@ class IciDataplane(Protocol):
     def detach_chip(self, chip_index: int) -> None: ...
     def wire_network_function(self, input_id: str, output_id: str) -> None: ...
     def unwire_network_function(self, input_id: str, output_id: str) -> None: ...
+    # optional: (input, output) pairs currently programmed — restart-
+    # recovery ground truth; dataplanes without it report "unknown"
+    # def list_wires(self) -> list[tuple[str, str]]: ...
 
 
 class DebugIciDataplane:
@@ -54,6 +57,7 @@ class DebugIciDataplane:
 
     def __init__(self):
         self.events: list[tuple] = []
+        self.wires: list[tuple] = []
 
     def init_dataplane(self, topology):
         self.events.append(("init", topology.topology))
@@ -68,9 +72,17 @@ class DebugIciDataplane:
 
     def wire_network_function(self, input_id, output_id):
         self.events.append(("wire-nf", input_id, output_id))
+        self.wires.append((input_id, output_id))
 
     def unwire_network_function(self, input_id, output_id):
         self.events.append(("unwire-nf", input_id, output_id))
+        try:
+            self.wires.remove((input_id, output_id))
+        except ValueError:
+            pass
+
+    def list_wires(self):
+        return list(self.wires)
 
 
 class GoogleTpuVsp:
@@ -289,3 +301,16 @@ class GoogleTpuVsp:
         self.dataplane.unwire_network_function(
             req.get("input", ""), req.get("output", ""))
         return {}
+
+    def list_network_functions(self, req: dict) -> dict:
+        """Programmed wire pairs from the dataplane — the daemon's
+        restart-recovery ground truth (the native agent persists them in
+        its crash-safe state file). A dataplane that cannot enumerate
+        reports supported=false, which callers must read as UNKNOWN —
+        an empty list would wrongly drop every journaled hop."""
+        lister = getattr(self.dataplane, "list_wires", None)
+        if lister is None:
+            return {"supported": False, "functions": []}
+        return {"supported": True,
+                "functions": [{"input": i, "output": o}
+                              for i, o in lister()]}

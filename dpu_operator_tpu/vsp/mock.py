@@ -93,3 +93,9 @@ class MockTpuVsp:
             except ValueError:
                 pass
         return {}
+
+    def list_network_functions(self, req: dict) -> dict:
+        with self._lock:
+            return {"supported": True,
+                    "functions": [{"input": i, "output": o}
+                                  for i, o in self.network_functions]}

@@ -275,6 +275,12 @@ class NativeIciDataplane:
     def unwire_network_function(self, input_id, output_id):
         self.client.unwire_nf(input_id, output_id)
 
+    def list_wires(self):
+        """Ground truth for daemon wire-table recovery: the agent's wire
+        table survives both daemon and agent restarts (crash-safe state
+        file replay, native/tpucp/agent.cc)."""
+        return self.client.list_wires()
+
     def chip_links_ok(self, chip_index) -> bool:
         """Health input for the VSP: every wired ICI port trained. An
         unattached chip (no wired ports) is healthy by definition."""

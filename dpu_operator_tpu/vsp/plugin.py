@@ -124,3 +124,13 @@ class GrpcPlugin:
     def delete_network_function(self, input_id: str, output_id: str) -> None:
         self._call("NetworkFunctionService", "DeleteNetworkFunction",
                    {"input": input_id, "output": output_id})
+
+    def list_network_functions(self):
+        """Programmed (input, output) wire pairs, or None when the VSP's
+        dataplane cannot enumerate them (None = unknown, NOT empty)."""
+        resp = self._call("NetworkFunctionService", "ListNetworkFunctions",
+                          {})
+        if not resp.get("supported"):
+            return None
+        return [(f.get("input", ""), f.get("output", ""))
+                for f in resp.get("functions", [])]

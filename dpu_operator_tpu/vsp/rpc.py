@@ -53,10 +53,13 @@ class VspServer:
         ("SliceService", "CreateSliceAttachment"): "create_slice_attachment",
         ("SliceService", "DeleteSliceAttachment"): "delete_slice_attachment",
         ("SliceService", "GetSliceInfo"): "get_slice_info",
+        ("SliceService", "GetChainEntry"): "get_chain_entry",
         ("NetworkFunctionService", "CreateNetworkFunction"):
             "create_network_function",
         ("NetworkFunctionService", "DeleteNetworkFunction"):
             "delete_network_function",
+        ("NetworkFunctionService", "ListNetworkFunctions"):
+            "list_network_functions",
         ("AdminService", "ResizeChips"): "resize_chips",
         ("AdminService", "RepairChains"): "repair_chains",
         ("AdminService", "GetChains"): "get_chains",
@@ -121,7 +124,15 @@ class VspChannel:
         self._channel.close()
 
     def wait_ready(self, timeout: float = 10.0):
-        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        fut = grpc.channel_ready_future(self._channel)
+        try:
+            fut.result(timeout=timeout)
+        except BaseException:
+            # cancel the connectivity watcher: left running, it polls the
+            # channel after close() and dies noisily in a grpc-internal
+            # thread ("Cannot invoke RPC: Channel closed!")
+            fut.cancel()
+            raise
 
     def call(self, service: str, method: str, request: dict,
              timeout: float = 30.0) -> dict:

@@ -1,0 +1,533 @@
+"""Cross-host SFC chain steering + wire-table restart recovery.
+
+VERDICT r4 #2: chain state was daemon-local memory — (a) an SFC whose NF
+pods schedule onto different hosts of a multi-host slice never got its
+cross-host hop wired; (b) a daemon restart lost the wire table, so
+repair/teardown of pre-restart hops silently stopped until pod churn.
+
+Tier 1 here runs TWO real daemons (full TpuSideManager stacks, real gRPC
+on unix sockets + real TCP cross-boundary servers) against one shared
+FakeKube: NF i lands on host A, NF i+1 on host B, and the hop wires on
+BOTH dataplanes through the peer plane (reference to beat:
+marvell/main.go:488-563 chain rules, single-DPU only). Tier 2 covers the
+journal: a restarted manager rebuilds its hop table reconciled against
+the dataplane's persisted wire list and keeps repairing/tearing down
+pre-restart hops."""
+
+import json
+import threading
+
+import pytest
+
+from dpu_operator_tpu.daemon import TpuSideManager
+from dpu_operator_tpu.k8s import FakeKube
+from dpu_operator_tpu.utils import vars as v
+
+SFC_API = "config.tpu.openshift.io/v1"
+
+
+class _Req:
+    def __init__(self, sandbox, device, ifname, pod, ns="default",
+                 ici_ports=()):
+        self.sandbox_id = sandbox
+        self.device_id = device
+        self.ifname = ifname
+        self.pod_name = pod
+        self.pod_namespace = ns
+        self.netns = f"/var/run/netns/{sandbox}"
+
+        class _NC:
+            cni_version = "0.4.0"
+            name = ""
+            ipam = {}
+        _NC.ici_ports = list(ici_ports)
+        self.netconf = _NC()
+
+
+def _nf_pod(kube, name, sfc, index, node):
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {"tpu.openshift.io/sfc": sfc,
+                                     "tpu.openshift.io/sfc-index":
+                                         str(index)}},
+        "spec": {"containers": [{"name": "c"}], "nodeName": node},
+    })
+
+
+def _sfc(kube, name, nf_names):
+    kube.create({
+        "apiVersion": SFC_API, "kind": "ServiceFunctionChain",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"networkFunctions": [{"name": n, "image": "img"}
+                                      for n in nf_names]}})
+
+
+def _wire_pod(mgr, sandbox, pod, chips):
+    mgr._cni_nf_add(_Req(sandbox, chips[0], "net1", pod))
+    return mgr._cni_nf_add(_Req(sandbox, chips[1], "net2", pod))
+
+
+# -- tier 1: two real daemons -------------------------------------------------
+
+@pytest.fixture
+def two_daemons():
+    from dpu_operator_tpu.platform.vendordetector import TpuDetector
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    from dpu_operator_tpu.vsp.mock import MockTpuVsp
+    from dpu_operator_tpu.vsp.plugin import GrpcPlugin
+    from dpu_operator_tpu.vsp.rpc import VspServer
+
+    # short tmp root: PathManager's socket paths must fit sun_path (108)
+    import shutil
+    import tempfile
+    tmp_path = tempfile.mkdtemp(prefix="xh-", dir="/tmp")
+
+    kube = FakeKube()
+    for node in ("node-a", "node-b"):
+        kube.create({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": node}})
+    daemons, cleanups = {}, []
+    for node in ("node-a", "node-b"):
+        pm = PathManager(tmp_path + "/" + node)
+        mock = MockTpuVsp(port=0)
+        sock = pm.vendor_plugin_socket()
+        pm.ensure_socket_dir(sock)
+        vsp_server = VspServer(mock, socket_path=sock)
+        vsp_server.start()
+        det = TpuDetector().detection_result(tpu_mode=True, identifier=node)
+        mgr = TpuSideManager(
+            GrpcPlugin(det, path_manager=pm, init_timeout=5.0), pm,
+            client=kube, node_name=node)
+        mgr.start_vsp()
+        mgr.listen()
+        mgr._advertise_address()
+        daemons[node] = (mgr, mock)
+        cleanups.append((mgr, vsp_server))
+    yield kube, daemons
+    for mgr, vsp_server in cleanups:
+        mgr.stop()
+        vsp_server.stop()
+    shutil.rmtree(tmp_path, ignore_errors=True)
+
+
+def test_daemon_advertises_cross_boundary_address(two_daemons):
+    kube, daemons = two_daemons
+    for node, (mgr, _) in daemons.items():
+        ann = kube.get("v1", "Node", node)["metadata"]["annotations"]
+        addr = ann[v.CROSS_BOUNDARY_ADDR_ANNOTATION]
+        assert addr.endswith(f":{mgr.bound_port}")
+
+
+def test_cross_host_hop_wires_on_both_dataplanes(two_daemons):
+    kube, daemons = two_daemons
+    mgr_a, mock_a = daemons["node-a"]
+    mgr_b, mock_b = daemons["node-b"]
+    _sfc(kube, "xh", ["f0", "f1"])
+    _nf_pod(kube, "xh-f0", "xh", 0, "node-a")
+    _nf_pod(kube, "xh-f1", "xh", 1, "node-b")
+    _wire_pod(mgr_a, "sbxA0000000", "xh-f0", ["chip-0", "chip-1"])
+    # only NF0's own pod-internal wire so far; the hop waits for NF1
+    assert len(mock_a.network_functions) == 1
+    _wire_pod(mgr_b, "sbxB0000000", "xh-f1", ["chip-2", "chip-3"])
+    # B does NOT own hop 0 (its NF is the downstream side)
+    assert len(mock_b.network_functions) == 1
+    # the upstream owner converges on its next resync
+    mgr_a.sync_cross_host_hops("default", "xh")
+    hop = ("nf-sbxA0000000-chip-1", "nf-sbxB0000000-chip-2")
+    assert hop in mock_a.network_functions  # egress half on host A
+    assert hop in mock_b.network_functions  # ingress half on host B
+    hop_key = ("default", "xh", 0)
+    assert mgr_a._chain_hops[hop_key] == hop
+    node_b_addr = kube.get("v1", "Node", "node-b")["metadata"][
+        "annotations"][v.CROSS_BOUNDARY_ADDR_ANNOTATION]
+    assert mgr_a._remote_hops[hop_key] == node_b_addr
+    # the wire-path trigger also converges without an explicit resync:
+    # re-running sync is idempotent
+    before = list(mock_a.network_functions)
+    mgr_a.sync_cross_host_hops("default", "xh")
+    assert mock_a.network_functions == before
+
+
+def test_cross_host_hop_teardown_unwires_remote_half(two_daemons):
+    kube, daemons = two_daemons
+    mgr_a, mock_a = daemons["node-a"]
+    mgr_b, mock_b = daemons["node-b"]
+    _sfc(kube, "xh2", ["f0", "f1"])
+    _nf_pod(kube, "xh2-f0", "xh2", 0, "node-a")
+    _nf_pod(kube, "xh2-f1", "xh2", 1, "node-b")
+    _wire_pod(mgr_a, "sbxA1111111", "xh2-f0", ["chip-0", "chip-1"])
+    _wire_pod(mgr_b, "sbxB1111111", "xh2-f1", ["chip-2", "chip-3"])
+    mgr_a.sync_cross_host_hops("default", "xh2")
+    hop = ("nf-sbxA1111111-chip-1", "nf-sbxB1111111-chip-2")
+    assert hop in mock_b.network_functions
+    # upstream sandbox torn down: the hop unwires on BOTH hosts
+    mgr_a._cni_nf_del(_Req("sbxA1111111", None, "net1", "xh2-f0"))
+    assert hop not in mock_a.network_functions
+    assert hop not in mock_b.network_functions
+    assert ("default", "xh2", 0) not in mgr_a._chain_hops
+
+
+def test_remote_nf_gone_tears_down_cross_host_hop(two_daemons):
+    kube, daemons = two_daemons
+    mgr_a, mock_a = daemons["node-a"]
+    mgr_b, mock_b = daemons["node-b"]
+    _sfc(kube, "xh3", ["f0", "f1"])
+    _nf_pod(kube, "xh3-f0", "xh3", 0, "node-a")
+    _nf_pod(kube, "xh3-f1", "xh3", 1, "node-b")
+    _wire_pod(mgr_a, "sbxA2222222", "xh3-f0", ["chip-0", "chip-1"])
+    _wire_pod(mgr_b, "sbxB2222222", "xh3-f1", ["chip-2", "chip-3"])
+    mgr_a.sync_cross_host_hops("default", "xh3")
+    hop = ("nf-sbxA2222222-chip-1", "nf-sbxB2222222-chip-2")
+    assert hop in mock_a.network_functions
+    # downstream NF dies on host B (B's own teardown path runs there,
+    # then the pod object disappears)
+    mgr_b._cni_nf_del(_Req("sbxB2222222", None, "net1", "xh3-f1"))
+    kube.delete("v1", "Pod", "xh3-f1", namespace="default")
+    mgr_a.sync_cross_host_hops("default", "xh3")
+    assert hop not in mock_a.network_functions
+    assert ("default", "xh3", 0) not in mgr_a._chain_hops
+    assert ("default", "xh3", 0) not in mgr_a._remote_hops
+
+
+def test_resync_does_not_undo_cross_host_repair(two_daemons):
+    """A repaired (degraded) cross-host hop must NOT be re-wired back
+    onto its dead ICI port by the 5 s resync — that would undo
+    repair_chains every cycle (wire/unwire ping-pong onto a dead link).
+    A replacement downstream NF still converges."""
+    kube, daemons = two_daemons
+    mgr_a, mock_a = daemons["node-a"]
+    mgr_b, mock_b = daemons["node-b"]
+    _sfc(kube, "xh5", ["f0", "f1"])
+    _nf_pod(kube, "xh5-f0", "xh5", 0, "node-a")
+    _nf_pod(kube, "xh5-f1", "xh5", 1, "node-b")
+    mgr_a._cni_nf_add(_Req("sbxA4444444", "chip-0", "net1", "xh5-f0"))
+    mgr_a._cni_nf_add(_Req("sbxA4444444", "chip-1", "net2", "xh5-f0",
+                           ici_ports=["ici-0-x+", "ici-1-x+"]))
+    mgr_b._cni_nf_add(_Req("sbxB4444444", "chip-2", "net1", "xh5-f1"))
+    mgr_b._cni_nf_add(_Req("sbxB4444444", "chip-3", "net2", "xh5-f1",
+                           ici_ports=["ici-2-x+", "ici-3-x+"]))
+    mgr_a.sync_cross_host_hops("default", "xh5")
+    hop_key = ("default", "xh5", 0)
+    assert mgr_a._chain_hops[hop_key] == ("ici-1-x+", "ici-2-x+")
+    # the allocated egress port goes dark; repair re-steers the local
+    # side onto the attachment endpoint
+    link_state = {1: [{"port": "x+", "up": False, "wired": True}]}
+    mgr_a.link_prober = lambda chip: link_state.get(
+        chip, [{"port": "x+", "up": True, "wired": True}])
+    repaired = mgr_a.repair_chains()
+    assert [k for k, _, _ in repaired] == [hop_key]
+    steered = ("nf-sbxA4444444-chip-1", "ici-2-x+")
+    assert mgr_a._chain_hops[hop_key] == steered
+    # resync must LEAVE the repair in place
+    mgr_a.sync_cross_host_hops("default", "xh5")
+    assert mgr_a._chain_hops[hop_key] == steered
+    assert hop_key in mgr_a._degraded_hops
+    # but a REPLACEMENT downstream NF (new endpoints) still converges
+    mgr_b._cni_nf_del(_Req("sbxB4444444", None, "net1", "xh5-f1"))
+    kube.delete("v1", "Pod", "xh5-f1", namespace="default")
+    _nf_pod(kube, "xh5-f1", "xh5", 1, "node-b")
+    mgr_b._cni_nf_add(_Req("sbxB5555555", "chip-2", "net1", "xh5-f1"))
+    mgr_b._cni_nf_add(_Req("sbxB5555555", "chip-3", "net2", "xh5-f1",
+                           ici_ports=["ici-2-y+", "ici-3-y+"]))
+    mgr_a.sync_cross_host_hops("default", "xh5")
+    # downstream side changed -> re-wired (upstream side recomputed from
+    # the still-allocated port list; repair will re-degrade it while the
+    # link stays dark, which is the make-before-break contract)
+    assert mgr_a._chain_hops[hop_key][1] == "ici-2-y+"
+
+
+def test_migrated_downstream_nf_rewires_locally(two_daemons):
+    """The downstream NF pod is recreated onto the OWNER's node: the
+    stale cross-host hop must be torn down on both dataplanes and the
+    local pair wired — otherwise traffic steers into the peer's dead
+    ingress until the upstream NF churns."""
+    kube, daemons = two_daemons
+    mgr_a, mock_a = daemons["node-a"]
+    mgr_b, mock_b = daemons["node-b"]
+    _sfc(kube, "xh6", ["f0", "f1"])
+    _nf_pod(kube, "xh6-f0", "xh6", 0, "node-a")
+    _nf_pod(kube, "xh6-f1", "xh6", 1, "node-b")
+    _wire_pod(mgr_a, "sbxA6666666", "xh6-f0", ["chip-0", "chip-1"])
+    _wire_pod(mgr_b, "sbxB6666666", "xh6-f1", ["chip-2", "chip-3"])
+    mgr_a.sync_cross_host_hops("default", "xh6")
+    old_hop = ("nf-sbxA6666666-chip-1", "nf-sbxB6666666-chip-2")
+    hop_key = ("default", "xh6", 0)
+    assert mgr_a._chain_hops[hop_key] == old_hop
+    # pod recreated on node-a (scheduler moved it); its CNI ADD now runs
+    # on A — the stale cross-host hop blocks _update_chain's wire, until
+    # the resync converts it
+    kube.delete("v1", "Pod", "xh6-f1", namespace="default")
+    _nf_pod(kube, "xh6-f1", "xh6", 1, "node-a")
+    _wire_pod(mgr_a, "sbxA7777777", "xh6-f1", ["chip-2", "chip-3"])
+    mgr_a.sync_cross_host_hops("default", "xh6")
+    new_hop = ("nf-sbxA6666666-chip-1", "nf-sbxA7777777-chip-2")
+    assert mgr_a._chain_hops[hop_key] == new_hop
+    assert new_hop in mock_a.network_functions
+    assert old_hop not in mock_a.network_functions  # old local half gone
+    assert old_hop not in mock_b.network_functions  # peer half pruned
+    assert hop_key not in mgr_a._remote_hops
+
+
+def test_failed_repair_mirror_is_redriven_on_resync(two_daemons):
+    """A peer unreachable exactly during the repair mirror must not
+    leave its dataplane steering the dead pair forever: the mirror is
+    parked and re-driven by the next resync."""
+    kube, daemons = two_daemons
+    mgr_a, mock_a = daemons["node-a"]
+    mgr_b, mock_b = daemons["node-b"]
+    _sfc(kube, "xh7", ["f0", "f1"])
+    _nf_pod(kube, "xh7-f0", "xh7", 0, "node-a")
+    _nf_pod(kube, "xh7-f1", "xh7", 1, "node-b")
+    mgr_a._cni_nf_add(_Req("sbxA8888888", "chip-0", "net1", "xh7-f0"))
+    mgr_a._cni_nf_add(_Req("sbxA8888888", "chip-1", "net2", "xh7-f0",
+                           ici_ports=["ici-0-x+", "ici-1-x+"]))
+    mgr_b._cni_nf_add(_Req("sbxB8888888", "chip-2", "net1", "xh7-f1"))
+    mgr_b._cni_nf_add(_Req("sbxB8888888", "chip-3", "net2", "xh7-f1",
+                           ici_ports=["ici-2-x+", "ici-3-x+"]))
+    mgr_a.sync_cross_host_hops("default", "xh7")
+    hop_key = ("default", "xh7", 0)
+    old = ("ici-1-x+", "ici-2-x+")
+    assert mgr_a._chain_hops[hop_key] == old
+    # peer goes dark for the mirror: make remote calls fail once
+    real_call = mgr_a._remote_call
+    fail = {"on": True}
+
+    def flaky_call(addr, svc, method, req, timeout=5.0):
+        if fail["on"]:
+            raise ConnectionError("peer restarting")
+        return real_call(addr, svc, method, req, timeout)
+
+    mgr_a._remote_call = flaky_call
+    link_state = {1: [{"port": "x+", "up": False, "wired": True}]}
+    mgr_a.link_prober = lambda chip: link_state.get(
+        chip, [{"port": "x+", "up": True, "wired": True}])
+    repaired = mgr_a.repair_chains()
+    steered = ("nf-sbxA8888888-chip-1", "ici-2-x+")
+    assert [k for k, _, _ in repaired] == [hop_key]
+    assert mgr_a._chain_hops[hop_key] == steered
+    # the peer never saw the re-steer (mirror failed); old pair still
+    # wired there
+    assert old in mock_b.network_functions
+    assert steered not in mock_b.network_functions
+    # peer comes back: the next resync re-drives the mirror
+    fail["on"] = False
+    mgr_a.sync_cross_host_hops("default", "xh7")
+    assert steered in mock_b.network_functions
+    assert old not in mock_b.network_functions
+    assert not mgr_a._mirror_pending
+
+
+def test_unreachable_peer_keeps_existing_hop(two_daemons):
+    """A peer daemon restart must not read as an NF teardown: when the
+    remote daemon is unreachable the hop is left wired."""
+    kube, daemons = two_daemons
+    mgr_a, mock_a = daemons["node-a"]
+    mgr_b, mock_b = daemons["node-b"]
+    _sfc(kube, "xh4", ["f0", "f1"])
+    _nf_pod(kube, "xh4-f0", "xh4", 0, "node-a")
+    _nf_pod(kube, "xh4-f1", "xh4", 1, "node-b")
+    _wire_pod(mgr_a, "sbxA3333333", "xh4-f0", ["chip-0", "chip-1"])
+    _wire_pod(mgr_b, "sbxB3333333", "xh4-f1", ["chip-2", "chip-3"])
+    mgr_a.sync_cross_host_hops("default", "xh4")
+    hop_key = ("default", "xh4", 0)
+    assert hop_key in mgr_a._chain_hops
+    # point node-b's advertised address at a dead port
+    node = kube.get("v1", "Node", "node-b")
+    node["metadata"]["annotations"][
+        v.CROSS_BOUNDARY_ADDR_ANNOTATION] = "127.0.0.1:1"
+    kube.update(node)
+    mgr_a.sync_cross_host_hops("default", "xh4")
+    assert hop_key in mgr_a._chain_hops  # NOT torn down
+    hop = mgr_a._chain_hops[hop_key]
+    assert hop in mock_a.network_functions
+
+
+# -- tier 2: wire-table restart recovery --------------------------------------
+
+class _RecordingVsp:
+    """Lean VSP double with a live wire list (the ground truth a real
+    VSP reads from the native agent's persisted state)."""
+
+    def __init__(self):
+        self.wired = []
+        self.unwired = []
+        self.attached = []
+        self.detached = []
+        self.wires = []
+
+    def create_network_function(self, a, b):
+        self.wired.append((a, b))
+        self.wires.append((a, b))
+
+    def delete_network_function(self, a, b):
+        self.unwired.append((a, b))
+        try:
+            self.wires.remove((a, b))
+        except ValueError:
+            pass
+
+    def create_slice_attachment(self, att):
+        self.attached.append(att["name"])
+        return att
+
+    def delete_slice_attachment(self, name):
+        self.detached.append(name)
+
+    def list_network_functions(self):
+        return list(self.wires)
+
+
+def _lean_mgr(kube, tmp_path, vsp, tag="m"):
+    from dpu_operator_tpu.cni import NetConfCache
+    m = TpuSideManager.__new__(TpuSideManager)
+    m.vsp = vsp
+    m.client = kube
+    m._attach_store = {}
+    m._attach_lock = threading.Lock()
+    m._chain_store = {}
+    m._chain_hops = {}
+    m._degraded_hops = set()
+    m._repair_pass_lock = threading.Lock()
+    m.link_prober = None
+    m.ipam_dir = str(tmp_path / "ipam")
+    m.nf_cache = NetConfCache(str(tmp_path / "nf"))
+    m._chains_file = str(tmp_path / "cache" / "chains.json")
+    return m
+
+
+def _restarted(kube, tmp_path, vsp):
+    """A fresh manager over the same journal + dataplane — the daemon
+    process restarting."""
+    fresh = _lean_mgr(kube, tmp_path, vsp)
+    fresh._recover_chains()
+    return fresh
+
+
+def test_restart_recovers_hops_and_repair_still_steers(kube, tmp_path):
+    vsp = _RecordingVsp()
+    mgr = _lean_mgr(kube, tmp_path, vsp)
+    _sfc(kube, "rsfc", ["f0", "f1"])
+    _nf_pod(kube, "rsfc-f0", "rsfc", 0, "")
+    _nf_pod(kube, "rsfc-f1", "rsfc", 1, "")
+    mgr._cni_nf_add(_Req("sbxR0000000", "chip-0", "net1", "rsfc-f0"))
+    mgr._cni_nf_add(
+        _Req("sbxR0000000", "chip-1", "net2", "rsfc-f0",
+             ici_ports=["ici-0-x+", "ici-1-x+"]))
+    mgr._cni_nf_add(_Req("sbxR1111111", "chip-2", "net1", "rsfc-f1"))
+    mgr._cni_nf_add(
+        _Req("sbxR1111111", "chip-3", "net2", "rsfc-f1",
+             ici_ports=["ici-2-x+", "ici-3-x+"]))
+    hop_key = ("default", "rsfc", 0)
+    assert mgr._chain_hops[hop_key] == ("ici-1-x+", "ici-2-x+")
+
+    fresh = _restarted(kube, tmp_path, vsp)
+    assert fresh._chain_hops[hop_key] == ("ici-1-x+", "ici-2-x+")
+    assert fresh._chain_store[("default", "rsfc")][0]["sandbox"] == \
+        "sbxR0000000"
+    # the pre-restart hop is still covered by self-healing: its
+    # allocated egress port goes dark and repair re-steers it
+    link_state = {1: [{"port": "x+", "up": False, "wired": True}]}
+    fresh.link_prober = lambda chip: link_state.get(
+        chip, [{"port": "x+", "up": True, "wired": True}])
+    repaired = fresh.repair_chains()
+    assert [k for k, _, _ in repaired] == [hop_key]
+    assert fresh._chain_hops[hop_key] == ("nf-sbxR0000000-chip-1",
+                                          "ici-2-x+")
+
+
+def test_restart_teardown_of_pre_restart_sandbox_unwires(kube, tmp_path):
+    vsp = _RecordingVsp()
+    mgr = _lean_mgr(kube, tmp_path, vsp)
+    _nf_pod(kube, "tsfc-f0", "tsfc", 0, "")
+    _nf_pod(kube, "tsfc-f1", "tsfc", 1, "")
+    _wire_pod(mgr, "sbxT0000000", "tsfc-f0", ["chip-0", "chip-1"])
+    _wire_pod(mgr, "sbxT1111111", "tsfc-f1", ["chip-2", "chip-3"])
+    hop = ("nf-sbxT0000000-chip-1", "nf-sbxT1111111-chip-2")
+    assert hop in vsp.wires
+
+    fresh = _restarted(kube, tmp_path, vsp)
+    fresh._cni_nf_del(_Req("sbxT1111111", None, "net1", "tsfc-f1"))
+    assert hop in fresh.vsp.unwired  # pre-restart hop torn down
+    assert ("default", "tsfc", 0) not in fresh._chain_hops
+
+
+def test_recovery_drops_hops_absent_from_dataplane(kube, tmp_path):
+    """The journal is reconciled against the dataplane's persisted wire
+    list: a hop whose wire never landed (crash between journal write and
+    agent ack loss) must not be resurrected."""
+    vsp = _RecordingVsp()
+    mgr = _lean_mgr(kube, tmp_path, vsp)
+    _nf_pod(kube, "dsfc-f0", "dsfc", 0, "")
+    _nf_pod(kube, "dsfc-f1", "dsfc", 1, "")
+    _wire_pod(mgr, "sbxD0000000", "dsfc-f0", ["chip-0", "chip-1"])
+    _wire_pod(mgr, "sbxD1111111", "dsfc-f1", ["chip-2", "chip-3"])
+    hop = ("nf-sbxD0000000-chip-1", "nf-sbxD1111111-chip-2")
+    vsp.wires.remove(hop)  # dataplane says this wire does not exist
+    fresh = _restarted(kube, tmp_path, vsp)
+    assert ("default", "dsfc", 0) not in fresh._chain_hops
+    # the chain entries themselves are still recovered (teardown of the
+    # sandboxes keeps working)
+    assert 0 in fresh._chain_store[("default", "dsfc")]
+
+
+def test_recovery_trusts_journal_when_dataplane_cannot_enumerate(
+        kube, tmp_path):
+    vsp = _RecordingVsp()
+    mgr = _lean_mgr(kube, tmp_path, vsp)
+    _nf_pod(kube, "usfc-f0", "usfc", 0, "")
+    _nf_pod(kube, "usfc-f1", "usfc", 1, "")
+    _wire_pod(mgr, "sbxU0000000", "usfc-f0", ["chip-0", "chip-1"])
+    _wire_pod(mgr, "sbxU1111111", "usfc-f1", ["chip-2", "chip-3"])
+
+    # a vsp WITHOUT the lister at all: recovery must treat the wire
+    # list as UNKNOWN and keep the journaled hops
+    class _Plain:
+        def delete_network_function(self, a, b):
+            pass
+    fresh = _lean_mgr(kube, tmp_path, _Plain())
+    fresh._recover_chains()
+    assert ("default", "usfc", 0) in fresh._chain_hops  # trusted as-is
+
+
+def test_degraded_marker_survives_restart(kube, tmp_path):
+    vsp = _RecordingVsp()
+    mgr = _lean_mgr(kube, tmp_path, vsp)
+    _nf_pod(kube, "gsfc-f0", "gsfc", 0, "")
+    _nf_pod(kube, "gsfc-f1", "gsfc", 1, "")
+    mgr._cni_nf_add(_Req("sbxG0000000", "chip-0", "net1", "gsfc-f0"))
+    mgr._cni_nf_add(_Req("sbxG0000000", "chip-1", "net2", "gsfc-f0",
+                         ici_ports=["ici-0-x+", "ici-1-x+"]))
+    mgr._cni_nf_add(_Req("sbxG1111111", "chip-2", "net1", "gsfc-f1"))
+    mgr._cni_nf_add(_Req("sbxG1111111", "chip-3", "net2", "gsfc-f1",
+                         ici_ports=["ici-2-x+", "ici-3-x+"]))
+    link_state = {1: [{"port": "x+", "up": False, "wired": True}]}
+    mgr.link_prober = lambda chip: link_state.get(
+        chip, [{"port": "x+", "up": True, "wired": True}])
+    mgr.repair_chains()
+    hop_key = ("default", "gsfc", 0)
+    assert hop_key in mgr._degraded_hops
+
+    fresh = _restarted(kube, tmp_path, vsp)
+    assert hop_key in fresh._degraded_hops
+    status = fresh.chain_status("default", "gsfc")
+    assert status and status[0]["degraded"] is True
+
+
+def test_journal_file_is_valid_json_snapshot(kube, tmp_path):
+    vsp = _RecordingVsp()
+    mgr = _lean_mgr(kube, tmp_path, vsp)
+    _nf_pod(kube, "jsfc-f0", "jsfc", 0, "")
+    _nf_pod(kube, "jsfc-f1", "jsfc", 1, "")
+    _wire_pod(mgr, "sbxJ0000000", "jsfc-f0", ["chip-0", "chip-1"])
+    _wire_pod(mgr, "sbxJ1111111", "jsfc-f1", ["chip-2", "chip-3"])
+    with open(mgr._chains_file) as f:
+        data = json.load(f)
+    assert data["hops"][0]["ids"] == ["nf-sbxJ0000000-chip-1",
+                                      "nf-sbxJ1111111-chip-2"]
+    # teardown prunes the journal too
+    mgr._cni_nf_del(_Req("sbxJ0000000", None, "net1", "jsfc-f0"))
+    mgr._cni_nf_del(_Req("sbxJ1111111", None, "net1", "jsfc-f1"))
+    with open(mgr._chains_file) as f:
+        data = json.load(f)
+    assert data["hops"] == []
