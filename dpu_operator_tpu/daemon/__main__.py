@@ -52,10 +52,13 @@ def main(argv=None):
     )
     # graceful termination (reference: ctrl.SetupSignalHandler via
     # utils/ctrl.go): kubelet sends SIGTERM on pod deletion; a hard kill
-    # mid-resize could leave the node cordoned or sockets stale —
-    # daemon.stop() runs the managers' orderly teardown instead
-    signal.signal(signal.SIGTERM, lambda *_: daemon.stop())
-    signal.signal(signal.SIGINT, lambda *_: daemon.stop())
+    # mid-resize could leave the node cordoned or sockets stale. The
+    # handler only SETS the stop event (request_stop): handlers run on
+    # the main thread, which may be holding _mgr_stop_lock inside the
+    # serve-loop exit path — a direct stop() there would deadlock. The
+    # serve() loop observes the event and runs the orderly teardown.
+    signal.signal(signal.SIGTERM, lambda *_: daemon.request_stop())
+    signal.signal(signal.SIGINT, lambda *_: daemon.request_stop())
     daemon.prepare_and_serve()
 
 

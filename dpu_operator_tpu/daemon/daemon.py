@@ -196,6 +196,15 @@ class Daemon:
             self._mgr_stopped = True
         self.manager.stop()
 
+    def request_stop(self):
+        """Signal-handler-safe stop: only set the event. A handler runs
+        on the main thread, which may be inside _stop_manager() holding
+        the non-reentrant _mgr_stop_lock (the serve-loop exit path) —
+        calling stop() there would deadlock, and stop()'s blocking
+        thread join does not belong in a handler either. serve()'s loop
+        observes the event and runs the orderly teardown itself."""
+        self._stop.set()
+
     def stop(self):
         self._stop.set()
         self._stop_manager()

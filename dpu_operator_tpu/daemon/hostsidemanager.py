@@ -13,6 +13,7 @@ ports are wired into the pod slice.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Optional
 
@@ -45,6 +46,11 @@ class HostSideManager:
         self._slice_topology = None
         self._topology_ok_at = 0.0       # last successful fetch
         self._topology_attempt_at = -1e9  # last attempt (cooldown)
+        # one topology dial at a time: the ListAndWatch stream thread and
+        # CNI/Allocate paths call _fetch_slice_topology concurrently; a
+        # try-acquire lets exactly one thread pay the 2 s deadline while
+        # the others serve the cached topology
+        self._topology_lock = threading.Lock()
         self.device_handler = TpuDeviceHandler(
             self.vsp, tpu_mode=False,
             topology_provider=self._fetch_slice_topology)
@@ -133,25 +139,40 @@ class HostSideManager:
         ONE dial attempt with a short deadline, TTL'd on success,
         cooldown'd on failure; a failed refresh keeps serving the last
         known topology (stale coords beat none until the next success)."""
-        now = time.monotonic()
-        fresh = (self._slice_topology is not None
-                 and now - self._topology_ok_at < self.TOPOLOGY_TTL)
-        in_cooldown = (now - self._topology_attempt_at
-                       < self.TOPOLOGY_RETRY_COOLDOWN)
-        if fresh or in_cooldown or self._tpu_daemon_addr is None:
+        def stale():
+            now = time.monotonic()
+            fresh = (self._slice_topology is not None
+                     and now - self._topology_ok_at < self.TOPOLOGY_TTL)
+            in_cooldown = (now - self._topology_attempt_at
+                           < self.TOPOLOGY_RETRY_COOLDOWN)
+            return not fresh and not in_cooldown
+
+        if not stale() or self._tpu_daemon_addr is None:
             return self._slice_topology
-        self._topology_attempt_at = now
-        ip, port = self._tpu_daemon_addr
+        # try-acquire: one thread dials; concurrent callers (ListAndWatch
+        # stream thread vs CNI/Allocate) serve the cache instead of
+        # double-dialing and each paying the 2 s deadline the cooldown
+        # exists to avoid
+        if not self._topology_lock.acquire(blocking=False):
+            return self._slice_topology
         try:
-            from .slicejoin import fetch_slice_info
-            info = fetch_slice_info(f"{ip}:{port}", timeout=2.0)
-            topo = info.get("topology", "")
-            if topo:
-                from ..ici import SliceTopology
-                self._slice_topology = SliceTopology(topo)
-                self._topology_ok_at = now
-        except Exception:  # noqa: BLE001 — decoration is best-effort
-            pass
+            if not stale():  # the winner of a race already refreshed
+                return self._slice_topology
+            now = time.monotonic()
+            self._topology_attempt_at = now
+            ip, port = self._tpu_daemon_addr
+            try:
+                from .slicejoin import fetch_slice_info
+                info = fetch_slice_info(f"{ip}:{port}", timeout=2.0)
+                topo = info.get("topology", "")
+                if topo:
+                    from ..ici import SliceTopology
+                    self._slice_topology = SliceTopology(topo)
+                    self._topology_ok_at = now
+            except Exception:  # noqa: BLE001 — decoration is best-effort
+                pass
+        finally:
+            self._topology_lock.release()
         return self._slice_topology
 
     def create_slice_attachment(self, host: int, chip: int,

@@ -34,10 +34,14 @@ class JoinResult:
     group: MultiSliceGroup
     members: list = field(default_factory=list)  # addresses, local first
     unreachable: list = field(default_factory=list)
+    # the walk hit max_slices with peers still queued: the group is a
+    # PREFIX of the joint group, indistinguishable from complete without
+    # this flag — callers scheduling collectives must treat it degraded
+    truncated: bool = False
 
     @property
     def degraded(self) -> bool:
-        return bool(self.unreachable)
+        return bool(self.unreachable) or self.truncated
 
 
 def fetch_slice_info(address: str, timeout: float = 5.0) -> dict:
@@ -82,6 +86,13 @@ def join_slices(seed_address: str, dial_timeout: float = 5.0,
         for peer in info.get("dcn_peers", []):
             if peer not in seen:
                 queue.append(peer)
+    leftover = [a for a in queue if a not in seen]
+    truncated = bool(leftover)
+    if truncated:
+        log.warning(
+            "slice join truncated at max_slices=%d: %d queued peer(s) "
+            "never visited (%s...) — the group is a prefix of the joint "
+            "group", max_slices, len(leftover), leftover[0])
     slices = []
     for addr in order:
         topo = infos[addr].get("topology", "")
@@ -90,6 +101,6 @@ def join_slices(seed_address: str, dial_timeout: float = 5.0,
             continue
         slices.append(SliceTopology(topo))
     metrics.SLICE_JOINS.inc(
-        outcome="degraded" if unreachable else "ok")
+        outcome="degraded" if (unreachable or truncated) else "ok")
     return JoinResult(group=MultiSliceGroup(slices), members=order,
-                      unreachable=unreachable)
+                      unreachable=unreachable, truncated=truncated)

@@ -202,9 +202,11 @@ class DevicePlugin:
         #: (the chip plugin feeds the port plugin's affinity this way)
         self.allocation_listener = allocation_listener
         #: callable -> dict of extra env to export on every Allocate —
-        #: the multi-host bootstrap contract (TPU_WORKER_ID/COUNT,
-        #: TPU_COORDINATOR_ADDRESS) the workload's
-        #: bootstrap.initialize_from_operator_env consumes
+        #: the OPERATOR-owned half of the multi-host bootstrap contract
+        #: (TPU_WORKER_ID, TPU_HOSTS_PER_SLICE, TPU_SLICE_TOPOLOGY);
+        #: job-owned facts (TPU_WORKER_COUNT, TPU_COORDINATOR_ADDRESS)
+        #: ride the pod spec — the workload merges both in
+        #: bootstrap.initialize_from_operator_env
         self.extra_env_provider = extra_env_provider
         self._server: Optional[grpc.Server] = None
         self._stop = threading.Event()
@@ -261,6 +263,11 @@ class DevicePlugin:
     def stop(self):
         self._stop.set()
         self._poke.set()
+        with self._refresh_cond:
+            # wake refresh() barrier waiters now: without the notify a
+            # thread blocked in wait_for only observes shutdown via its
+            # full timeout (slow SIGTERM during a concurrent resize)
+            self._refresh_cond.notify_all()
         if self._server:
             self._server.stop(0.5).wait()
             self._server = None

@@ -40,8 +40,9 @@ def distributed_env(environ=None) -> Optional[dict]:
     if not coordinator:
         raise RuntimeError(
             "TPU_WORKER_COUNT > 1 but TPU_COORDINATOR_ADDRESS unset — "
-            "the device plugin exports both on Allocate; is this pod "
-            "consuming google.com/tpu?")
+            "both are JOB-owned facts: set them in the job's pod "
+            "template (the operator exports only TPU_WORKER_ID, "
+            "TPU_HOSTS_PER_SLICE and TPU_SLICE_TOPOLOGY on Allocate)")
     return {
         "coordinator_address": coordinator,
         "num_processes": count,

@@ -1,0 +1,145 @@
+"""Regression tests for round-4 ADVICE findings: the bootstrap
+diagnostic points at the job spec (not the device plugin), SIGTERM
+handlers only set the stop event (no lock/join inside a signal handler),
+the host-side topology fetch single-flights its dial, a truncated slice
+join is surfaced as degraded, and DevicePlugin.stop() wakes refresh
+barrier waiters immediately."""
+
+import threading
+import time
+
+import pytest
+
+from dpu_operator_tpu.daemon.daemon import Daemon
+from dpu_operator_tpu.daemon.hostsidemanager import HostSideManager
+from dpu_operator_tpu.daemon import slicejoin
+
+
+def test_bootstrap_error_blames_the_job_spec():
+    """ADVICE r4 #1: TPU_WORKER_COUNT/TPU_COORDINATOR_ADDRESS come from
+    the JOB spec; the old message sent operators to the device plugin."""
+    from dpu_operator_tpu.workloads.bootstrap import distributed_env
+    with pytest.raises(RuntimeError) as ei:
+        distributed_env({"TPU_WORKER_COUNT": "4"})
+    msg = str(ei.value)
+    assert "JOB" in msg
+    assert "device plugin" not in msg
+    # the operator-exported vars are named so the reader learns the split
+    assert "TPU_WORKER_ID" in msg
+
+
+def test_request_stop_is_safe_while_mgr_stop_lock_is_held():
+    """ADVICE r4 #2: a signal landing while the main thread holds
+    _mgr_stop_lock must not deadlock — the handler path (request_stop)
+    only sets the event."""
+    d = Daemon.__new__(Daemon)
+    d._stop = threading.Event()
+    d._mgr_stop_lock = threading.Lock()
+    d._mgr_stopped = False
+    d.manager = object()
+    with d._mgr_stop_lock:  # the serve-loop exit path owns the lock
+        t = threading.Thread(target=d.request_stop)
+        t.start()
+        t.join(timeout=2)
+        assert not t.is_alive(), "request_stop blocked on _mgr_stop_lock"
+    assert d._stop.is_set()
+
+
+def test_daemon_main_handlers_use_request_stop():
+    """The installed SIGTERM/SIGINT handlers must route through the
+    handler-safe entry point, not stop()."""
+    import inspect
+    import dpu_operator_tpu.daemon.__main__ as main_mod
+    src = inspect.getsource(main_mod)
+    assert "request_stop()" in src
+    assert "lambda *_: daemon.stop()" not in src
+
+
+def test_topology_fetch_single_flights_concurrent_callers(monkeypatch):
+    """ADVICE r4 #3: concurrent callers must not double-dial — exactly
+    one pays the deadline, the rest serve the cached topology."""
+    calls = []
+
+    def slow_fetch(addr, timeout=2.0):
+        calls.append(addr)
+        time.sleep(0.2)
+        return {"topology": "v5e-4"}
+
+    monkeypatch.setattr(slicejoin, "fetch_slice_info", slow_fetch)
+    m = HostSideManager.__new__(HostSideManager)
+    m._slice_topology = None
+    m._topology_ok_at = 0.0
+    m._topology_attempt_at = -1e9
+    m._topology_lock = threading.Lock()
+    m._tpu_daemon_addr = ("127.0.0.1", 9999)
+
+    results = [None] * 4
+    threads = [threading.Thread(
+        target=lambda i=i: results.__setitem__(
+            i, m._fetch_slice_topology())) for i in range(4)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    elapsed = time.monotonic() - t0
+    assert len(calls) == 1, f"double-dialed: {len(calls)} fetches"
+    # losers returned the cache immediately instead of queueing behind
+    # the dial (4 serialized dials would be >= 0.8 s)
+    assert elapsed < 0.6
+    # the winner cached the result for everyone after it
+    assert m._fetch_slice_topology().topology == "v5e-4"
+    assert len(calls) == 1  # fresh: no new dial
+
+
+def test_slice_join_truncation_is_surfaced(monkeypatch, caplog):
+    """ADVICE r4 #4: a walk stopped at max_slices must not report a
+    complete-looking group."""
+    graph = {f"10.0.0.{i}:1": {"topology": "v5e-4",
+                               "dcn_peers": [f"10.0.0.{i + 1}:1"]}
+             for i in range(6)}
+    graph["10.0.0.5:1"]["dcn_peers"] = []  # end of the chain
+
+    monkeypatch.setattr(slicejoin, "fetch_slice_info",
+                        lambda addr, timeout=5.0: graph[addr])
+    import logging
+    with caplog.at_level(logging.WARNING,
+                         logger="dpu_operator_tpu.daemon.slicejoin"):
+        result = slicejoin.join_slices("10.0.0.0:1", max_slices=3)
+    assert len(result.members) == 3
+    assert result.truncated is True
+    assert result.degraded is True  # collectives must not trust a prefix
+    assert any("truncated" in r.message for r in caplog.records)
+    # an untruncated walk stays clean
+    small = slicejoin.join_slices("10.0.0.4:1", max_slices=64)
+    assert small.truncated is False
+    assert small.degraded is False
+
+
+def test_device_plugin_stop_wakes_refresh_waiters(tmp_path):
+    """ADVICE r4 #5: stop() must notify _refresh_cond so a blocked
+    refresh() barrier returns immediately, not after its full timeout."""
+    from dpu_operator_tpu.deviceplugin.server import DevicePlugin
+    from dpu_operator_tpu.utils.path_manager import PathManager
+
+    class _Handler:
+        def get_devices(self):
+            return {}
+
+    dp = DevicePlugin(_Handler(), path_manager=PathManager(str(tmp_path)))
+    dp._active_streams = 1  # a stream exists but never serves the gen
+    done = {}
+
+    def blocked_refresh():
+        t0 = time.monotonic()
+        done["result"] = dp.refresh(wait=10.0)
+        done["elapsed"] = time.monotonic() - t0
+
+    t = threading.Thread(target=blocked_refresh)
+    t.start()
+    time.sleep(0.2)  # let it enter wait_for
+    dp.stop()
+    t.join(timeout=3)
+    assert not t.is_alive(), "refresh() still blocked after stop()"
+    assert done["elapsed"] < 2.0, f"waited {done['elapsed']:.1f}s"
+    assert done["result"] is False
