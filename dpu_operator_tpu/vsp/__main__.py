@@ -27,6 +27,13 @@ def main(argv=None):
                              "VSP spawns it and uses the native ICI "
                              "dataplane (cp-agent-run.go:9-73 analog)")
     parser.add_argument("--cp-agent-state", default="/var/run/tpucp.state")
+    parser.add_argument("--cp-agent-dev-dir", default="",
+                        help="chip device directory the agent scans "
+                             "(default /dev; dev machines point it at a "
+                             "fake root)")
+    parser.add_argument("--cp-agent-allow-regular-dev", action="store_true",
+                        help="accept regular files as chip devices "
+                             "(dev/test harnesses only)")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -39,8 +46,10 @@ def main(argv=None):
     if args.cp_agent and not args.mock:
         from .native_dp import AgentClient, AgentProcess, NativeIciDataplane
         agent_sock = sock + ".cp-agent"
-        agent_proc = AgentProcess(args.cp_agent, agent_sock,
-                                  state_file=args.cp_agent_state)
+        agent_proc = AgentProcess(
+            args.cp_agent, agent_sock, state_file=args.cp_agent_state,
+            dev_dir=args.cp_agent_dev_dir,
+            allow_regular_dev=args.cp_agent_allow_regular_dev)
         agent_proc.start()
         dataplane = NativeIciDataplane(AgentClient(agent_sock))
         logging.info("native cp-agent on %s", agent_sock)

@@ -1,22 +1,40 @@
 #!/usr/bin/env python3
-"""Image-entrypoint smoke harness: prove the build matrix without docker.
+"""Docker-less image executor: prove the image matrix without docker.
 
-VERDICT r2 #6 — this environment has no docker/podman, so the Dockerfiles
-were unexecuted and unproven. Per Dockerfile this harness proves the two
-things an image build + `docker run --help` would prove:
+VERDICT r2 #6 / r4 #3 — this environment has no docker/podman, so the
+Dockerfiles can never be built. Per Dockerfile this harness proves what a
+`docker build` + functional `docker run` would prove, in three tiers:
 
 1. **lint** — every COPY source path exists in the build context (repo
    root); `COPY --from=<stage>` paths are checked against the native
    Makefile's build outputs; the ENTRYPOINT parses as a JSON exec array.
-2. **smoke** — the package is pip-installed into a CLEAN venv (no repo on
-   sys.path; --no-deps/--no-build-isolation with system site packages
-   standing in for each image's `RUN pip install` layer) and the image's
-   EXACT entrypoint command runs with --help (python entrypoints and the
-   native agent) or its no-op invocation (CNI shim CHECK), expecting
-   exit 0.
+2. **materialize** — the final stage's COPY graph is applied to a fresh
+   rootfs tree (WORKDIR-relative and absolute destinations, multi-stage
+   sources resolved from the native build), and the Python package is
+   pip-installed into a clean venv FROM THAT TREE — so a Dockerfile that
+   forgets to COPY a subpackage fails here, not in production. The
+   `RUN pip install` third-party layer is grafted from the invoking
+   interpreter's site-packages via a .pth (no network in this env).
+3. **execute** — each image's EXACT entrypoint runs from its materialized
+   tree with a FUNCTIONAL scenario, not just --help:
+     operator   --help exits 0
+     daemon     full node stack on a fake hardware root: detects the TPU
+                platform, dials a VSP (harness-hosted mock on the real
+                unix socket), registers with a harness kubelet, brings up
+                the CNI server, then tears down cleanly on SIGTERM
+     vsp        spawns the MATERIALIZED cp-agent, serves the vendor
+                socket; the harness dials it and drives LifeCycle Init →
+                topology + GetDevices like the daemon would
+     nri        serves /healthz + /mutate against a real HTTPS apiserver
+                fixture; a pod AdmissionReview comes back patched with
+                the NAD's resource request
+     cp-agent   the materialized binary serves its framed unix-socket
+                protocol: init(v5e-4) + enumerate round-trip
+     workload   --help exits 0 (the jax path; full traffic-flow runs are
+                the bench tier's job)
 
-Reference analog: taskfiles/images.yaml (buildah matrix) +
-taskfiles/binaries.yaml:4-39 (one build per binary).
+Reference analog: taskfiles/images.yaml (buildah matrix, then e2e runs
+the images) + taskfiles/binaries.yaml:4-39.
 
 Usage: python hack/smoke_images.py [--lint-only]
 """
@@ -26,21 +44,23 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import posixpath
 import shlex
+import shutil
+import signal
+import socket
 import subprocess
 import sys
 import tempfile
+import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-#: smoke argv appended to each ENTRYPOINT (None = run entrypoint verbatim);
-#: env overrides per image for entrypoints driven by environment
-SMOKE_ARGS = {"default": ["--help"]}
-SMOKE_ENV = {}
 
 
 def parse_dockerfile(path: str) -> dict:
     """-> {"stages": [names], "copies": [(stage_or_None, [srcs], dst)],
+    "final_copies": [...], "workdir": final-stage WORKDIR,
     "entrypoint": [argv] | None} with continuation lines merged."""
     merged: list[str] = []
     with open(path) as f:
@@ -58,6 +78,8 @@ def parse_dockerfile(path: str) -> dict:
         merged.append(pending)
 
     stages, copies, entrypoint = [], [], None
+    stage_of_copy: list[int] = []
+    workdirs: list[str] = []
     for line in merged:
         stripped = line.strip()
         if not stripped or stripped.startswith("#"):
@@ -67,6 +89,9 @@ def parse_dockerfile(path: str) -> dict:
         if inst == "FROM":
             stages.append(parts[3] if len(parts) >= 4
                           and parts[2].upper() == "AS" else "")
+            workdirs.append("/")
+        elif inst == "WORKDIR" and workdirs:
+            workdirs[-1] = parts[1]
         elif inst == "COPY":
             args = parts[1:]
             from_stage = None
@@ -75,11 +100,18 @@ def parse_dockerfile(path: str) -> dict:
                 args = args[1:]
             args = [a for a in args if not a.startswith("--")]
             copies.append((from_stage, args[:-1], args[-1]))
+            stage_of_copy.append(len(stages) - 1)
         elif inst == "ENTRYPOINT":
             payload = stripped[len("ENTRYPOINT"):].strip()
             entrypoint = (json.loads(payload) if payload.startswith("[")
                           else shlex.split(payload))
-    return {"stages": stages, "copies": copies, "entrypoint": entrypoint}
+    final = len(stages) - 1
+    return {
+        "stages": stages, "copies": copies, "entrypoint": entrypoint,
+        "final_copies": [c for c, s in zip(copies, stage_of_copy)
+                         if s == final],
+        "workdir": workdirs[final] if workdirs else "/",
+    }
 
 
 #: build outputs a COPY --from may reference, produced by `make -C native`
@@ -113,8 +145,40 @@ def lint_dockerfile(path: str) -> list[str]:
     return problems
 
 
-def build_clean_venv(tmp: str) -> str:
-    """Fresh venv with the package installed the way the images do.
+def materialize_rootfs(tmp: str, name: str, spec: dict) -> tuple[str, str]:
+    """Apply the final stage's COPY graph to a fresh tree.
+
+    Returns (rootfs, workdir-inside-rootfs). Docker COPY semantics for
+    the shapes the repo uses: a directory source copies its CONTENTS to
+    the destination directory; a file source lands at the exact
+    destination path (or inside it when the destination ends with /)."""
+    rootfs = os.path.join(tmp, "rootfs-" + name)
+    workdir = spec["workdir"] or "/"
+    for from_stage, srcs, dst in spec["final_copies"]:
+        dst_abs = dst if dst.startswith("/") else posixpath.join(
+            workdir, dst)
+        for src in srcs:
+            source = (os.path.join(REPO, NATIVE_OUTPUTS[src])
+                      if from_stage is not None
+                      else os.path.join(REPO, src))
+            target = os.path.join(rootfs, dst_abs.lstrip("/"))
+            if os.path.isdir(source):
+                os.makedirs(target, exist_ok=True)
+                shutil.copytree(source, target, dirs_exist_ok=True)
+            else:
+                if dst_abs.endswith("/") or dst in (".", "./"):
+                    target = os.path.join(target, os.path.basename(src))
+                os.makedirs(os.path.dirname(target), exist_ok=True)
+                shutil.copyfile(source, target)
+                shutil.copymode(source, target)
+    tree_workdir = os.path.join(rootfs, workdir.lstrip("/"))
+    os.makedirs(tree_workdir, exist_ok=True)
+    return rootfs, tree_workdir
+
+
+def build_tree_venv(tmp: str, name: str, tree_workdir: str) -> str:
+    """Fresh venv with the package installed FROM THE MATERIALIZED TREE
+    — a Dockerfile that forgets to COPY a subpackage fails here.
 
     The venv is isolated (the repo checkout is NOT importable from it);
     third-party deps (each image's `RUN pip install` layer) are grafted
@@ -122,7 +186,7 @@ def build_clean_venv(tmp: str) -> str:
     environment has no network, so deps cannot be downloaded."""
     import sysconfig
 
-    venv = os.path.join(tmp, "venv")
+    venv = os.path.join(tmp, "venv-" + name)
     subprocess.run([sys.executable, "-m", "venv", venv], check=True)
     site = subprocess.run(
         [os.path.join(venv, "bin", "python3"), "-c",
@@ -133,66 +197,341 @@ def build_clean_venv(tmp: str) -> str:
     pip = os.path.join(venv, "bin", "pip")
     subprocess.run(
         [pip, "install", "--quiet", "--no-deps", "--no-build-isolation",
-         REPO],
+         tree_workdir],
         check=True, capture_output=True)
     return os.path.join(venv, "bin", "python3")
 
 
-def make_workdir(tmp: str, name: str, copies: list) -> str:
-    """Emulate the image WORKDIR: non-package COPY sources land in it
-    (pyproject/dpu_operator_tpu are represented by the venv install)."""
-    import shutil
+# -- execution scenarios ------------------------------------------------------
 
-    workdir = os.path.join(tmp, "workdir-" + name)
-    os.makedirs(workdir, exist_ok=True)
-    for from_stage, srcs, dst in copies:
-        if from_stage is not None:
-            continue
-        for src in srcs:
-            if src.rstrip("/") in ("pyproject.toml", "dpu_operator_tpu"):
-                continue
-            # absolute dsts must stay inside the emulated workdir, never
-            # escape onto the real filesystem
-            rel_dst = (dst if dst != "./" else src).lstrip("/")
-            target = os.path.join(workdir, rel_dst)
-            os.makedirs(os.path.dirname(target) or workdir, exist_ok=True)
-            full = os.path.join(REPO, src)
-            if os.path.isdir(full):
-                shutil.copytree(full, target, dirs_exist_ok=True)
-            else:
-                shutil.copyfile(full, target)
-    return workdir
+def _entry_argv(ctx: dict) -> list[str]:
+    """The image's exact entrypoint, with the interpreter swapped for the
+    tree venv's and absolute in-image paths re-rooted onto the tree (a
+    container would resolve them inside its own filesystem)."""
+    argv = list(ctx["entrypoint"])
+    if argv[0] in ("python3", "python"):
+        argv[0] = ctx["venv_python"]
+    out = []
+    for a in argv:
+        if a.startswith("/") and os.path.exists(
+                os.path.join(ctx["rootfs"], a.lstrip("/"))):
+            a = os.path.join(ctx["rootfs"], a.lstrip("/"))
+        out.append(a)
+    return out
 
 
-def smoke_entrypoint(venv_python: str, name: str, entrypoint: list,
-                     cwd: str) -> list[str]:
-    """Run the image's entrypoint with the smoke contract; return
-    problems."""
-    argv = list(entrypoint)
+def _clean_env(extra: dict = ()) -> dict:
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)
-    env.update(SMOKE_ENV.get(name, {}))
-    if argv[0] in ("python3", "python"):
-        argv[0] = venv_python
-        argv += SMOKE_ARGS.get(name, SMOKE_ARGS["default"])
-    elif os.path.basename(argv[0]) == "tpu_cp_agent":
-        argv = [os.path.join(REPO, "native", "build", "tpu_cp_agent"),
-                "--help"]
-    elif os.path.basename(argv[0]) == "tpu-cni":
-        argv = [os.path.join(REPO, "native", "build", "tpu-cni")]
-        env["CNI_COMMAND"] = "CHECK"
-    proc = subprocess.run(argv, cwd=cwd, env=env, capture_output=True,
-                          text=True, timeout=120,
+    env.pop("KUBERNETES_SERVICE_HOST", None)
+    env.update(extra or {})
+    return env
+
+
+def _wait_for(predicate, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def _terminate(proc: subprocess.Popen, what: str) -> list[str]:
+    """SIGTERM + wait; a clean scenario must exit 0."""
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return [f"{what}: did not exit on SIGTERM"]
+    if rc != 0:
+        return [f"{what}: exited {rc} on SIGTERM: "
+                f"{proc.stderr.read().decode()[:300]}"]
+    return []
+
+
+def _run_help(ctx: dict) -> list[str]:
+    argv = _entry_argv(ctx) + ["--help"]
+    proc = subprocess.run(argv, cwd=ctx["tree_workdir"], env=_clean_env(),
+                          capture_output=True, text=True, timeout=180,
                           stdin=subprocess.DEVNULL)
     if proc.returncode != 0:
-        return [f"entrypoint {' '.join(entrypoint)} + smoke args exited "
-                f"{proc.returncode}: {proc.stderr.strip()[:300]}"]
+        return [f"--help exited {proc.returncode}: "
+                f"{proc.stderr.strip()[:300]}"]
     return []
+
+
+def _fake_tpu_root(tmp: str, name: str, chips: int = 4) -> str:
+    """A hardware root shaped like a TPU VM: accelerator-type metadata +
+    accel device nodes (regular files; harness scenarios opt into the
+    fake-friendly relaxations the real code gates)."""
+    root = os.path.join(tmp, "hwroot-" + name)
+    os.makedirs(os.path.join(root, "run", "tpu"), exist_ok=True)
+    with open(os.path.join(root, "run", "tpu", "accelerator_type"),
+              "w") as f:
+        f.write("v5litepod-4")
+    os.makedirs(os.path.join(root, "dev"), exist_ok=True)
+    for i in range(chips):
+        open(os.path.join(root, "dev", f"accel{i}"), "w").close()
+    return root
+
+
+def scenario_operator(ctx: dict) -> list[str]:
+    return _run_help(ctx)
+
+
+def scenario_workload(ctx: dict) -> list[str]:
+    return _run_help(ctx)
+
+
+def scenario_daemon(ctx: dict) -> list[str]:
+    """One full detect pass: platform detection on a fake hardware root,
+    VSP dial + Init (harness-hosted mock on the real socket), kubelet
+    registration (harness FakeKubelet), CNI + device-plugin servers up,
+    graceful SIGTERM teardown."""
+    sys.path.insert(0, REPO)
+    from dpu_operator_tpu.deviceplugin.fake_kubelet import FakeKubelet
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    from dpu_operator_tpu.vsp.mock import MockTpuVsp
+    from dpu_operator_tpu.vsp.rpc import VspServer
+
+    root = _fake_tpu_root(ctx["tmp"], "daemon")
+    pm = PathManager(root)
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    vsp_server = VspServer(MockTpuVsp(), socket_path=sock)
+    vsp_server.start()
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    home = os.path.join(ctx["tmp"], "home-empty")
+    os.makedirs(home, exist_ok=True)
+    argv = _entry_argv(ctx) + ["--mode", "tpu", "--root", root]
+    shim = os.path.join(ctx["rootfs"], "opt/tpu/tpu-cni")
+    proc = subprocess.Popen(
+        argv, cwd=ctx["tree_workdir"],
+        env=_clean_env({"HOME": home, "TPU_CNI_SHIM_BIN": shim,
+                        "NODE_NAME": "smoke-node"}),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    problems: list[str] = []
+    try:
+        _wait_for(lambda: os.path.exists(pm.cni_server_socket()),
+                  timeout=30, what="daemon CNI server socket")
+        _wait_for(lambda: kubelet.registrations, timeout=30,
+                  what="device-plugin registration with kubelet")
+        resources = {r.resource_name for r in kubelet.registrations}
+        if "google.com/tpu" not in resources:
+            problems.append(f"daemon registered {resources}, expected "
+                            "google.com/tpu")
+    except TimeoutError as e:
+        proc.kill()
+        problems.append(f"daemon: {e}; stderr: "
+                        f"{proc.stderr.read().decode()[:400]}")
+    else:
+        problems += _terminate(proc, "daemon")
+    finally:
+        kubelet.stop()
+        vsp_server.stop()
+    return problems
+
+
+def scenario_vsp(ctx: dict) -> list[str]:
+    """The image's exact entrypoint (including its own materialized
+    cp-agent): serve the vendor socket, then drive LifeCycle Init →
+    programmed topology + GetDevices, like the daemon's GrpcPlugin."""
+    sys.path.insert(0, REPO)
+    from dpu_operator_tpu.vsp.rpc import VspChannel, unix_target
+
+    root = _fake_tpu_root(ctx["tmp"], "vsp")
+    sock = os.path.join(ctx["tmp"], "vsp.sock")
+    argv = _entry_argv(ctx) + [
+        "--root", root, "--socket", sock,
+        "--cp-agent-state", os.path.join(ctx["tmp"], "cp.state"),
+        "--cp-agent-dev-dir", os.path.join(root, "dev"),
+        "--cp-agent-allow-regular-dev"]
+    proc = subprocess.Popen(argv, cwd=ctx["tree_workdir"],
+                            env=_clean_env(),
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    problems: list[str] = []
+    channel = None
+    try:
+        _wait_for(lambda: os.path.exists(sock), timeout=30,
+                  what="VSP vendor socket")
+        channel = VspChannel(unix_target(sock))
+        channel.wait_ready(timeout=10)
+        resp = channel.call("LifeCycleService", "Init",
+                            {"tpu_mode": True}, timeout=10)
+        if resp.get("topology") != "v5e-4":
+            problems.append(f"VSP Init topology {resp.get('topology')!r}, "
+                            "expected v5e-4 from the fake root metadata")
+        devs = channel.call("DeviceService", "GetDevices", {},
+                            timeout=10).get("devices", {})
+        if len(devs) != 4:
+            problems.append(f"VSP GetDevices returned {len(devs)} chips, "
+                            "expected 4")
+    except Exception as e:  # noqa: BLE001 — report, don't crash harness
+        proc.kill()
+        return [f"vsp: {type(e).__name__}: {e}; stderr: "
+                f"{proc.stderr.read().decode()[:400]}"]
+    finally:
+        if channel is not None:
+            channel.close()
+    problems += _terminate(proc, "vsp")
+    return problems
+
+
+def scenario_nri(ctx: dict) -> list[str]:
+    """Serve + mutate: the webhook entrypoint against a real HTTPS
+    apiserver fixture; a pod with a NAD annotation comes back with the
+    NAD's resource injected."""
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from apiserver_fixture import MiniApiServer
+    from dpu_operator_tpu.k8s import FakeKube
+
+    backing = FakeKube()
+    backing.create({
+        "apiVersion": "k8s.cni.cncf.io/v1",
+        "kind": "NetworkAttachmentDefinition",
+        "metadata": {"name": "tpunfcni-conf", "namespace": "default",
+                     "annotations": {
+                         "k8s.v1.cni.cncf.io/resourceName":
+                             "google.com/tpu"}},
+        "spec": {"config": "{}"}})
+    api = MiniApiServer(kube=backing)
+    api.start()
+    kubeconfig = api.write_kubeconfig(
+        os.path.join(ctx["tmp"], "nri-kubeconfig"))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    argv = _entry_argv(ctx) + ["--bind", "127.0.0.1", "--port", str(port),
+                               "--kubeconfig", kubeconfig]
+    proc = subprocess.Popen(argv, cwd=ctx["tree_workdir"],
+                            env=_clean_env(),
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    problems: list[str] = []
+
+    def healthy():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=1) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
+    try:
+        _wait_for(healthy, timeout=30, what="webhook /healthz")
+        review = {
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "smoke-1", "operation": "CREATE", "object": {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"namespace": "default", "annotations": {
+                    "k8s.v1.cni.cncf.io/networks": "tpunfcni-conf"}},
+                "spec": {"containers": [{"name": "w"}]}}}}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/mutate",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json.loads(r.read())
+        resp = out.get("response", {})
+        if not resp.get("allowed"):
+            problems.append(f"mutate not allowed: {resp}")
+        elif "patch" not in resp:
+            problems.append("mutate returned no patch for a NAD-annotated "
+                            "pod")
+        else:
+            import base64
+            patches = json.loads(base64.b64decode(resp["patch"]))
+            if not any(isinstance(p.get("value"), dict)
+                       and "google.com/tpu" in p["value"]
+                       for p in patches):
+                problems.append(f"patch lacks google.com/tpu: {patches}")
+    except Exception as e:  # noqa: BLE001
+        proc.kill()
+        problems.append(f"nri: {type(e).__name__}: {e}; stderr: "
+                        f"{proc.stderr.read().decode()[:400]}")
+    else:
+        problems += _terminate(proc, "nri")
+    finally:
+        api.stop()
+    return problems
+
+
+def scenario_cp_agent(ctx: dict) -> list[str]:
+    """The materialized binary serves its framed protocol: socket ping
+    via init(v5e-4) + enumerate."""
+    sys.path.insert(0, REPO)
+    from dpu_operator_tpu.vsp.native_dp import AgentClient
+
+    sock = os.path.join(ctx["tmp"], "cpagent.sock")
+    binary = os.path.join(ctx["rootfs"], "usr/local/bin/tpu_cp_agent")
+    if not os.path.exists(binary):
+        return ["materialized tree lacks /usr/local/bin/tpu_cp_agent"]
+    argv = [binary, "--socket", sock,
+            "--state-file", os.path.join(ctx["tmp"], "cpagent.state")]
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    problems: list[str] = []
+    client = None
+    try:
+        _wait_for(lambda: os.path.exists(sock), timeout=15,
+                  what="cp-agent socket")
+        client = AgentClient(sock)
+        info = client.init("v5e-4")
+        if info["num_chips"] != 4:
+            problems.append(f"agent init returned {info['num_chips']} "
+                            "chips for v5e-4")
+        chips = client.enumerate()
+        if len(chips) != 4:
+            problems.append(f"agent enumerate returned {len(chips)} chips")
+        client.shutdown()  # protocol-level stop: clean exit expected
+        rc = proc.wait(timeout=10)
+        if rc != 0:
+            problems.append(f"agent exited {rc} after Shutdown")
+    except Exception as e:  # noqa: BLE001
+        proc.kill()
+        return [f"cp-agent: {type(e).__name__}: {e}; stderr: "
+                f"{proc.stderr.read().decode()[:400]}"]
+    finally:
+        if client is not None:
+            client.close()
+    return problems
+
+
+SCENARIOS = {
+    "operator": scenario_operator,
+    "daemon": scenario_daemon,
+    "vsp": scenario_vsp,
+    "nri": scenario_nri,
+    "cp-agent": scenario_cp_agent,
+    "workload": scenario_workload,
+}
+
+
+def execute_image(tmp: str, name: str, spec: dict) -> list[str]:
+    """Materialize + venv + run the image's functional scenario."""
+    rootfs, tree_workdir = materialize_rootfs(tmp, name, spec)
+    venv_python = None
+    if os.path.exists(os.path.join(tree_workdir, "pyproject.toml")):
+        try:
+            venv_python = build_tree_venv(tmp, name, tree_workdir)
+        except subprocess.CalledProcessError as e:
+            return [f"pip install from materialized tree failed: "
+                    f"{(e.stderr or b'').decode()[:300]}"]
+    scenario = SCENARIOS.get(name, _run_help)
+    ctx = {"name": name, "rootfs": rootfs, "tree_workdir": tree_workdir,
+           "venv_python": venv_python, "entrypoint": spec["entrypoint"],
+           "tmp": tmp}
+    return scenario(ctx)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser("smoke-images")
     parser.add_argument("--lint-only", action="store_true")
+    parser.add_argument("--only", default="",
+                        help="comma-separated image names to execute")
     args = parser.parse_args(argv)
 
     dockerfiles = sorted(
@@ -200,22 +539,21 @@ def main(argv=None) -> int:
     if not dockerfiles:
         print("no Dockerfiles found", file=sys.stderr)
         return 1
+    only = {n for n in args.only.split(",") if n}
     failures = 0
-    venv_python = None
-    with tempfile.TemporaryDirectory(prefix="smoke-") as tmp:
+    # short tmp root: unix socket paths must fit sun_path (108 bytes)
+    with tempfile.TemporaryDirectory(prefix="smk-", dir="/tmp") as tmp:
         if not args.lint_only:
             subprocess.run(["make", "-C", os.path.join(REPO, "native")],
                            check=True, capture_output=True)
-            venv_python = build_clean_venv(tmp)
         for df in dockerfiles:
             name = df.split(".", 1)[1]
+            if only and name not in only:
+                continue
             problems = lint_dockerfile(os.path.join(REPO, df))
             if not problems and not args.lint_only:
                 spec = parse_dockerfile(os.path.join(REPO, df))
-                workdir = make_workdir(tmp, name, spec["copies"])
-                problems += smoke_entrypoint(venv_python, name,
-                                             spec["entrypoint"],
-                                             cwd=workdir)
+                problems += execute_image(tmp, name, spec)
             status = "ok" if not problems else "FAIL"
             print(f"{df}: {status}")
             for p in problems:
