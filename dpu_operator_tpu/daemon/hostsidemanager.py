@@ -81,6 +81,9 @@ class HostSideManager:
 
     def serve(self):
         self.device_plugin.register_with_kubelet()
+        # survive kubelet restarts: re-register when kubelet.sock is
+        # recreated (the restart wipes the plugin registry)
+        self.device_plugin.enable_kubelet_watch()
         if self.client is not None:
             self._manager = Manager(self.client)
             self._manager.add_reconciler(

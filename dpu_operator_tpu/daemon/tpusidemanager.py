@@ -226,6 +226,11 @@ class TpuSideManager:
             self.enable_ici_ports(lambda: (topo, worker))
         else:
             self.device_plugin.register_with_kubelet()
+        # survive kubelet restarts: re-register when kubelet.sock is
+        # recreated (the restart wipes the plugin registry)
+        self.device_plugin.enable_kubelet_watch()
+        if self.ici_device_plugin is not None:
+            self.ici_device_plugin.enable_kubelet_watch()
         self._advertise_address()
         if self.client is not None:
             self._manager = Manager(self.client)
@@ -1498,8 +1503,22 @@ class TpuSideManager:
         from ..deviceplugin.server import preferred_ici_ports
         with self._attach_lock:
             recent = list(self._recent_chip_allocs)
-        return preferred_ici_ports(available, must_include, size, devices,
-                                   recent_chips=recent)
+        picked = preferred_ici_ports(available, must_include, size, devices,
+                                     recent_chips=recent)
+        # formally bound the ordering assumption (v1beta1 carries no pod
+        # identity): when kubelet allocated this pod's ports BEFORE its
+        # chips, the pick degrades to clustering — observable here, so
+        # operators can see how often the degraded path is taken
+        recent_set = set(recent)
+        aligned = any(
+            f"chip-{(devices.get(p) or {}).get('chip')}" in recent_set
+            for p in picked)
+        metrics.PORT_AFFINITY.inc(
+            result="aligned" if aligned else "fallback")
+        if not aligned and picked:
+            log.info("ici-port allocation without fresh chip affinity "
+                     "(ports-before-chips ordering); clustering pick used")
+        return picked
 
     def enable_ici_ports(self, topology_provider):
         """Advertise google.com/ici-port as a second device plugin. Port

@@ -58,6 +58,9 @@ class FakeKubelet:
         self.allocated: dict[str, set] = {}
         self._lock = threading.Lock()
         self._updated = threading.Condition(self._lock)
+        # live ListAndWatch stream calls, cancellable on restart()
+        self._watch_calls: list = []
+        self._gen = 0
 
     def start(self):
         sock = self.path_manager.kubelet_socket()
@@ -71,6 +74,7 @@ class FakeKubelet:
 
     def stop(self):
         self._stop.set()
+        self._cancel_watches()
         if self._server:
             self._server.stop(0.5).wait()
             self._server = None
@@ -80,6 +84,48 @@ class FakeKubelet:
             for channel in self._alloc_channels.values():
                 channel.close()
             self._alloc_channels.clear()
+
+    def _cancel_watches(self):
+        with self._lock:
+            calls, self._watch_calls = self._watch_calls, []
+        for call in calls:
+            try:
+                call.cancel()
+            except Exception:  # noqa: BLE001 — already finished
+                pass
+
+    def restart(self, wipe_plugin_sockets: bool = True):
+        """Simulate a kubelet restart: connections drop, the plugin
+        registry is forgotten, the plugins dir is wiped (real kubelet
+        clears *.sock on startup), and a fresh Registration server binds
+        a NEW kubelet.sock inode. Plugins that fail to watch for the
+        recreation silently stop being allocatable — the failure mode
+        DevicePlugin.enable_kubelet_watch exists to close."""
+        if self._server:
+            self._server.stop(0.5).wait()
+            self._server = None
+        with self._lock:
+            self._gen += 1
+            self.registrations.clear()
+            self.device_lists.clear()
+            for channel in self._alloc_channels.values():
+                channel.close()
+            self._alloc_channels.clear()
+        self._cancel_watches()
+        for t in self._watch_threads:
+            t.join(timeout=2)
+        self._watch_threads.clear()
+        plugin_dir = self.path_manager.kubelet_plugin_dir()
+        if wipe_plugin_sockets and os.path.isdir(plugin_dir):
+            kubelet_sock = os.path.basename(
+                self.path_manager.kubelet_socket())
+            for fname in os.listdir(plugin_dir):
+                if fname.endswith(".sock") and fname != kubelet_sock:
+                    try:
+                        os.unlink(os.path.join(plugin_dir, fname))
+                    except OSError:
+                        pass
+        self.start()
 
     # -- Registration service -------------------------------------------------
     def _register(self, request: pb.RegisterRequest, context):
@@ -96,6 +142,8 @@ class FakeKubelet:
 
     # -- kubelet-side ListAndWatch consumption -------------------------------
     def _watch_plugin(self, resource: str, endpoint: str):
+        with self._lock:
+            gen = self._gen
         channel = grpc.insecure_channel(f"unix://{endpoint}")
         try:
             grpc.channel_ready_future(channel).result(timeout=5)
@@ -103,9 +151,12 @@ class FakeKubelet:
                 "/v1beta1.DevicePlugin/ListAndWatch",
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=pb.ListAndWatchResponse.FromString)
-            for resp in stream(pb.Empty()):
-                if self._stop.is_set():
-                    break
+            call = stream(pb.Empty())
+            with self._lock:
+                self._watch_calls.append(call)
+            for resp in call:
+                if self._stop.is_set() or self._gen != gen:
+                    break  # kubelet "process" died (restart())
                 devices = list(resp.devices)
                 healthy = sum(1 for d in devices if d.health == "Healthy")
                 with self._updated:

@@ -220,6 +220,14 @@ class DevicePlugin:
         self._refresh_gen = 0
         self._served_gen = 0
         self._active_streams = 0
+        # kubelet-restart resilience: the watcher thread re-registers
+        # when kubelet.sock is recreated (enable_kubelet_watch).
+        # _lifecycle_lock serializes stop() against the watcher's
+        # _restart_server so a SIGTERM racing a kubelet restart cannot
+        # revive the server (start() clears _stop)
+        self._kubelet_watch_thread: Optional[threading.Thread] = None
+        self._lifecycle_lock = threading.Lock()
+        self.reregistrations = 0
 
     # -- serving --------------------------------------------------------------
     @property
@@ -268,9 +276,83 @@ class DevicePlugin:
             # thread blocked in wait_for only observes shutdown via its
             # full timeout (slow SIGTERM during a concurrent resize)
             self._refresh_cond.notify_all()
-        if self._server:
-            self._server.stop(0.5).wait()
-            self._server = None
+        with self._lifecycle_lock:
+            # re-assert under the lock: a concurrent _restart_server's
+            # start() may have cleared _stop between our set above and
+            # acquiring the lock — without this the revived server and
+            # watch loop would outlive shutdown
+            self._stop.set()
+            if self._server:
+                self._server.stop(0.5).wait()
+                self._server = None
+        if self._kubelet_watch_thread is not None:
+            self._kubelet_watch_thread.join(timeout=3)
+            self._kubelet_watch_thread = None
+
+    # -- kubelet-restart resilience -------------------------------------------
+    def enable_kubelet_watch(self, interval: float = 1.0):
+        """Re-register when kubelet.sock is recreated (kubelet restart).
+
+        A restarting kubelet forgets its plugin registry and wipes the
+        plugin sockets in its plugins dir, so a plugin that never
+        re-registers silently stops being allocatable until pod churn
+        (upstream plugins watch for exactly this via fsnotify on
+        kubelet.sock; the reference has no restart handling —
+        deviceplugin.go:229-262 registers once). Polling watcher, 1 Hz:
+        an inode change or reappearance of kubelet.sock triggers
+        re-serve (our own socket file may have been wiped too) +
+        Register."""
+        if self._kubelet_watch_thread is not None:
+            return
+        self._kubelet_watch_thread = threading.Thread(
+            target=self._kubelet_watch_loop, args=(interval,),
+            daemon=True, name=f"kubelet-watch-{self.resource}")
+        self._kubelet_watch_thread.start()
+
+    def _kubelet_sock_id(self):
+        try:
+            st = os.stat(self.path_manager.kubelet_socket())
+            # ctime too: tmpfs happily reuses a just-freed inode number,
+            # so (ino, dev) alone can miss a delete+recreate cycle
+            return (st.st_ino, st.st_dev, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    def _kubelet_watch_loop(self, interval: float):
+        last = self._kubelet_sock_id()
+        while not self._stop.wait(interval):
+            cur = self._kubelet_sock_id()
+            if cur is None:
+                last = None  # kubelet down: re-register when it returns
+                continue
+            if cur == last:
+                continue
+            log.warning("kubelet.sock recreated; re-registering %s",
+                        self.resource)
+            try:
+                if not os.path.exists(self.socket_path):
+                    # the restart wiped the plugins dir including our
+                    # socket FILE (the bound listener is orphaned):
+                    # re-bind before registering the endpoint
+                    self._restart_server()
+                self.register_with_kubelet()
+            except Exception:  # noqa: BLE001 — retry next tick
+                log.exception("re-registration of %s failed; retrying",
+                              self.resource)
+                last = None
+                continue
+            self.reregistrations += 1
+            metrics.KUBELET_REREGISTRATIONS.inc(resource=self.resource)
+            last = cur
+
+    def _restart_server(self):
+        with self._lifecycle_lock:
+            if self._stop.is_set():
+                return  # shutdown won the race: stay down
+            if self._server is not None:
+                self._server.stop(0.5).wait()
+                self._server = None
+            self.start()
 
     # -- registration (deviceplugin.go:229-262) -------------------------------
     def register_with_kubelet(self, timeout: float = 10.0):

@@ -1,0 +1,212 @@
+"""Kubelet-plane hardening (VERDICT r4 #4): a kubelet restart recreates
+kubelet.sock, wipes the plugin registry AND the plugin sockets — a plugin
+that never re-registers silently stops being allocatable until pod churn.
+Also bounds the ports-before-chips ordering assumption: out-of-order
+Allocate must degrade to a valid clustering pick, never fail, and align
+again on the next pod once chips flow."""
+
+import threading
+import time
+
+import pytest
+
+from dpu_operator_tpu.deviceplugin import DevicePlugin, FakeKubelet
+from dpu_operator_tpu.deviceplugin.server import preferred_ici_ports
+from dpu_operator_tpu.utils.path_manager import PathManager
+
+
+class StaticHandler:
+    def __init__(self, devices):
+        self.devices = devices
+
+    def get_devices(self):
+        return self.devices
+
+
+DEVS = {
+    f"chip-{i}": {"id": f"chip-{i}", "healthy": True,
+                  "dev_path": f"/dev/accel{i}", "coords": [i % 2, i // 2]}
+    for i in range(4)
+}
+
+
+@pytest.fixture
+def pm(short_tmp):
+    return PathManager(short_tmp)
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_plugin_reregisters_after_kubelet_restart(pm):
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    plugin = DevicePlugin(StaticHandler(dict(DEVS)), path_manager=pm,
+                          poll_interval=0.05)
+    plugin.start()
+    try:
+        plugin.register_with_kubelet()
+        plugin.enable_kubelet_watch(interval=0.1)
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        assert len(kubelet.registrations) == 1
+
+        kubelet.restart()
+        assert kubelet.registrations == []  # registry forgotten
+        # the watcher notices the recreated socket, re-serves its own
+        # (wiped) endpoint, and re-registers — devices flow again
+        assert _wait(lambda: plugin.reregistrations >= 1), \
+            "plugin never re-registered after kubelet restart"
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        assert len(kubelet.registrations) == 1
+        # and Allocate works over the re-bound socket
+        resp = kubelet.allocate("google.com/tpu", ["chip-0"])
+        assert resp.container_responses[0].envs["TPU_DEVICE_IDS"] == \
+            "chip-0"
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_plugin_survives_repeated_restarts(pm):
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    plugin = DevicePlugin(StaticHandler(dict(DEVS)), path_manager=pm,
+                          poll_interval=0.05)
+    plugin.start()
+    try:
+        plugin.register_with_kubelet()
+        plugin.enable_kubelet_watch(interval=0.1)
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        for round_no in range(1, 3):
+            kubelet.restart()
+            assert _wait(
+                lambda: plugin.reregistrations >= round_no), round_no
+            assert kubelet.wait_for_devices("google.com/tpu", 4)
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_kubelet_outage_then_return_triggers_reregistration(pm):
+    """kubelet.sock disappearing (crash) then returning later must also
+    re-register — not only an atomic inode swap."""
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    plugin = DevicePlugin(StaticHandler(dict(DEVS)), path_manager=pm,
+                          poll_interval=0.05)
+    plugin.start()
+    try:
+        plugin.register_with_kubelet()
+        plugin.enable_kubelet_watch(interval=0.1)
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        kubelet.stop()  # outage: socket file still gone after stop?
+        import os
+        sock = pm.kubelet_socket()
+        if os.path.exists(sock):
+            os.unlink(sock)
+        time.sleep(0.3)  # watcher observes the outage
+        kubelet2 = FakeKubelet(pm)
+        kubelet2.start()
+        try:
+            assert _wait(lambda: plugin.reregistrations >= 1)
+            assert kubelet2.wait_for_devices("google.com/tpu", 4)
+        finally:
+            kubelet2.stop()
+    finally:
+        plugin.stop()
+
+
+def test_stop_racing_watcher_restart_stays_down(pm):
+    """SIGTERM racing the watcher's _restart_server must not revive the
+    server: start() clears _stop, so an unguarded restart would leave a
+    live gRPC server and watch loop after shutdown."""
+    plugin = DevicePlugin(StaticHandler(dict(DEVS)), path_manager=pm,
+                          poll_interval=0.05)
+    plugin.start()
+    plugin.stop()
+    plugin._restart_server()  # the watcher losing the race
+    assert plugin._server is None
+    assert plugin._stop.is_set()
+
+
+# -- ports-before-chips ordering bound ---------------------------------------
+
+PORT_DEVS = {
+    f"ici-{c}-{p}": {"id": f"ici-{c}-{p}", "healthy": True, "chip": c}
+    for c in range(4) for p in ("x+", "x-")
+}
+
+
+def test_out_of_order_allocation_degrades_to_valid_clustering():
+    """No recent chip allocation (kubelet allocated this pod's ports
+    FIRST): the pick must still return size valid ports clustered by
+    chip — degraded affinity, never a failure."""
+    available = sorted(PORT_DEVS)
+    picked = preferred_ici_ports(available, [], 2, PORT_DEVS,
+                                 recent_chips=[])
+    assert len(picked) == 2
+    assert set(picked) <= set(available)
+    # clustering: both ports on the same (lowest) chip
+    chips = {PORT_DEVS[p]["chip"] for p in picked}
+    assert len(chips) == 1
+
+
+def test_affinity_realigns_once_chips_flow():
+    """After the chips Allocate lands, the next port pick rides those
+    chips — one port per chip, newest first."""
+    available = sorted(PORT_DEVS)
+    picked = preferred_ici_ports(available, [], 2, PORT_DEVS,
+                                 recent_chips=["chip-2", "chip-1"])
+    assert {PORT_DEVS[p]["chip"] for p in picked} == {2, 1}
+
+
+def test_wire_level_ports_before_chips_admission(pm):
+    """Full wire-level simulation of the out-of-order admission: the
+    kubelet allocates the pod's ici-ports BEFORE its chips. Both
+    Allocates succeed; the port allocation is valid (no overlap, correct
+    size) even with no chip affinity available."""
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    recent: list = []
+
+    def preferred(available, must, size, devices):
+        return preferred_ici_ports(available, must, size, devices,
+                                   recent_chips=list(recent))
+
+    chip_plugin = DevicePlugin(
+        StaticHandler(dict(DEVS)), path_manager=pm, poll_interval=0.05,
+        allocation_listener=lambda ids: recent.extend(ids))
+    port_plugin = DevicePlugin(
+        StaticHandler(dict(PORT_DEVS)), resource="google.com/ici-port",
+        path_manager=pm, poll_interval=0.05, preferred_fn=preferred)
+    chip_plugin.start()
+    port_plugin.start()
+    try:
+        chip_plugin.register_with_kubelet()
+        port_plugin.register_with_kubelet()
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        assert kubelet.wait_for_devices("google.com/ici-port", 8)
+        # PORTS FIRST (map-order iteration in kubelet's device manager)
+        _, port_ids = kubelet.allocate_preferred("google.com/ici-port", 2)
+        assert len(port_ids) == 2
+        # degraded pick: no chip affinity yet, clustered on one chip
+        assert len({PORT_DEVS[p]["chip"] for p in port_ids}) == 1
+        # chips whose ports the degraded pick did NOT consume
+        _, chip_ids = kubelet.allocate_preferred(
+            "google.com/tpu", 2, must_include=("chip-2", "chip-3"))
+        assert set(chip_ids) == {"chip-2", "chip-3"}
+        # next pod: ports now align with the chips just allocated —
+        # one port per chip
+        _, port_ids2 = kubelet.allocate_preferred("google.com/ici-port", 2)
+        assert {PORT_DEVS[p]["chip"] for p in port_ids2} == {2, 3}
+        assert not set(port_ids2) & set(port_ids)  # never double-assigned
+    finally:
+        chip_plugin.stop()
+        port_plugin.stop()
+        kubelet.stop()
