@@ -42,8 +42,14 @@ def main(argv=None):
     mgr.add_reconciler(ServiceFunctionChainClusterReconciler())
 
     started = threading.Event()
+    # /metrics is authenticated+authorized via TokenReview/
+    # SubjectAccessReview (reference: cmd/main.go:66-70 filters metrics
+    # with WithAuthenticationAndAuthorization; RBAC:
+    # config/rbac/metrics_auth_role.yaml + metrics_reader_role.yaml)
+    from .utils.metrics import TokenReviewAuth
     metrics_server = MetricsServer(port=args.metrics_port,
-                                   ready_check=started.is_set)
+                                   ready_check=started.is_set,
+                                   auth=TokenReviewAuth(client))
     metrics_server.start()
 
     from .webhook import WebhookServer
@@ -53,7 +59,12 @@ def main(argv=None):
     webhook.start()
 
     if args.leader_elect:
-        client.acquire_leader_lease("tpu-operator-leader")
+        # the lease lives in the operator's own namespace so the
+        # namespaced leader-election Role covers it
+        # (config/rbac/leader_election_role.yaml)
+        from .utils import NAMESPACE
+        client.acquire_leader_lease("tpu-operator-leader",
+                                    namespace=NAMESPACE)
 
     mgr.start()
     started.set()

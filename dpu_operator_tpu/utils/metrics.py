@@ -9,6 +9,7 @@ binary (operator, daemon, webhook) exposes the same observability surface.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -173,19 +174,97 @@ BOUNDARY_SYNCS = REGISTRY.counter(
 SLICE_JOINS = REGISTRY.counter(
     "tpu_daemon_slice_joins_total",
     "Multi-slice peer walks by outcome (ok/degraded)")
+KUBELET_REREGISTRATIONS = REGISTRY.counter(
+    "tpu_daemon_kubelet_reregistrations_total",
+    "Device-plugin re-registrations after kubelet.sock recreation")
+PORT_AFFINITY = REGISTRY.counter(
+    "tpu_daemon_port_affinity_total",
+    "ICI-port preferred allocations by result (aligned = ports ride the "
+    "pod's own recent chip allocation; fallback = kubelet allocated "
+    "ports before chips, clustering pick used)")
+
+
+class TokenReviewAuth:
+    """Authenticate + authorize /metrics scrapers against the apiserver:
+    TokenReview (authn), then SubjectAccessReview for `get` on the
+    nonResourceURL /metrics (authz) — the reference's
+    WithAuthenticationAndAuthorization filter (cmd/main.go:66-70), which
+    is backed by exactly these two APIs. The serving identity needs
+    create on tokenreviews + subjectaccessreviews
+    (config/rbac/metrics_auth_role.yaml); scrapers need a binding to
+    config/rbac/metrics_reader_role.yaml. Verdicts are cached per token
+    for *ttl* seconds (upstream caches the same way)."""
+
+    def __init__(self, client, ttl: float = 60.0):
+        self.client = client
+        self.ttl = ttl
+        # keyed by sha256(token): plaintext bearer tokens must not sit
+        # in process memory (heap/core dumps) — k8s' own delegating
+        # authenticator caches by token hash for the same reason
+        self._cache: dict[str, tuple[float, bool]] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(token: str) -> str:
+        import hashlib
+        return hashlib.sha256(token.encode()).hexdigest()
+
+    def __call__(self, token: str) -> bool:
+        now = time.monotonic()
+        key = self._key(token)
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None and now < hit[0]:
+                return hit[1]
+        try:
+            tr = self.client.create({
+                "apiVersion": "authentication.k8s.io/v1",
+                "kind": "TokenReview", "metadata": {},
+                "spec": {"token": token}})
+            status = tr.get("status") or {}
+            allowed = False
+            if status.get("authenticated"):
+                user = status.get("user") or {}
+                sar = self.client.create({
+                    "apiVersion": "authorization.k8s.io/v1",
+                    "kind": "SubjectAccessReview", "metadata": {},
+                    "spec": {"user": user.get("username", ""),
+                             "groups": user.get("groups") or [],
+                             "nonResourceAttributes": {
+                                 "path": "/metrics", "verb": "get"}}})
+                allowed = bool((sar.get("status") or {}).get("allowed"))
+        except Exception:  # noqa: BLE001 — fail CLOSED on review errors,
+            # but do NOT cache the error verdict: one apiserver blip must
+            # not 403 a valid scraper for the whole TTL window
+            logging.getLogger(__name__).exception(
+                "metrics token review failed; denying this scrape")
+            return False
+        with self._lock:
+            self._cache[key] = (now + self.ttl, allowed)
+            if len(self._cache) > 1024:  # bound memory under token churn
+                self._cache.pop(next(iter(self._cache)))
+        return allowed
 
 
 class MetricsServer:
     """/metrics + /healthz + /readyz on one port (the operator binds
-    metrics :18090 and health :18091 separately; one mux suffices here)."""
+    metrics :18090 and health :18091 separately; one mux suffices here).
+
+    With *auth* set (a callable token -> allowed, e.g. TokenReviewAuth),
+    /metrics requires a Bearer token — 401 without one, 403 when the
+    review denies — while /healthz and /readyz stay open (kubelet probes
+    cannot attach tokens; the reference likewise filters only metrics,
+    cmd/main.go:66-70)."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  registry: Registry = REGISTRY,
-                 ready_check: Optional[Callable[[], bool]] = None):
+                 ready_check: Optional[Callable[[], bool]] = None,
+                 auth: Optional[Callable[[str], bool]] = None):
         self.host = host
         self.port = port
         self.registry = registry
         self.ready_check = ready_check or (lambda: True)
+        self.auth = auth
         self._server: Optional[ThreadingHTTPServer] = None
 
     def start(self):
@@ -199,9 +278,22 @@ class MetricsServer:
 
             def do_GET(self):
                 if self.path == "/metrics":
-                    body = outer.registry.render().encode()
-                    ctype = "text/plain; version=0.0.4"
                     code = 200
+                    if outer.auth is not None:
+                        hdr = self.headers.get("Authorization", "")
+                        token = (hdr[len("Bearer "):]
+                                 if hdr.startswith("Bearer ") else "")
+                        if not token:
+                            code = 401
+                        elif not outer.auth(token):
+                            code = 403
+                    if code != 200:
+                        body = b"Unauthorized" if code == 401 \
+                            else b"Forbidden"
+                        ctype = "text/plain"
+                    else:
+                        body = outer.registry.render().encode()
+                        ctype = "text/plain; version=0.0.4"
                 elif self.path == "/healthz":
                     body, ctype, code = b"ok", "text/plain", 200
                 elif self.path == "/readyz":

@@ -38,6 +38,7 @@ KNOWN_KINDS = [
     "Role", "RoleBinding", "Lease", "NetworkAttachmentDefinition",
     "CustomResourceDefinition", "TpuOperatorConfig", "ServiceFunctionChain",
     "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
+    "TokenReview", "SubjectAccessReview",
 ]
 _PLURAL_TO_KIND = {plural(k): k for k in KNOWN_KINDS}
 
@@ -232,17 +233,27 @@ class _Handler(BaseHTTPRequestHandler):
         return False
 
     # -- RBAC (reference: config/rbac/ exercised implicitly by envtest) ------
-    def _authorized(self, verb: str, group: str, resource: str,
-                    subresource: str | None) -> bool:
-        """ClusterRole/ClusterRoleBinding evaluation for the authenticated
-        subject. The admin token (subject None) bypasses, matching
-        envtest's cluster-admin default; tokens registered in
-        token_subjects get real rule evaluation (VERDICT r2 #9: role.yaml
-        must be validated by something that can fail)."""
-        if self._subject is None or not self.server.owner.rbac_enabled:
-            return True
-        full_resource = (f"{resource}/{subresource}" if subresource
-                         else resource)
+    @staticmethod
+    def _rule_allows(rule: dict, verb: str, group: str,
+                     full_resource: str, name: str | None) -> bool:
+        """One PolicyRule vs one request — k8s semantics including
+        resourceNames: a name-scoped rule never matches a request
+        without a single object name (create/list/watch), and only
+        matches named requests naming one of its resourceNames."""
+        if not (_in(rule.get("apiGroups"), group)
+                and _in(rule.get("resources"), full_resource)
+                and _in(rule.get("verbs"), verb)):
+            return False
+        scoped = rule.get("resourceNames")
+        if scoped:
+            return name is not None and name in scoped
+        return True
+
+    def _roles_for_subject(self, namespace: str | None):
+        """Yield every Role/ClusterRole bound to the authenticated
+        subject: ClusterRoleBindings (cluster-wide) plus RoleBindings in
+        *namespace* (which may reference a Role or a ClusterRole —
+        granting the latter only within that namespace)."""
         for binding in self.kube.list("rbac.authorization.k8s.io/v1",
                                       "ClusterRoleBinding"):
             if not any(self._subject_matches(s)
@@ -253,12 +264,45 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
             role = self.kube.get("rbac.authorization.k8s.io/v1",
                                  "ClusterRole", ref.get("name", ""))
-            if role is None:
-                continue
+            if role is not None:
+                yield role
+        if namespace:
+            for binding in self.kube.list(
+                    "rbac.authorization.k8s.io/v1", "RoleBinding",
+                    namespace=namespace):
+                if not any(self._subject_matches(s)
+                           for s in binding.get("subjects") or []):
+                    continue
+                ref = binding.get("roleRef") or {}
+                role = None
+                if ref.get("kind") == "Role":
+                    role = self.kube.get(
+                        "rbac.authorization.k8s.io/v1", "Role",
+                        ref.get("name", ""), namespace=namespace)
+                elif ref.get("kind") == "ClusterRole":
+                    role = self.kube.get(
+                        "rbac.authorization.k8s.io/v1", "ClusterRole",
+                        ref.get("name", ""))
+                if role is not None:
+                    yield role
+
+    def _authorized(self, verb: str, group: str, resource: str,
+                    subresource: str | None, name: str | None = None,
+                    namespace: str | None = None) -> bool:
+        """Role/ClusterRole rule evaluation for the authenticated
+        subject, including resourceNames scoping and namespaced
+        RoleBindings. The admin token (subject None) bypasses, matching
+        envtest's cluster-admin default; tokens registered in
+        token_subjects get real rule evaluation (VERDICT r2 #9: role.yaml
+        must be validated by something that can fail)."""
+        if self._subject is None or not self.server.owner.rbac_enabled:
+            return True
+        full_resource = (f"{resource}/{subresource}" if subresource
+                         else resource)
+        for role in self._roles_for_subject(namespace):
             for rule in role.get("rules") or []:
-                if (_in(rule.get("apiGroups"), group)
-                        and _in(rule.get("resources"), full_resource)
-                        and _in(rule.get("verbs"), verb)):
+                if self._rule_allows(rule, verb, group, full_resource,
+                                     name):
                     return True
         return False
 
@@ -272,12 +316,89 @@ class _Handler(BaseHTTPRequestHandler):
             return subject.get("namespace") == mine.get("namespace")
         return True
 
+    # -- authn/authz review APIs (TokenReview / SubjectAccessReview) ---------
+    @staticmethod
+    def _username_for(subject: dict) -> str:
+        if subject.get("kind") == "ServiceAccount":
+            return (f"system:serviceaccount:"
+                    f"{subject.get('namespace', '')}:"
+                    f"{subject.get('name', '')}")
+        return subject.get("name", "")
+
+    @staticmethod
+    def _subject_for_username(username: str) -> dict:
+        if username.startswith("system:serviceaccount:"):
+            _, _, rest = username.partition("system:serviceaccount:")
+            ns, _, name = rest.partition(":")
+            return {"kind": "ServiceAccount", "name": name,
+                    "namespace": ns}
+        return {"kind": "User", "name": username}
+
+    def _review(self, kind: str, obj: dict) -> dict:
+        """Serve authentication.k8s.io TokenReview and authorization.k8s.io
+        SubjectAccessReview — what the operator's metrics-auth filter
+        POSTs to authenticate and authorize scrapers (the reference's
+        WithAuthenticationAndAuthorization, cmd/main.go:66-70, backed by
+        exactly these two APIs). Caller RBAC already checked (create on
+        tokenreviews/subjectaccessreviews — metrics_auth_role.yaml)."""
+        spec = obj.get("spec") or {}
+        if kind == "TokenReview":
+            token = spec.get("token", "")
+            subject = None
+            if token == self.server.token:
+                subject = {"kind": "User", "name": "fixture-admin"}
+            else:
+                subject = self.server.owner.token_subjects.get(token)
+            status = {"authenticated": subject is not None}
+            if subject is not None:
+                status["user"] = {"username": self._username_for(subject),
+                                  "groups": ["system:authenticated"]}
+            return dict(obj, status=status)
+        # SubjectAccessReview: evaluate the SPEC'd user (not the caller)
+        username = spec.get("user", "")
+        subject = self._subject_for_username(username)
+        nra = spec.get("nonResourceAttributes") or {}
+        allowed = False
+        if username == "fixture-admin":
+            allowed = True
+        elif nra:
+            saved = self._subject
+            self._subject = subject
+            try:
+                for role in self._roles_for_subject(None):
+                    for rule in role.get("rules") or []:
+                        if (_in(rule.get("nonResourceURLs"),
+                                nra.get("path", ""))
+                                and _in(rule.get("verbs"),
+                                        nra.get("verb", ""))):
+                            allowed = True
+                            break
+                    if allowed:
+                        break
+            finally:
+                self._subject = saved
+        else:
+            ra = spec.get("resourceAttributes") or {}
+            saved = self._subject
+            self._subject = subject
+            try:
+                allowed = self._authorized(
+                    ra.get("verb", ""), ra.get("group", ""),
+                    ra.get("resource", ""), ra.get("subresource") or None,
+                    name=ra.get("name") or None,
+                    namespace=ra.get("namespace") or None)
+            finally:
+                self._subject = saved
+        return dict(obj, status={"allowed": allowed})
+
     def _check_rbac(self, verb: str, api_version: str, resource_kind: str,
-                    subresource: str | None) -> bool:
+                    subresource: str | None, name: str | None = None,
+                    namespace: str | None = None) -> bool:
         """Send 403 and return False when the subject lacks the verb."""
         group = api_version.rpartition("/")[0]
         resource = plural(resource_kind)
-        if self._authorized(verb, group, resource, subresource):
+        if self._authorized(verb, group, resource, subresource, name=name,
+                            namespace=namespace):
             return True
         mine = self._subject or {}
         self._send(403, _status(
@@ -430,7 +551,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         api_version, kind, namespace, name, subresource, query = parsed
         if not self._check_rbac("get" if name else "list", api_version,
-                                kind, subresource):
+                                kind, subresource, name=name,
+                                namespace=namespace):
             return
         if name:
             obj = self.kube.get(api_version, kind, name, namespace=namespace)
@@ -459,7 +581,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if not self._body_matches_url(obj, parsed[0], parsed[1]):
             return
-        if not self._check_rbac("create", parsed[0], parsed[1], None):
+        if not self._check_rbac("create", parsed[0], parsed[1], None,
+                                namespace=parsed[2]):
+            return
+        if parsed[1] in ("TokenReview", "SubjectAccessReview"):
+            self._send(201, self._review(parsed[1], obj))
             return
         try:
             obj = self._run_admission(obj, "CREATE")
@@ -478,10 +604,11 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = self._parse()
         if parsed is None:
             return
-        _, _, _, _, subresource, _ = parsed
+        _, _, p_namespace, p_name, subresource, _ = parsed
         if not self._body_matches_url(obj, parsed[0], parsed[1]):
             return
-        if not self._check_rbac("update", parsed[0], parsed[1], subresource):
+        if not self._check_rbac("update", parsed[0], parsed[1], subresource,
+                                name=p_name, namespace=p_namespace):
             return
         if subresource is None:
             try:
@@ -513,7 +640,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if not self._body_matches_url(obj, api_version, kind):
             return
-        if not self._check_rbac("patch", api_version, kind, None):
+        if not self._check_rbac("patch", api_version, kind, None,
+                                name=name, namespace=namespace):
             return
         # server-side apply is CREATE-or-UPDATE; webhooks fire on the apply
         # intent (our apply bodies are full manifests, so the admitted
@@ -542,7 +670,8 @@ class _Handler(BaseHTTPRequestHandler):
         if name is None:
             self._send(405, _status(405, "MethodNotAllowed", "collection"))
             return
-        if not self._check_rbac("delete", api_version, kind, None):
+        if not self._check_rbac("delete", api_version, kind, None,
+                                name=name, namespace=namespace):
             return
         existing = self.kube.get(api_version, kind, name,
                                  namespace=namespace)

@@ -101,16 +101,27 @@ def test_bundle_services_consistent_with_config():
 
 def test_csv_cluster_permissions_match_role_yaml():
     """The CSV's inline clusterPermissions must be byte-for-byte the rules
-    config/rbac/role.yaml grants (the rules tests/test_rbac.py enforces) —
-    an OLM install and a `make deploy` must agree."""
+    the SA's cluster-scoped bindings grant — manager role + metrics-auth
+    role (the rules tests/test_rbac.py and tests/test_metrics_auth.py
+    enforce) — and its namespaced permissions the leader-election Role.
+    An OLM install and a `make deploy` must agree."""
     csv = _load(os.path.join(
         BUNDLE, "manifests", "tpu-operator.clusterserviceversion.yaml"))
     role = _load(os.path.join(REPO, "config", "rbac", "role.yaml"))
+    metrics_auth = _load(os.path.join(REPO, "config", "rbac",
+                                      "metrics_auth_role.yaml"))
     perms = csv["spec"]["install"]["spec"]["clusterPermissions"]
     assert len(perms) == 1
     assert perms[0]["serviceAccountName"] == \
         "tpu-operator-controller-manager"
-    assert perms[0]["rules"] == role["rules"]
+    assert perms[0]["rules"] == role["rules"] + metrics_auth["rules"]
+    leader = _load(os.path.join(REPO, "config", "rbac",
+                                "leader_election_role.yaml"))
+    ns_perms = csv["spec"]["install"]["spec"]["permissions"]
+    assert len(ns_perms) == 1
+    assert ns_perms[0]["serviceAccountName"] == \
+        "tpu-operator-controller-manager"
+    assert ns_perms[0]["rules"] == leader["rules"]
 
 
 def test_csv_deployment_matches_manager_yaml():
