@@ -49,16 +49,24 @@ class NetConfCache:
         multiple networks/NADs has one entry per ifname, each possibly
         carrying a different ipam/network — full teardown must release
         all of them, not just the first (advisor round-2 finding)."""
+        return [data for _, data in self.load_all_with_ifnames(sandbox_id)]
+
+    def load_all_with_ifnames(self, sandbox_id: str) -> list:
+        """(ifname, entry) pairs — exec-delegated IPAM plugins key
+        leases by (containerID, ifname), so full-sandbox teardown must
+        DEL each interface by name, not once with an empty ifname."""
         out = []
+        prefix = f"{sandbox_id}-"
         try:
             entries = sorted(os.listdir(self.cache_dir))
         except OSError:
             return out
         for fn in entries:
-            if fn.startswith(f"{sandbox_id}-") and fn.endswith(".json"):
+            if fn.startswith(prefix) and fn.endswith(".json"):
+                ifname = fn[len(prefix):-len(".json")]
                 try:
                     with open(os.path.join(self.cache_dir, fn)) as f:
-                        out.append(json.load(f))
+                        out.append((ifname, json.load(f)))
                 except (OSError, json.JSONDecodeError):
                     continue
         return out

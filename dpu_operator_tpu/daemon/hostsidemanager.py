@@ -224,7 +224,8 @@ class HostSideManager:
         # optional — chip attachments may be compute-only)
         try:
             ips = ipam_add(req.netconf.ipam, self.ipam_dir,
-                           req.netconf.name, req.sandbox_id, req.ifname)
+                           req.netconf.name, req.sandbox_id, req.ifname,
+                           netns=req.netns)
         except Exception:
             try:
                 self.delete_slice_attachment(host=0, chip=chip)
@@ -272,7 +273,7 @@ class HostSideManager:
         ipam_cfg = (cached.get("netconf") or {}).get("ipam") or {}
         ipam_del(ipam_cfg, self.ipam_dir,
                  (cached.get("netconf") or {}).get("name", ""),
-                 req.sandbox_id, req.ifname)
+                 req.sandbox_id, req.ifname, netns=req.netns)
         self.allocator.release(cached["deviceID"], req.sandbox_id)
         self.cache.delete(req.sandbox_id, req.ifname)
         return {}

@@ -391,7 +391,7 @@ class TpuSideManager:
         ipam_cfg = req.netconf.ipam or {}
         network = req.netconf.name or ""
         ips = ipam_add(ipam_cfg, self.ipam_dir, network,
-                       req.sandbox_id, req.ifname)
+                       req.sandbox_id, req.ifname, netns=req.netns)
         # always cache: the device id must survive daemon restarts so a
         # later DEL can release the chip's slice attachment (the VSP and
         # its attachment table live in a separate long-lived process)
@@ -1411,7 +1411,7 @@ class TpuSideManager:
             cached = self.nf_cache.load(req.sandbox_id, req.ifname) or {}
             ipam_del(cached.get("ipam") or req.netconf.ipam, self.ipam_dir,
                      cached.get("network") or req.netconf.name,
-                     req.sandbox_id, req.ifname)
+                     req.sandbox_id, req.ifname, netns=req.netns)
             self.nf_cache.delete(req.sandbox_id, req.ifname)
             att = self._slice_attachment_for(req.device_id)
             if att:
@@ -1422,19 +1422,20 @@ class TpuSideManager:
             # ipam + network) — release every (ipam, network) before the
             # cache entries are destroyed, else the other networks'
             # host-local allocations leak permanently.
-            cached_all = self.nf_cache.load_all(req.sandbox_id)
-            released = set()
-            for cached in cached_all:
-                key = (json.dumps(cached.get("ipam"), sort_keys=True),
-                       cached.get("network"))
-                if key in released:
-                    continue
-                released.add(key)
+            cached_pairs = self.nf_cache.load_all_with_ifnames(
+                req.sandbox_id)
+            cached_all = [c for _, c in cached_pairs]
+            # per-IFNAME release: exec-delegated IPAM plugins key leases
+            # by (containerID, ifname), so one empty-ifname DEL per
+            # (ipam, network) would leak every lease the sandbox held
+            # (host-local releases by exact owner either way)
+            for ifname, cached in cached_pairs:
                 ipam_del(cached.get("ipam"), self.ipam_dir,
-                         cached.get("network"), req.sandbox_id, None)
+                         cached.get("network"), req.sandbox_id, ifname,
+                         netns=req.netns)
             if not cached_all:
                 ipam_del(req.netconf.ipam, self.ipam_dir, req.netconf.name,
-                         req.sandbox_id, None)
+                         req.sandbox_id, None, netns=req.netns)
             self.nf_cache.delete_sandbox(req.sandbox_id)
             # full teardown releases EVERY chip attachment the sandbox's
             # ADDs created — devices from the restart-surviving cache,
