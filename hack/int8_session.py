@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""One int8-vs-bf16 decode measurement session (VERDICT r4 #7).
+
+The published int8 serving speedup must be the conservative figure
+across >= 3 SPACED sessions, not the best single-session number (the
+tunnel's contention phases inflated the +51% headline; same-session
+re-runs read +28%..+37%). Run this several times across a day and feed
+the per-session JSON lines to the BASELINE.md update.
+
+Usage: python hack/int8_session.py [--steps 256] [--best-of 3]
+Prints one JSON line: {ts, device, b1_bf16, b1_int8, b1_speedup,
+b8_bf16, b8_int8, b8_speedup, hbm_frac_*}.
+"""
+
+import argparse
+import json
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--best-of", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    from dpu_operator_tpu.workloads import perf
+    from dpu_operator_tpu.workloads.decode import measure_decode
+
+    dev = jax.devices()[0]
+    cfg = perf.flagship_config()
+    out = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "device": getattr(dev, "device_kind", str(dev)),
+           "steps": args.steps, "best_of": args.best_of}
+    for batch in (1, 8):
+        kw = dict(batch=batch, steps=args.steps, iters=args.iters,
+                  best_of=args.best_of)
+        bf16 = measure_decode(cfg, **kw)
+        q = measure_decode(cfg, quantized=True, **kw)
+        out[f"b{batch}_bf16_tok_s"] = round(bf16["tokens_per_s"], 1)
+        out[f"b{batch}_int8_tok_s"] = round(q["tokens_per_s"], 1)
+        out[f"b{batch}_speedup"] = round(
+            q["tokens_per_s"] / bf16["tokens_per_s"], 3)
+        out[f"b{batch}_bf16_hbm_frac"] = round(bf16["hbm_frac"], 3)
+        out[f"b{batch}_int8_hbm_frac"] = round(q["hbm_frac"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
