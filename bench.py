@@ -52,6 +52,29 @@ _TRANSIENT_MARKERS = (
 )
 _TRANSIENT_TYPES = ("JaxRuntimeError", "XlaRuntimeError")
 
+def _deadline_from_env() -> float:
+    """Soft wall-clock budget for the WHOLE bench (seconds): once
+    exceeded, pending sections are skipped (recorded in "errors") and
+    the JSON line prints with whatever landed — retries must never push
+    the run past the driver's window. 0 disables. A malformed value
+    falls back to the default: an env typo must not crash the bench
+    before the always-print-JSON guard is even reached."""
+    raw = os.environ.get("TPU_BENCH_DEADLINE_S", "2700")
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"ignoring malformed TPU_BENCH_DEADLINE_S={raw!r}; "
+              "using 2700", file=sys.stderr)
+        return 2700.0
+
+
+DEADLINE_S = _deadline_from_env()
+_START = time.monotonic()
+
+
+def past_deadline() -> bool:
+    return DEADLINE_S > 0 and (time.monotonic() - _START) > DEADLINE_S
+
 
 def is_transient(exc: BaseException) -> bool:
     """True when *exc* looks like a tunnel/transport drop (retryable with
@@ -101,6 +124,10 @@ def measured(fn, frac_of, name, cap, attempts=4, backoff_s=5.0, sleep=time.sleep
     """
     last_frac, last_exc = None, None
     for attempt in range(attempts):
+        if attempt and past_deadline():
+            print(f"{name}: bench deadline reached; abandoning retries",
+                  file=sys.stderr)
+            break
         try:
             result = fn()
         except Exception as e:  # noqa: BLE001 — anything from the tunnel
@@ -353,6 +380,10 @@ def run_sections(sections):
     sections still run. Returns (results, errors)."""
     results, errors = {}, {}
     for name, thunk in sections:
+        if past_deadline():
+            errors[name] = "skipped: bench deadline reached"
+            print(f"section {name} skipped: deadline", file=sys.stderr)
+            continue
         try:
             results[name] = thunk()
         except Exception as e:  # noqa: BLE001 — record and continue
@@ -449,6 +480,11 @@ def main():
     # first dial must not lose all four compute sections
     compute_sections = []
     for attempt in range(3):
+        if attempt and past_deadline():
+            errors.setdefault(
+                "compute_setup",
+                "skipped retries: bench deadline reached")
+            break
         try:
             bench = ComputeBench()
         except Exception as e:  # noqa: BLE001 — device init failed

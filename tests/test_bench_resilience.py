@@ -126,6 +126,30 @@ class TestRunSections:
         assert results == {"a": 1, "c": 3}
         assert "b" in errors and "tunnel died" in errors["b"]
 
+    def test_deadline_skips_pending_sections(self, monkeypatch):
+        """Once past the soft deadline, pending sections are skipped and
+        recorded — the run must always finish inside the driver window
+        with a JSON line."""
+        monkeypatch.setattr(bench, "past_deadline", lambda: True)
+        results, errors = bench.run_sections([("a", lambda: 1)])
+        assert results == {}
+        assert "deadline" in errors["a"]
+
+    def test_deadline_abandons_retries_in_measured(self, monkeypatch):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise FakeJaxRuntimeError("INTERNAL: down")
+
+        monkeypatch.setattr(bench, "reset_backend", lambda: None)
+        # first attempt runs; the deadline check stops every retry
+        monkeypatch.setattr(bench, "past_deadline", lambda: True)
+        with pytest.raises(FakeJaxRuntimeError):
+            bench.measured(fn, lambda x: x, "mfu", cap=1.0,
+                           sleep=_nosleep)
+        assert calls["n"] == 1
+
 
 def _train(mfu=0.71):
     return types.SimpleNamespace(
