@@ -112,12 +112,40 @@ def _logits(x, embed):
     return acc.astype(jnp.float32) * xs * embed["scale"][:, 0]
 
 
-def init_kv_cache(cfg: TransformerConfig, batch: int) -> list:
-    """Per-layer K/V of (B, S_max, H, Dh), bf16."""
+def init_kv_cache(cfg: TransformerConfig, batch: int,
+                  kv_int8: bool = False) -> list:
+    """Per-layer K/V of (B, S_max, H, Dh): bf16, or int8 + per-(token,
+    head) f32 scales (KV8). Decode streams the whole cache every step,
+    so at B8 the KV bytes dominate even the int8 weight bytes — KV8
+    halves them. The dequant multiplies ride the attention einsums
+    (int8->bf16 convert fuses into the HBM read; scales apply to the
+    (B,H,q,S) score/weight tensors), so no bf16 copy of the cache is
+    ever materialized."""
     shape = (batch, cfg.max_seq, cfg.n_heads, cfg.d_head)
+    if kv_int8:
+        sshape = (batch, cfg.max_seq, cfg.n_heads, 1)
+        return [{"k_q": jnp.zeros(shape, jnp.int8),
+                 "k_s": jnp.zeros(sshape, jnp.float32),
+                 "v_q": jnp.zeros(shape, jnp.int8),
+                 "v_s": jnp.zeros(sshape, jnp.float32)}
+                for _ in range(cfg.n_layers)]
     return [{"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
+
+
+def _kv_quant(t):
+    """Symmetric int8 over the head dim: t (B, T, H, Dh) -> (q, scale)
+    with scale (B, T, H, 1). Same numerics as the activation quant —
+    one implementation so a rounding/floor tweak can never diverge the
+    two paths."""
+    return _act_quant(t)
+
+
+def _scale_bhqk(s):
+    """(B, S, H, 1) per-position scales -> (B, H, 1, S) to broadcast
+    over attention scores/weights."""
+    return s[..., 0].transpose(0, 2, 1)[:, :, None, :]
 
 
 def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
@@ -140,17 +168,48 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
             return t.reshape(B, 1, cfg.n_heads, cfg.d_head)
 
         q, k, v = heads(q), heads(k), heads(v)
-        ck = jax.lax.dynamic_update_slice(
-            layer_cache["k"], k, (0, pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(
-            layer_cache["v"], v, (0, pos, 0, 0))
-        new_cache.append({"k": ck, "v": cv})
+        if "k_q" in layer_cache:  # KV8: int8 cache, fused dequant
+            kq, ks = _kv_quant(k)
+            vq, vs = _kv_quant(v)
+            ck = jax.lax.dynamic_update_slice(
+                layer_cache["k_q"], kq, (0, pos, 0, 0))
+            cks = jax.lax.dynamic_update_slice(
+                layer_cache["k_s"], ks, (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                layer_cache["v_q"], vq, (0, pos, 0, 0))
+            cvs = jax.lax.dynamic_update_slice(
+                layer_cache["v_s"], vs, (0, pos, 0, 0))
+            new_cache.append({"k_q": ck, "k_s": cks,
+                              "v_q": cv, "v_s": cvs})
+            # q . k_q on the MXU (convert fused into the cache read);
+            # the per-position k scale applies to the (B,H,1,S) scores
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, ck.astype(cfg.dtype))
+            att = (att.astype(jnp.float32) * _scale_bhqk(cks)
+                   / np.sqrt(cfg.d_head))
+            att = jnp.where(positions[None, None, None, :] <= pos,
+                            att, -1e9)
+            att = jax.nn.softmax(att, -1)
+            # fold the v scales into the attention weights, then one
+            # int8-read einsum
+            att_v = (att * _scale_bhqk(cvs)).astype(cfg.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att_v,
+                           cv.astype(cfg.dtype)).reshape(
+                B, 1, cfg.d_model)
+        else:
+            ck = jax.lax.dynamic_update_slice(
+                layer_cache["k"], k, (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                layer_cache["v"], v, (0, pos, 0, 0))
+            new_cache.append({"k": ck, "v": cv})
 
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / np.sqrt(cfg.d_head)
-        att = jnp.where(positions[None, None, None, :] <= pos, att, -1e9)
-        att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(cfg.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", att, cv).reshape(
-            B, 1, cfg.d_model)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / np.sqrt(
+                cfg.d_head)
+            att = jnp.where(positions[None, None, None, :] <= pos,
+                            att, -1e9)
+            att = jax.nn.softmax(att.astype(jnp.float32),
+                                 -1).astype(cfg.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att, cv).reshape(
+                B, 1, cfg.d_model)
         x = x + _mm(o, lp["wo"])
         h2 = _rmsnorm(x, lp["ln2"])
         if "moe" in lp:
@@ -164,15 +223,18 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
     return logits, new_cache
 
 
-def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array):
+def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,
+            kv_int8: bool = False):
     """Warm the cache with ONE batched forward over the whole prompt
     (time-to-first-token costs a single parameter sweep, not P sequential
-    decode steps); returns (cache, last_logits). prompt: (B, P) int32."""
+    decode steps); returns (cache, last_logits). prompt: (B, P) int32.
+    With *kv_int8* the cache is stored quantized (the prefill attention
+    itself uses the still-in-register bf16 K/V)."""
     B, P = prompt.shape
     x = (_embed_rows(params["embed"], prompt)
          + params["pos"][:P]).astype(cfg.dtype)
     mask = jnp.tril(jnp.ones((P, P), jnp.bool_))
-    cache = init_kv_cache(cfg, B)
+    cache = init_kv_cache(cfg, B, kv_int8=kv_int8)
     new_cache = []
     for lp, layer_cache in zip(params["layers"], cache):
         h = _rmsnorm(x, lp["ln1"])
@@ -183,12 +245,26 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array):
             return t.reshape(B, P, cfg.n_heads, cfg.d_head)
 
         q, k, v = heads(q), heads(k), heads(v)
-        new_cache.append({
-            "k": jax.lax.dynamic_update_slice(layer_cache["k"], k,
-                                              (0, 0, 0, 0)),
-            "v": jax.lax.dynamic_update_slice(layer_cache["v"], v,
-                                              (0, 0, 0, 0)),
-        })
+        if kv_int8:
+            kq, ks = _kv_quant(k)
+            vq, vs = _kv_quant(v)
+            new_cache.append({
+                "k_q": jax.lax.dynamic_update_slice(
+                    layer_cache["k_q"], kq, (0, 0, 0, 0)),
+                "k_s": jax.lax.dynamic_update_slice(
+                    layer_cache["k_s"], ks, (0, 0, 0, 0)),
+                "v_q": jax.lax.dynamic_update_slice(
+                    layer_cache["v_q"], vq, (0, 0, 0, 0)),
+                "v_s": jax.lax.dynamic_update_slice(
+                    layer_cache["v_s"], vs, (0, 0, 0, 0)),
+            })
+        else:
+            new_cache.append({
+                "k": jax.lax.dynamic_update_slice(layer_cache["k"], k,
+                                                  (0, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(layer_cache["v"], v,
+                                                  (0, 0, 0, 0)),
+            })
         att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(cfg.d_head)
         att = jnp.where(mask, att, -1e9)
         att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(cfg.dtype)
@@ -206,13 +282,14 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array):
     return new_cache, last_logits
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "top_k", "greedy"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "top_k", "greedy",
+                                   "kv_int8"))
 def _generate_compiled(params: dict, cfg: TransformerConfig,
                        prompt: jax.Array, steps: int, temperature,
                        top_k: int, greedy: bool,
-                       key: jax.Array) -> jax.Array:
+                       key: jax.Array, kv_int8: bool = False) -> jax.Array:
     P = prompt.shape[1]
-    cache, last_logits = prefill(params, cfg, prompt)
+    cache, last_logits = prefill(params, cfg, prompt, kv_int8=kv_int8)
 
     def pick(logits, k):
         if greedy:
@@ -240,11 +317,14 @@ def _generate_compiled(params: dict, cfg: TransformerConfig,
 
 def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
              steps: int, temperature: float = 0.0, top_k: int = 0,
-             key: jax.Array | None = None) -> jax.Array:
+             key: jax.Array | None = None,
+             kv_int8: bool = False) -> jax.Array:
     """Autoregressive continuation: (B, P) prompt -> (B, steps) ids, one
     compiled program (prefill + decode scan). temperature=0 is greedy;
     otherwise categorical sampling from logits/temperature, optionally
-    truncated to the top_k logits (*key* required when sampling)."""
+    truncated to the top_k logits (*key* required when sampling).
+    *kv_int8* stores the KV cache quantized (halved cache bytes — the
+    dominant HBM traffic at batch >= 8)."""
     B, P = prompt.shape
     if P + steps > cfg.max_seq:
         raise ValueError(
@@ -256,13 +336,14 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
         key = jax.random.key(0)  # unused on the greedy path
     return _generate_compiled(params, cfg, prompt, steps,
                               jnp.float32(max(temperature, 1e-6)), top_k,
-                              greedy, key)
+                              greedy, key, kv_int8=kv_int8)
 
 
 def measure_decode(cfg: TransformerConfig, batch: int = 8,
                    prompt_len: int = 16, steps: int = 64,
                    iters: int = 4, best_of: int = 3,
-                   quantized: bool = False) -> dict:
+                   quantized: bool = False,
+                   kv_int8: bool = False) -> dict:
     """Serving throughput: steady-state decode tokens/s (marginal over two
     generation lengths so prefill + dispatch costs cancel — the same
     slope methodology as perf.marginal_time; best-of for the tunnel's
@@ -281,7 +362,7 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
 
     def make_chained(n):
         def go():
-            out = generate(params, cfg, prompt, n)
+            out = generate(params, cfg, prompt, n, kv_int8=kv_int8)
             float(out[0, -1])
         return go
 
@@ -297,9 +378,14 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
     # leaves — norms, pos, MoE experts — at their real width)
     weight_bytes = float(sum(leaf.nbytes
                              for leaf in jax.tree_util.tree_leaves(params)))
-    kv_bytes = 2.0 * cfg.n_layers * cfg.max_seq * cfg.d_model * 2.0 * batch
+    # per-element KV width: bf16 = 2 bytes; KV8 = 1 byte + the per-
+    # (token, head) f32 scale amortized over d_head elements
+    kv_width = (1.0 + 4.0 / cfg.d_head) if kv_int8 else 2.0
+    kv_bytes = (2.0 * cfg.n_layers * cfg.max_seq * cfg.d_model
+                * kv_width * batch)
     min_s = (weight_bytes + kv_bytes) / hbm_bandwidth_gbps() / 1e9
     return {"batch": batch, "steps": steps,
             "ms_per_token": per_step * 1e3,
             "tokens_per_s": batch / per_step,
+            "roofline_ms_per_token": min_s * 1e3,
             "hbm_frac": min_s / per_step}

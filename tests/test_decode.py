@@ -204,3 +204,88 @@ def test_quantized_weights_are_int8():
     assert lp["wqkv"]["scale"].shape == (1, 3 * cfg.d_model)
     # norms stay high-precision
     assert lp["ln1"].dtype == cfg.dtype
+
+
+def test_kv_int8_decode_matches_bf16_cache_closely():
+    """KV8: the int8 KV cache (per-token/head scales, dequant fused into
+    the attention einsums) keeps the decode trajectory close to the
+    bf16-cache path — same weights, only the cache representation
+    differs, so agreement should be HIGH, not just correlated."""
+    import numpy as np
+
+    from dpu_operator_tpu.workloads.decode import generate
+    from dpu_operator_tpu.workloads.model import (TransformerConfig,
+                                                  init_params)
+
+    cfg = TransformerConfig(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                            d_ff=128, max_seq=64)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    toks = np.asarray(generate(params, cfg, prompt, steps=16))
+    ktoks = np.asarray(generate(params, cfg, prompt, steps=16,
+                                kv_int8=True))
+    agree = (toks == ktoks).mean()
+    assert agree > 0.8, agree
+
+
+def test_kv_int8_composes_with_w8a8():
+    """int8 weights + int8 KV together (the full serving quant stack)
+    still track the bf16 reference."""
+    import numpy as np
+
+    from dpu_operator_tpu.workloads.decode import (generate,
+                                                   quantize_decode_params)
+    from dpu_operator_tpu.workloads.model import (TransformerConfig,
+                                                  init_params)
+
+    cfg = TransformerConfig(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                            d_ff=128, max_seq=64)
+    params = init_params(jax.random.key(0), cfg)
+    qparams = quantize_decode_params(params)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    toks = np.asarray(generate(params, cfg, prompt, steps=12))
+    qtoks = np.asarray(generate(qparams, cfg, prompt, steps=12,
+                                kv_int8=True))
+    agree = (toks == qtoks).mean()
+    assert agree > 0.5, agree
+
+
+def test_kv_int8_cache_shapes_and_dtypes():
+    from dpu_operator_tpu.workloads.decode import init_kv_cache, prefill
+    from dpu_operator_tpu.workloads.model import (TransformerConfig,
+                                                  init_params)
+
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, max_seq=32)
+    cache = init_kv_cache(cfg, batch=2, kv_int8=True)
+    assert cache[0]["k_q"].dtype == jnp.int8
+    assert cache[0]["k_s"].shape == (2, 32, 2, 1)
+    # prefill stores quantized rows for the prompt span
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.ones((2, 5), jnp.int32)
+    qcache, _ = prefill(params, cfg, prompt, kv_int8=True)
+    import numpy as np
+    assert np.abs(np.asarray(qcache[0]["k_q"][:, :5])).max() > 0
+    assert np.asarray(qcache[0]["k_s"][:, :5]).min() > 0
+
+
+def test_measure_decode_kv_int8_byte_model():
+    """The roofline byte model must charge KV8 at ~1 byte/elem (+ scale
+    amortization), not bf16's 2."""
+    from dpu_operator_tpu.workloads.decode import measure_decode
+    from dpu_operator_tpu.workloads.model import TransformerConfig
+
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, max_seq=32)
+    r16 = measure_decode(cfg, batch=1, steps=8, iters=1, best_of=1)
+    r8 = measure_decode(cfg, batch=1, steps=8, iters=1, best_of=1,
+                        kv_int8=True)
+    # same weights; only the kv bytes differ — the model's roofline must
+    # shrink by exactly the kv-width delta
+    kv16 = 2.0 * cfg.n_layers * cfg.max_seq * cfg.d_model * 2.0
+    kv8 = (2.0 * cfg.n_layers * cfg.max_seq * cfg.d_model
+           * (1.0 + 4.0 / cfg.d_head))
+    from dpu_operator_tpu.workloads.perf import hbm_bandwidth_gbps
+    delta_ms = (kv16 - kv8) / hbm_bandwidth_gbps() / 1e9 * 1e3
+    got = r16["roofline_ms_per_token"] - r8["roofline_ms_per_token"]
+    assert got == pytest.approx(delta_ms, rel=1e-6)
