@@ -57,6 +57,15 @@ const char kAxes[3] = {'x', 'y', 'z'};
 }  // namespace
 
 bool ChipDb::Init(const std::string& topology, std::string* error) {
+  // Same-topology re-Init is IDEMPOTENT: a restarting daemon re-runs
+  // VSP Init -> init_dataplane -> here while pods still hold live
+  // attachments and wired NF hops. Clearing would silently erase the
+  // dataplane state the crash-safe state file exists to preserve (and
+  // the daemon's journal recovery reconciles against). Only a genuine
+  // slice RESHAPE (different topology string) resets the db.
+  if (initialized() && topology == topology_) {
+    return true;
+  }
   // format: <gen>-<chips>
   auto dash = topology.rfind('-');
   if (dash == std::string::npos) {

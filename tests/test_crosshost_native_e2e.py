@@ -1,0 +1,188 @@
+"""Cross-host SFC chains over REAL native agents, end to end.
+
+The deepest tier of the round-5 cross-host story: two hosts, each with
+its own C++ tpu_cp_agent (crash-safe state file), GoogleTpuVsp over the
+native dataplane, and a full TpuSideManager on real sockets — sharing
+one FakeKube. Proves on the actual dataplane what
+tests/test_sfc_crosshost.py proves against mocks:
+
+- a hop between NFs on different hosts lands in BOTH agents' wire
+  tables (the egress half on the upstream host, the ingress half on the
+  peer);
+- link-fault repair re-steers the hop and MIRRORS the re-steer into the
+  peer agent;
+- a daemon restart re-runs VSP Init (now idempotent in the agent — a
+  clearing re-Init used to erase live wiring) and the journal recovery
+  reconciles against the agent's preserved wire table, so teardown of
+  pre-restart hops still unwires both dataplanes.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from dpu_operator_tpu.daemon import TpuSideManager
+from dpu_operator_tpu.k8s import FakeKube
+from dpu_operator_tpu.platform import FakePlatform
+from dpu_operator_tpu.platform.vendordetector import TpuDetector
+from dpu_operator_tpu.utils.path_manager import PathManager
+from dpu_operator_tpu.vsp.google import GoogleTpuVsp
+from dpu_operator_tpu.vsp.native_dp import (AgentClient, AgentProcess,
+                                            NativeIciDataplane)
+from dpu_operator_tpu.vsp.plugin import GrpcPlugin
+from dpu_operator_tpu.vsp.rpc import VspServer
+
+from test_sfc_crosshost import _Req, _nf_pod, _sfc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def agent_binary():
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                   check=True, capture_output=True)
+    return os.path.join(REPO, "native", "build", "tpu_cp_agent")
+
+
+class _Host:
+    """One host: native agent + GoogleTpuVsp(native dataplane) + full
+    TpuSideManager with a kube client and node identity."""
+
+    def __init__(self, root: str, name: str, agent_binary: str, kube):
+        self.name = name
+        self.kube = kube
+        self.dir = os.path.join(root, name)
+        os.makedirs(self.dir)
+        self.pm = PathManager(self.dir)
+        self.agent = AgentProcess(agent_binary, self.dir + "/cp.sock",
+                                  state_file=self.dir + "/cp.state",
+                                  dev_dir=self.dir, allow_regular_dev=True)
+        self.agent.start()
+        accel = []
+        for i in range(4):
+            path = f"{self.dir}/accel{i}"
+            open(path, "w").close()
+            accel.append(path)
+        self.agent_client = AgentClient(self.agent.socket_path)
+        self.vsp = GoogleTpuVsp(
+            FakePlatform(accelerator_type="v5litepod-4", accel=accel),
+            dataplane=NativeIciDataplane(self.agent_client), comm_port=0)
+        sock = self.pm.vendor_plugin_socket()
+        self.pm.ensure_socket_dir(sock)
+        self.vsp_server = VspServer(self.vsp, socket_path=sock)
+        self.vsp_server.start()
+        self.mgr = None
+        self._start_manager()
+
+    def _start_manager(self):
+        det = TpuDetector().detection_result(tpu_mode=True,
+                                             identifier=self.name)
+        self.mgr = TpuSideManager(
+            GrpcPlugin(det, path_manager=self.pm, init_timeout=5.0),
+            self.pm, client=self.kube, node_name=self.name)
+        self.mgr.start_vsp()
+        self.mgr.setup_devices()
+        self.mgr.listen()
+        self.mgr._advertise_address()
+
+    def restart_manager(self):
+        """The daemon process restarting: everything in-memory is lost;
+        the VSP (separate pod) and its agent keep running."""
+        self.mgr.stop()
+        self._start_manager()
+
+    def wires(self):
+        return self.agent_client.list_wires()
+
+    def stop(self):
+        self.mgr.stop()
+        self.vsp_server.stop()
+        self.agent_client.close()
+        self.agent.stop()
+
+
+@pytest.fixture
+def two_hosts(short_tmp, agent_binary):
+    kube = FakeKube()
+    for node in ("host-a", "host-b"):
+        kube.create({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": node}})
+    a = _Host(short_tmp, "host-a", agent_binary, kube)
+    b = _Host(short_tmp, "host-b", agent_binary, kube)
+    yield kube, a, b
+    b.stop()
+    a.stop()
+
+
+def _wire_nf(mgr, sandbox, pod, chips, ports):
+    mgr._cni_nf_add(_Req(sandbox, chips[0], "net1", pod,
+                         ici_ports=ports))
+    mgr._cni_nf_add(_Req(sandbox, chips[1], "net2", pod,
+                         ici_ports=ports))
+
+
+def test_cross_host_hop_in_both_agent_wire_tables(two_hosts):
+    kube, a, b = two_hosts
+    _sfc(kube, "nx", ["f0", "f1"])
+    _nf_pod(kube, "nx-f0", "nx", 0, "host-a")
+    _nf_pod(kube, "nx-f1", "nx", 1, "host-b")
+    _wire_nf(a.mgr, "sbxNA0000000", "nx-f0", ["chip-0", "chip-1"],
+             ["ici-0-x+", "ici-1-x+"])
+    _wire_nf(b.mgr, "sbxNB0000000", "nx-f1", ["chip-2", "chip-3"],
+             ["ici-2-x+", "ici-3-x+"])
+    a.mgr.sync_cross_host_hops("default", "nx")
+    hop = ("ici-1-x+", "ici-2-x+")
+    assert hop in a.wires()  # egress half programmed in A's dataplane
+    assert hop in b.wires()  # ingress half programmed in B's dataplane
+
+
+def test_link_fault_repair_mirrors_into_peer_agent(two_hosts):
+    kube, a, b = two_hosts
+    _sfc(kube, "nr", ["f0", "f1"])
+    _nf_pod(kube, "nr-f0", "nr", 0, "host-a")
+    _nf_pod(kube, "nr-f1", "nr", 1, "host-b")
+    _wire_nf(a.mgr, "sbxNA1111111", "nr-f0", ["chip-0", "chip-1"],
+             ["ici-0-x+", "ici-1-x+"])
+    _wire_nf(b.mgr, "sbxNB1111111", "nr-f1", ["chip-2", "chip-3"],
+             ["ici-2-x+", "ici-3-x+"])
+    a.mgr.sync_cross_host_hops("default", "nr")
+    old = ("ici-1-x+", "ici-2-x+")
+    assert old in a.wires() and old in b.wires()
+    # the allocated egress port's physical link goes dark on host A
+    a.agent_client.set_link(1, "x+", up=False)
+    a.mgr.link_prober = a.agent_client.link_state
+    repaired = a.mgr.repair_chains()
+    assert [k for k, _, _ in repaired] == [("default", "nr", 0)]
+    steered = ("nf-sbxNA1111111-chip-1", "ici-2-x+")
+    # BOTH dataplanes now steer the repaired pair; the dead pair is gone
+    assert steered in a.wires() and old not in a.wires()
+    assert steered in b.wires() and old not in b.wires()
+
+
+def test_daemon_restart_recovers_against_agent_ground_truth(two_hosts):
+    kube, a, b = two_hosts
+    _sfc(kube, "ns", ["f0", "f1"])
+    _nf_pod(kube, "ns-f0", "ns", 0, "host-a")
+    _nf_pod(kube, "ns-f1", "ns", 1, "host-b")
+    _wire_nf(a.mgr, "sbxNA2222222", "ns-f0", ["chip-0", "chip-1"],
+             ["ici-0-x+", "ici-1-x+"])
+    _wire_nf(b.mgr, "sbxNB2222222", "ns-f1", ["chip-2", "chip-3"],
+             ["ici-2-x+", "ici-3-x+"])
+    a.mgr.sync_cross_host_hops("default", "ns")
+    hop = ("ici-1-x+", "ici-2-x+")
+    assert hop in a.wires()
+
+    a.restart_manager()
+    # re-Init did NOT wipe the agent (idempotent same-topology init) and
+    # recovery restored the hop from journal ∩ agent wire table
+    assert hop in a.wires()
+    hop_key = ("default", "ns", 0)
+    assert a.mgr._chain_hops[hop_key] == hop
+    assert a.mgr._remote_hops[hop_key]  # remote marker survived too
+
+    # teardown of the pre-restart sandbox unwires BOTH dataplanes
+    a.mgr._cni_nf_del(_Req("sbxNA2222222", None, "net1", "ns-f0"))
+    assert hop not in a.wires()
+    assert hop not in b.wires()
+    assert hop_key not in a.mgr._chain_hops

@@ -238,3 +238,23 @@ def test_link_fault_survives_restart(agent_binary, short_tmp):
     assert states["x+"]["up"] is False
     client2.close()
     proc2.stop()
+
+
+def test_reinit_same_topology_preserves_state(agent, short_tmp):
+    """A restarting daemon re-runs VSP Init -> agent Init while pods
+    still hold live wiring: same-topology re-Init must be idempotent,
+    NOT clear the db — erased wires would orphan every running NF and
+    hollow out the daemon's journal-vs-dataplane recovery."""
+    _, client = agent
+    client.init("v5e-8")
+    client.attach(0, ["x+"])
+    client.wire_nf("ici-0-x+", "ici-1-x-")
+    info = client.init("v5e-8")  # the daemon came back
+    assert info["num_chips"] == 8
+    assert ("ici-0-x+", "ici-1-x-") in client.list_wires()
+    chips = client.enumerate()
+    assert chips[0]["attached"] is True
+    # a genuine reshape still resets
+    client.init("v5e-4")
+    assert client.list_wires() == []
+    assert client.enumerate()[0]["attached"] is False
