@@ -66,14 +66,15 @@ def main(argv=None):
         client.acquire_leader_lease("tpu-operator-leader",
                                     namespace=NAMESPACE)
 
+    # handlers before the manager goes active (a SIGTERM in the gap
+    # would bypass the orderly stop below)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
     mgr.start()
     started.set()
     log.info("operator running (metrics :%d, webhook :%d)",
              metrics_server.port, webhook.port)
-
-    done = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: done.set())
-    signal.signal(signal.SIGINT, lambda *_: done.set())
     done.wait()
     mgr.stop()
     webhook.stop()

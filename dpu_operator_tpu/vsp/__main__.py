@@ -57,11 +57,14 @@ def main(argv=None):
     impl = MockTpuVsp() if args.mock else GoogleTpuVsp(
         HardwarePlatform(args.root), dataplane=dataplane)
     server = VspServer(impl, sock)
-    server.start()
-    logging.info("VSP serving on %s", sock)
+    # handlers BEFORE the server goes live: a SIGTERM in the gap would
+    # kill the process with the default handler, skipping the orderly
+    # server/agent teardown below
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
+    server.start()
+    logging.info("VSP serving on %s", sock)
     stop.wait()
     server.stop()
     if agent_proc:

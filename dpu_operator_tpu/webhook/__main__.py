@@ -24,10 +24,13 @@ def main(argv=None):
     client = RealKube(args.kubeconfig or None)
     server = WebhookServer(client, host=args.bind, port=args.port,
                            certfile=args.tls_cert, keyfile=args.tls_key)
-    server.start()
+    # handlers BEFORE the server goes live: a SIGTERM landing between
+    # start() and signal() would hit the default handler and kill the
+    # process mid-request instead of draining
     done = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: done.set())
     signal.signal(signal.SIGINT, lambda *_: done.set())
+    server.start()
     done.wait()
     server.stop()
 
