@@ -41,6 +41,14 @@ def main(argv=None):
     mgr.add_reconciler(TpuOperatorConfigReconciler(EnvImageManager()))
     mgr.add_reconciler(ServiceFunctionChainClusterReconciler())
 
+    # handlers FIRST — before any server, lease, or manager goes live:
+    # a SIGTERM in any later gap would hit the default handler, skipping
+    # the orderly stops below (and stranding a just-acquired leader
+    # lease until expiry)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+
     started = threading.Event()
     # /metrics is authenticated+authorized via TokenReview/
     # SubjectAccessReview (reference: cmd/main.go:66-70 filters metrics
@@ -66,11 +74,6 @@ def main(argv=None):
         client.acquire_leader_lease("tpu-operator-leader",
                                     namespace=NAMESPACE)
 
-    # handlers before the manager goes active (a SIGTERM in the gap
-    # would bypass the orderly stop below)
-    done = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: done.set())
-    signal.signal(signal.SIGINT, lambda *_: done.set())
     mgr.start()
     started.set()
     log.info("operator running (metrics :%d, webhook :%d)",

@@ -41,6 +41,13 @@ def main(argv=None):
     sock = args.socket or pm.vendor_plugin_socket()
     pm.ensure_socket_dir(sock)
 
+    # handlers FIRST — before the cp-agent child is spawned: a SIGTERM
+    # between agent start and handler install would kill the VSP with
+    # the default handler, orphaning the agent process and its socket
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
     agent_proc = None
     dataplane = None
     if args.cp_agent and not args.mock:
@@ -57,12 +64,6 @@ def main(argv=None):
     impl = MockTpuVsp() if args.mock else GoogleTpuVsp(
         HardwarePlatform(args.root), dataplane=dataplane)
     server = VspServer(impl, sock)
-    # handlers BEFORE the server goes live: a SIGTERM in the gap would
-    # kill the process with the default handler, skipping the orderly
-    # server/agent teardown below
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
     server.start()
     logging.info("VSP serving on %s", sock)
     stop.wait()

@@ -229,7 +229,11 @@ class TestMainResilience:
             def flash(self):
                 return _flash()
 
-            def decode(self, quantized=False):
+            def decode(self, quantized=False, kv_int8=False, batch=None,
+                       name="decode_hbm_frac"):
+                if batch == 8:
+                    return {"tokens_per_s": 4200.0, "ms_per_token": 1.9,
+                            "hbm_frac": 0.45}
                 return {"tokens_per_s": 1650.0 if quantized else 1200.0,
                         "ms_per_token": 0.83, "hbm_frac": 0.98}
 
@@ -242,6 +246,7 @@ class TestMainResilience:
         assert payload["flash_frac_of_peak"] == 0.70
         assert payload["decode_tok_s_b1"] == 1200.0
         assert payload["decode_tok_s_b1_int8"] == 1650.0
+        assert payload["decode_tok_s_b8_int8kv8"] == 4200.0
         assert payload["pod_schedule_to_ready_p50"] == 0.01
         assert payload["metric"] == "flash_frac_of_peak"
 
@@ -273,7 +278,11 @@ class TestMainResilience:
             def flash(self):
                 return _flash()
 
-            def decode(self, quantized=False):
+            def decode(self, quantized=False, kv_int8=False, batch=None,
+                       name="decode_hbm_frac"):
+                if batch == 8:
+                    return {"tokens_per_s": 4200.0, "ms_per_token": 1.9,
+                            "hbm_frac": 0.45}
                 return {"tokens_per_s": 1200.0, "ms_per_token": 0.83,
                         "hbm_frac": 0.98}
 
