@@ -183,3 +183,50 @@ def test_csv_webhookdefinitions_match_config_webhook():
             assert (d.get("sideEffects") == wh.get("sideEffects")), wh["name"]
             assert (d.get("admissionReviewVersions")
                     == wh.get("admissionReviewVersions")), wh["name"]
+
+
+# -- package-manifests channel (manifests/, reference parity) ----------------
+
+MANIFESTS = os.path.join(REPO, "manifests")
+
+
+def test_package_manifests_mirror_the_bundle():
+    """manifests/stable must stay byte-identical to bundle/manifests
+    (both install formats describe the same operator; reference ships
+    both: manifests/ + bundle/). Two-way: an orphan file left in
+    stable/ after a bundle manifest is removed fails too."""
+    import filecmp
+    bundle_files = set(os.listdir(os.path.join(BUNDLE, "manifests")))
+    stable_files = set(os.listdir(os.path.join(MANIFESTS, "stable")))
+    assert stable_files - {"image-references"} == bundle_files, (
+        "manifests/stable and bundle/manifests diverged: "
+        f"{stable_files ^ bundle_files}")
+    for fname in sorted(bundle_files):
+        assert filecmp.cmp(
+            os.path.join(BUNDLE, "manifests", fname),
+            os.path.join(MANIFESTS, "stable", fname), shallow=False), \
+            f"manifests/stable/{fname} drifted from bundle/manifests"
+
+
+def test_package_channel_points_at_the_csv():
+    pkg = _load(os.path.join(MANIFESTS, "tpu-operator.package.yaml"))
+    csv = _load(os.path.join(MANIFESTS, "stable",
+                             "tpu-operator.clusterserviceversion.yaml"))
+    assert pkg["packageName"] == "tpu-operator"
+    stable = next(c for c in pkg["channels"] if c["name"] == "stable")
+    assert stable["currentCSV"] == csv["metadata"]["name"]
+
+
+def test_image_references_cover_the_image_matrix():
+    """Every image the operator deploys (images.py env matrix) has a
+    release-pipeline substitution tag (reference:
+    manifests/stable/image-references)."""
+    refs = _load(os.path.join(MANIFESTS, "stable", "image-references"))
+    tags = {t["name"] for t in refs["spec"]["tags"]}
+    # exact tag-name set: one per deployable image + the operator
+    assert tags == {"tpu-operator", "tpu-daemon", "tpu-vsp", "tpu-cni",
+                    "network-resources-injector", "tpu-cp-agent",
+                    "tpu-workload"}
+    for t in refs["spec"]["tags"]:
+        assert t["from"]["kind"] == "DockerImage"
+        assert t["name"] in t["from"]["name"], t
