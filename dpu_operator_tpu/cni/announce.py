@@ -1,0 +1,195 @@
+"""Address announcement after CNI addressing: gratuitous ARP + NA.
+
+Reference: pkgs/sriovutils/packet.go:32-164 — after SetupVF + IPAM the
+SR-IOV CNI announces the pod's new addresses (hand-built gratuitous ARP
+over a raw AF_PACKET socket; unsolicited IPv6 Neighbor Advertisement)
+so upstream switches and neighbor caches learn the moved interface
+immediately instead of after cache timeout (`AnnounceIPs`, :166).
+
+The TPU translation keeps the exact function for the case where it
+matters: NF/tenant pods whose NetConf carries IPAM get addressed
+secondary interfaces, and when those are real netdevs (multus-style
+secondary NICs on a TPU VM), peers' ARP/ND caches are as stale as on
+any host. Frames are built by hand here too (RFC 5227 ARP announce;
+RFC 4861 unsolicited NA with the override flag) and sent best-effort —
+no interface, no CAP_NET_RAW, or a synthetic test netns all degrade to
+a no-op, because addressing must never fail on the announce.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import ipaddress
+import logging
+import os
+import socket
+import struct
+
+log = logging.getLogger(__name__)
+
+ETH_P_ARP = 0x0806
+ETH_P_IPV6 = 0x86DD
+_BCAST = b"\xff\xff\xff\xff\xff\xff"
+#: all-nodes multicast MAC for ff02::1
+_V6_ALLNODES_MAC = b"\x33\x33\x00\x00\x00\x01"
+_V6_ALLNODES = ipaddress.IPv6Address("ff02::1")
+
+
+def garp_frame(mac: bytes, ip: ipaddress.IPv4Address) -> bytes:
+    """RFC 5227 ARP announcement: an ARP *request* whose sender and
+    target protocol address are both the announced IP (target hardware
+    address zero), broadcast — updates every listener's cache without
+    soliciting replies."""
+    if len(mac) != 6:
+        raise ValueError("mac must be 6 bytes")
+    arp = struct.pack(
+        "!HHBBH6s4s6s4s",
+        1,                    # htype: ethernet
+        0x0800,               # ptype: IPv4
+        6, 4,                 # hlen, plen
+        1,                    # op: request (RFC 5227 announce)
+        mac, ip.packed,
+        b"\x00" * 6, ip.packed)
+    return _BCAST + mac + struct.pack("!H", ETH_P_ARP) + arp
+
+
+def _icmpv6_checksum(src: ipaddress.IPv6Address,
+                     dst: ipaddress.IPv6Address, payload: bytes) -> int:
+    pseudo = (src.packed + dst.packed
+              + struct.pack("!I", len(payload)) + b"\x00\x00\x00\x3a")
+    data = pseudo + payload
+    if len(data) % 2:
+        data += b"\x00"
+    total = sum(struct.unpack(f"!{len(data) // 2}H", data))
+    while total >> 16:
+        total = (total & 0xFFFF) + (total >> 16)
+    return ~total & 0xFFFF
+
+
+def unsolicited_na_frame(mac: bytes,
+                         ip: ipaddress.IPv6Address) -> bytes:
+    """RFC 4861 unsolicited Neighbor Advertisement to all-nodes with the
+    OVERRIDE flag set and a target-link-layer-address option — the IPv6
+    counterpart of the gratuitous ARP."""
+    if len(mac) != 6:
+        raise ValueError("mac must be 6 bytes")
+    # NA: type 136, code 0, checksum (fill later), flags O=1, target,
+    # option: type 2 (target lladdr), len 1 (8 bytes)
+    na = struct.pack("!BBHI16s", 136, 0, 0, 0x20000000, ip.packed) \
+        + struct.pack("!BB6s", 2, 1, mac)
+    csum = _icmpv6_checksum(ip, _V6_ALLNODES, na)
+    na = na[:2] + struct.pack("!H", csum) + na[4:]
+    ipv6 = struct.pack("!IHBB16s16s",
+                       0x60000000,        # version 6
+                       len(na),           # payload length
+                       58,                # next header: ICMPv6
+                       255,               # hop limit (required by ND)
+                       ip.packed, _V6_ALLNODES.packed)
+    return (_V6_ALLNODES_MAC + mac + struct.pack("!H", ETH_P_IPV6)
+            + ipv6 + na)
+
+
+def _iface_mac(sock: socket.socket, ifname: str) -> bytes:
+    info = fcntl.ioctl(sock.fileno(), 0x8927,  # SIOCGIFHWADDR
+                       struct.pack("256s", ifname.encode()[:15]))
+    return info[18:24]
+
+
+def _send_frames(ifname: str, ips: list) -> int:
+    sent = 0
+    try:
+        sock = socket.socket(socket.AF_PACKET, socket.SOCK_RAW)
+    except (OSError, AttributeError):
+        return 0  # no CAP_NET_RAW (tests/daemonless) — announce is a nicety
+    try:
+        try:
+            sock.bind((ifname, 0))
+            mac = _iface_mac(sock, ifname)
+        except OSError:
+            return 0  # interface gone / synthetic netns
+        for ip in ips:
+            try:
+                frame = (garp_frame(mac, ip) if ip.version == 4
+                         else unsolicited_na_frame(mac, ip))
+                sock.send(frame)
+                sent += 1
+            except OSError:  # noqa: PERF203 — per-address best-effort
+                continue
+    finally:
+        sock.close()
+    return sent
+
+
+def announce_ips(ifname: str, ips: list, netns: str = "") -> int:
+    """Announce *ips* (CNI result 'address' strings) on *ifname* inside
+    *netns* — the pod's namespace, entered by a short-lived SPAWNED
+    helper (`python -m dpu_operator_tpu.cni.announce`): setns is
+    process-wide, and fork() from the multithreaded daemon could clone
+    a lock held by another thread and deadlock the child. Best-effort:
+    returns the number of frames sent; every failure path (bad
+    addresses, no netns, helper crash/timeout, fd exhaustion) is 0,
+    never an exception — addressing must not fail on the announce
+    (sriov.go:477 treats it the same way). A pod interface only ever
+    exists in a pod namespace, so without a live *netns* there is
+    nothing to announce on — broadcasting on a same-named HOST
+    interface would poison peer caches with the host MAC."""
+    parsed = []
+    for a in ips:
+        try:
+            parsed.append(str(ipaddress.ip_interface(a)))
+        except ValueError:
+            continue
+    if not parsed or not ifname or not netns:
+        return 0
+    if not os.path.exists(netns) or not hasattr(os, "setns"):
+        return 0
+    import subprocess
+    import sys
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "dpu_operator_tpu.cni.announce",
+             netns, ifname, *parsed],
+            capture_output=True, timeout=10)
+        return int(proc.stdout.strip() or 0)
+    except (OSError, ValueError, subprocess.SubprocessError):
+        return 0
+
+
+def announce_result(ifname: str, result, netns: str = "") -> int:
+    """Announce every address in an ipam_add result fragment — the one
+    call both CNI ADD paths make after addressing succeeds."""
+    if not result:
+        return 0
+    return announce_ips(
+        ifname, [i.get("address", "") for i in result.get("ips", [])],
+        netns=netns)
+
+
+def _helper_main(argv: list) -> int:
+    """`python -m dpu_operator_tpu.cni.announce <netns> <ifname> <ip>...`
+    — enter the namespace, send, print the count. Always exits 0; the
+    parent treats any malfunction as 0 frames."""
+    if len(argv) < 3:
+        print(0)
+        return 0
+    netns, ifname, addrs = argv[0], argv[1], argv[2:]
+    parsed = []
+    for a in addrs:
+        try:
+            parsed.append(ipaddress.ip_interface(a).ip)
+        except ValueError:
+            continue
+    try:
+        fd = os.open(netns, os.O_RDONLY)
+        os.setns(fd, os.CLONE_NEWNET)
+        os.close(fd)
+    except OSError:
+        print(0)
+        return 0
+    print(_send_frames(ifname, parsed))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_helper_main(sys.argv[1:]))

@@ -20,6 +20,7 @@ from typing import Optional
 import grpc
 
 from ..cni import ChipAllocator, CniServer, NetConfCache
+from ..cni.announce import announce_result
 from ..cni.ipam import ipam_add, ipam_del
 from ..cni.types import DeviceWiring, PodRequest
 from ..deviceplugin import DevicePlugin
@@ -234,6 +235,10 @@ class HostSideManager:
                             "failure for %s", req.sandbox_id)
             self.allocator.release(req.device_id, req.sandbox_id)
             raise
+        # announce the new addresses on the pod's interface so peer
+        # ARP/ND caches update immediately (AnnounceIPs, sriov.go:477 —
+        # best-effort, 0 without a live netns/netdev/CAP_NET_RAW)
+        announce_result(req.ifname, ips, netns=req.netns)
         # concrete per-sandbox wiring: device node, cgroup rule, libtpu
         # mount, env — what the runtime must materialize (SetupVF analog)
         info = self.device_handler.get_devices().get(req.device_id) or {}

@@ -18,6 +18,7 @@ import threading
 from typing import Optional
 
 from ..cni import CniServer
+from ..cni.announce import announce_result
 from ..cni.ipam import ipam_add, ipam_del
 from ..utils import metrics
 from ..cni.types import PodRequest
@@ -392,6 +393,10 @@ class TpuSideManager:
         network = req.netconf.name or ""
         ips = ipam_add(ipam_cfg, self.ipam_dir, network,
                        req.sandbox_id, req.ifname, netns=req.netns)
+        # peer caches learn the NF interface's addresses immediately
+        # (AnnounceIPs parity, sriov.go:477; best-effort no-op when the
+        # attachment has no real netdev)
+        announce_result(req.ifname, ips, netns=req.netns)
         # always cache: the device id must survive daemon restarts so a
         # later DEL can release the chip's slice attachment (the VSP and
         # its attachment table live in a separate long-lived process)
