@@ -33,6 +33,7 @@ import json
 import logging
 import os
 import statistics
+import subprocess
 import sys
 import tempfile
 import time
@@ -52,20 +53,25 @@ _TRANSIENT_MARKERS = (
 )
 _TRANSIENT_TYPES = ("JaxRuntimeError", "XlaRuntimeError")
 
+def _float_env(name: str, default: float) -> float:
+    """Parse a float env knob; a malformed value falls back to the
+    default with a stderr note — an env typo must not crash the bench
+    before the always-print-JSON guard is even reached."""
+    raw = os.environ.get(name, str(default))
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"ignoring malformed {name}={raw!r}; using {default}",
+              file=sys.stderr)
+        return default
+
+
 def _deadline_from_env() -> float:
     """Soft wall-clock budget for the WHOLE bench (seconds): once
     exceeded, pending sections are skipped (recorded in "errors") and
     the JSON line prints with whatever landed — retries must never push
-    the run past the driver's window. 0 disables. A malformed value
-    falls back to the default: an env typo must not crash the bench
-    before the always-print-JSON guard is even reached."""
-    raw = os.environ.get("TPU_BENCH_DEADLINE_S", "2700")
-    try:
-        return float(raw)
-    except ValueError:
-        print(f"ignoring malformed TPU_BENCH_DEADLINE_S={raw!r}; "
-              "using 2700", file=sys.stderr)
-        return 2700.0
+    the run past the driver's window. 0 disables."""
+    return _float_env("TPU_BENCH_DEADLINE_S", 2700.0)
 
 
 DEADLINE_S = _deadline_from_env()
@@ -108,6 +114,55 @@ def reset_backend() -> None:
             return
         except Exception:
             continue
+
+
+def probe_backend(timeout_s=240.0, attempts=3):
+    """Check from a SUBPROCESS that jax can initialize its default backend
+    (the axon TPU plugin when the tunnel is up). Returns the device kind
+    string, or None when every probe failed or timed out.
+
+    Why a subprocess: an unavailable tunnel makes the in-process
+    `jax.devices()` BLOCK for ~25 minutes before raising (observed in
+    round 5) — long enough to eat the whole driver window across the
+    3 compute-setup attempts. A subprocess dial can be killed at
+    *timeout_s*; a healthy tunnel answers in seconds, so a generous
+    timeout cannot misclassify a working chip. timeout_s <= 0 disables
+    the per-dial timeout (this file's env convention: 0 disables); each
+    dial is then still capped at the REMAINING bench deadline — the
+    deadline can only be checked between attempts, so an uncapped dial
+    blocked on a dead tunnel would otherwise be uninterruptible."""
+    code = "import jax; print(jax.devices()[0].device_kind, flush=True)"
+    for attempt in range(attempts):
+        if past_deadline():
+            # also gates attempt 0: with the deadline exhausted the dial
+            # would run under the 1 s floor below and a HEALTHY chip
+            # would be misreported as a probe failure (the caller
+            # publishes a deadline-specific error instead)
+            return None
+        # every dial — not just the timeout-disabled case — is capped at
+        # the remaining bench deadline: the deadline can only be checked
+        # BETWEEN attempts, and "retries must never push the run past
+        # the driver's window" (module contract)
+        remaining = (max(1.0, DEADLINE_S - (time.monotonic() - _START))
+                     if DEADLINE_S > 0 else None)
+        cap = timeout_s if timeout_s > 0 else remaining
+        if cap is not None and remaining is not None:
+            cap = min(cap, remaining)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=cap)
+        except subprocess.TimeoutExpired:
+            print(f"backend probe timed out after {cap:.0f}s "
+                  f"(attempt {attempt + 1})", file=sys.stderr)
+            continue
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+        print(f"backend probe failed (attempt {attempt + 1}): "
+              f"{out.stderr.strip()[-300:]}", file=sys.stderr)
+        if attempt + 1 < attempts:
+            time.sleep(5.0)
+    return None
 
 
 def measured(fn, frac_of, name, cap, attempts=4, backoff_s=5.0, sleep=time.sleep):
@@ -171,10 +226,12 @@ def _pod(name, chips=1):
     }
 
 
-def bench_pod_ready(n_pods: int, wire: bool = False) -> list:
-    """Per-pod create→ready latency. *wire*=False drives FakeKube by
-    direct method call (in-process tier); *wire*=True stands up the
-    MiniApiServer and a RealKube client under the operator
+def bench_pod_ready(n_pods: int, wire: bool = False) -> "list | dict":
+    """Per-pod create→ready latency. *wire*=False returns the bare latency
+    list; *wire*=True returns {"latencies": [...], "apiserver_rtt": [...]}
+    (the RTT samples calibrate fixture overhead). *wire*=False drives
+    FakeKube by direct method call (in-process tier); *wire*=True stands up
+    the MiniApiServer and a RealKube client under the operator
     ServiceAccount's token with RBAC ENFORCED, so every create/get/
     delete is genuine HTTPS (VERDICT r3 #4 — the reference's
     integration tier always ran against a real apiserver,
@@ -279,6 +336,29 @@ def bench_pod_ready(n_pods: int, wire: bool = False) -> list:
                             "mode": "network-function", "deviceID": chip}))
             kube.delete("v1", "Pod", name, namespace="default")
             kubelet.release("google.com/tpu", [chip])  # pod teardown
+        if wire:
+            # calibration: bare apiserver round-trips (GET of an object
+            # that exists) so the pod p50 can be read NET of fixture
+            # overhead — the wire tier's latency is dominated by
+            # MiniApiServer + RealKube HTTPS costs, not operator work
+            # (VERDICT r4 weak #4), and without this number a reader
+            # cannot separate the two. Calibration is best-effort: a
+            # failure here must not discard the latencies already
+            # measured (the section-resilience contract above).
+            rtts = []
+            try:
+                # the node agent's pod watch schedules this like any
+                # other pod (it briefly holds a chip); deleted below
+                kube.create(_pod("bench-rtt"))
+                for _ in range(min(max(n_pods, 10), 50)):
+                    t0 = time.perf_counter()
+                    kube.get("v1", "Pod", "bench-rtt", namespace="default")
+                    rtts.append(time.perf_counter() - t0)
+                kube.delete("v1", "Pod", "bench-rtt", namespace="default")
+            except Exception as e:  # noqa: BLE001 — calibration only
+                print(f"wire RTT calibration failed (ignored): {e}",
+                      file=sys.stderr)
+            return {"latencies": latencies, "apiserver_rtt": rtts}
     finally:
         mgr.stop()
         vsp_server.stop()
@@ -454,8 +534,20 @@ def build_payload(results, errors):
     # comparison but is NOT comparable to the reference's 2-minute
     # real-hardware bound, so no ratio is published (VERDICT r3 #4).
     if results.get("pods_wire"):
-        payload["pod_schedule_to_ready_p50_wire"] = round(
-            statistics.median(results["pods_wire"]), 4)
+        wire = results["pods_wire"]
+        # dict since round 5 (latencies + apiserver-RTT calibration);
+        # tolerate the old bare-list shape so a cached result can't crash
+        # the payload builder
+        lat = wire["latencies"] if isinstance(wire, dict) else wire
+        if lat:
+            payload["pod_schedule_to_ready_p50_wire"] = round(
+                statistics.median(lat), 4)
+        if isinstance(wire, dict) and wire.get("apiserver_rtt"):
+            # one create+get+delete drives ~8 RealKube round-trips
+            # through the pod path; the per-RTT median lets a reader
+            # bound how much of the wire p50 is fixture, not operator
+            payload["wire_apiserver_rtt_p50"] = round(
+                statistics.median(wire["apiserver_rtt"]), 5)
     if results.get("pods"):
         payload["pod_schedule_to_ready_p50"] = round(
             statistics.median(results["pods"]), 4)
@@ -490,6 +582,39 @@ def main():
         ("pods_wire", lambda: bench_pod_ready(n_pods, wire=True)),
     ]
     results, errors = run_sections(sections)
+
+    # Probe the accelerator from a SUBPROCESS before any in-process jax
+    # contact: when the tunnel is dead, in-process backend init blocks
+    # ~25 min per attempt (observed) — three compute-setup attempts
+    # would eat the driver's whole window. The probe bounds each dial;
+    # on terminal failure the CPU fallback is pinned so every section
+    # still lands (degraded, flagged in "errors") and the line prints.
+    probe_timeout = _float_env("TPU_BENCH_PROBE_TIMEOUT_S", 240.0)
+    kind = probe_backend(timeout_s=probe_timeout)
+    if kind is not None:
+        # record chip provenance now: if the tunnel drops before
+        # ComputeBench lands, the degraded record still says what the
+        # probe saw (ComputeBench overwrites with its own view later)
+        results["device"] = kind
+    if kind is None:
+        # distinguish "tunnel looks dead" from "out of time": the record
+        # is what verdicts are judged on, and blaming the tunnel for a
+        # deadline overrun would misdirect the next investigation
+        errors["tpu_probe"] = (
+            "skipped/cut short: bench deadline reached; CPU fallback"
+            if past_deadline() else
+            "accelerator backend probe failed/timed out; CPU fallback "
+            "(compute values are smoke signals, not chip numbers)")
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            # the config pin only affects FUTURE backend selection; if
+            # anything initialized a backend earlier in this process the
+            # TPU client is already registered and ComputeBench would
+            # still dial the dead tunnel — drop it explicitly
+            reset_backend()
+        except Exception:  # noqa: BLE001 — fallback is best-effort
+            pass
 
     # device init (the first jax contact through the tunnel) gets the
     # same transient-retry treatment as the measurements: one hiccup at

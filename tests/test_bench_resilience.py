@@ -186,6 +186,22 @@ class TestBuildPayload:
         assert payload["errors"]["train"].startswith("JaxRuntimeError")
         assert payload["pod_schedule_to_ready_p50"] == 0.01
 
+    def test_wire_dict_shape_publishes_rtt_calibration(self):
+        payload = bench.build_payload(
+            {"pods_wire": {"latencies": [0.09, 0.11],
+                           "apiserver_rtt": [0.01, 0.012, 0.011]}}, {})
+        assert payload["pod_schedule_to_ready_p50_wire"] == 0.1
+        assert payload["wire_apiserver_rtt_p50"] == 0.011
+
+    def test_wire_dict_with_empty_latencies_does_not_crash(self):
+        # TPU_BENCH_PODS=0 smoke run: the dict is truthy even when no pod
+        # latencies landed; median([]) must not kill the payload builder
+        payload = bench.build_payload(
+            {"pods_wire": {"latencies": [],
+                           "apiserver_rtt": [0.01, 0.02]}}, {})
+        assert "pod_schedule_to_ready_p50_wire" not in payload
+        assert payload["wire_apiserver_rtt_p50"] == 0.015
+
     def test_nothing_landed_still_builds_a_line(self):
         payload = bench.build_payload({}, {"compute_setup": "boom"})
         assert payload["value"] is None
@@ -193,7 +209,146 @@ class TestBuildPayload:
         json.dumps(payload)  # serializable
 
 
+@pytest.fixture(autouse=True)
+def _no_real_probe(monkeypatch):
+    """main() probes the accelerator via a real subprocess (which would
+    dial the axon tunnel on a TPU-attached machine); tests stub it to a
+    healthy answer unless they override."""
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda *a, **k: "TPU v5 lite")
+
+
+# the real function, captured before the autouse stub replaces the module
+# attribute — TestProbeBackend exercises the genuine implementation
+_REAL_PROBE = bench.probe_backend
+
+
+class TestProbeBackend:
+    @pytest.fixture(autouse=True)
+    def _fresh_clock(self, monkeypatch):
+        """past_deadline() measures from module import (_START, set at
+        collection time); pin it to now so a long-running suite can't
+        push these tests past the 2700s default deadline spuriously."""
+        import time as _time
+        monkeypatch.setattr(bench, "_START", _time.monotonic())
+
+    def test_healthy_probe_returns_kind(self, monkeypatch):
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: types.SimpleNamespace(
+                                returncode=0, stdout="warn\nTPU v5 lite\n",
+                                stderr=""))
+        assert _REAL_PROBE(timeout_s=1) == "TPU v5 lite"
+
+    def test_timeout_every_attempt_returns_none(self, monkeypatch):
+        calls = {"n": 0}
+
+        def timed_out(*a, **k):
+            calls["n"] += 1
+            raise bench.subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+        monkeypatch.setattr(bench.subprocess, "run", timed_out)
+        assert _REAL_PROBE(timeout_s=1, attempts=3) is None
+        assert calls["n"] == 3
+
+    def test_zero_timeout_disables_the_per_dial_timeout(self, monkeypatch):
+        # env convention: 0 disables (matches TPU_BENCH_DEADLINE_S);
+        # subprocess.run(timeout=0) would expire instantly and force a
+        # false CPU fallback on a healthy chip. With a bench deadline
+        # set, the dial is still capped at the REMAINING deadline (an
+        # uncapped dial on a dead tunnel would be uninterruptible);
+        # with the deadline also disabled, the dial is unbounded.
+        seen = {}
+
+        def record(*a, **k):
+            seen["timeout"] = k.get("timeout", "missing")
+            return types.SimpleNamespace(returncode=0,
+                                         stdout="TPU v5 lite\n", stderr="")
+
+        monkeypatch.setattr(bench.subprocess, "run", record)
+        monkeypatch.setattr(bench, "DEADLINE_S", 0)
+        assert _REAL_PROBE(timeout_s=0) == "TPU v5 lite"
+        assert seen["timeout"] is None
+
+        monkeypatch.setattr(bench, "DEADLINE_S", 2700.0)
+        assert _REAL_PROBE(timeout_s=0) == "TPU v5 lite"
+        assert 0 < seen["timeout"] <= 2700.0
+
+    def test_positive_timeout_is_capped_by_remaining_deadline(
+            self, monkeypatch):
+        # a 240s dial must not overshoot a nearly-exhausted deadline:
+        # the deadline is only checkable BETWEEN attempts
+        seen = {}
+
+        def record(*a, **k):
+            seen["timeout"] = k.get("timeout")
+            return types.SimpleNamespace(returncode=0,
+                                         stdout="TPU v5 lite\n", stderr="")
+
+        monkeypatch.setattr(bench.subprocess, "run", record)
+        import time as _time
+        monkeypatch.setattr(bench, "_START", _time.monotonic())
+        monkeypatch.setattr(bench, "DEADLINE_S", 120.0)  # < the 240s dial
+        assert _REAL_PROBE(timeout_s=240.0) == "TPU v5 lite"
+        assert 0 < seen["timeout"] <= 120.0
+
+    def test_exhausted_deadline_skips_the_probe_entirely(self, monkeypatch):
+        # under the 1s remaining-floor a healthy chip could never answer;
+        # the probe must bail (the caller records a deadline-specific
+        # error, not a tunnel failure)
+        def boom(*a, **k):
+            raise AssertionError("must not dial")
+
+        monkeypatch.setattr(bench.subprocess, "run", boom)
+        import time as _time
+        monkeypatch.setattr(bench, "_START", _time.monotonic() - 10.0)
+        monkeypatch.setattr(bench, "DEADLINE_S", 1.0)  # clearly exhausted
+        assert _REAL_PROBE(timeout_s=240.0) is None
+
+    def test_failing_probe_returns_none_then_recovers(self, monkeypatch):
+        seq = [types.SimpleNamespace(returncode=1, stdout="",
+                                     stderr="UNAVAILABLE: tunnel"),
+               types.SimpleNamespace(returncode=0, stdout="TPU v5 lite\n",
+                                     stderr="")]
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: seq.pop(0))
+        monkeypatch.setattr(bench.time, "sleep", _nosleep)
+        assert _REAL_PROBE(timeout_s=1, attempts=2) == "TPU v5 lite"
+
+
 class TestMainResilience:
+    def test_main_pins_cpu_and_records_error_when_probe_dies(
+            self, monkeypatch):
+        monkeypatch.setattr(bench, "probe_backend", lambda *a, **k: None)
+        monkeypatch.setattr(bench, "bench_pod_ready",
+                            lambda n, wire=False: [0.01] * n)
+        # main()'s fallback pins jax_platforms=cpu + clears backends
+        # process-wide; neutralize both so the pin can't leak into later
+        # tests (conftest pins cpu anyway, but keep the suite hygienic)
+        import jax
+        monkeypatch.setattr(jax.config, "update", lambda *a, **k: None)
+        monkeypatch.setattr(bench, "reset_backend", lambda: None)
+
+        class CpuBench:
+            dev = types.SimpleNamespace(device_kind="cpu")
+
+            def train(self):
+                return _train(0.02)
+
+            def flash(self):
+                return _flash()
+
+            def decode(self, **kw):
+                return {"tokens_per_s": 5.0, "ms_per_token": 200.0,
+                        "hbm_frac": 0.01}
+
+        monkeypatch.setattr(bench, "ComputeBench", CpuBench)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            bench.main()
+        payload = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert "tpu_probe" in payload["errors"]
+        assert payload["value"] == 0.02  # degraded but numeric, rc 0
+
     def test_main_emits_json_line_rc0_when_everything_fails(
             self, monkeypatch):
         def dead_pods(*a, **k):
