@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Wait out a TPU-tunnel outage, then run a command (default: bench.py).
+
+The tunnel to the chip is time-shared and goes through phases — including
+hard-down windows where in-process jax backend init BLOCKS ~25 minutes
+before raising UNAVAILABLE (observed 2026-07-31, a multi-hour outage).
+This tool probes with bench.probe_backend's killable-subprocess dial so
+each check costs at most --probe-timeout, and launches the payload the
+moment the chip answers:
+
+    python hack/tunnel_watch.py                        # bench on recovery
+    python hack/tunnel_watch.py --then "python hack/int8_session.py"
+    python hack/tunnel_watch.py --attempts 1           # one-shot probe
+
+Exit codes: 0 = payload ran (its own rc is printed), 3 = tunnel never
+answered within the attempt budget.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import bench  # noqa: E402
+
+# probe_backend gates on bench's soft deadline, measured from bench's
+# IMPORT — after 2700 s of watching it would return None without
+# dialing. The watch has its own attempt budget; disable the inherited
+# deadline (the payload runs as a fresh subprocess with its own).
+bench.DEADLINE_S = 0
+probe_backend = bench.probe_backend
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=float, default=420.0,
+                    help="seconds between probes (default 420)")
+    ap.add_argument("--attempts", type=int, default=14,
+                    help="probe rounds before giving up (default 14)")
+    def _positive(v):
+        f = float(v)
+        if f <= 0:
+            # 0 would disable the per-dial cap; with the bench deadline
+            # also disabled below, a hard-down tunnel would block ~25
+            # min per dial — the exact hang this tool exists to avoid
+            raise argparse.ArgumentTypeError("--probe-timeout must be > 0")
+        return f
+
+    ap.add_argument("--probe-timeout", type=_positive, default=240.0,
+                    help="per-dial subprocess timeout (default 240, > 0)")
+    ap.add_argument(
+        "--then",
+        default=f"{sys.executable} {os.path.join(REPO_ROOT, 'bench.py')}",
+        help="command to run once the tunnel answers (cwd = repo root)")
+    args = ap.parse_args()
+
+    for i in range(1, args.attempts + 1):
+        kind = probe_backend(timeout_s=args.probe_timeout, attempts=1)
+        if kind is not None:
+            print(f"tunnel up (attempt {i}): {kind}", flush=True)
+            rc = subprocess.run(args.then, shell=True,
+                                cwd=REPO_ROOT).returncode
+            print(f"payload rc={rc}", flush=True)
+            return 0
+        print(f"attempt {i}/{args.attempts}: tunnel down "
+              f"({time.strftime('%H:%M', time.gmtime())}Z)", flush=True)
+        if i < args.attempts:
+            time.sleep(args.interval)
+    print("tunnel never answered; giving up", flush=True)
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
