@@ -23,6 +23,10 @@ native:
 bench: native
 	$(PYTHON) bench.py
 
+# wait out a TPU-tunnel outage, then run the bench the moment it answers
+bench-when-up: native
+	$(PYTHON) hack/tunnel_watch.py
+
 graft-check:
 	$(PYTHON) __graft_entry__.py
 
