@@ -27,11 +27,6 @@ sys.path.insert(0, REPO_ROOT)
 
 import bench  # noqa: E402
 
-# probe_backend gates on bench's soft deadline, measured from bench's
-# IMPORT — after 2700 s of watching it would return None without
-# dialing. The watch has its own attempt budget; disable the inherited
-# deadline (the payload runs as a fresh subprocess with its own).
-bench.DEADLINE_S = 0
 probe_backend = bench.probe_backend
 
 
@@ -39,8 +34,14 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--interval", type=float, default=420.0,
                     help="seconds between probes (default 420)")
-    ap.add_argument("--attempts", type=int, default=14,
-                    help="probe rounds before giving up (default 14)")
+    def _positive_int(v):
+        n = int(v)
+        if n <= 0:
+            raise argparse.ArgumentTypeError("--attempts must be > 0")
+        return n
+
+    ap.add_argument("--attempts", type=_positive_int, default=14,
+                    help="probe rounds before giving up (default 14, > 0)")
     def _positive(v):
         f = float(v)
         if f <= 0:
@@ -58,20 +59,31 @@ def main() -> int:
         help="command to run once the tunnel answers (cwd = repo root)")
     args = ap.parse_args()
 
-    for i in range(1, args.attempts + 1):
-        kind = probe_backend(timeout_s=args.probe_timeout, attempts=1)
-        if kind is not None:
-            print(f"tunnel up (attempt {i}): {kind}", flush=True)
-            rc = subprocess.run(args.then, shell=True,
-                                cwd=REPO_ROOT).returncode
-            print(f"payload rc={rc}", flush=True)
-            return 0
-        print(f"attempt {i}/{args.attempts}: tunnel down "
-              f"({time.strftime('%H:%M', time.gmtime())}Z)", flush=True)
-        if i < args.attempts:
-            time.sleep(args.interval)
-    print("tunnel never answered; giving up", flush=True)
-    return 3
+    # probe_backend gates on bench's soft deadline, measured from bench's
+    # IMPORT — after 2700 s of watching it would return None without
+    # dialing. The watch has its own attempt budget; disable the
+    # inherited deadline around the loop and RESTORE it after (the
+    # payload runs as a fresh subprocess with its own; an in-process
+    # embedder must get bench back unmutated).
+    saved_deadline = bench.DEADLINE_S
+    bench.DEADLINE_S = 0
+    try:
+        for i in range(1, args.attempts + 1):
+            kind = probe_backend(timeout_s=args.probe_timeout, attempts=1)
+            if kind is not None:
+                print(f"tunnel up (attempt {i}): {kind}", flush=True)
+                rc = subprocess.run(args.then, shell=True,
+                                    cwd=REPO_ROOT).returncode
+                print(f"payload rc={rc}", flush=True)
+                return 0
+            print(f"attempt {i}/{args.attempts}: tunnel down "
+                  f"({time.strftime('%H:%M', time.gmtime())}Z)", flush=True)
+            if i < args.attempts:
+                time.sleep(args.interval)
+        print("tunnel never answered; giving up", flush=True)
+        return 3
+    finally:
+        bench.DEADLINE_S = saved_deadline
 
 
 if __name__ == "__main__":

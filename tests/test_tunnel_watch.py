@@ -1,0 +1,99 @@
+"""hack/tunnel_watch.py — outage watch around bench.probe_backend.
+
+The watch must never recreate the unbounded in-process dial it exists to
+avoid (probe timeout > 0 enforced, bench's import-time deadline disabled)
+and must run its payload from the repo root regardless of the caller's
+cwd (a multi-hour wait followed by "can't open bench.py" would exit 0).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "hack"))
+
+import tunnel_watch  # noqa: E402
+
+
+@pytest.fixture
+def argv(monkeypatch):
+    def set_argv(*args):
+        monkeypatch.setattr(sys, "argv", ["tunnel_watch", *args])
+    return set_argv
+
+
+@pytest.fixture(autouse=True)
+def _restore_bench_deadline(monkeypatch):
+    """main() disables bench.DEADLINE_S for the watch; re-registering the
+    current value with monkeypatch restores it after each test so the
+    mutation can't leak into bench's own tests."""
+    import bench
+    monkeypatch.setattr(bench, "DEADLINE_S", bench.DEADLINE_S)
+
+
+def test_payload_runs_from_repo_root_on_recovery(argv, monkeypatch,
+                                                 tmp_path, capfd):
+    monkeypatch.setattr(tunnel_watch, "probe_backend",
+                        lambda **k: "TPU v5 lite")
+    monkeypatch.chdir(tmp_path)  # foreign cwd must not matter
+    argv("--then", "pwd", "--attempts", "3")
+    assert tunnel_watch.main() == 0
+    out = capfd.readouterr().out
+    assert tunnel_watch.REPO_ROOT in out
+    assert "payload rc=0" in out
+
+
+def test_gives_up_with_exit_3_and_never_sleeps_after_last(argv, monkeypatch):
+    calls = {"probe": 0, "sleep": 0}
+    monkeypatch.setattr(tunnel_watch, "probe_backend",
+                        lambda **k: calls.__setitem__(
+                            "probe", calls["probe"] + 1))
+    monkeypatch.setattr(tunnel_watch.time, "sleep",
+                        lambda s: calls.__setitem__(
+                            "sleep", calls["sleep"] + 1))
+    argv("--attempts", "3", "--interval", "1")
+    assert tunnel_watch.main() == 3
+    assert calls["probe"] == 3
+    assert calls["sleep"] == 2  # between attempts only
+
+
+def test_probe_timeout_zero_rejected(argv):
+    argv("--probe-timeout", "0")
+    with pytest.raises(SystemExit) as e:
+        tunnel_watch.main()
+    assert e.value.code == 2  # argparse usage error
+
+
+def test_bench_deadline_disabled_during_watch(argv, monkeypatch):
+    # probe_backend gates on bench.DEADLINE_S measured from bench IMPORT;
+    # a long watch would silently stop dialing unless main() disables it
+    import bench
+    monkeypatch.setattr(bench, "DEADLINE_S", 2700.0)
+    seen = {}
+
+    def probe(**k):
+        seen["deadline_at_probe"] = bench.DEADLINE_S
+        return "TPU v5 lite"
+
+    monkeypatch.setattr(tunnel_watch, "probe_backend", probe)
+    argv("--then", "true", "--attempts", "1")
+    assert tunnel_watch.main() == 0
+    assert seen["deadline_at_probe"] == 0
+    assert bench.DEADLINE_S == 2700.0  # restored for in-process embedders
+
+
+def test_attempts_zero_rejected(argv):
+    argv("--attempts", "0")
+    with pytest.raises(SystemExit) as e:
+        tunnel_watch.main()
+    assert e.value.code == 2
+
+
+def test_payload_failure_is_reported_not_masked(argv, monkeypatch, capfd):
+    monkeypatch.setattr(tunnel_watch, "probe_backend",
+                        lambda **k: "TPU v5 lite")
+    argv("--then", "exit 7", "--attempts", "1")
+    assert tunnel_watch.main() == 0  # watch succeeded; payload rc printed
+    assert "payload rc=7" in capfd.readouterr().out
