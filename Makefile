@@ -3,8 +3,8 @@ PYTHON ?= python
 REGISTRY ?= localhost:5000
 TAG ?= latest
 
-.PHONY: test fast-test bench native traffic-flow images smoke-images \
-        deploy undeploy graft-check clean
+.PHONY: test fast-test collect-check bench native traffic-flow images \
+        smoke-images deploy undeploy graft-check clean
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -12,6 +12,13 @@ test: native
 # reference `fast-test`: skip the slow e2e tier
 fast-test: native
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_e2e.py -m "not slow"
+
+# import-rot gate: pytest exits nonzero on ANY collection error, so a
+# broken import (e.g. a jax API move) fails here in seconds instead of
+# silently dropping whole test files from the suite (-qq keeps success
+# output to per-file counts while error tracebacks still print)
+collect-check:
+	$(PYTHON) -m pytest tests/ -qq --collect-only
 
 # flake detector (reference: ginkgo --repeat 4 in `task test`)
 test-repeat: native

@@ -249,55 +249,63 @@ def bench_pod_ready(n_pods: int, wire: bool = False) -> "list | dict":
     tmp = tempfile.mkdtemp(prefix="tpubench-", dir="/tmp")
     pm = PathManager(tmp)
     backing = FakeKube()
-    apiserver = None
-    if wire:
-        import yaml
-
-        sys.path.insert(0, os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "tests"))
-        from apiserver_fixture import MiniApiServer
-        from dpu_operator_tpu.k8s.real import RealKube
-
-        sa_subject = {"kind": "ServiceAccount",
-                      "name": "tpu-operator-controller-manager",
-                      "namespace": "tpu-operator-system"}
-        apiserver = MiniApiServer(kube=backing)
-        apiserver.rbac_enabled = True
-        apiserver.token_subjects["bench-sa-token"] = sa_subject
-        rbac_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "config", "rbac")
-        for fname in sorted(os.listdir(rbac_dir)):
-            with open(os.path.join(rbac_dir, fname)) as f:
-                for obj in yaml.safe_load_all(f):
-                    # skip kustomization.yaml & friends — only real
-                    # kubernetes objects belong in the store
-                    if obj and obj.get("kind") and obj.get("apiVersion"):
-                        backing.create(obj)
-        apiserver.start()
-        kube = RealKube(kubeconfig=apiserver.write_kubeconfig(
-            tmp + "/kubeconfig", token="bench-sa-token"))
-    else:
-        kube = backing
-    # the scheduler/kubelet side acts on the backing store directly in
-    # both tiers (it is the cluster, not a client)
-    agent = FakeNodeAgent(backing)
-    agent.start()
-    agent.register_node("tpu-vm-0", labels={"tpu": "true"})
-    kubelet = FakeKubelet(pm, node_agent=agent, node_name="tpu-vm-0")
-    kubelet.start()
-
-    mock = MockTpuVsp(port=0)
-    sock = pm.vendor_plugin_socket()
-    pm.ensure_socket_dir(sock)
-    vsp_server = VspServer(mock, socket_path=sock)
-    vsp_server.start()
-    det = TpuDetector().detection_result(tpu_mode=True, identifier="bench")
-    mgr = TpuSideManager(GrpcPlugin(det, path_manager=pm, init_timeout=5.0),
-                         pm, client=kube)
-    mgr.device_plugin.poll_interval = 0.1
-
+    # every handle the finally tears down, pre-declared: SETUP failures
+    # (a bad RBAC file, a kubeconfig write error) must clean up too, not
+    # just failures inside the measurement loop
+    apiserver = tests_path = kube = agent = kubelet = None
+    vsp_server = mgr = None
     latencies = []
     try:
+        if wire:
+            import yaml
+
+            tests_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tests")
+            sys.path.insert(0, tests_path)
+            from apiserver_fixture import MiniApiServer
+            from dpu_operator_tpu.k8s.real import RealKube
+
+            sa_subject = {"kind": "ServiceAccount",
+                          "name": "tpu-operator-controller-manager",
+                          "namespace": "tpu-operator-system"}
+            apiserver = MiniApiServer(kube=backing)
+            apiserver.rbac_enabled = True
+            apiserver.token_subjects["bench-sa-token"] = sa_subject
+            rbac_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "config", "rbac")
+            for fname in sorted(os.listdir(rbac_dir)):
+                with open(os.path.join(rbac_dir, fname)) as f:
+                    for obj in yaml.safe_load_all(f):
+                        # skip kustomization.yaml & friends — only real
+                        # kubernetes objects belong in the store
+                        if obj and obj.get("kind") and obj.get("apiVersion"):
+                            backing.create(obj)
+            apiserver.start()
+            kube = RealKube(kubeconfig=apiserver.write_kubeconfig(
+                tmp + "/kubeconfig", token="bench-sa-token"))
+        else:
+            kube = backing
+        # the scheduler/kubelet side acts on the backing store directly in
+        # both tiers (it is the cluster, not a client)
+        agent = FakeNodeAgent(backing)
+        agent.start()
+        agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+        kubelet = FakeKubelet(pm, node_agent=agent, node_name="tpu-vm-0")
+        kubelet.start()
+
+        mock = MockTpuVsp(port=0)
+        sock = pm.vendor_plugin_socket()
+        pm.ensure_socket_dir(sock)
+        vsp_server = VspServer(mock, socket_path=sock)
+        vsp_server.start()
+        det = TpuDetector().detection_result(tpu_mode=True,
+                                             identifier="bench")
+        mgr = TpuSideManager(
+            GrpcPlugin(det, path_manager=pm, init_timeout=5.0),
+            pm, client=kube)
+        mgr.device_plugin.poll_interval = 0.1
+
         mgr.start_vsp()
         mgr.setup_devices()
         mgr.listen()
@@ -358,14 +366,30 @@ def bench_pod_ready(n_pods: int, wire: bool = False) -> "list | dict":
             except Exception as e:  # noqa: BLE001 — calibration only
                 print(f"wire RTT calibration failed (ignored): {e}",
                       file=sys.stderr)
-            return {"latencies": latencies, "apiserver_rtt": rtts}
+            # connection-reuse stats from the pooled client: requests
+            # per connection >1 proves keep-alive is actually riding the
+            # wire tier (the fast lane's observable)
+            conn = (kube.connection_stats()
+                    if hasattr(kube, "connection_stats") else {})
+            return {"latencies": latencies, "apiserver_rtt": rtts,
+                    "connections": conn}
     finally:
-        mgr.stop()
-        vsp_server.stop()
-        kubelet.stop()
-        agent.stop()
+        if mgr is not None:
+            mgr.stop()
+        if vsp_server is not None:
+            vsp_server.stop()
+        if kubelet is not None:
+            kubelet.stop()
+        if agent is not None:
+            agent.stop()
         if apiserver is not None:
             apiserver.stop()
+        if wire and kube is not None and hasattr(kube, "close"):
+            kube.close()  # release pooled sockets
+        if tests_path is not None and tests_path in sys.path:
+            sys.path.remove(tests_path)
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
     return latencies
 
 
@@ -483,6 +507,16 @@ def run_sections(sections):
     return results, errors
 
 
+def _p95(samples) -> float:
+    """p95 over a small sample set (nearest-rank; no numpy dependency).
+    ceil(0.95*n)-1, NOT int(0.95*n): the latter lands on the max whenever
+    0.95*n is integral (n=20, the default pod count), silently reporting
+    p100."""
+    import math
+    ordered = sorted(samples)
+    return ordered[max(0, math.ceil(0.95 * len(ordered)) - 1)]
+
+
 def build_payload(results, errors):
     """One JSON-able dict from whatever landed. Headline stays `mfu`
     whenever the train section survived; otherwise the best available
@@ -542,15 +576,29 @@ def build_payload(results, errors):
         if lat:
             payload["pod_schedule_to_ready_p50_wire"] = round(
                 statistics.median(lat), 4)
+            payload["pod_schedule_to_ready_p95_wire"] = round(
+                _p95(lat), 4)
         if isinstance(wire, dict) and wire.get("apiserver_rtt"):
             # one create+get+delete drives ~8 RealKube round-trips
             # through the pod path; the per-RTT median lets a reader
             # bound how much of the wire p50 is fixture, not operator
+            rtts = wire["apiserver_rtt"]
             payload["wire_apiserver_rtt_p50"] = round(
-                statistics.median(wire["apiserver_rtt"]), 5)
+                statistics.median(rtts), 5)
+            payload["wire_apiserver_rtt_p95"] = round(_p95(rtts), 5)
+        if isinstance(wire, dict) and wire.get("connections"):
+            conn = wire["connections"]
+            # >1 request per connection = keep-alive reuse is real on
+            # the wire tier (the pooled-client acceptance gate)
+            payload["wire_requests_per_conn"] = conn.get(
+                "requests_per_connection", 0.0)
+            payload["wire_connections_opened"] = conn.get(
+                "connections_opened", 0)
     if results.get("pods"):
         payload["pod_schedule_to_ready_p50"] = round(
             statistics.median(results["pods"]), 4)
+        payload["pod_schedule_to_ready_p95"] = round(
+            _p95(results["pods"]), 4)
     if train is None:
         # promote a fallback headline so "value" is numeric when another
         # compute metric landed. ONLY fraction-of-roofline metrics are

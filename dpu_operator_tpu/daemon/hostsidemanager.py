@@ -171,7 +171,7 @@ class HostSideManager:
                 topo = info.get("topology", "")
                 if topo:
                     from ..ici import SliceTopology
-                    self._slice_topology = SliceTopology(topo)
+                    self._slice_topology = SliceTopology.cached(topo)
                     self._topology_ok_at = now
             except Exception:  # noqa: BLE001 — decoration is best-effort
                 pass

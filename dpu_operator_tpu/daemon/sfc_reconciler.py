@@ -24,6 +24,17 @@ def _condition(ctype: str, ok: bool, reason: str, message: str) -> dict:
             "reason": reason, "message": message}
 
 
+def _already_exists(e: Exception) -> bool:
+    """409/AlreadyExists across both client flavors (RealKube raises
+    requests.HTTPError with a response; FakeKube raises AlreadyExists)."""
+    from ..k8s.fake import AlreadyExists
+
+    if isinstance(e, AlreadyExists):
+        return True
+    status = getattr(getattr(e, "response", None), "status_code", None)
+    return status == 409
+
+
 class SfcReconciler:
     watches = (API_VERSION, "ServiceFunctionChain")
 
@@ -101,15 +112,33 @@ class SfcReconciler:
             return ReconcileResult()  # pod GC via owner refs
         sfc = ServiceFunctionChain.from_obj(obj)
         scheduled = ready = 0
+        # ONE labeled LIST replaces N per-NF GETs (wire-path fast lane:
+        # this runs every 5 s resync per chain, and each NF pod carries
+        # the "sfc: <name>" label stamped by _network_function_pod)
+        existing_pods = {
+            p["metadata"]["name"]: p
+            for p in client.list("v1", "Pod", namespace=sfc.namespace,
+                                 label_selector={"sfc": sfc.name})}
         for index, nf in enumerate(sfc.network_functions):
             pod = self._network_function_pod(sfc, nf, index)
-            existing = client.get("v1", "Pod", pod["metadata"]["name"],
-                                  namespace=sfc.namespace)
+            name = pod["metadata"]["name"]
+            existing = existing_pods.get(name)
             if existing is None:
-                client.create(pod)
-                log.info("created NF pod %s", pod["metadata"]["name"])
-                scheduled += 1  # created this pass; not yet Running
-                continue
+                try:
+                    client.create(pod)
+                    log.info("created NF pod %s", name)
+                    scheduled += 1  # created this pass; not yet Running
+                    continue
+                except Exception as e:  # noqa: BLE001 — conflict probe
+                    if not _already_exists(e):
+                        raise
+                    # a pod with this name exists but missed the labeled
+                    # LIST (hand-created or pre-label-era): adopt it via
+                    # the old per-name GET instead of crash-looping
+                    existing = client.get("v1", "Pod", name,
+                                          namespace=sfc.namespace)
+                    if existing is None:
+                        continue  # deleted between create and get
             scheduled += 1
             if (existing.get("status", {}).get("phase")) == "Running":
                 ready += 1

@@ -99,7 +99,7 @@ def join_slices(seed_address: str, dial_timeout: float = 5.0,
         if not topo:
             log.warning("slice %s reports no topology; skipping", addr)
             continue
-        slices.append(SliceTopology(topo))
+        slices.append(SliceTopology.cached(topo))
     metrics.SLICE_JOINS.inc(
         outcome="degraded" if (unreachable or truncated) else "ok")
     return JoinResult(group=MultiSliceGroup(slices), members=order,

@@ -208,7 +208,7 @@ class TpuSideManager:
         topology = getattr(self.vsp, "topology", "")
         if topology and self.ici_device_plugin is None:
             from ..ici import SliceTopology
-            topo = SliceTopology(topology)
+            topo = SliceTopology.cached(topology)
             worker = int(os.environ.get("TPU_WORKER_ID", "0"))
             # bootstrap contract: Allocate exports the facts the OPERATOR
             # owns — this host's index in the slice and the slice shape.
@@ -700,9 +700,9 @@ class TpuSideManager:
     # Created on first touch via dict.setdefault (atomic on CPython)
     # instead of __init__, so the many partial managers tests build via
     # TpuSideManager.__new__ need no new boilerplate; grouped here so
-    # every such field is discoverable in one place. Plain value slots
-    # using the same convention: _chains_pending / _chains_flushed
-    # (journal snapshot handoff, see _save_chains_locked/_flush_chains).
+    # every such field is discoverable in one place. Plain value slot
+    # using the same convention: _chains_dirty (journal coalescing
+    # flag, see _save_chains_locked/_flush_chains).
 
     @property
     def _remote_hops(self) -> dict:
@@ -1188,22 +1188,25 @@ class TpuSideManager:
 
     def _save_chains_locked(self):
         """Every wire-table MUTATION site calls this (lock held): keeps
-        the /metrics gauge fresh AND snapshots the chain bookkeeping for
-        the journal, so a daemon restart does not orphan steered hops
-        (VERDICT r4 weak #3b — the native agent's dataplane state
-        survived but the daemon's hop keys did not, so repair/teardown
-        of pre-restart hops silently stopped until pod churn). Only a plain-dict
-        snapshot happens here; serialization AND the disk write run in
-        _flush_chains() after the lock is released — either under
-        _attach_lock would stall every concurrent CNI ADD/DEL."""
+        the /metrics gauge fresh and marks the journal dirty, so a daemon
+        restart does not orphan steered hops (VERDICT r4 weak #3b).
+        Deliberately O(1): a batch of mutations inside one entry point
+        (an ADD wiring several hops, a teardown dropping a whole chain)
+        used to pay an O(state) snapshot per mutation; now the snapshot
+        and disk write happen ONCE per batch, in _flush_chains(), which
+        every public entry point calls after releasing the lock."""
         metrics.CHAIN_HOPS.set(len(self._chain_hops))
-        path = getattr(self, "_chains_file", None)
-        if not path:  # partial managers in tests journal nowhere
-            return
-        # copy mutable leaves: the serializer runs OUTSIDE _attach_lock
-        # (in _flush_chains), so the snapshot must not alias live entry
-        # dicts/lists that keep mutating under the lock
-        data = {
+        if not getattr(self, "_chains_file", None):
+            return  # partial managers in tests journal nowhere
+        metrics.JOURNAL_MUTATIONS.inc()
+        self.__dict__["_chains_dirty"] = True
+
+    def _snapshot_chains_locked(self) -> dict:
+        """Journal snapshot of the wire table (_attach_lock held).
+        Mutable leaves are copied: json serialization runs after the
+        lock is released, so the snapshot must not alias live entry
+        dicts/lists that keep mutating under the lock."""
+        return {
             "chains": [
                 {"namespace": ns, "name": name,
                  "entries": {
@@ -1231,35 +1234,43 @@ class TpuSideManager:
                 for sbx, e in self._attach_store.items()
                 if e.get("wired") and e.get("pair")},
         }
-        self.__dict__["_chains_pending"] = data
 
     def _flush_chains(self):
-        """Write the latest journal snapshot to disk. Called at the END
-        of every public entry point that may have mutated the wire table
-        (locks released); cheap no-op when nothing changed. A crash in
-        the mutation→flush window loses at most the last mutation, which
-        recovery reconciles against the dataplane anyway."""
+        """Coalesced journal writer. Called at the END of every public
+        entry point that may have mutated the wire table (locks
+        released); cheap no-op when nothing changed. One snapshot + one
+        atomic write covers the whole batch of mutations the entry point
+        made — per-mutation snapshotting used to dominate CNI ADD/DEL
+        under chain churn. A crash in the mutation→flush window loses at
+        most that batch, which recovery reconciles against the dataplane
+        anyway.
+
+        _journal_lock serializes writers so a slower thread cannot
+        overwrite a newer snapshot with a stale one; the snapshot is
+        taken under _attach_lock INSIDE it, so whichever writer runs
+        last always persists the newest state."""
         path = getattr(self, "_chains_file", None)
         if not path:
             return
         with self._journal_lock:
-            # read pending INSIDE the lock: reading it before would let
-            # a slower thread overwrite a newer snapshot with its stale
-            # one (the journal would then lose a hop until the next
-            # mutation — or forever, if the daemon crashes first)
-            pending = self.__dict__.get("_chains_pending")
-            if pending is None or pending is self.__dict__.get(
-                    "_chains_flushed"):
-                return
+            with self._attach_lock:
+                if not self.__dict__.get("_chains_dirty"):
+                    return
+                data = self._snapshot_chains_locked()
+                self.__dict__["_chains_dirty"] = False
             try:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 tmp = path + ".tmp"
                 with open(tmp, "w") as f:
-                    json.dump(pending, f)
+                    json.dump(data, f)
                 os.replace(tmp, path)  # atomic: no torn reads
-                self.__dict__["_chains_flushed"] = pending
+                metrics.JOURNAL_FLUSHES.inc()
             except OSError:
                 log.exception("chain journal write failed (%s)", path)
+                with self._attach_lock:
+                    # retry on the next entry point instead of silently
+                    # dropping the batch
+                    self.__dict__["_chains_dirty"] = True
 
     def _recover_chains(self):
         """Rebuild the wire table after a daemon restart: load the
@@ -1460,17 +1471,18 @@ class TpuSideManager:
                     release_atts.append(att[0])
         unwire = None
         with self._attach_lock:
+            # entry None (duplicate/defensive DEL): nothing in memory to
+            # unwind — the attachment release and journal flush still run
+            # below, OUTSIDE the lock (a slow VSP release must not block
+            # other ADD/DELs, and _flush_chains re-acquires _attach_lock,
+            # which is non-reentrant)
             entry = self._attach_store.get(req.sandbox_id)
-            if entry is None:
-                self._release_attachments(release_atts)
-                self._flush_chains()
-                return {}
-            if attachment_id is None:
+            if entry is not None and attachment_id is None:
                 if entry["wired"]:
                     unwire = entry.get("pair")
                 self._attach_store.pop(req.sandbox_id)
                 self._save_chains_locked()
-            elif attachment_id in entry["atts"]:
+            elif entry is not None and attachment_id in entry["atts"]:
                 if entry["wired"] and attachment_id in (
                         entry.get("pair") or ()):
                     unwire = entry.get("pair")

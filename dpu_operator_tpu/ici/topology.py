@@ -14,9 +14,12 @@ cubes with wraparound links on full-cube dimensions.
 
 from __future__ import annotations
 
+import json
 import math
 import re
+import threading
 from dataclasses import dataclass, field
+from typing import ClassVar, Optional
 
 _TOPOLOGY_RE = re.compile(r"^(v[2-6][ep]?)-(\d+)$")
 
@@ -122,6 +125,16 @@ class SliceTopology:
     chips: list = field(init=False, default_factory=list)
     links: list = field(init=False, default_factory=list)
 
+    #: memoized prototypes for :meth:`cached`, keyed on topology string.
+    #: BOUNDED: topology strings reach cached() from remote peers
+    #: (slicejoin GetSliceInfo answers), so an unbounded cache would let
+    #: a buggy/malicious peer stream distinct strings and pin wired
+    #: topologies in daemon memory forever. FIFO eviction; real fleets
+    #: see a handful of distinct topologies.
+    _CACHE: ClassVar[dict] = {}
+    _CACHE_LOCK: ClassVar[threading.Lock] = threading.Lock()
+    _CACHE_MAX: ClassVar[int] = 32
+
     def __post_init__(self):
         self.generation, n = parse_topology(self.topology)
         self.shape = slice_shape(self.topology)
@@ -138,6 +151,67 @@ class SliceTopology:
                                    host=idx // per_host,
                                    local_index=idx % per_host))
         self._wire(dims)
+        self._build_indexes()
+
+    @classmethod
+    def cached(cls, topology: str) -> "SliceTopology":
+        """Memoized construction: wiring a large slice (v5e-256 is 256
+        chips / ~2000 links) costs real time on every daemon poll path
+        that re-derives the topology; the prototype is built once per
+        topology string and each call returns an independent shallow
+        clone (fresh lists and index dicts over the same frozen
+        Chip/IciLink values), so one consumer mutating its copy cannot
+        poison another's."""
+        with cls._CACHE_LOCK:
+            proto = cls._CACHE.get(topology)
+        if proto is None:
+            proto = cls(topology)
+            with cls._CACHE_LOCK:
+                while len(cls._CACHE) >= cls._CACHE_MAX:
+                    cls._CACHE.pop(next(iter(cls._CACHE)))
+                cls._CACHE.setdefault(topology, proto)
+        return proto._clone()
+
+    def _clone(self) -> "SliceTopology":
+        new = object.__new__(type(self))
+        new.topology = self.topology
+        new.generation = self.generation
+        new.shape = self.shape
+        new.chips = list(self.chips)
+        new.links = list(self.links)
+        new._links_by_src = {k: list(v)
+                             for k, v in self._links_by_src.items()}
+        new._chips_by_host = {k: list(v)
+                              for k, v in self._chips_by_host.items()}
+        new._links_by_host = {k: list(v)
+                              for k, v in self._links_by_host.items()}
+        new._link_by_id = dict(self._link_by_id)
+        new._chip_by_id = dict(self._chip_by_id)
+        new._dict_json = self._dict_json  # immutable string; shareable
+        return new
+
+    def _build_indexes(self):
+        """Precomputed adjacency views (ISSUE: daemon lookups were
+        O(links) scans per device-plugin poll). Built by one pass over
+        the wired lists so every index preserves global link order —
+        the scan methods below stay order-identical to the old
+        comprehensions, just O(result) instead of O(links)."""
+        by_src: dict = {}
+        by_host_chips: dict = {}
+        by_host_links: dict = {}
+        host_of = {}
+        for c in self.chips:
+            by_host_chips.setdefault(c.host, []).append(c)
+            host_of[c.index] = c.host
+        for l in self.links:
+            by_src.setdefault(l.src, []).append(l)
+            by_host_links.setdefault(host_of[l.src], []).append(l)
+        self._links_by_src = by_src
+        self._chips_by_host = by_host_chips
+        self._links_by_host = by_host_links
+        self._link_by_id = {l.id: l for l in self.links}
+        self._chip_by_id = {c.id: c for c in self.chips}
+        self._dict_json: Optional[str] = None
 
     def _index(self, coords: tuple) -> int:
         idx = 0
@@ -177,14 +251,25 @@ class SliceTopology:
         return 1 + max(c.host for c in self.chips)
 
     def chips_on_host(self, host: int) -> list:
-        return [c for c in self.chips if c.host == host]
+        """O(result) view over the host index (was an O(chips) scan)."""
+        return list(self._chips_by_host.get(host, ()))
 
     def links_from(self, chip_index: int) -> list:
-        return [l for l in self.links if l.src == chip_index]
+        """O(result) view over the adjacency index (was O(links))."""
+        return list(self._links_by_src.get(chip_index, ()))
 
     def ici_ports_on_host(self, host: int) -> list:
-        local = {c.index for c in self.chips_on_host(host)}
-        return [l for l in self.links if l.src in local]
+        """O(result) view, global-link-order preserving (was O(links)
+        per device-plugin ListAndWatch poll)."""
+        return list(self._links_by_host.get(host, ()))
+
+    def link_by_id(self, link_id: str) -> Optional[IciLink]:
+        """Resolve an ici-port endpoint id ("ici-<chip>-<port>") O(1)."""
+        return self._link_by_id.get(link_id)
+
+    def chip_by_id(self, chip_id: str) -> Optional[Chip]:
+        """Resolve a device id ("chip-<n>") O(1)."""
+        return self._chip_by_id.get(chip_id)
 
     # -- bandwidth model (feeds bench + traffic tests) -----------------------
     def bisection_bandwidth_gbps(self) -> float:
@@ -218,22 +303,30 @@ class SliceTopology:
         return bytes_per_chip / (2 * (n - 1) * step_s) / 1e9
 
     def to_dict(self) -> dict:
-        return {
-            "topology": self.topology,
-            "generation": self.generation,
-            "shape": list(self.shape),
-            "numChips": self.num_chips,
-            "numHosts": self.num_hosts,
-            "chips": [
-                {"id": c.id, "index": c.index, "coords": list(c.coords),
-                 "host": c.host}
-                for c in self.chips
-            ],
-            "links": [
-                {"id": l.id, "src": l.src, "dst": l.dst, "port": l.port}
-                for l in self.links
-            ],
-        }
+        """Serialized wiring. Cached as a JSON string after the first
+        call (the per-chip/per-link dict build is the expensive part for
+        serialization consumers like MultiSliceGroup.to_dict); every
+        call deserializes a fresh copy so callers can mutate their
+        result without poisoning the cache."""
+        if self._dict_json is None:
+            self._dict_json = json.dumps({
+                "topology": self.topology,
+                "generation": self.generation,
+                "shape": list(self.shape),
+                "numChips": self.num_chips,
+                "numHosts": self.num_hosts,
+                "chips": [
+                    {"id": c.id, "index": c.index,
+                     "coords": list(c.coords), "host": c.host}
+                    for c in self.chips
+                ],
+                "links": [
+                    {"id": l.id, "src": l.src, "dst": l.dst,
+                     "port": l.port}
+                    for l in self.links
+                ],
+            })
+        return json.loads(self._dict_json)
 
 
 @dataclass

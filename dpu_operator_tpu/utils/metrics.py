@@ -61,10 +61,13 @@ class Histogram:
     DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                        5.0, 10.0, 30.0, 60.0, 120.0)
 
-    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS):
+    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS,
+                 const_labels: Optional[dict] = None):
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets)
+        #: fixed label set rendered on every sample (HistogramVec children)
+        self.const_labels = tuple(sorted((const_labels or {}).items()))
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._lock = threading.Lock()
@@ -82,18 +85,66 @@ class Histogram:
     def time(self):
         return _Timer(self)
 
-    def _render(self) -> list:
-        out = [f"# HELP {self.name} {self.help}",
-               f"# TYPE {self.name} histogram"]
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _render(self, with_header: bool = True) -> list:
+        out = ([f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} histogram"] if with_header else [])
+        extra = "".join(f',{k}="{v}"' for k, v in self.const_labels)
+        base = (_labels(self.const_labels) if self.const_labels else "")
         with self._lock:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += self._counts[i]
-                out.append(f'{self.name}_bucket{{le="{_num(b)}"}} {cum}')
+                out.append(
+                    f'{self.name}_bucket{{le="{_num(b)}"{extra}}} {cum}')
             cum += self._counts[-1]
-            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-            out.append(f"{self.name}_sum {_num(self._sum)}")
-            out.append(f"{self.name}_count {cum}")
+            out.append(f'{self.name}_bucket{{le="+Inf"{extra}}} {cum}')
+            out.append(f"{self.name}_sum{base} {_num(self._sum)}")
+            out.append(f"{self.name}_count{base} {cum}")
+        return out
+
+
+class HistogramVec:
+    """Histogram family keyed on one label (e.g. per-verb apiserver
+    latency): children share the metric name and buckets; HELP/TYPE are
+    emitted once for the family, per Prometheus exposition rules."""
+
+    def __init__(self, name: str, help_: str, label: str,
+                 buckets=Histogram.DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.label = label
+        self.buckets = tuple(buckets)
+        self._children: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value: str) -> Histogram:
+        with self._lock:
+            child = self._children.get(value)
+            if child is None:
+                child = Histogram(self.name, self.help, self.buckets,
+                                  const_labels={self.label: value})
+                self._children[value] = child
+            return child
+
+    def observe(self, value: str, seconds: float):
+        self.labels(value).observe(seconds)
+
+    def _render(self) -> list:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for _, child in children:
+            out.extend(child._render(with_header=False))
         return out
 
 
@@ -134,6 +185,10 @@ class Registry:
 
     def histogram(self, name: str, help_: str, **kw) -> Histogram:
         return self._add(Histogram(name, help_, **kw))
+
+    def histogram_vec(self, name: str, help_: str, label: str,
+                      **kw) -> HistogramVec:
+        return self._add(HistogramVec(name, help_, label, **kw))
 
     def _add(self, metric):
         with self._lock:
@@ -182,6 +237,29 @@ PORT_AFFINITY = REGISTRY.counter(
     "ICI-port preferred allocations by result (aligned = ports ride the "
     "pod's own recent chip allocation; fallback = kubelet allocated "
     "ports before chips, clustering pick used)")
+# -- wire-path fast lane (pooled apiserver client + journal coalescing) ------
+KUBE_REQUEST_SECONDS = REGISTRY.histogram_vec(
+    "tpu_kube_client_request_seconds",
+    "Apiserver request latency through RealKube, by verb", label="verb",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+KUBE_REQUESTS = REGISTRY.counter(
+    "tpu_kube_client_requests_total",
+    "Apiserver requests by verb and transport (pooled/session)")
+KUBE_CONNECTIONS = REGISTRY.counter(
+    "tpu_kube_client_connections_total",
+    "HTTPS connections opened by the pooled apiserver client "
+    "(requests_total / connections_total = keep-alive reuse factor)")
+KUBE_STALE_RECONNECTS = REGISTRY.counter(
+    "tpu_kube_client_stale_reconnects_total",
+    "Pooled connections found dead on reuse and replaced mid-request")
+JOURNAL_MUTATIONS = REGISTRY.counter(
+    "tpu_daemon_journal_mutations_total",
+    "Chain wire-table mutations marked for journaling")
+JOURNAL_FLUSHES = REGISTRY.counter(
+    "tpu_daemon_journal_flushes_total",
+    "Chain journal disk writes (mutations_total / flushes_total = "
+    "coalescing factor)")
 
 
 class TokenReviewAuth:

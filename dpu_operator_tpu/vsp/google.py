@@ -120,7 +120,7 @@ class GoogleTpuVsp:
             accel_type = self.platform.accelerator_type()
             topo = (accelerator_type_to_topology(accel_type)
                     if accel_type else "v5e-4")
-            self.topology = SliceTopology(topo)
+            self.topology = SliceTopology.cached(topo)
             self.dataplane.init_dataplane(self.topology)
         # Return the comm channel endpoint — host side dials it, tpu side
         # binds its slice-attachment server there (marvell/main.go:691-725) —
@@ -292,7 +292,28 @@ class GoogleTpuVsp:
         }
 
     # -- NetworkFunctionService ----------------------------------------------
+    #: port-addressed endpoint ids ("ici-<chip>-<port>", IciLink.id);
+    #: attachment-id endpoints have no port-level existence to check
+    _ICI_ENDPOINT_RE = re.compile(r"^ici-(\d+)-(.+)$")
+
+    def _check_port_endpoint(self, endpoint: str):
+        """Flag a port-addressed endpoint absent from the programmed
+        topology (O(1) via the link_by_id index): such a hop rides a
+        port the torus does not have, i.e. a likely blackhole that
+        would otherwise only surface when traffic dies. Warn, don't
+        raise — endpoints are symbolic until the attach wires them, and
+        steering must stay permissive under topology drift."""
+        if self.topology is None:
+            return
+        if (self._ICI_ENDPOINT_RE.match(endpoint)
+                and self.topology.link_by_id(endpoint) is None):
+            log.warning("NF wire endpoint %s names no ICI port of "
+                        "topology %s — likely blackholed hop",
+                        endpoint, self.topology.topology)
+
     def create_network_function(self, req: dict) -> dict:
+        for endpoint in (req.get("input", ""), req.get("output", "")):
+            self._check_port_endpoint(endpoint)
         self.dataplane.wire_network_function(
             req.get("input", ""), req.get("output", ""))
         return {}

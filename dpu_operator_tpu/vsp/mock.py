@@ -24,7 +24,7 @@ class MockTpuVsp:
         self.slice_attachments: dict[str, dict] = {}
         self.network_functions: list[tuple] = []
         self.init_requests: list[dict] = []
-        self._slice = SliceTopology(topology)
+        self._slice = SliceTopology.cached(topology)
         self._lock = threading.Lock()
 
     # -- LifeCycleService -----------------------------------------------------

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from .smap import shard_map
 
 
 def psum_allreduce(mesh: Mesh, axis: str = "model"):
@@ -92,7 +93,7 @@ def all_to_all_exchange(mesh: Mesh, axis: str = "model"):
     expert sharding)."""
     spec = P(axis, None)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+    @partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
              check_vma=False)
     def _a2a(x):
         # local x: (n, chunk) — one outgoing chunk per peer
@@ -110,7 +111,7 @@ def ppermute_hop(mesh: Mesh, axis: str = "model"):
     perm = [(i, (i + 1) % n) for i in range(n)]
     spec = P(axis)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+    @partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
              check_vma=False)
     def _hop(x):
         return lax.ppermute(x, axis, perm)

@@ -69,7 +69,7 @@ def mesh_for_topology(topology: str | SliceTopology,
     first (data) axis, matching how dp tolerates longer hop counts.
     """
     topo = (topology if isinstance(topology, SliceTopology)
-            else SliceTopology(topology))
+            else SliceTopology.cached(topology))
     shape = topo.shape
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) != topo.num_chips:

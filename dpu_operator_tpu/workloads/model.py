@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from .smap import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -172,8 +174,8 @@ def _flash_attn(mesh: Mesh | None, block_q: int, block_k: int):
     spec = P(_batch_axes(mesh), None, "model", None)
     # check_vma=False: pallas_call's ShapeDtypeStruct outputs carry no vma
     # annotation, which the default varying-mesh-axes check rejects
-    return jax.shard_map(call, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)
+    return shard_map(call, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)
 
 
 def _rmsnorm(x, scale):
@@ -299,7 +301,13 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh):
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
         updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        new_params = optax.apply_updates(params, updates)
+        # pin the output placement to param_specs: GSPMD inference is
+        # free to re-shard otherwise (observed on jax 0.4.x: ulysses-mode
+        # params came back P("model") instead of replicated, breaking the
+        # sequence-mode contract that all of "model" is spent on S)
+        new_params = jax.lax.with_sharding_constraint(new_params, pshard)
+        return new_params, opt_state, loss
 
     def init_state(rng):
         params = jax.device_put(init_params(rng, cfg), pshard)

@@ -19,7 +19,9 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from .smap import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

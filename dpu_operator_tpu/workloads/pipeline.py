@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .smap import shard_map
+
 
 def _layer_fwd(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
     """One dense (non-tp) transformer layer — the per-stage unit (norm
@@ -116,7 +118,7 @@ def make_pipeline_forward(cfg, mesh: Mesh,
         act_spec = P(None, data_dim, None, None)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            shard_map, mesh=mesh,
             in_specs=(pipeline_param_specs()["stages"], act_spec),
             out_specs=act_spec, check_vma=False)
         def run(stages, xm):

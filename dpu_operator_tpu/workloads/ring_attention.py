@@ -19,7 +19,9 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from .smap import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG_INF = -1e30

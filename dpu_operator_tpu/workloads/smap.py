@@ -1,0 +1,30 @@
+"""Version-portable ``shard_map``.
+
+``shard_map`` graduated out of ``jax.experimental`` around jax 0.6 and its
+replication-check keyword was renamed ``check_rep`` -> ``check_vma`` in the
+process. The workload modules are written against the new surface
+(``from jax import shard_map`` + ``check_vma=``); this shim keeps them
+importable on the 0.4.x toolchain baked into the container by falling back
+to ``jax.experimental.shard_map`` and translating the keyword.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: public API, check_vma keyword
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental API, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` with the replication-check keyword translated to
+    whatever this jax version calls it. Used via ``partial`` exactly like
+    the real thing."""
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -23,7 +23,9 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-from jax import lax, shard_map
+from jax import lax
+
+from .smap import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

@@ -43,13 +43,34 @@ KNOWN_KINDS = [
 _PLURAL_TO_KIND = {plural(k): k for k in KNOWN_KINDS}
 
 
+def _make_cert_openssl(tmpdir: str) -> tuple[str, str]:
+    """Cert generation via the openssl CLI — fallback for environments
+    without the ``cryptography`` package (``req -x509`` already marks the
+    cert CA:TRUE; adding basicConstraints again would duplicate the
+    extension and break verification)."""
+    import subprocess
+
+    cert_path = tmpdir + "/apiserver.crt"
+    key_path = tmpdir + "/apiserver.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+         "-keyout", key_path, "-out", cert_path, "-days", "1", "-nodes",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True)
+    return cert_path, key_path
+
+
 def make_self_signed_cert(tmpdir: str) -> tuple[str, str]:
     """Self-signed cert for 127.0.0.1; doubles as its own CA.
     Returns (cert_path, key_path)."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        return _make_cert_openssl(tmpdir)
 
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
@@ -188,6 +209,10 @@ def _call_webhook(url: str, ca_bundle_b64: str, review: dict,
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "MiniApiServer/1.0"
+    # Nagle + client delayed-ACK turns the headers-then-body write pair
+    # into a ~40 ms stall per response on some kernels; real apiservers
+    # set TCP_NODELAY too (Go's net/http does it on every conn)
+    disable_nagle_algorithm = True
 
     # quiet request logging
     def log_message(self, fmt, *args):  # noqa: D102

@@ -1,0 +1,103 @@
+"""Topology index consistency: the precomputed adjacency indexes must be
+views of the same wiring the list scans used to derive (wire-path fast
+lane, ISSUE 1 satellite).
+
+Every indexed lookup is checked against a reference scan over the flat
+``links``/``chips`` lists for a grid of v5e (2D mesh/torus) and v5p (3D
+torus) shapes, including the extent-2 dimensions whose links must stay
+deduplicated, plus the memoized-construction cache's independence
+guarantees.
+"""
+
+import pytest
+
+from dpu_operator_tpu.ici import SliceTopology
+from dpu_operator_tpu.ici.topology import PORTS_PER_CHIP
+
+#: v5e: 2D shapes incl. extent-2 dims (2x2, 2x4) and tori (8x8);
+#: v5p: 3D shapes incl. the 4x4x4 full cube and extent-2 dims (2x2x2)
+GRID = ["v5e-4", "v5e-8", "v5e-16", "v5e-64", "v5p-8", "v5p-16",
+        "v5p-32", "v5p-64"]
+
+
+@pytest.mark.parametrize("topology", GRID)
+def test_links_from_matches_scan(topology):
+    s = SliceTopology(topology)
+    for chip in s.chips:
+        scan = [l for l in s.links if l.src == chip.index]
+        assert s.links_from(chip.index) == scan
+
+
+@pytest.mark.parametrize("topology", GRID)
+def test_host_indexes_match_scan(topology):
+    s = SliceTopology(topology)
+    for host in range(s.num_hosts):
+        assert s.chips_on_host(host) == [
+            c for c in s.chips if c.host == host]
+        local = {c.index for c in s.chips_on_host(host)}
+        assert s.ici_ports_on_host(host) == [
+            l for l in s.links if l.src in local]
+
+
+@pytest.mark.parametrize("topology", GRID)
+def test_id_maps_resolve_every_element(topology):
+    s = SliceTopology(topology)
+    for c in s.chips:
+        assert s.chip_by_id(c.id) is c
+    for l in s.links:
+        assert s.link_by_id(l.id) is l
+    assert s.chip_by_id("chip-9999") is None
+    assert s.link_by_id("ici-0-nope") is None
+
+
+@pytest.mark.parametrize("topology", GRID)
+def test_extent2_dims_stay_deduplicated(topology):
+    """Extent-2 dimensions produce ONE link pair per neighbor couple —
+    no duplicate (src, dst, dim) either in the flat list or through the
+    indexes."""
+    s = SliceTopology(topology)
+    triples = [(l.src, l.dst, l.dim) for l in s.links]
+    assert len(triples) == len(set(triples))
+    # the per-chip degree the index reports must match the torus rule:
+    # one port per extent>=3 dimension direction, one per extent-2 dim,
+    # zero on extent-1 dims
+    want_degree = sum(
+        0 if extent == 1 else (1 if extent == 2 else 2)
+        for extent in s.shape)
+    for chip in s.chips:
+        assert len(s.links_from(chip.index)) == want_degree
+        assert want_degree <= PORTS_PER_CHIP[s.generation]
+
+
+def test_cached_returns_equal_but_independent_state():
+    a = SliceTopology.cached("v5e-16")
+    b = SliceTopology.cached("v5e-16")
+    fresh = SliceTopology("v5e-16")
+    assert a is not b
+    assert a.chips == b.chips == fresh.chips
+    assert a.links == b.links == fresh.links
+    assert a.to_dict() == fresh.to_dict()
+    # mutating one clone's lists must not leak into the other (or into
+    # a later cache hit)
+    a.links.append("junk")
+    a.chips.pop()
+    assert "junk" not in b.links
+    assert len(b.chips) == 16
+    c = SliceTopology.cached("v5e-16")
+    assert "junk" not in c.links and len(c.chips) == 16
+
+
+def test_cached_to_dict_copies_are_independent():
+    s = SliceTopology.cached("v5p-8")
+    d1 = s.to_dict()
+    d1["chips"][0]["id"] = "poisoned"
+    d1["links"].clear()
+    d2 = s.to_dict()
+    assert d2["chips"][0]["id"] == "chip-0"
+    assert len(d2["links"]) == len(s.links)
+
+
+@pytest.mark.parametrize("topology", ["v5e-16", "v5p-32"])
+def test_cached_matches_fresh_across_generations(topology):
+    assert (SliceTopology.cached(topology).to_dict()
+            == SliceTopology(topology).to_dict())

@@ -1,0 +1,338 @@
+"""Wire-path fast lane: pooled apiserver client, coalesced journal
+writes, and the SFC reconciler's batched pod listing (ISSUE 1 tentpole).
+
+The pool is exercised against the real HTTPS MiniApiServer (keep-alive
+reuse, stale-socket reconnect); journal coalescing and LIST batching are
+asserted at the call-count level — the behaviors the bench's
+`wire_requests_per_conn` counter and the journal metrics guard.
+"""
+
+import threading
+
+import pytest
+
+from apiserver_fixture import MiniApiServer
+from dpu_operator_tpu.daemon.sfc_reconciler import SfcReconciler
+from dpu_operator_tpu.daemon.tpusidemanager import TpuSideManager
+from dpu_operator_tpu.k8s.fake import FakeKube
+from dpu_operator_tpu.k8s.manager import Request
+from dpu_operator_tpu.k8s.real import RealKube
+from dpu_operator_tpu.utils import metrics
+
+
+@pytest.fixture()
+def wire_kube(tmp_path):
+    srv = MiniApiServer().start()
+    kube = RealKube(kubeconfig=srv.write_kubeconfig(
+        str(tmp_path / "kubeconfig")))
+    yield kube
+    kube.close()
+    srv.stop()
+
+
+# -- pooled client ------------------------------------------------------------
+def test_pool_reuses_one_connection_across_requests(wire_kube):
+    kube = wire_kube
+    assert kube.pool is not None, "direct HTTPS must ride the pool"
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "cm", "namespace": "default"},
+                 "data": {"a": "1"}})
+    for _ in range(10):
+        assert kube.get("v1", "ConfigMap", "cm",
+                        namespace="default") is not None
+    stats = kube.connection_stats()
+    assert stats["connections_opened"] == 1
+    assert stats["requests"] == 11
+    assert stats["requests_per_connection"] > 1
+
+
+def test_pool_reconnects_on_stale_socket(wire_kube):
+    kube = wire_kube
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "cm2", "namespace": "default"},
+                 "data": {}})
+    # kill the idle pooled socket under the client — the apiserver
+    # dropping a keep-alive connection while it idles
+    with kube.pool._lock:
+        assert kube.pool._idle
+        for conn in kube.pool._idle:
+            conn.sock.close()
+    assert kube.get("v1", "ConfigMap", "cm2",
+                    namespace="default") is not None
+    stats = kube.connection_stats()
+    assert stats["stale_reconnects"] >= 1
+    assert stats["connections_opened"] == 2  # one fresh dial, not a storm
+
+
+def test_pool_retry_bypasses_other_stale_idle_sockets(wire_kube):
+    """An idle timeout kills EVERY parked socket at once: the retry
+    after the first stale hit must dial fresh, not check out the next
+    (equally dead) idle connection."""
+    kube = wire_kube
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "cm3", "namespace": "default"},
+                 "data": {}})
+    # park a second connection, then kill both while they idle
+    import concurrent.futures as cf
+    with cf.ThreadPoolExecutor(2) as ex:
+        list(ex.map(lambda _: kube.get("v1", "ConfigMap", "cm3",
+                                       namespace="default"), range(2)))
+    with kube.pool._lock:
+        assert len(kube.pool._idle) >= 2
+        for conn in kube.pool._idle:
+            conn.sock.close()
+    assert kube.get("v1", "ConfigMap", "cm3",
+                    namespace="default") is not None
+
+
+def test_duplicate_cni_del_does_not_deadlock(tmp_path):
+    """A DEL for a sandbox with no in-memory entry (duplicate/defensive
+    DEL) must complete: _flush_chains re-acquires _attach_lock, so the
+    entry-None path has to release the lock first (review finding)."""
+    from dpu_operator_tpu.cni import NetConfCache
+    from dpu_operator_tpu.cni.types import NetConf, PodRequest
+
+    mgr = _lean_mgr(tmp_path)
+    mgr.ipam_dir = str(tmp_path / "ipam")
+    mgr.nf_cache = NetConfCache(str(tmp_path / "nf"))
+    req = PodRequest(command="DEL", pod_namespace="default",
+                     pod_name="p", sandbox_id="sbx-none", netns="",
+                     ifname="net1", device_id="chip-0",
+                     netconf=NetConf.from_dict({"cniVersion": "0.4.0",
+                                                "type": "tpu-cni"}))
+    done = []
+    t = threading.Thread(target=lambda: done.append(
+        mgr._cni_nf_del(req)), daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert done == [{}], "duplicate DEL deadlocked"
+
+
+def test_pool_timeout_is_not_retried_as_stale(wire_kube):
+    """A per-request timeout is a caller DEADLINE (the leader lease
+    sizes one attempt per renew period): the pool must surface it
+    within the bound, never burn a second attempt on a fresh dial."""
+    import time
+
+    kube = wire_kube
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "slow", "namespace": "default"},
+                 "data": {}})
+    kube.get("v1", "ConfigMap", "slow", namespace="default")  # warm conn
+    # stall the apiserver by patching the fixture's backing store
+    from dpu_operator_tpu.k8s import fake as fake_mod
+    orig = fake_mod.FakeKube.get
+
+    def slow_get(self, *a, **kw):
+        time.sleep(1.0)
+        return orig(self, *a, **kw)
+
+    fake_mod.FakeKube.get = slow_get
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as exc:
+            kube.get("v1", "ConfigMap", "slow", namespace="default",
+                     timeout=0.2)
+        elapsed = time.monotonic() - t0
+    finally:
+        fake_mod.FakeKube.get = orig
+    assert isinstance(exc.value, TimeoutError)
+    assert elapsed < 0.8, f"timeout doubled by a retry: {elapsed:.2f}s"
+    assert kube.connection_stats()["stale_reconnects"] == 0
+
+
+def test_pool_latency_histogram_observes_per_verb(wire_kube):
+    before = metrics.KUBE_REQUEST_SECONDS.labels("get").count
+    wire_kube.get("v1", "ConfigMap", "absent", namespace="default")
+    assert metrics.KUBE_REQUEST_SECONDS.labels("get").count == before + 1
+    rendered = "\n".join(metrics.KUBE_REQUEST_SECONDS._render())
+    assert 'verb="get"' in rendered
+
+
+def test_pool_concurrent_requests_are_consistent(wire_kube):
+    kube = wire_kube
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "cc", "namespace": "default"},
+                 "data": {"k": "v"}})
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(20):
+                got = kube.get("v1", "ConfigMap", "cc",
+                               namespace="default")
+                assert got["data"]["k"] == "v"
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    stats = kube.connection_stats()
+    # 81 requests over at most 4 parallel sockets: reuse must dominate
+    assert stats["requests"] == 81
+    assert stats["connections_opened"] <= 4
+
+
+def test_pool_preserves_base_url_path_prefix():
+    """Proxied apiserver endpoints carry a path prefix
+    (https://host/k8s/clusters/c-abc): the pool must re-apply it to the
+    base-relative paths RealKube passes."""
+    import ssl
+
+    from dpu_operator_tpu.k8s.pool import HttpsConnectionPool
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    pool = HttpsConnectionPool("https://h:1/k8s/clusters/c-abc/", ctx)
+    assert pool.path_prefix == "/k8s/clusters/c-abc"
+    assert HttpsConnectionPool("https://h:1", ctx).path_prefix == ""
+
+
+def test_pool_decodes_gzip_responses():
+    """The pool advertises Accept-Encoding: gzip (apiserver compresses
+    big LISTs) and must decode transparently."""
+    import gzip
+
+    from dpu_operator_tpu.k8s.pool import _decode_body
+
+    body = b'{"items": []}'
+    assert _decode_body({"Content-Encoding": "gzip"},
+                        gzip.compress(body)) == body
+    assert _decode_body({}, body) == body
+    assert _decode_body({"content-encoding": "GZIP"},
+                        gzip.compress(body)) == body
+
+
+def test_topology_cache_is_bounded():
+    """Topology strings reach cached() from remote peers (slicejoin):
+    the prototype cache must evict, not grow forever."""
+    from dpu_operator_tpu.ici import SliceTopology
+
+    for n in (4, 8, 16, 32):
+        for gen in ("v5e", "v5p", "v2", "v3", "v4", "v6e"):
+            SliceTopology.cached(f"{gen}-{n}")
+    assert len(SliceTopology._CACHE) <= SliceTopology._CACHE_MAX
+    # cache still functions after eviction pressure
+    s = SliceTopology.cached("v5e-16")
+    assert s.num_chips == 16
+
+
+def test_bench_p95_is_not_the_max():
+    """Nearest-rank p95 at n=20 (the default pod count) must pick the
+    19th sample, not the max (int(0.95*20)=19 was off by one)."""
+    import bench
+
+    samples = list(range(1, 21))
+    assert bench._p95(samples) == 19
+    assert bench._p95([5.0]) == 5.0
+    assert bench._p95(list(range(1, 11))) == 10  # ceil(9.5)-1 = idx 9
+
+
+# -- journal coalescing -------------------------------------------------------
+def _lean_mgr(tmp_path):
+    m = TpuSideManager.__new__(TpuSideManager)
+    m.vsp = None
+    m.client = None
+    m._attach_store = {}
+    m._attach_lock = threading.Lock()
+    m._chain_store = {}
+    m._chain_hops = {}
+    m._degraded_hops = set()
+    m._chains_file = str(tmp_path / "cache" / "chains.json")
+    return m
+
+
+def test_journal_coalesces_mutation_batch_into_one_write(tmp_path):
+    mgr = _lean_mgr(tmp_path)
+    flushes0 = metrics.JOURNAL_FLUSHES.value()
+    with mgr._attach_lock:
+        for i in range(10):
+            mgr._chain_hops[("default", "sfc", i)] = (f"a{i}", f"b{i}")
+            mgr._save_chains_locked()  # 10 mutations...
+    mgr._flush_chains()  # ...one writer
+    assert metrics.JOURNAL_FLUSHES.value() == flushes0 + 1
+    import json
+    with open(mgr._chains_file) as f:
+        assert len(json.load(f)["hops"]) == 10
+
+
+def test_journal_flush_is_noop_when_clean(tmp_path):
+    import os
+    mgr = _lean_mgr(tmp_path)
+    with mgr._attach_lock:
+        mgr._chain_hops[("default", "s", 0)] = ("a", "b")
+        mgr._save_chains_locked()
+    mgr._flush_chains()
+    mtime = os.path.getmtime(mgr._chains_file)
+    flushes = metrics.JOURNAL_FLUSHES.value()
+    mgr._flush_chains()  # nothing dirty: no write
+    assert metrics.JOURNAL_FLUSHES.value() == flushes
+    assert os.path.getmtime(mgr._chains_file) == mtime
+
+
+def test_journal_roundtrips_through_recovery(tmp_path):
+    """Coalesced writes must still persist exactly what recovery needs
+    (same contract the per-mutation journal had)."""
+    mgr = _lean_mgr(tmp_path)
+    with mgr._attach_lock:
+        mgr._chain_hops[("default", "sfc", 0)] = ("out0", "in1")
+        mgr._degraded_hops.add(("default", "sfc", 0))
+        mgr._save_chains_locked()
+    mgr._flush_chains()
+
+    class _NoListVsp:
+        pass  # no list_network_functions: journal trusted as-is
+
+    fresh = _lean_mgr(tmp_path)
+    fresh.vsp = _NoListVsp()
+    fresh._recover_chains()
+    assert fresh._chain_hops[("default", "sfc", 0)] == ("out0", "in1")
+    assert ("default", "sfc", 0) in fresh._degraded_hops
+
+
+# -- reconciler LIST batching -------------------------------------------------
+class _CountingKube(FakeKube):
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def get(self, api_version, kind, name, namespace=None, **kw):
+        self.calls.append(("get", kind, name))
+        return super().get(api_version, kind, name, namespace=namespace)
+
+    def list(self, api_version, kind, namespace=None, label_selector=None):
+        self.calls.append(("list", kind, tuple(sorted(
+            (label_selector or {}).items()))))
+        return super().list(api_version, kind, namespace=namespace,
+                            label_selector=label_selector)
+
+
+def test_reconciler_lists_nf_pods_once_per_chain():
+    from dpu_operator_tpu.api.types import API_VERSION
+
+    kube = _CountingKube()
+    kube.create({"apiVersion": API_VERSION, "kind": "ServiceFunctionChain",
+                 "metadata": {"name": "chain", "namespace": "default"},
+                 "spec": {"networkFunctions": [
+                     {"name": "f0"}, {"name": "f1"}, {"name": "f2"}]}})
+    rec = SfcReconciler(workload_image="img")
+    rec.reconcile(kube, Request(API_VERSION, "ServiceFunctionChain",
+                                "chain", namespace="default"))
+    pod_gets = [c for c in kube.calls if c[0] == "get" and c[1] == "Pod"]
+    pod_lists = [c for c in kube.calls if c[0] == "list" and c[1] == "Pod"]
+    assert not pod_gets, "per-NF pod GETs must be batched into the LIST"
+    assert pod_lists == [("list", "Pod", (("sfc", "chain"),))]
+    # created NF pods carry the label the LIST selects on
+    pods = kube.list("v1", "Pod", namespace="default",
+                     label_selector={"sfc": "chain"})
+    assert sorted(p["metadata"]["name"] for p in pods) == [
+        "chain-f0", "chain-f1", "chain-f2"]
+    # second pass sees all three as existing without any Pod GET
+    kube.calls.clear()
+    rec.reconcile(kube, Request(API_VERSION, "ServiceFunctionChain",
+                                "chain", namespace="default"))
+    assert not [c for c in kube.calls
+                if c[0] == "get" and c[1] == "Pod"]
