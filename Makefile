@@ -3,8 +3,8 @@ PYTHON ?= python
 REGISTRY ?= localhost:5000
 TAG ?= latest
 
-.PHONY: test fast-test collect-check bench native traffic-flow images \
-        smoke-images deploy undeploy graft-check clean
+.PHONY: test fast-test collect-check chaos-check bench native traffic-flow \
+        images smoke-images deploy undeploy graft-check clean
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -19,6 +19,15 @@ fast-test: native
 # output to per-file counts while error tracebacks still print)
 collect-check:
 	$(PYTHON) -m pytest tests/ -qq --collect-only
+
+# scripted-fault matrix (utils/resilience.py + testing/chaos.py): every
+# recovery path — apiserver reset, VSP crash mid-call, CNI ADD transient
+# failure, journal truncation — replayed deterministically. Seeds are
+# pinned in the tests; PYTHONHASHSEED pins dict-order-sensitive paths so
+# a failure reproduces bit-identically.
+chaos-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m chaos \
+	  -p no:randomly -p no:cacheprovider
 
 # flake detector (reference: ginkgo --repeat 4 in `task test`)
 test-repeat: native

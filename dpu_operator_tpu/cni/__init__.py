@@ -1,9 +1,11 @@
-from .types import CniRequest, CniResponse, PodRequest, NetConf, CNI_TIMEOUT
+from .types import (AlreadyGone, CniRequest, CniResponse, PodRequest,
+                    NetConf, CNI_TIMEOUT)
 from .server import CniServer
 from .shim import CniShim
 from .cache import NetConfCache, ChipAllocator
 
 __all__ = [
+    "AlreadyGone",
     "CniRequest",
     "CniResponse",
     "PodRequest",

@@ -13,14 +13,21 @@ import logging
 import os
 import socketserver
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutTimeout
 from http.server import BaseHTTPRequestHandler
 from typing import Callable, Optional
 
-from ..utils import metrics
+from ..utils import metrics, resilience
 from ..utils.tracing import span
 from .logging import request_logger
-from .types import CNI_TIMEOUT, CniRequest, CniResponse, PodRequest
+from .types import (
+    CNI_TIMEOUT,
+    AlreadyGone,
+    CniRequest,
+    CniResponse,
+    PodRequest,
+)
 
 log = logging.getLogger(__name__)
 
@@ -40,14 +47,24 @@ class _UnixHTTPServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer
 
 
 class CniServer:
+    #: in-dispatch retry budget for ADD: kubelet DOES retry failed ADDs,
+    #: but each kubelet retry tears down and recreates the sandbox —
+    #: riding out a transient VSP/apiserver blip inside one dispatch is
+    #: an order of magnitude cheaper. Bounded well inside the request
+    #: deadline so retries never convert a fast failure into a timeout.
+    ADD_ATTEMPTS = 3
+
     def __init__(self, socket_path: str,
                  add_handler: Optional[Callable[[PodRequest], dict]] = None,
                  del_handler: Optional[Callable[[PodRequest], dict]] = None,
-                 timeout: float = CNI_TIMEOUT):
+                 timeout: float = CNI_TIMEOUT,
+                 retry: Optional[resilience.RetryPolicy] = None):
         self.socket_path = socket_path
         self.add_handler = add_handler
         self.del_handler = del_handler
         self.timeout = timeout
+        self.retry = retry or resilience.RetryPolicy(
+            max_attempts=self.ADD_ATTEMPTS, base=0.05, cap=1.0)
         self._server: Optional[_UnixHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._pool = ThreadPoolExecutor(max_workers=8)
@@ -115,39 +132,105 @@ class CniServer:
                   sandbox=pod_req.sandbox_id, ifname=pod_req.ifname):
             return self._dispatch(handler, pod_req)
 
+    @staticmethod
+    def _already_gone(exc: BaseException) -> bool:
+        """DEL hitting state that no longer exists (daemon restarted
+        mid-teardown, kubelet re-sent a completed DEL): missing state IS
+        the desired end state — CNI DEL must be idempotent (the spec
+        requires DEL to succeed when the resource is absent), so these
+        convert to success, not a 500 that makes kubelet retry forever.
+        Deliberately narrow: the typed AlreadyGone (handlers signal it
+        explicitly) and FileNotFoundError (cache file vanished) — NOT
+        bare KeyError, which would convert handler bugs (a malformed
+        cache entry missing a key) into silent success + leaked
+        devices."""
+        return isinstance(exc, (AlreadyGone, FileNotFoundError))
+
     def _dispatch(self, handler, pod_req: PodRequest) -> CniResponse:
-        fut = self._pool.submit(handler, pod_req)
-        try:
-            with metrics.CNI_SECONDS.time():
-                result = fut.result(timeout=self.timeout)
-            metrics.CNI_REQUESTS.inc(command=pod_req.command, result="ok")
-        except FutTimeout:
-            metrics.CNI_REQUESTS.inc(command=pod_req.command,
-                                     result="timeout")
-            # The error response below makes kubelet tear the sandbox down,
-            # but the handler thread may still be running and commit its
-            # side effects afterwards. Cancel if still queued; if a late ADD
-            # succeeds anyway, undo it so allocator/cache state doesn't leak
-            # for a dead sandbox.
-            fut.cancel()
-            if pod_req.command == "ADD" and self.del_handler is not None:
-                rollback = self.del_handler
+        deadline = time.monotonic() + self.timeout
+        attempt = 0
+        with metrics.CNI_SECONDS.time():
+            while True:
+                remaining = deadline - time.monotonic()
+                fut = self._pool.submit(handler, pod_req)
+                try:
+                    result = fut.result(timeout=max(remaining, 0.0))
+                    metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                                             result="ok")
+                except FutTimeout:
+                    return self._timed_out(fut, pod_req, attempt)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if (pod_req.command == "DEL"
+                            and self._already_gone(e)):
+                        metrics.CNI_REQUESTS.inc(command="DEL",
+                                                 result="already_gone")
+                        log.info("CNI DEL for absent state on sandbox "
+                                 "%s: treated as success",
+                                 pod_req.sandbox_id)
+                        return CniResponse(result={
+                            "cniVersion": pod_req.netconf.cni_version})
+                    # bounded in-dispatch retries for transient ADD
+                    # failures (a VSP pod restarting under the daemon, an
+                    # apiserver blip mid-wire): far cheaper than failing
+                    # the ADD and paying a full kubelet sandbox recreate
+                    delay = self.retry.backoff(attempt)
+                    if (pod_req.command == "ADD"
+                            and attempt + 1 < self.retry.max_attempts
+                            and resilience.is_transient(e)
+                            and time.monotonic() + delay < deadline):
+                        attempt += 1
+                        metrics.RESILIENCE_RETRIES.inc(
+                            site="cni.ADD", outcome="retried")
+                        log.warning("CNI ADD attempt %d for sandbox %s "
+                                    "failed (%s); retrying in %.2fs",
+                                    attempt, pod_req.sandbox_id, e,
+                                    delay)
+                        self.retry.sleep(delay)
+                        continue
+                    if pod_req.command == "ADD":
+                        # mirror RetryPolicy.call's outcome accounting
+                        # so retried − ok − gave_up balances per site
+                        metrics.RESILIENCE_RETRIES.inc(
+                            site="cni.ADD",
+                            outcome="gave_up"
+                            if resilience.is_transient(e) else "aborted")
+                    metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                                             result="error")
+                    raise
+                if attempt:
+                    metrics.RESILIENCE_RETRIES.inc(site="cni.ADD",
+                                                   outcome="ok")
+                return CniResponse(
+                    result=result or {"cniVersion":
+                                      pod_req.netconf.cni_version})
 
-                def _undo_late_add(f):
-                    if f.cancelled() or f.exception() is not None:
-                        return
-                    log.warning("late CNI ADD success after timeout; "
-                                "rolling back sandbox %s", pod_req.sandbox_id)
-                    try:
-                        rollback(pod_req)
-                    except Exception:  # noqa: BLE001
-                        log.exception("rollback of timed-out ADD failed")
+    def _timed_out(self, fut, pod_req: PodRequest,
+                   attempt: int = 0) -> CniResponse:
+        metrics.CNI_REQUESTS.inc(command=pod_req.command, result="timeout")
+        if attempt:
+            # a retried ADD that then hung still closes its accounting:
+            # retried − ok − gave_up must balance per site
+            metrics.RESILIENCE_RETRIES.inc(site="cni.ADD",
+                                           outcome="gave_up")
+        # The error response below makes kubelet tear the sandbox down,
+        # but the handler thread may still be running and commit its
+        # side effects afterwards. Cancel if still queued; if a late ADD
+        # succeeds anyway, undo it so allocator/cache state doesn't leak
+        # for a dead sandbox.
+        fut.cancel()
+        if pod_req.command == "ADD" and self.del_handler is not None:
+            rollback = self.del_handler
 
-                fut.add_done_callback(_undo_late_add)
-            return CniResponse(
-                error=f"CNI {pod_req.command} timed out after {self.timeout}s")
-        except Exception:
-            metrics.CNI_REQUESTS.inc(command=pod_req.command, result="error")
-            raise
-        return CniResponse(result=result or {"cniVersion":
-                                             pod_req.netconf.cni_version})
+            def _undo_late_add(f):
+                if f.cancelled() or f.exception() is not None:
+                    return
+                log.warning("late CNI ADD success after timeout; "
+                            "rolling back sandbox %s", pod_req.sandbox_id)
+                try:
+                    rollback(pod_req)
+                except Exception:  # noqa: BLE001
+                    log.exception("rollback of timed-out ADD failed")
+
+            fut.add_done_callback(_undo_late_add)
+        return CniResponse(
+            error=f"CNI {pod_req.command} timed out after {self.timeout}s")

@@ -168,6 +168,15 @@ class PodRequest:
         )
 
 
+class AlreadyGone(Exception):
+    """DEL handlers raise this when the state they were asked to tear
+    down no longer exists (daemon restarted mid-teardown, kubelet
+    re-sent a completed DEL). The CNI server converts it to SUCCESS —
+    the CNI spec requires DEL to be idempotent — without also masking
+    accidental KeyErrors from handler bugs the way a bare-KeyError catch
+    would."""
+
+
 @dataclass
 class CniResponse:
     """CNI result JSON the shim prints (types.PrintResult parity)."""

@@ -75,6 +75,12 @@ class Daemon:
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
         self._serve_thread: Optional[threading.Thread] = None
+        #: /metrics + /healthz + /readyz for the daemon (reference: the
+        #: DPU-side daemon's :18001, dpusidemanager.go:271-275). Started
+        #: in serve() when TPU_DAEMON_HEALTH_PORT is set; /healthz
+        #: reports "degraded: <sites>" while a circuit breaker is open,
+        #: so operators see a walled-off VSP instead of discovering it.
+        self.health_server = None
         # manager teardown must run exactly once, whichever of the
         # signal handler / serve-loop exit gets there first
         self._mgr_stop_lock = threading.Lock()
@@ -144,8 +150,34 @@ class Daemon:
             self._error = e
             self._stop.set()
 
+    def degraded_sites(self) -> list:
+        """Open circuit breakers across the live side manager."""
+        provider = getattr(self.manager, "degraded_sites", None)
+        return list(provider()) if callable(provider) else []
+
+    def ready(self) -> bool:
+        return (self.manager is not None and self._error is None
+                and not self._stop.is_set())
+
+    def _start_health_server(self):
+        port = os.environ.get("TPU_DAEMON_HEALTH_PORT", "")
+        if not port or self.health_server is not None:
+            return
+        from ..utils.metrics import MetricsServer
+        try:
+            self.health_server = MetricsServer(
+                port=int(port), ready_check=self.ready,
+                degraded_check=self.degraded_sites)
+            self.health_server.start()
+            log.info("daemon health/metrics on :%d",
+                     self.health_server.port)
+        except Exception:  # noqa: BLE001 — observability must not take
+            self.health_server = None  # the daemon down
+            log.exception("daemon health server failed to start")
+
     def serve(self, block: bool = True):
         """1 Hz detect loop; returns when stopped or a manager errored."""
+        self._start_health_server()
         while not self._stop.is_set():
             if self.manager is None:
                 detection = self.detect_once()
@@ -210,3 +242,6 @@ class Daemon:
         self._stop_manager()
         if self._serve_thread:
             self._serve_thread.join(timeout=5)
+        if self.health_server is not None:
+            self.health_server.stop()
+            self.health_server = None

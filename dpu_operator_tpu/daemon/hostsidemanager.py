@@ -88,8 +88,16 @@ class HostSideManager:
         if self.client is not None:
             self._manager = Manager(self.client)
             self._manager.add_reconciler(
-                SfcReconciler(workload_image=self.workload_image))
+                SfcReconciler(workload_image=self.workload_image,
+                              degraded_provider=self.degraded_sites))
             self._manager.start()
+
+    def degraded_sites(self) -> list:
+        """Open circuit breakers on the VSP seam (utils/resilience.py)
+        — surfaced as a Degraded condition on SFC CRs this side
+        reconciles. Mock VSPs without breakers report healthy."""
+        provider = getattr(self.vsp, "degraded_sites", None)
+        return list(provider()) if callable(provider) else []
 
     def stop(self):
         if self._manager:

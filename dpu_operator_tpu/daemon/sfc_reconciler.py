@@ -14,6 +14,7 @@ import logging
 
 from ..api.types import API_VERSION, ServiceFunctionChain
 from ..k8s.manager import ReconcileResult, Request
+from ..utils import resilience
 from ..utils import vars as v
 
 log = logging.getLogger(__name__)
@@ -44,7 +45,8 @@ class SfcReconciler:
 
     def __init__(self, workload_image: str = "",
                  chain_status_provider=None, boundary_sync=None,
-                 cross_host_sync=None):
+                 cross_host_sync=None, degraded_provider=None,
+                 retry: resilience.RetryPolicy = None):
         """*chain_status_provider*: callable (namespace, name) -> list of
         hop dicts ({index, input, output, degraded}) from the live wire
         table — the TpuSideManager passes its own (chain_status).
@@ -53,11 +55,21 @@ class SfcReconciler:
         live spec edit take effect on the next resync, without pod
         churn. *cross_host_sync*: callable (namespace, name) converging
         hops whose downstream NF lives under another daemon (a neighbor
-        that wires after this host's NF lands within one resync)."""
+        that wires after this host's NF lands within one resync).
+        *degraded_provider*: callable () -> list of degraded dependency
+        sites (open circuit breakers, utils/resilience.py) — surfaced as
+        a ``Degraded`` condition on the CR so operators SEE a walled-off
+        VSP instead of discovering it from missing wires."""
         self.workload_image = workload_image
         self.chain_status_provider = chain_status_provider
         self.boundary_sync = boundary_sync
         self.cross_host_sync = cross_host_sync
+        self.degraded_provider = degraded_provider
+        # transient apiserver blips during NF pod creation retry in
+        # place; a still-failing create raises after rollback (below)
+        # and rides the manager's exponential-backoff requeue
+        self.retry = retry or resilience.RetryPolicy(
+            max_attempts=3, base=0.05, cap=0.5)
 
     def _network_function_pod(self, sfc: ServiceFunctionChain, nf,
                               index: int = 0) -> dict:
@@ -119,18 +131,33 @@ class SfcReconciler:
             p["metadata"]["name"]: p
             for p in client.list("v1", "Pod", namespace=sfc.namespace,
                                  label_selector={"sfc": sfc.name})}
+        created_this_pass: list[str] = []
         for index, nf in enumerate(sfc.network_functions):
             pod = self._network_function_pod(sfc, nf, index)
             name = pod["metadata"]["name"]
             existing = existing_pods.get(name)
             if existing is None:
                 try:
-                    client.create(pod)
+                    # transient transport errors retry in place; POST is
+                    # only re-sent when the request never reached the
+                    # server (is_transient excludes timeouts), and a
+                    # mid-response reset that DID commit surfaces as
+                    # AlreadyExists on the retry — the adopt path below
+                    self.retry.call(lambda p=pod: client.create(p),
+                                    site="sfc.create_nf_pod")
                     log.info("created NF pod %s", name)
+                    created_this_pass.append(name)
                     scheduled += 1  # created this pass; not yet Running
                     continue
                 except Exception as e:  # noqa: BLE001 — conflict probe
                     if not _already_exists(e):
+                        # NF programming failed mid-chain: roll back the
+                        # pods this pass created rather than leaving a
+                        # half-programmed chain parked until the next
+                        # watch event, then re-raise so the manager
+                        # requeues with exponential backoff
+                        self._rollback(client, sfc.namespace,
+                                       created_this_pass)
                         raise
                     # a pod with this name exists but missed the labeled
                     # LIST (hand-created or pre-label-era): adopt it via
@@ -163,6 +190,20 @@ class SfcReconciler:
                               sfc.namespace, sfc.name)
         self._write_status(client, obj, sfc, scheduled, ready)
         return ReconcileResult(requeue_after=self.RESYNC_SECONDS)
+
+    def _rollback(self, client, namespace: str, created: list):
+        """Undo this pass's partial NF programming: the chain either
+        lands whole or not at all (a lone mid-chain NF pod would wire a
+        dangling hop the moment its CNI ADD runs). Best-effort — the
+        requeue re-creates everything anyway; this just stops the
+        half-chain from sitting there between retries."""
+        for name in created:
+            try:
+                client.delete("v1", "Pod", name, namespace=namespace)
+                log.info("rolled back partially-programmed NF pod %s",
+                         name)
+            except Exception:  # noqa: BLE001 — GC catches leftovers
+                log.warning("rollback of NF pod %s failed", name)
 
     def _write_status(self, client, obj: dict, sfc: ServiceFunctionChain,
                       scheduled: int, ready: int):
@@ -206,6 +247,20 @@ class SfcReconciler:
                     else "all hops ride their allocated ICI ports"),
             ],
         }
+        # an open circuit breaker (walled-off VSP) surfaces as a
+        # Degraded condition — added only while a breaker is open, so
+        # healthy chains keep their stable three-condition shape
+        sites = []
+        if self.degraded_provider is not None:
+            try:
+                sites = list(self.degraded_provider())
+            except Exception:  # noqa: BLE001 — status is best-effort
+                log.exception("degraded provider failed")
+        if sites:
+            status["conditions"].append(_condition(
+                "Degraded", True, "CircuitBreakerOpen",
+                f"dependency breaker(s) open: {', '.join(sites)} — "
+                "calls short-circuit until a half-open probe succeeds"))
         if obj.get("status") != status:
             updated = dict(obj, status=status)
             try:

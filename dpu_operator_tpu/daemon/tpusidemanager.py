@@ -239,7 +239,8 @@ class TpuSideManager:
                 SfcReconciler(workload_image=self.workload_image,
                               chain_status_provider=self.chain_status,
                               boundary_sync=self.sync_chain_boundaries,
-                              cross_host_sync=self.sync_cross_host_hops))
+                              cross_host_sync=self.sync_cross_host_hops,
+                              degraded_provider=self.degraded_sites))
             self._manager.start()
         # self-healing chain repair: probe ICI link state through the
         # native agent (VSP spawns it next to the vendor-plugin socket —
@@ -1263,6 +1264,21 @@ class TpuSideManager:
                 tmp = path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(data, f)
+                # keep the outgoing snapshot reachable as last-good via
+                # a hardlink (O(1), no data copy): os.replace is atomic
+                # against OUR writes, but a crash/power-cut can still
+                # leave the primary truncated at the filesystem level —
+                # recovery falls back to this file (_load_journal)
+                bak = path + ".last-good"
+                if os.path.exists(path):
+                    try:
+                        os.unlink(bak)
+                    except OSError:
+                        pass
+                    try:
+                        os.link(path, bak)
+                    except OSError:
+                        pass  # exotic fs without hardlinks: no fallback
                 os.replace(tmp, path)  # atomic: no torn reads
                 metrics.JOURNAL_FLUSHES.inc()
             except OSError:
@@ -1271,6 +1287,41 @@ class TpuSideManager:
                     # retry on the next entry point instead of silently
                     # dropping the batch
                     self.__dict__["_chains_dirty"] = True
+
+    @staticmethod
+    def _load_journal(path: str):
+        """Read the journal snapshot, falling back to the last-good
+        hardlink when the primary is truncated/corrupt (a crash
+        mid-write at the filesystem level). Never raises: daemon
+        prepare() must come up even with both copies gone — the wire
+        table then rebuilds from the dataplane's ground truth alone.
+        Recovery source lands on the journal_recoveries counter so a
+        fleet-wide corruption pattern is visible, not silent."""
+        for candidate, source in ((path, "primary"),
+                                  (path + ".last-good", "last_good")):
+            try:
+                with open(candidate) as f:
+                    data = json.load(f)
+                if not isinstance(data, dict):
+                    raise ValueError(
+                        f"journal root is {type(data).__name__}, "
+                        "expected object")
+            except FileNotFoundError:
+                continue
+            except (OSError, ValueError) as e:
+                log.warning("chain journal %s unreadable (%s); trying "
+                            "next candidate", candidate, e)
+                continue
+            if source != "primary":
+                log.warning("chain journal %s truncated/corrupt; "
+                            "recovered from last-good snapshot %s",
+                            path, candidate)
+            metrics.JOURNAL_RECOVERIES.inc(result=source)
+            return data
+        log.error("no readable chain journal at %s (primary and "
+                  "last-good both unreadable); starting empty", path)
+        metrics.JOURNAL_RECOVERIES.inc(result="empty")
+        return None
 
     def _recover_chains(self):
         """Rebuild the wire table after a daemon restart: load the
@@ -1283,14 +1334,11 @@ class TpuSideManager:
         every pre-restart hop is worse than carrying a stale one, which
         the reconciler's resync prunes anyway."""
         path = getattr(self, "_chains_file", None)
-        if not path or not os.path.exists(path):
+        if not path or (not os.path.exists(path)
+                        and not os.path.exists(path + ".last-good")):
             return
-        try:
-            with open(path) as f:
-                data = json.load(f)
-        except (OSError, ValueError):
-            log.exception("chain journal unreadable (%s); starting empty",
-                          path)
+        data = self._load_journal(path)
+        if data is None:
             return
         ground = None
         lister = getattr(self.vsp, "list_network_functions", None)
@@ -1352,6 +1400,14 @@ class TpuSideManager:
         if restored or dropped:
             log.info("recovered %d steered hop(s) from the chain journal "
                      "(%d dropped as not wired)", restored, dropped)
+
+    def degraded_sites(self) -> list:
+        """Dependency sites currently walled off by an open circuit
+        breaker (utils/resilience.py) — the daemon's Degraded signal,
+        surfaced on SFC CR conditions and the health endpoint. Mock VSPs
+        without breakers report healthy."""
+        provider = getattr(self.vsp, "degraded_sites", None)
+        return list(provider()) if callable(provider) else []
 
     # -- chain observability --------------------------------------------------
     def chain_status(self, namespace: str, name: str) -> list:

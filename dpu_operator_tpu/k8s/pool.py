@@ -26,18 +26,18 @@ import threading
 from typing import Optional
 from urllib.parse import urlencode, urlsplit
 
-from ..utils import metrics
+from ..utils import metrics, resilience
 
 #: errors that mark a REUSED connection as stale (server closed the
 #: keep-alive socket while it idled) — retried once on a fresh dial.
-#: Timeouts are deliberately NOT retried even though TimeoutError is an
-#: OSError: a caller-bounded request (the leader lease passes
-#: lease_seconds/6 so one attempt fits a renew period) must fail within
-#: its deadline, not silently double it — the request() body re-raises
-#: them before the stale check.
-_STALE_ERRORS = (http.client.BadStatusLine, http.client.CannotSendRequest,
-                 ConnectionError, BrokenPipeError, ssl.SSLEOFError,
-                 OSError)
+#: The shared transient-transport set (utils/resilience.py) plus bare
+#: OSError: socket-level reuse of a dead connection surfaces OSErrors
+#: beyond the connection-reset family. Timeouts are deliberately NOT
+#: retried even though TimeoutError is an OSError: a caller-bounded
+#: request (the leader lease passes lease_seconds/6 so one attempt fits
+#: a renew period) must fail within its deadline, not silently double
+#: it — the request() body re-raises them before the stale check.
+_STALE_ERRORS = resilience.TRANSIENT_TRANSPORT_ERRORS + (OSError,)
 
 #: verbs safe to retry after a failure in the RESPONSE phase, where the
 #: server may already have executed the request (k8s GET/DELETE are
@@ -184,6 +184,8 @@ class HttpsConnectionPool:
                     with self._lock:
                         self.stale_reconnects += 1
                     metrics.KUBE_STALE_RECONNECTS.inc()
+                    metrics.RESILIENCE_RETRIES.inc(site="kube.pool",
+                                                   outcome="retried")
                     return True
                 return False
 

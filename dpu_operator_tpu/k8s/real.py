@@ -27,6 +27,7 @@ from typing import Callable, Optional
 import yaml
 
 from ..utils import metrics
+from ..utils import resilience
 from .pool import HttpsConnectionPool
 
 log = logging.getLogger(__name__)
@@ -35,6 +36,30 @@ try:
     import requests
 except ImportError:  # pragma: no cover
     requests = None
+
+#: verbs safe to re-drive after a TRANSPORT error: reads are trivially
+#: idempotent; DELETE converges (404 is success); PUT/PATCH are guarded
+#: by resourceVersion conflicts / server-side apply. "create" (POST) is
+#: deliberately absent — the apiserver may have committed the object
+#: before the connection died, and a blind retry would duplicate it
+#: (callers see AlreadyExists/409 on their own retry and handle it).
+_RETRYABLE_VERBS = frozenset({"get", "list", "delete", "update", "apply",
+                              "update_status"})
+
+
+def _transient_http_error(exc: BaseException) -> bool:
+    """Transport-level failure safe to retry? Timeouts are categorically
+    NOT retried (timeout-means-fail: a caller-bounded request — the
+    leader lease sizes one attempt per renew period — must fail within
+    its deadline, not double it). requests' ConnectTimeout subclasses
+    its ConnectionError, so the timeout check runs first."""
+    if requests is not None and isinstance(
+            exc, requests.exceptions.Timeout):
+        return False
+    if resilience.is_transient(exc):
+        return True
+    return (requests is not None
+            and isinstance(exc, requests.exceptions.ConnectionError))
 
 # Plural-name heuristics for REST path mapping; irregulars listed explicitly.
 _IRREGULAR_PLURALS = {
@@ -118,6 +143,13 @@ class RealKube:
         #: per-request HTTP timeout (connect+read); callers with stricter
         #: deadlines (leader lease) pass their own
         self.request_timeout = 30.0
+        #: transient-transport retry for idempotent verbs (resilience
+        #: layer): beyond the pool's single stale-socket retry this adds
+        #: jittered backoff, so an apiserver restart (every connection
+        #: reset at once) is ridden out instead of surfaced to every
+        #: reconciler simultaneously
+        self.retry = resilience.RetryPolicy(max_attempts=3, base=0.05,
+                                            cap=1.0)
         # -- wire-path fast lane: persistent keep-alive connection pool --
         # requests.Session reuses sockets but pays ~4x per-request
         # overhead in request/response machinery; the pooled http.client
@@ -159,31 +191,43 @@ class RealKube:
         requests session otherwise; per-verb latency is observed either
         way so the histogram reflects what production actually pays."""
         timeout = timeout or self.request_timeout
-        t0 = time.perf_counter()
-        try:
-            if self.pool is not None:
-                hdrs = {k: v for k, v in self.session.headers.items()
-                        if k.lower() not in ("accept-encoding",)}
-                body = data
-                if json_obj is not None:
-                    body = json.dumps(json_obj).encode()
-                    hdrs["Content-Type"] = "application/json"
-                if isinstance(body, str):
-                    body = body.encode()
-                if headers:
-                    hdrs.update(headers)
-                return self.pool.request(
-                    method, url[len(self.base):], params=params, body=body,
-                    headers=hdrs, timeout=timeout)
-            return self.session.request(
-                method, url, params=params, json=json_obj, data=data,
-                headers=headers, timeout=timeout)
-        finally:
-            metrics.KUBE_REQUEST_SECONDS.observe(
-                verb, time.perf_counter() - t0)
-            metrics.KUBE_REQUESTS.inc(
-                verb=verb,
-                transport="pooled" if self.pool is not None else "session")
+
+        def one_attempt():
+            # metrics are per ATTEMPT, inside the retry: the per-verb
+            # histogram means wire RTT — folding backoff sleeps and N
+            # failed connects into one sample would inflate the p95
+            # exactly when retries kick in
+            t0 = time.perf_counter()
+            try:
+                if self.pool is not None:
+                    hdrs = {k: v for k, v in self.session.headers.items()
+                            if k.lower() not in ("accept-encoding",)}
+                    body = data
+                    if json_obj is not None:
+                        body = json.dumps(json_obj).encode()
+                        hdrs["Content-Type"] = "application/json"
+                    if isinstance(body, str):
+                        body = body.encode()
+                    if headers:
+                        hdrs.update(headers)
+                    return self.pool.request(
+                        method, url[len(self.base):], params=params,
+                        body=body, headers=hdrs, timeout=timeout)
+                return self.session.request(
+                    method, url, params=params, json=json_obj, data=data,
+                    headers=headers, timeout=timeout)
+            finally:
+                metrics.KUBE_REQUEST_SECONDS.observe(
+                    verb, time.perf_counter() - t0)
+                metrics.KUBE_REQUESTS.inc(
+                    verb=verb,
+                    transport="pooled" if self.pool is not None
+                    else "session")
+
+        if verb in _RETRYABLE_VERBS:
+            return self.retry.call(one_attempt, site=f"kube.{verb}",
+                                   retry_if=_transient_http_error)
+        return one_attempt()
 
     def connection_stats(self) -> dict:
         """Pool reuse counters for the wire bench; zeros on the

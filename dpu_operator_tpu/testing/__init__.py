@@ -1,0 +1,13 @@
+"""Deterministic fault-injection (chaos) harness for resilience tests."""
+
+from .chaos import (  # noqa: F401
+    ChaosChannel,
+    ChaosKube,
+    ChaosVsp,
+    Fail,
+    FailAfter,
+    FaultPlan,
+    Latency,
+    Ok,
+    truncate_file,
+)

@@ -1,0 +1,278 @@
+"""Deterministic fault injection for the daemon wire path.
+
+Every recovery path the resilience layer (utils/resilience.py) promises
+— apiserver reset, VSP crash mid-call, CNI ADD transient failure,
+journal truncation — needs a REPEATABLE test, not an ad-hoc monkeypatch.
+This module provides scripted-fault wrappers over the seams the tests
+already use:
+
+- :class:`ChaosKube` wraps :class:`k8s.fake.FakeKube` (or any
+  KubeClient) and injects faults per verb.
+- :class:`ChaosChannel` wraps a VSP channel's ``call`` (what
+  ``GrpcPlugin._call`` drives); :class:`ChaosVsp` wraps a whole
+  VendorPlugin for managers that hold the plugin directly.
+- :func:`truncate_file` models a crash mid-write (partial journal
+  snapshot) deterministically from a seed.
+
+Faults are consumed in script order; once a key's script is exhausted,
+calls pass through untouched. Random fault streams (``FaultPlan.flaky``)
+are driven by ``random.Random(seed)``, so a failing chaos run replays
+bit-identically from its seed.
+
+Fault vocabulary:
+
+- :class:`Fail` — raise BEFORE the wrapped operation runs: the request
+  never reached the server (send-phase failure; any verb may retry).
+- :class:`FailAfter` — run the operation, THEN raise: connection reset
+  mid-response, the server-committed-but-client-errored case that makes
+  blind POST retries unsafe (k8s/pool.py's response-phase rule).
+- :class:`Latency` — sleep, then run: a slow dependency for deadline/
+  timeout budgets.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Fault:
+    """One scripted fault; ``apply`` wraps the underlying operation."""
+
+    def apply(self, op: Callable, args: tuple, kwargs: dict):
+        raise NotImplementedError
+
+
+class Fail(Fault):
+    """Fail *times* calls before the operation executes (send phase:
+    connection refused / reset before the request left)."""
+
+    def __init__(self, exc: Callable[[], BaseException] = None,
+                 times: int = 1):
+        self.exc = exc or (lambda: ConnectionResetError(
+            "chaos: connection reset"))
+        self.times = times
+
+    def apply(self, op, args, kwargs):
+        raise self.exc()
+
+
+class FailAfter(Fault):
+    """Execute the operation, then fail: connection reset mid-RESPONSE.
+    The side effect landed on the server; the client saw an error. The
+    canonical trap for non-idempotent retries."""
+
+    def __init__(self, exc: Callable[[], BaseException] = None,
+                 times: int = 1):
+        self.exc = exc or (lambda: ConnectionResetError(
+            "chaos: connection reset mid-response"))
+        self.times = times
+
+    def apply(self, op, args, kwargs):
+        op(*args, **kwargs)
+        raise self.exc()
+
+
+class Latency(Fault):
+    """Delay the call by *seconds*, then execute it."""
+
+    def __init__(self, seconds: float, times: int = 1,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.seconds = seconds
+        self.times = times
+        self.sleep = sleep
+
+    def apply(self, op, args, kwargs):
+        self.sleep(self.seconds)
+        return op(*args, **kwargs)
+
+
+class FaultPlan:
+    """Per-key fault scripts, consumed in order; thread-safe.
+
+    ``plan.script("create", Fail(times=2), Latency(0.05))`` makes the
+    next two ``create`` calls fail, the third slow, the rest clean. The
+    key ``"*"`` matches any call that has no key-specific script left.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self._scripts: dict[str, list[Fault]] = {}
+        self._lock = threading.Lock()
+        #: (key, fault-class-name) log of every injected fault, for
+        #: assertions on what the harness actually did
+        self.injected: list[tuple[str, str]] = []
+
+    def script(self, key: str, *faults: Fault) -> "FaultPlan":
+        with self._lock:
+            self._scripts.setdefault(key, []).extend(faults)
+        return self
+
+    def flaky(self, key: str, rate: float, n: int = 32,
+              exc: Optional[Callable[[], BaseException]] = None
+              ) -> "FaultPlan":
+        """Script *n* calls where each fails with probability *rate*,
+        decided by the plan's seeded RNG — a deterministic flap storm."""
+        faults = [Fail(exc) if self.rng.random() < rate else _PassThrough()
+                  for _ in range(n)]
+        return self.script(key, *faults)
+
+    def _pop(self, key: str) -> Optional[Fault]:
+        with self._lock:
+            for k in (key, "*"):
+                script = self._scripts.get(k)
+                if script:
+                    fault = script[0]
+                    fault.times -= 1
+                    if fault.times <= 0:
+                        script.pop(0)
+                    if not isinstance(fault, _PassThrough):
+                        self.injected.append(
+                            (key, type(fault).__name__))
+                    return fault
+        return None
+
+    def run(self, key: str, op: Callable, *args, **kwargs):
+        fault = self._pop(key)
+        if fault is None:
+            return op(*args, **kwargs)
+        return fault.apply(op, args, kwargs)
+
+    def exhausted(self) -> bool:
+        with self._lock:
+            return not any(self._scripts.values())
+
+
+class Ok(Fault):
+    """Explicit pass-through slot in a script (the call succeeds)."""
+
+    def __init__(self, times: int = 1):
+        self.times = times
+
+    def apply(self, op, args, kwargs):
+        return op(*args, **kwargs)
+
+
+_PassThrough = Ok
+
+
+class ChaosKube:
+    """KubeClient wrapper injecting scripted faults per verb.
+
+    Wraps FakeKube (or any client with the same surface); the verb names
+    used as fault keys are the method names: get/list/create/update/
+    apply/delete/update_status. (watch is NOT scriptable — it passes
+    through to the inner client; fault its underlying list/get instead.)
+    """
+
+    _VERBS = ("get", "list", "create", "update", "apply", "delete",
+              "update_status")
+
+    def __init__(self, inner, plan: Optional[FaultPlan] = None,
+                 seed: int = 0):
+        self.inner = inner
+        self.plan = plan or FaultPlan(seed)
+
+    def __getattr__(self, name):
+        # non-verb attributes (watch, instances, helpers) pass through
+        return getattr(self.inner, name)
+
+    def _verb(self, verb, *args, **kwargs):
+        return self.plan.run(verb, getattr(self.inner, verb),
+                             *args, **kwargs)
+
+    def get(self, *a, **kw):
+        # RealKube.get grows a timeout kwarg FakeKube lacks; drop it so
+        # chaos tests can exercise timeout-carrying call sites too
+        kw.pop("timeout", None)
+        return self._verb("get", *a, **kw)
+
+    def list(self, *a, **kw):
+        return self._verb("list", *a, **kw)
+
+    def create(self, *a, **kw):
+        kw.pop("timeout", None)
+        return self._verb("create", *a, **kw)
+
+    def update(self, *a, **kw):
+        kw.pop("timeout", None)
+        return self._verb("update", *a, **kw)
+
+    def apply(self, *a, **kw):
+        return self._verb("apply", *a, **kw)
+
+    def delete(self, *a, **kw):
+        return self._verb("delete", *a, **kw)
+
+    def update_status(self, *a, **kw):
+        return self._verb("update_status", *a, **kw)
+
+
+class ChaosChannel:
+    """VspChannel stand-in: scripted faults keyed by ``Service.Method``
+    (falling back to ``*``), delegating to *inner* — either a real
+    channel or a dict/callable backend for pure-unit tests."""
+
+    def __init__(self, inner_call: Callable,
+                 plan: Optional[FaultPlan] = None, seed: int = 0):
+        """*inner_call*(service, method, request, timeout) -> dict."""
+        self.inner_call = inner_call
+        self.plan = plan or FaultPlan(seed)
+        self.closed = False
+        #: reconnect observability: GrpcPlugin swaps channels on retry
+        self.calls = 0
+
+    def call(self, service: str, method: str, request: dict,
+             timeout: float = 30.0) -> dict:
+        self.calls += 1
+        return self.plan.run(
+            f"{service}.{method}", self.inner_call, service, method,
+            request, timeout)
+
+    def close(self):
+        self.closed = True
+
+
+class ChaosVsp:
+    """VendorPlugin wrapper: scripted faults keyed by method name, for
+    managers that hold the plugin object directly (TpuSideManager)."""
+
+    _METHODS = ("start", "close", "get_devices", "set_num_chips",
+                "create_slice_attachment", "delete_slice_attachment",
+                "get_slice_info", "create_network_function",
+                "delete_network_function", "list_network_functions")
+
+    def __init__(self, inner, plan: Optional[FaultPlan] = None,
+                 seed: int = 0):
+        self.inner = inner
+        self.plan = plan or FaultPlan(seed)
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        if name in self._METHODS and callable(attr):
+            def chaotic(*a, __attr=attr, __name=name, **kw):
+                return self.plan.run(__name, __attr, *a, **kw)
+            return chaotic
+        return attr
+
+
+def truncate_file(path: str, seed: int = 0,
+                  keep_fraction: Optional[float] = None) -> int:
+    """Model a crash mid-write: truncate *path* to a seed-determined
+    prefix (strictly smaller than the file, at least 1 byte so the
+    result is malformed rather than merely empty). Returns the new
+    size."""
+    size = os.path.getsize(path)
+    if size <= 1:
+        return size
+    if keep_fraction is None:
+        keep = random.Random(seed).randrange(1, size)
+    else:
+        keep = max(1, min(size - 1, int(size * keep_fraction)))
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep

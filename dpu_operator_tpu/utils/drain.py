@@ -16,6 +16,19 @@ from . import vars as v
 log = logging.getLogger(__name__)
 
 
+class NodeNotFound(KeyError):
+    """Cordon/uncordon target does not exist. Subclasses KeyError so
+    pre-existing `except KeyError` call sites keep working, but carries
+    a real message instead of a bare node name."""
+
+    def __init__(self, node_name: str):
+        super().__init__(node_name)
+        self.node_name = node_name
+
+    def __str__(self):
+        return f"node {self.node_name!r} not found"
+
+
 class Drainer:
     def __init__(self, client):
         self.client = client
@@ -23,15 +36,26 @@ class Drainer:
     def cordon(self, node_name: str):
         node = self.client.get("v1", "Node", node_name)
         if node is None:
-            raise KeyError(node_name)
+            raise NodeNotFound(node_name)
+        if node.get("spec", {}).get("unschedulable") is True:
+            return  # idempotent: already cordoned
         node.setdefault("spec", {})["unschedulable"] = True
         self.client.update(node)
 
     def uncordon(self, node_name: str):
+        """Idempotent: a node that is already schedulable (or was
+        deleted while cordoned — resize teardown racing node removal) is
+        the desired end state, not an error. The finally-uncordon in
+        resize_chips must never mask the original failure with a bare
+        KeyError of its own."""
         node = self.client.get("v1", "Node", node_name)
         if node is None:
-            raise KeyError(node_name)
-        node.setdefault("spec", {})["unschedulable"] = False
+            log.warning("uncordon %s: node gone; nothing to do",
+                        node_name)
+            return
+        if not node.get("spec", {}).get("unschedulable"):
+            return  # idempotent: already schedulable
+        node["spec"]["unschedulable"] = False
         self.client.update(node)
 
     def drain(self, node_name: str,

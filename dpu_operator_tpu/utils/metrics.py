@@ -260,6 +260,26 @@ JOURNAL_FLUSHES = REGISTRY.counter(
     "tpu_daemon_journal_flushes_total",
     "Chain journal disk writes (mutations_total / flushes_total = "
     "coalescing factor)")
+# -- resilience layer (utils/resilience.py: retry/backoff + breakers) --------
+RESILIENCE_RETRIES = REGISTRY.counter(
+    "tpu_resilience_retries_total",
+    "Retry-policy outcomes by call site (retried = one more attempt "
+    "scheduled; ok = succeeded after >=1 retry; gave_up = attempts/"
+    "deadline exhausted; aborted = non-transient, not retried)")
+BREAKER_STATE = REGISTRY.gauge(
+    "tpu_resilience_breaker_state",
+    "Circuit-breaker state by site (0 closed, 1 half-open, 2 open)")
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "tpu_resilience_breaker_transitions_total",
+    "Circuit-breaker state transitions by site and target state")
+BREAKER_REJECTIONS = REGISTRY.counter(
+    "tpu_resilience_breaker_rejections_total",
+    "Calls short-circuited by an open/saturated breaker, by site")
+JOURNAL_RECOVERIES = REGISTRY.counter(
+    "tpu_daemon_journal_recoveries_total",
+    "Chain-journal startup recoveries by source (primary = journal "
+    "read clean; last_good = truncated/corrupt journal, fell back to "
+    "the previous snapshot; empty = no readable snapshot at all)")
 
 
 class TokenReviewAuth:
@@ -337,12 +357,19 @@ class MetricsServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  registry: Registry = REGISTRY,
                  ready_check: Optional[Callable[[], bool]] = None,
-                 auth: Optional[Callable[[str], bool]] = None):
+                 auth: Optional[Callable[[str], bool]] = None,
+                 degraded_check: Optional[Callable[[], list]] = None):
+        """*degraded_check* returns the call sites currently degraded
+        (open circuit breakers, utils/resilience.py) — surfaced in the
+        /healthz body. Degraded is still 200: the process is alive and
+        partially serving; taking it out of rotation would turn one
+        failing dependency into a total outage."""
         self.host = host
         self.port = port
         self.registry = registry
         self.ready_check = ready_check or (lambda: True)
         self.auth = auth
+        self.degraded_check = degraded_check
         self._server: Optional[ThreadingHTTPServer] = None
 
     def start(self):
@@ -373,7 +400,11 @@ class MetricsServer:
                         body = outer.registry.render().encode()
                         ctype = "text/plain; version=0.0.4"
                 elif self.path == "/healthz":
-                    body, ctype, code = b"ok", "text/plain", 200
+                    degraded = (outer.degraded_check()
+                                if outer.degraded_check else [])
+                    body = (("degraded: " + ",".join(degraded)).encode()
+                            if degraded else b"ok")
+                    ctype, code = "text/plain", 200
                 elif self.path == "/readyz":
                     ready = outer.ready_check()
                     body = b"ok" if ready else b"not ready"

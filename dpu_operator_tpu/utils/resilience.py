@@ -1,0 +1,308 @@
+"""Unified retry/backoff + circuit-breaker policies for the wire seams.
+
+The reference operator survives apiserver flaps, VSP crashes and kubelet
+restarts because controller-runtime requeues and gRPC reconnects for it;
+this reproduction's equivalents (pooled apiserver client, VSP plugin
+``_call``, SFC reconciler, CNI server) raise raw transport errors from
+every layer. This module is the one place failure policy lives:
+
+- :class:`RetryPolicy` — bounded attempts, exponential backoff with FULL
+  jitter (AWS architecture-blog shape: ``sleep = uniform(0, min(cap,
+  base * 2**attempt))``), an optional wall-clock deadline budget, and
+  per-call-site counters in :mod:`utils.metrics`.
+- :class:`CircuitBreaker` — classic closed/open/half-open. Open short-
+  circuits calls with :class:`BreakerOpen` so a dead dependency costs a
+  dict lookup, not a timeout; after ``reset_timeout`` a bounded number
+  of half-open probes decide re-close vs re-open.
+
+Both take injectable ``clock``/``sleep``/``rng`` so the chaos harness
+(:mod:`dpu_operator_tpu.testing.chaos`) can drive every recovery path
+deterministically from a seed.
+
+What counts as transient is deliberately narrow (:func:`is_transient`):
+connection-level transport errors. Timeouts are NEVER transient — a
+caller-bounded request must fail within its deadline, not silently
+multiply it (the pool's timeout-means-fail rule) — but they still count
+as breaker failures: a hung dependency is exactly what a breaker exists
+to wall off.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import random
+import ssl
+import threading
+import time
+from typing import Callable, Optional
+
+from . import metrics
+
+log = logging.getLogger(__name__)
+
+
+class TransientError(Exception):
+    """Raise (or wrap) to mark an error as retry-safe regardless of type."""
+
+
+class BreakerOpen(Exception):
+    """Short-circuited by an open circuit breaker — the call was NOT
+    attempted; the dependency was already failing."""
+
+    def __init__(self, site: str, retry_after: float = 0.0):
+        super().__init__(
+            f"circuit breaker open for {site!r}"
+            + (f" (retry in {retry_after:.1f}s)" if retry_after else ""))
+        self.site = site
+        self.retry_after = retry_after
+
+
+#: transport-level errors a retry may safely re-drive (the connection
+#: died; the TCP/unix stream is gone). TimeoutError is an OSError, so
+#: :func:`is_transient` must be used rather than a bare isinstance.
+TRANSIENT_TRANSPORT_ERRORS = (
+    ConnectionError, BrokenPipeError, InterruptedError,
+    http.client.BadStatusLine, http.client.CannotSendRequest,
+    http.client.ResponseNotReady, ssl.SSLEOFError, TransientError,
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Retry-safe transport error? Timeouts are categorically NOT
+    (timeout-means-fail: the caller's deadline is a contract)."""
+    if isinstance(exc, TimeoutError):
+        return False
+    if isinstance(exc, TRANSIENT_TRANSPORT_ERRORS):
+        return True
+    # socket.timeout aliases TimeoutError on py3.10+, handled above;
+    # ssl.SSLError("timed out") strings are timeouts in disguise
+    if isinstance(exc, ssl.SSLError):
+        return "timed out" not in str(exc)
+    return False
+
+
+class RetryPolicy:
+    """Exponential backoff + full jitter + deadline budget.
+
+    ``call(fn, site=...)`` runs *fn* up to ``max_attempts`` times,
+    sleeping ``uniform(0, min(cap, base * 2**attempt))`` between
+    attempts, never past ``deadline`` seconds of total elapsed time.
+    Which exceptions retry is decided by *retry_if* (default
+    :func:`is_transient`); everything else propagates immediately.
+    With a *breaker*, every attempt first consults it (raising
+    :class:`BreakerOpen` when open) and reports success/failure back.
+
+    Instances are immutable policy: share one per seam, pass per-call
+    knobs to :meth:`call`.
+    """
+
+    def __init__(self, max_attempts: int = 3, base: float = 0.05,
+                 cap: float = 2.0, deadline: Optional[float] = None,
+                 rng: Optional[random.Random] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base = base
+        self.cap = cap
+        self.deadline = deadline
+        self.rng = rng or random.Random()
+        self.sleep = sleep
+        self.clock = clock
+
+    def backoff(self, attempt: int) -> float:
+        """Full-jitter sleep before retry number *attempt* (0-based)."""
+        return self.rng.uniform(0.0, min(self.cap,
+                                         self.base * (2 ** attempt)))
+
+    def call(self, fn: Callable, *, site: str,
+             retry_if: Callable[[BaseException], bool] = is_transient,
+             breaker: Optional["CircuitBreaker"] = None,
+             failure_if: Optional[Callable[[BaseException], bool]] = None,
+             on_retry: Optional[Callable[[BaseException], None]] = None):
+        """Run *fn* under this policy. *on_retry* runs before each retry
+        (reconnect hooks); its own errors fold into the next attempt.
+
+        *failure_if* decides which exceptions count against the BREAKER
+        (default: whatever *retry_if* retries, plus timeouts — a hung
+        dependency is exactly what a breaker walls off). Application-
+        level errors (a server rejecting bad arguments) are real answers
+        from a HEALTHY dependency: they must not trip the breaker, or a
+        misconfigured caller in a loop walls off the dependency for
+        every other caller on the node."""
+        if failure_if is None:
+            def failure_if(e, _retry_if=retry_if):
+                return _retry_if(e) or isinstance(e, TimeoutError)
+        start = self.clock()
+        attempt = 0
+        while True:
+            if breaker is not None:
+                breaker.before_call(site)
+            try:
+                result = fn()
+            except BreakerOpen:
+                raise
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if breaker is not None:
+                    if failure_if(e):
+                        breaker.record_failure()
+                    else:
+                        # an application-level error is a real answer
+                        # over a WORKING transport: breaker-success (a
+                        # half-open probe must re-close on it, or one
+                        # app error would wedge the breaker half-open)
+                        breaker.record_success()
+                elapsed = self.clock() - start
+                out_of_budget = (self.deadline is not None
+                                 and elapsed >= self.deadline)
+                if (attempt + 1 >= self.max_attempts or out_of_budget
+                        or not retry_if(e)):
+                    outcome = ("gave_up" if retry_if(e) else "aborted")
+                    metrics.RESILIENCE_RETRIES.inc(site=site,
+                                                   outcome=outcome)
+                    raise
+                metrics.RESILIENCE_RETRIES.inc(site=site,
+                                               outcome="retried")
+                delay = self.backoff(attempt)
+                if self.deadline is not None:
+                    delay = min(delay,
+                                max(0.0, self.deadline - elapsed))
+                log.debug("retry %d/%d for %s in %.3fs after %r",
+                          attempt + 1, self.max_attempts, site, delay, e)
+                if delay > 0:
+                    self.sleep(delay)
+                if on_retry is not None:
+                    try:
+                        on_retry(e)
+                    except Exception:  # noqa: BLE001 — fold into retry
+                        log.debug("on_retry hook failed for %s", site,
+                                  exc_info=True)
+                attempt += 1
+                continue
+            if breaker is not None:
+                breaker.record_success()
+            if attempt:
+                metrics.RESILIENCE_RETRIES.inc(site=site, outcome="ok")
+            return result
+
+
+class CircuitBreaker:
+    """Closed/open/half-open breaker around one dependency.
+
+    - CLOSED: calls flow; ``failure_threshold`` consecutive failures
+      trip to OPEN.
+    - OPEN: calls are rejected instantly with :class:`BreakerOpen`
+      until ``reset_timeout`` elapses.
+    - HALF_OPEN: up to ``half_open_max`` concurrent probe calls are let
+      through; one success closes the breaker, one failure re-opens it
+      (and restarts the reset clock).
+
+    Thread-safe. The state is exported on the
+    ``tpu_resilience_breaker_state`` gauge (0 closed / 1 half-open /
+    2 open) so operators can SEE degradation; call sites additionally
+    surface an open breaker as a ``Degraded`` condition.
+    """
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half-open", "open"
+    _STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, site: str, failure_threshold: int = 5,
+                 reset_timeout: float = 30.0, half_open_max: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.site = site
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.half_open_max = half_open_max
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probes = 0
+        metrics.BREAKER_STATE.set(0, site=site)
+
+    # -- state machine --------------------------------------------------------
+    def _transition_locked(self, state: str):
+        if state == self._state:
+            return
+        self._state = state
+        metrics.BREAKER_STATE.set(self._STATE_VALUE[state], site=self.site)
+        metrics.BREAKER_TRANSITIONS.inc(site=self.site, to=state)
+        log.log(logging.WARNING if state != self.CLOSED else logging.INFO,
+                "circuit breaker %s -> %s", self.site, state)
+
+    def _tick_locked(self):
+        """Open -> half-open once reset_timeout elapsed (a REAL
+        transition, not a lazy view: the state gauge and any observer
+        must agree on what the breaker is doing)."""
+        if (self._state == self.OPEN
+                and self.clock() - self._opened_at >= self.reset_timeout):
+            self._transition_locked(self.HALF_OPEN)
+            self._probes = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._tick_locked()
+            return self._state
+
+    @property
+    def is_open(self) -> bool:
+        return self.state == self.OPEN
+
+    @property
+    def degraded(self) -> bool:
+        """True until the dependency PROVES recovery (a successful probe
+        re-closes the breaker). Half-open is still degraded: reporting
+        healthy the moment the reset timer fires — before any probe
+        succeeded — would flap the Degraded condition and /healthz every
+        reset_timeout for the whole length of a sustained outage."""
+        return self.state != self.CLOSED
+
+    def before_call(self, site: str = ""):
+        """Admission check; raises :class:`BreakerOpen` when rejected."""
+        with self._lock:
+            self._tick_locked()
+            if self._state == self.CLOSED:
+                return
+            if self._state == self.OPEN:
+                remaining = (self._opened_at + self.reset_timeout
+                             - self.clock())
+                metrics.BREAKER_REJECTIONS.inc(site=self.site)
+                raise BreakerOpen(site or self.site, max(remaining, 0.0))
+            if self._probes >= self.half_open_max:
+                metrics.BREAKER_REJECTIONS.inc(site=self.site)
+                raise BreakerOpen(site or self.site)
+            self._probes += 1
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            if self._state != self.CLOSED:
+                self._transition_locked(self.CLOSED)
+
+    def record_failure(self):
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                # the probe failed: straight back to open, clock restarts
+                self._opened_at = self.clock()
+                self._transition_locked(self.OPEN)
+                return
+            self._failures += 1
+            if (self._state == self.CLOSED
+                    and self._failures >= self.failure_threshold):
+                self._opened_at = self.clock()
+                self._transition_locked(self.OPEN)
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """One breaker-guarded call without retry."""
+        self.before_call()
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result

@@ -11,15 +11,55 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from typing import Optional, Protocol
 
 from ..render import apply_all_from_bindata
+from ..utils import resilience
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
 from .rpc import VspChannel, unix_target
 
 log = logging.getLogger(__name__)
+
+
+def _grpc_code_name(exc: BaseException):
+    """Status-code name of a gRPC error, None for non-gRPC errors."""
+    code = getattr(exc, "code", None)
+    if callable(code):
+        try:
+            code = code()
+        except Exception:  # noqa: BLE001 — not a live grpc error
+            code = None
+    return getattr(code, "name", None)
+
+
+def _vsp_transient(exc: BaseException) -> bool:
+    """Retry-safe VSP failure? gRPC errors carry a status code:
+    UNAVAILABLE is the VSP process dying / socket dropping (retry with a
+    reconnect); DEADLINE_EXCEEDED is a timeout (never retried — the
+    caller's deadline is a contract, and the daemon's CNI path runs
+    inside kubelet's own budget); anything else (UNIMPLEMENTED, a
+    server-side raise surfacing as UNKNOWN) is a real answer, not a
+    transport fault. Non-gRPC errors fall back to the shared transport
+    classification."""
+    name = _grpc_code_name(exc)
+    if name is not None:
+        return name == "UNAVAILABLE"
+    return resilience.is_transient(exc)
+
+
+def _vsp_breaker_failure(exc: BaseException) -> bool:
+    """What counts against the breaker: transport faults AND timeouts (a
+    hung VSP is what the breaker walls off) — but NOT application-level
+    errors, which are real answers from a healthy VSP; tripping on those
+    would let one misconfigured chain wall the VSP off for every pod on
+    the node."""
+    name = _grpc_code_name(exc)
+    if name is not None:
+        return name in ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+    return resilience.is_transient(exc) or isinstance(exc, TimeoutError)
 
 _BINDATA = os.path.join(os.path.dirname(__file__), "bindata", "vsp-ds")
 
@@ -38,7 +78,9 @@ class VendorPlugin(Protocol):
 class GrpcPlugin:
     def __init__(self, detection, client=None, image_manager=None,
                  path_manager: Optional[PathManager] = None,
-                 node_name: str = "", init_timeout: float = 10.0):
+                 node_name: str = "", init_timeout: float = 10.0,
+                 retry: Optional[resilience.RetryPolicy] = None,
+                 breaker: Optional[resilience.CircuitBreaker] = None):
         """*detection* is a DetectionResult; *client* a KubeClient (None skips
         VSP DaemonSet deployment — used when the VSP runs in-process)."""
         self.detection = detection
@@ -49,6 +91,17 @@ class GrpcPlugin:
         self.init_timeout = init_timeout
         self.topology = ""  # programmed slice topology from Init (tpu mode)
         self._channel: Optional[VspChannel] = None
+        # resilience: transient VSP failures (the plugin pod restarting,
+        # the unix socket dropping) reconnect + retry with backoff; a
+        # persistently-dead VSP opens the breaker so every daemon path
+        # (CNI ADD, reconciler resync, repair loop) fails FAST with
+        # BreakerOpen — surfaced as a Degraded condition, not a crash —
+        # until a half-open probe finds the VSP back.
+        self.retry = retry or resilience.RetryPolicy(
+            max_attempts=3, base=0.05, cap=0.5)
+        self.breaker = breaker or resilience.CircuitBreaker(
+            "vsp", failure_threshold=5, reset_timeout=10.0)
+        self._channel_lock = threading.Lock()
 
     # -- lifecycle ------------------------------------------------------------
     def _deploy_vsp(self):
@@ -71,8 +124,7 @@ class GrpcPlugin:
         slice-attachment server binds; the programmed slice topology (tpu
         mode) lands on ``self.topology``."""
         self._deploy_vsp()
-        sock = self.path_manager.vendor_plugin_socket()
-        self._channel = VspChannel(unix_target(sock))
+        self._channel = self._new_channel()
         deadline = time.monotonic() + self.init_timeout
         last_err: Optional[Exception] = None
         while time.monotonic() < deadline:
@@ -92,15 +144,61 @@ class GrpcPlugin:
             f"{last_err}")
 
     def close(self):
-        if self._channel:
-            self._channel.close()
-            self._channel = None
+        # under _channel_lock: close() racing a retry's _reconnect must
+        # not let the reconnect resurrect a channel after we closed it
+        # (the fresh dial would leak, and the plugin would look alive)
+        with self._channel_lock:
+            channel, self._channel = self._channel, None
+        if channel:
+            channel.close()
+
+    # -- resilience -----------------------------------------------------------
+    def _new_channel(self) -> VspChannel:
+        """Channel factory — the chaos harness overrides this per
+        instance to keep scripted faults in the loop across reconnects."""
+        return VspChannel(
+            unix_target(self.path_manager.vendor_plugin_socket()))
+
+    def _reconnect(self, _exc: BaseException = None):
+        """Swap in a fresh channel before a retry: gRPC channels can wedge
+        on a unix socket whose server restarted (the old inode is gone);
+        redialing binds the new one. Serialized so concurrent retries
+        don't leak channels."""
+        with self._channel_lock:
+            old = self._channel
+            if old is None:
+                return
+            self._channel = self._new_channel()
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001 — old channel already dead
+                pass
+
+    def degraded_sites(self) -> list:
+        """Breakers not yet proven recovered (open OR half-open) — what
+        the daemon's Degraded condition and /healthz report. Degradation
+        clears only when a probe actually succeeds, so a sustained VSP
+        outage reads as one continuous Degraded span, not a flap every
+        reset_timeout."""
+        return [self.breaker.site] if self.breaker.degraded else []
 
     # -- pass-throughs (vendorplugin.go:209-265) ------------------------------
     def _call(self, service, method, req, timeout=30.0):
         if self._channel is None:
             raise RuntimeError("plugin not started")
-        return self._channel.call(service, method, req, timeout=timeout)
+
+        def attempt():
+            # read the channel each attempt: _reconnect swaps it
+            channel = self._channel
+            if channel is None:
+                raise RuntimeError("plugin closed mid-call")
+            return channel.call(service, method, req, timeout=timeout)
+
+        return self.retry.call(attempt, site=f"vsp.{service}.{method}",
+                               retry_if=_vsp_transient,
+                               breaker=self.breaker,
+                               failure_if=_vsp_breaker_failure,
+                               on_retry=self._reconnect)
 
     def get_devices(self) -> dict:
         return self._call("DeviceService", "GetDevices", {}).get("devices", {})

@@ -10,12 +10,22 @@ the reference (vendorplugin.go:183-207).
 from __future__ import annotations
 
 import json
+import logging
 import os
+import random
 import threading
 from concurrent import futures
 from typing import Callable, Optional
 
 import grpc
+
+log = logging.getLogger(__name__)
+
+#: IANA dynamic/ephemeral range the TCP bind retries over when the
+#: VSP-suggested port is taken (another daemon instance racing a
+#: restart, a TIME_WAIT leftover)
+_EPHEMERAL_RANGE = (49152, 65535)
+_BIND_ATTEMPTS = 8
 
 def _ser(obj: dict) -> bytes:
     return json.dumps(obj or {}).encode()
@@ -96,14 +106,63 @@ class VspServer:
             methods[f"/tpuvsp.{svc}/{rpc}"] = wrap()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers((_GenericHandler(methods),))
-        if self.socket_path:
-            self._server.add_insecure_port(f"unix://{self.socket_path}")
-        else:
-            ip, port = self.tcp_addr
-            self.bound_port = self._server.add_insecure_port(f"{ip}:{port}")
-            if self.bound_port == 0:
-                raise OSError(f"cannot bind VSP server to {ip}:{port}")
-        self._server.start()
+        try:
+            if self.socket_path:
+                if self._server.add_insecure_port(
+                        f"unix://{self.socket_path}") == 0:
+                    raise OSError(
+                        f"cannot bind VSP server to {self.socket_path}")
+            else:
+                self.bound_port = self._bind_tcp(*self.tcp_addr)
+            self._server.start()
+        except BaseException:
+            # close any listening socket the partial bind/start left
+            # open on EVERY error path — a leaked listener keeps the
+            # port unbindable for the retrying restart that follows
+            self._teardown_failed_server()
+            raise
+
+    def _bind_tcp(self, ip: str, port: int) -> int:
+        """Bind the cross-boundary TCP endpoint: the suggested *port*
+        first, then a seeded draw over the ephemeral range (the caller
+        advertises whatever actually bound — peers read the address off
+        the Node annotation, so a substitute port is fully functional),
+        then an OS-assigned port as the last word. One bind failure must
+        not kill a daemon that is already holding live wires."""
+        candidates = [port]
+        # deterministic per (ip, port) so restart storms probe the same
+        # sequence instead of scattering, while distinct servers diverge
+        rng = random.Random(f"{ip}:{port}")
+        candidates += [rng.randint(*_EPHEMERAL_RANGE)
+                       for _ in range(_BIND_ATTEMPTS - 2)]
+        candidates.append(0)  # OS picks: only fails with no free ports
+        last = None
+        for cand in candidates:
+            try:
+                bound = self._server.add_insecure_port(f"{ip}:{cand}")
+            except RuntimeError:
+                # newer grpc raises instead of returning 0 on bind
+                # failure; both shapes mean "try the next candidate"
+                bound = 0
+            if bound != 0:
+                if cand != port:
+                    log.warning(
+                        "VSP server port %s:%d unavailable; bound "
+                        "ephemeral %d instead", ip, port, bound)
+                return bound
+            last = cand
+        raise OSError(
+            f"cannot bind VSP server to {ip}: tried port {port}, "
+            f"{_BIND_ATTEMPTS - 2} ephemeral candidates, and an "
+            f"OS-assigned port (last tried {last})")
+
+    def _teardown_failed_server(self):
+        server, self._server = self._server, None
+        if server is not None:
+            try:
+                server.stop(0)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
 
     def stop(self, grace: float = 0.5):
         if self._server:

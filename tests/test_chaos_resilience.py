@@ -1,0 +1,626 @@
+"""Scripted-fault (chaos) matrix for the resilience layer.
+
+Every fault the ISSUE names — apiserver reset, VSP crash mid-call, CNI
+ADD transient failure, journal truncation — is injected deterministically
+(fixed seeds, fake clocks, no real sleeps) through the harness in
+dpu_operator_tpu/testing/chaos.py, and each recovery path must complete
+WITHOUT manual intervention, with retry/breaker state visible on the
+utils/metrics.py counters.
+"""
+
+import json
+import random
+import threading
+
+import pytest
+
+from dpu_operator_tpu.api import NetworkFunction, ServiceFunctionChain
+from dpu_operator_tpu.cni.server import CniServer
+from dpu_operator_tpu.cni.types import NetConf, PodRequest
+from dpu_operator_tpu.daemon import SfcReconciler
+from dpu_operator_tpu.daemon.tpusidemanager import TpuSideManager
+from dpu_operator_tpu.k8s import Manager
+from dpu_operator_tpu.k8s.manager import Request
+from dpu_operator_tpu.testing import (
+    ChaosChannel,
+    ChaosKube,
+    Fail,
+    FailAfter,
+    FaultPlan,
+    Ok,
+    truncate_file,
+)
+from dpu_operator_tpu.utils import metrics, resilience
+from dpu_operator_tpu.vsp.plugin import GrpcPlugin
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1337
+
+
+def _policy(**kw):
+    """Deterministic, sleepless retry policy for tests."""
+    kw.setdefault("rng", random.Random(SEED))
+    kw.setdefault("sleep", lambda s: None)
+    return resilience.RetryPolicy(**kw)
+
+
+def _sfc(name="chaos-sfc", nfs=("nf-a", "nf-b")):
+    return ServiceFunctionChain(
+        name=name,
+        network_functions=[NetworkFunction(n, f"img-{n}") for n in nfs],
+    ).to_obj()
+
+
+def _req(name="chaos-sfc"):
+    return Request("config.tpu.openshift.io/v1", "ServiceFunctionChain",
+                   name, "default")
+
+
+# -- RetryPolicy / CircuitBreaker primitives ---------------------------------
+
+def test_retry_policy_full_jitter_backoff_is_bounded_and_seeded():
+    p1 = _policy(base=0.1, cap=2.0)
+    p2 = _policy(base=0.1, cap=2.0)
+    seq1 = [p1.backoff(a) for a in range(6)]
+    seq2 = [p2.backoff(a) for a in range(6)]
+    assert seq1 == seq2  # same seed -> same jitter stream
+    for attempt, delay in enumerate(seq1):
+        assert 0.0 <= delay <= min(2.0, 0.1 * 2 ** attempt)
+
+
+def test_retry_policy_recovers_then_reports_ok():
+    before = metrics.RESILIENCE_RETRIES.value(site="t.ok", outcome="ok")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("flap")
+        return "fine"
+
+    assert _policy(max_attempts=3).call(flaky, site="t.ok") == "fine"
+    assert len(calls) == 3
+    assert metrics.RESILIENCE_RETRIES.value(
+        site="t.ok", outcome="ok") == before + 1
+
+
+def test_retry_policy_timeout_means_fail():
+    calls = []
+
+    def hung():
+        calls.append(1)
+        raise TimeoutError("deadline")
+
+    with pytest.raises(TimeoutError):
+        _policy(max_attempts=5).call(hung, site="t.timeout")
+    assert len(calls) == 1  # never retried
+
+
+def test_retry_policy_deadline_budget_stops_retries():
+    clock = [0.0]
+
+    def tick():
+        clock[0] += 10.0  # each attempt "costs" 10s
+        raise ConnectionResetError("flap")
+
+    p = resilience.RetryPolicy(max_attempts=10, base=0.0, cap=0.0,
+                               deadline=25.0, sleep=lambda s: None,
+                               clock=lambda: clock[0])
+    calls_before = clock[0]
+    with pytest.raises(ConnectionResetError):
+        p.call(tick, site="t.deadline")
+    # 3 attempts: 10s, 20s elapsed < 25; at 30s the budget is blown
+    assert clock[0] == calls_before + 30.0
+
+
+def test_breaker_opens_half_opens_and_recloses():
+    now = [0.0]
+    br = resilience.CircuitBreaker("t.br", failure_threshold=3,
+                                   reset_timeout=10.0,
+                                   clock=lambda: now[0])
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == resilience.CircuitBreaker.OPEN
+    with pytest.raises(resilience.BreakerOpen):
+        br.before_call()
+    assert metrics.BREAKER_STATE.value(site="t.br") == 2
+    now[0] = 11.0  # past reset_timeout: one probe allowed
+    assert br.state == resilience.CircuitBreaker.HALF_OPEN
+    br.before_call()
+    with pytest.raises(resilience.BreakerOpen):
+        br.before_call()  # half_open_max=1: second probe rejected
+    br.record_success()
+    assert br.state == resilience.CircuitBreaker.CLOSED
+    assert metrics.BREAKER_STATE.value(site="t.br") == 0
+
+
+def test_breaker_failed_probe_reopens_and_restarts_clock():
+    now = [0.0]
+    br = resilience.CircuitBreaker("t.br2", failure_threshold=1,
+                                   reset_timeout=10.0,
+                                   clock=lambda: now[0])
+    br.record_failure()
+    now[0] = 10.5
+    br.before_call()  # half-open probe admitted
+    br.record_failure()  # probe failed
+    assert br.state == resilience.CircuitBreaker.OPEN
+    now[0] = 15.0  # clock restarted at 10.5: still open
+    with pytest.raises(resilience.BreakerOpen):
+        br.before_call()
+
+
+# -- apiserver reset (k8s seam) ----------------------------------------------
+
+def test_apiserver_reset_during_reconcile_recovers(kube):
+    """Send-phase connection resets on NF pod creation retry in place:
+    the chain lands whole with no manual intervention."""
+    chaos = ChaosKube(kube, seed=SEED)
+    chaos.plan.script("create", Fail(times=2))
+    kube.create(_sfc())
+    rec = SfcReconciler(workload_image="img", retry=_policy())
+    rec.reconcile(chaos, _req())
+    assert kube.get("v1", "Pod", "chaos-sfc-nf-a",
+                    namespace="default") is not None
+    assert kube.get("v1", "Pod", "chaos-sfc-nf-b",
+                    namespace="default") is not None
+    assert chaos.plan.exhausted()
+    assert metrics.RESILIENCE_RETRIES.value(
+        site="sfc.create_nf_pod", outcome="ok") >= 1
+
+
+def test_mid_response_reset_never_duplicates_the_create(kube):
+    """Reset mid-RESPONSE: the apiserver committed the pod, the client
+    saw an error. The retry surfaces AlreadyExists and the adopt path
+    takes over — exactly one pod, no crash loop."""
+    chaos = ChaosKube(kube, seed=SEED)
+    chaos.plan.script("create", FailAfter(times=1))
+    kube.create(_sfc(nfs=("nf-a",)))
+    rec = SfcReconciler(workload_image="img", retry=_policy())
+    rec.reconcile(chaos, _req())
+    pods = kube.list("v1", "Pod", namespace="default",
+                     label_selector={"sfc": "chaos-sfc"})
+    assert len(pods) == 1
+
+
+def test_hard_create_failure_rolls_back_partial_chain(kube):
+    """Non-transient failure on NF #2 after NF #1 was created: the pass
+    rolls its pods back instead of parking a half-programmed chain."""
+    chaos = ChaosKube(kube, seed=SEED)
+    chaos.plan.script(
+        "create", Ok(),
+        Fail(exc=lambda: RuntimeError("quota denied"), times=3))
+    kube.create(_sfc())
+    rec = SfcReconciler(workload_image="img", retry=_policy())
+    with pytest.raises(RuntimeError):
+        rec.reconcile(chaos, _req())
+    assert kube.list("v1", "Pod", namespace="default",
+                     label_selector={"sfc": "chaos-sfc"}) == []
+
+
+def test_apiserver_flap_storm_converges_through_manager(kube):
+    """A seeded flap storm across verbs: the manager's backoff requeue +
+    in-place retries converge the chain with zero operator action."""
+    chaos = ChaosKube(kube, seed=SEED)
+    chaos.plan.script("get", Fail(times=1))
+    chaos.plan.script("update_status", Fail(times=1))
+    mgr = Manager(chaos)
+    mgr.RETRY_BASE = 0.05  # keep the error-retry fast for the test
+    mgr.add_reconciler(SfcReconciler(workload_image="img",
+                                     retry=_policy()))
+    mgr.start()
+    try:
+        kube.create(_sfc(name="storm"))
+        assert mgr.wait_idle(timeout=15.0)
+        deadline = 50
+        while not chaos.plan.exhausted() and deadline:
+            mgr.wait_idle(timeout=1.0)
+            deadline -= 1
+        assert kube.get("v1", "Pod", "storm-nf-a",
+                        namespace="default") is not None
+    finally:
+        mgr.stop()
+
+
+# -- RealKube retry seam (no live apiserver needed) --------------------------
+
+class _ScriptedPool:
+    """HttpsConnectionPool stand-in driven by a FaultPlan."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.requests = []
+
+    def request(self, method, path, params=None, body=None, headers=None,
+                timeout=None):
+        self.requests.append(method)
+
+        def ok(*_a, **_kw):
+            from dpu_operator_tpu.k8s.pool import PooledResponse
+            return PooledResponse(200, {}, b'{"items": []}', path)
+
+        return self.plan.run(method, ok)
+
+
+def _bare_realkube(plan):
+    from dpu_operator_tpu.k8s.real import RealKube
+
+    class _Session:
+        headers = {}
+
+    rk = RealKube.__new__(RealKube)
+    rk.base = "https://apiserver:6443"
+    rk.session = _Session()
+    rk.pool = _ScriptedPool(plan)
+    rk.request_timeout = 5.0
+    rk.retry = _policy(max_attempts=3)
+    return rk
+
+
+def test_realkube_retries_idempotent_verbs_not_create():
+    plan = FaultPlan(SEED).script("GET", Fail(times=2))
+    rk = _bare_realkube(plan)
+    r = rk._request("get", "GET", rk.base + "/api/v1/pods")
+    assert r.status_code == 200
+    assert rk.pool.requests.count("GET") == 3  # 2 failures + success
+
+    plan = FaultPlan(SEED).script("POST", Fail(times=1))
+    rk = _bare_realkube(plan)
+    with pytest.raises(ConnectionResetError):
+        rk._request("create", "POST", rk.base + "/api/v1/pods",
+                    json_obj={"kind": "Pod"})
+    assert rk.pool.requests.count("POST") == 1  # never retried
+
+
+def test_realkube_timeout_is_never_retried():
+    plan = FaultPlan(SEED).script(
+        "GET", Fail(exc=lambda: TimeoutError("read timed out"), times=1))
+    rk = _bare_realkube(plan)
+    with pytest.raises(TimeoutError):
+        rk._request("get", "GET", rk.base + "/api/v1/pods")
+    assert rk.pool.requests.count("GET") == 1
+
+
+# -- VSP crash mid-call (vsp seam) -------------------------------------------
+
+def _plugin(channel, breaker=None):
+    p = GrpcPlugin(detection=None, retry=_policy(max_attempts=3),
+                   breaker=breaker)
+    p._channel = channel
+    p._new_channel = lambda: channel  # keep the scripted channel wired
+    return p
+
+
+def test_vsp_crash_mid_call_reconnects_and_recovers():
+    backend = ChaosChannel(
+        lambda svc, m, req, timeout: {"devices": {"chip-0": {}}},
+        seed=SEED)
+    backend.plan.script("DeviceService.GetDevices", Fail(times=2))
+    plugin = _plugin(backend)
+    assert plugin.get_devices() == {"chip-0": {}}
+    assert backend.calls == 3
+    assert backend.plan.exhausted()
+
+
+def test_vsp_persistent_crash_opens_breaker_and_reports_degraded():
+    now = [0.0]
+    breaker = resilience.CircuitBreaker("vsp", failure_threshold=3,
+                                        reset_timeout=10.0,
+                                        clock=lambda: now[0])
+    backend = ChaosChannel(lambda svc, m, req, timeout: {"supported": True},
+                           seed=SEED)
+    backend.plan.script("*", Fail(times=10))
+    plugin = _plugin(backend, breaker=breaker)
+    with pytest.raises(ConnectionResetError):
+        plugin.get_devices()  # 3 attempts = 3 failures -> breaker opens
+    assert breaker.is_open
+    assert plugin.degraded_sites() == ["vsp"]
+    calls_before = backend.calls
+    with pytest.raises(resilience.BreakerOpen):
+        plugin.get_devices()  # short-circuited: the VSP is walled off
+    assert backend.calls == calls_before
+    rejections = metrics.BREAKER_REJECTIONS.value(site="vsp")
+    assert rejections >= 1
+    # reset_timeout later a half-open probe finds the VSP healthy again
+    now[0] = 11.0
+    backend.plan._scripts.clear()
+    assert plugin.get_devices() == {}
+    assert not breaker.is_open
+    assert plugin.degraded_sites() == []
+
+
+def test_sustained_outage_reads_as_one_degraded_span():
+    """Degraded must NOT flap off every reset_timeout during a hard
+    outage: half-open (reset timer fired, recovery unproven) is still
+    degraded; only a SUCCESSFUL probe clears it. The state gauge and
+    the degraded signal must agree throughout."""
+    now = [0.0]
+    br = resilience.CircuitBreaker("t.span", failure_threshold=1,
+                                   reset_timeout=10.0,
+                                   clock=lambda: now[0])
+    br.record_failure()  # outage starts
+    assert br.degraded
+    now[0] = 10.5  # reset timer fired, dependency still dead
+    assert br.degraded  # NO healthy window before a probe succeeds
+    assert metrics.BREAKER_STATE.value(site="t.span") == 1  # gauge agrees
+    br.before_call()
+    br.record_failure()  # probe fails: still one continuous span
+    assert br.degraded
+    now[0] = 21.0
+    br.before_call()
+    br.record_success()  # recovery PROVEN: span ends
+    assert not br.degraded
+    assert metrics.BREAKER_STATE.value(site="t.span") == 0
+
+
+def test_vsp_app_errors_do_not_trip_the_breaker():
+    """A misconfigured caller looping on a deterministic server-side
+    rejection (gRPC UNKNOWN) must NOT wall off a healthy VSP for every
+    other caller on the node — app errors are answers, not faults."""
+    class _Code:
+        name = "UNKNOWN"
+
+    class _AppError(Exception):
+        def code(self):
+            return _Code()
+
+    breaker = resilience.CircuitBreaker("vsp", failure_threshold=2,
+                                        reset_timeout=10.0)
+    backend = ChaosChannel(lambda *a: {}, seed=SEED)
+    backend.plan.script("*", *[Fail(exc=_AppError, times=1)
+                               for _ in range(6)])
+    plugin = _plugin(backend, breaker=breaker)
+    for _ in range(6):
+        with pytest.raises(_AppError):
+            plugin.get_devices()
+    assert not breaker.is_open  # healthy VSP stays reachable
+    assert plugin.degraded_sites() == []
+
+
+def test_app_error_recloses_a_half_open_breaker():
+    """During a half-open probe, an application-level answer proves the
+    transport works: the breaker must re-close, not wedge half-open."""
+    now = [0.0]
+    br = resilience.CircuitBreaker("t.app", failure_threshold=1,
+                                   reset_timeout=5.0,
+                                   clock=lambda: now[0])
+    br.record_failure()  # open
+    now[0] = 6.0
+
+    def app_error():
+        raise ValueError("bad request, healthy server")
+
+    with pytest.raises(ValueError):
+        _policy(max_attempts=1).call(app_error, site="t.app", breaker=br)
+    assert br.state == resilience.CircuitBreaker.CLOSED
+
+
+def test_open_breaker_surfaces_degraded_condition_on_sfc(kube):
+    """The daemon reports Degraded on the CR instead of crashing while
+    the VSP breaker is open."""
+    sites = ["vsp"]
+    kube.create(_sfc(name="degraded-sfc", nfs=("nf-a",)))
+    rec = SfcReconciler(workload_image="img", retry=_policy(),
+                        degraded_provider=lambda: sites)
+    rec.reconcile(kube, _req("degraded-sfc"))
+    obj = kube.get("config.tpu.openshift.io/v1", "ServiceFunctionChain",
+                   "degraded-sfc", namespace="default")
+    conds = {c["type"]: c for c in obj["status"]["conditions"]}
+    assert conds["Degraded"]["status"] == "True"
+    assert conds["Degraded"]["reason"] == "CircuitBreakerOpen"
+    assert "vsp" in conds["Degraded"]["message"]
+    # breaker closes -> the condition disappears on the next resync
+    sites.clear()
+    rec.reconcile(kube, _req("degraded-sfc"))
+    obj = kube.get("config.tpu.openshift.io/v1", "ServiceFunctionChain",
+                   "degraded-sfc", namespace="default")
+    assert "Degraded" not in {c["type"]
+                              for c in obj["status"]["conditions"]}
+
+
+def test_healthz_reports_degraded_sites_while_breaker_open():
+    """Operators see degradation on /healthz (still 200 — alive and
+    partially serving), not discover it from missing wires."""
+    import urllib.request
+
+    sites = ["vsp"]
+    srv = metrics.MetricsServer(host="127.0.0.1", port=0,
+                                degraded_check=lambda: sites)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/healthz"
+        with urllib.request.urlopen(url) as r:
+            assert r.status == 200
+            assert r.read() == b"degraded: vsp"
+        sites.clear()
+        with urllib.request.urlopen(url) as r:
+            assert r.read() == b"ok"
+    finally:
+        srv.stop()
+
+
+# -- CNI ADD transient failure / idempotent DEL (cni seam) -------------------
+
+def _pod_request(command):
+    return PodRequest(command=command, pod_namespace="default",
+                      pod_name="p", sandbox_id="sbx-1", netns="/ns",
+                      ifname="net1", device_id="chip-0",
+                      netconf=NetConf())
+
+
+def test_cni_add_transient_failure_retries_in_dispatch(short_tmp):
+    calls = []
+
+    def add(req):
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("vsp flap")
+        return {"cniVersion": "0.4.0", "ok": True}
+
+    srv = CniServer(short_tmp + "/cni.sock", add_handler=add,
+                    timeout=5.0, retry=_policy(max_attempts=3))
+    resp = srv._dispatch(add, _pod_request("ADD"))
+    assert resp.error == ""
+    assert resp.result["ok"] is True
+    assert len(calls) == 3
+
+
+def test_cni_add_non_transient_failure_fails_fast(short_tmp):
+    calls = []
+
+    def add(req):
+        calls.append(1)
+        raise ValueError("bad netconf")
+
+    srv = CniServer(short_tmp + "/cni.sock", add_handler=add,
+                    timeout=5.0, retry=_policy(max_attempts=3))
+    with pytest.raises(ValueError):
+        srv._dispatch(add, _pod_request("ADD"))
+    assert len(calls) == 1
+
+
+def test_cni_del_tolerates_already_gone_state(short_tmp):
+    from dpu_operator_tpu.cni import AlreadyGone
+
+    def dele(req):
+        raise AlreadyGone(req.sandbox_id)  # state gone: daemon restarted
+
+    srv = CniServer(short_tmp + "/cni.sock", del_handler=dele,
+                    timeout=5.0, retry=_policy())
+    resp = srv._dispatch(dele, _pod_request("DEL"))
+    assert resp.error == ""  # idempotent success, kubelet stops retrying
+
+    def dele_fnf(req):
+        raise FileNotFoundError("cache file vanished")
+
+    resp = srv._dispatch(dele_fnf, _pod_request("DEL"))
+    assert resp.error == ""
+
+
+def test_cni_del_bare_keyerror_is_NOT_swallowed(short_tmp):
+    """A malformed cache entry (handler bug) must surface as an error so
+    kubelet retries — not convert to silent success + leaked devices."""
+    def buggy(req):
+        return {}["chip"]  # accidental KeyError, not an already-gone
+
+    srv = CniServer(short_tmp + "/cni.sock", del_handler=buggy,
+                    timeout=5.0, retry=_policy())
+    with pytest.raises(KeyError):
+        srv._dispatch(buggy, _pod_request("DEL"))
+
+
+# -- journal truncation (crash mid-write) ------------------------------------
+
+class _UnknownWiresVsp:
+    def list_network_functions(self):
+        return None  # dataplane cannot enumerate: journal trusted as-is
+
+
+def _partial_manager(chains_file):
+    m = TpuSideManager.__new__(TpuSideManager)
+    m.vsp = _UnknownWiresVsp()
+    m._attach_store = {}
+    m._attach_lock = threading.Lock()
+    m._chain_store = {}
+    m._chain_hops = {}
+    m._degraded_hops = set()
+    m._chains_file = chains_file
+    return m
+
+
+def test_truncated_journal_falls_back_to_last_good(short_tmp):
+    path = short_tmp + "/chains.json"
+    writer = _partial_manager(path)
+    with writer._attach_lock:
+        writer._chain_hops[("default", "c", 0)] = ("a-out", "b-in")
+        writer._save_chains_locked()
+    writer._flush_chains()  # snapshot v1 (no last-good yet)
+    with writer._attach_lock:
+        writer._chain_hops[("default", "c", 1)] = ("b-out", "c-in")
+        writer._save_chains_locked()
+    writer._flush_chains()  # snapshot v2; last-good = v1
+    before = metrics.JOURNAL_RECOVERIES.value(result="last_good")
+    truncate_file(path, seed=SEED)  # crash mid-write of v2
+    reader = _partial_manager(path)
+    reader._recover_chains()
+    # v1's hop is back; the truncated v2 delta is lost (at most one
+    # batch), NOT a crash during daemon prepare()
+    assert reader._chain_hops[("default", "c", 0)] == ("a-out", "b-in")
+    assert metrics.JOURNAL_RECOVERIES.value(
+        result="last_good") == before + 1
+
+
+def test_both_journal_copies_corrupt_starts_empty(short_tmp):
+    path = short_tmp + "/chains.json"
+    with open(path, "w") as f:
+        f.write('{"chains": [')  # torn
+    with open(path + ".last-good", "w") as f:
+        f.write("not json either")
+    before = metrics.JOURNAL_RECOVERIES.value(result="empty")
+    reader = _partial_manager(path)
+    reader._recover_chains()  # must not raise
+    assert reader._chain_hops == {}
+    assert metrics.JOURNAL_RECOVERIES.value(result="empty") == before + 1
+
+
+def test_clean_journal_counts_primary_recovery(short_tmp):
+    path = short_tmp + "/chains.json"
+    with open(path, "w") as f:
+        json.dump({"chains": [], "hops": [
+            {"namespace": "default", "name": "c", "index": 0,
+             "ids": ["x", "y"]}], "mirrors": [], "sandboxes": {}}, f)
+    before = metrics.JOURNAL_RECOVERIES.value(result="primary")
+    reader = _partial_manager(path)
+    reader._recover_chains()
+    assert reader._chain_hops[("default", "c", 0)] == ("x", "y")
+    assert metrics.JOURNAL_RECOVERIES.value(
+        result="primary") == before + 1
+
+
+# -- VSP server bind retry (satellite) ---------------------------------------
+
+def test_vsp_server_bind_retries_over_ephemeral_range():
+    import socket
+
+    from dpu_operator_tpu.vsp.rpc import VspServer
+
+    class _Impl:
+        def get_devices(self, req):
+            return {"devices": {}}
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    taken = blocker.getsockname()[1]
+    srv = VspServer(_Impl(), tcp_addr=("127.0.0.1", taken))
+    try:
+        srv.start()  # must NOT raise: falls through to an ephemeral port
+        assert srv.bound_port not in (0, taken)
+    finally:
+        srv.stop()
+        blocker.close()
+
+
+# -- drain typed errors (satellite) ------------------------------------------
+
+def test_cordon_raises_typed_node_not_found(kube):
+    from dpu_operator_tpu.utils.drain import Drainer, NodeNotFound
+
+    with pytest.raises(NodeNotFound) as ei:
+        Drainer(kube).cordon("ghost")
+    assert "ghost" in str(ei.value)
+    assert isinstance(ei.value, KeyError)  # old call sites keep working
+
+
+def test_uncordon_is_idempotent(kube, node_agent):
+    from dpu_operator_tpu.utils.drain import Drainer
+
+    node_agent.register_node("n1", allocatable={"google.com/tpu": "4"})
+    d = Drainer(kube)
+    d.uncordon("n1")  # already schedulable: no-op, no error
+    d.cordon("n1")
+    d.cordon("n1")  # idempotent cordon too
+    d.uncordon("n1")
+    d.uncordon("n1")
+    assert kube.get("v1", "Node", "n1")["spec"]["unschedulable"] is False
+    d.uncordon("gone-node")  # missing node: desired end state, no raise

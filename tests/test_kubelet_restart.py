@@ -135,6 +135,74 @@ def test_stop_racing_watcher_restart_stays_down(pm):
     assert plugin._stop.is_set()
 
 
+# -- kubelet restart + apiserver flap during an in-flight SFC reconcile ------
+
+
+def test_kubelet_restart_and_apiserver_flap_during_sfc_reconcile(pm):
+    """The two failure domains at once: kubelet.sock is recreated while
+    the SFC reconciler is mid-flight against a flapping apiserver. The
+    resilience layer must converge BOTH planes with no intervention —
+    the device plugin re-registers, and the chain's NF pods land once
+    the flap clears (manager backoff + in-place create retries)."""
+    import pytest
+
+    _ = pytest.importorskip("dpu_operator_tpu.testing")
+    from dpu_operator_tpu.api import (
+        NetworkFunction,
+        ServiceFunctionChain,
+    )
+    from dpu_operator_tpu.daemon import SfcReconciler
+    from dpu_operator_tpu.k8s import FakeKube, Manager
+    from dpu_operator_tpu.testing import ChaosKube, Fail
+    from dpu_operator_tpu.utils.resilience import RetryPolicy
+
+    kube = FakeKube()
+    chaos = ChaosKube(kube, seed=7)
+    # the flap: reconcile's first GET dies send-phase, the first two NF
+    # pod creates die send-phase (retried in place), one status write
+    # dies too (next resync repairs it)
+    chaos.plan.script("get", Fail(times=1))
+    chaos.plan.script("create", Fail(times=2))
+    chaos.plan.script("update_status", Fail(times=1))
+
+    kubelet = FakeKubelet(pm)
+    kubelet.start()
+    plugin = DevicePlugin(StaticHandler(dict(DEVS)), path_manager=pm,
+                          poll_interval=0.05)
+    plugin.start()
+    mgr = Manager(chaos)
+    mgr.RETRY_BASE = 0.05
+    mgr.add_reconciler(SfcReconciler(
+        workload_image="img",
+        retry=RetryPolicy(max_attempts=3, base=0.01, cap=0.05)))
+    mgr.start()
+    try:
+        plugin.register_with_kubelet()
+        plugin.enable_kubelet_watch(interval=0.1)
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+        # SFC lands while both faults are armed
+        kube.create(ServiceFunctionChain(
+            name="flap-sfc",
+            network_functions=[NetworkFunction("nf-a", "img-a"),
+                               NetworkFunction("nf-b", "img-b")],
+        ).to_obj())
+        kubelet.restart()  # kubelet dies mid-reconcile
+        assert mgr.wait_idle(timeout=15.0)
+        # apiserver plane converged: both NF pods exist despite the flap
+        assert _wait(lambda: kube.get(
+            "v1", "Pod", "flap-sfc-nf-a", namespace="default") is not None)
+        assert _wait(lambda: kube.get(
+            "v1", "Pod", "flap-sfc-nf-b", namespace="default") is not None)
+        assert _wait(chaos.plan.exhausted), "scripted faults not consumed"
+        # kubelet plane converged: plugin re-registered, devices back
+        assert _wait(lambda: plugin.reregistrations >= 1)
+        assert kubelet.wait_for_devices("google.com/tpu", 4)
+    finally:
+        mgr.stop()
+        plugin.stop()
+        kubelet.stop()
+
+
 # -- ports-before-chips ordering bound ---------------------------------------
 
 PORT_DEVS = {
