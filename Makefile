@@ -3,10 +3,11 @@ PYTHON ?= python
 REGISTRY ?= localhost:5000
 TAG ?= latest
 
-.PHONY: test fast-test collect-check chaos-check bench native traffic-flow \
-        images smoke-images deploy undeploy graft-check clean
+.PHONY: test fast-test collect-check chaos-check lint-check type-check \
+        bench native traffic-flow images smoke-images deploy undeploy \
+        graft-check clean
 
-test: native
+test: lint-check native
 	$(PYTHON) -m pytest tests/ -q
 
 # reference `fast-test`: skip the slow e2e tier
@@ -28,6 +29,25 @@ collect-check:
 chaos-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m chaos \
 	  -p no:randomly -p no:cacheprovider
+
+# opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST
+# checkers — wire-seam, retry-discipline, exception-hygiene,
+# metrics-naming, chaos-determinism, lock-discipline. Nonzero on any
+# violation not pragma'd or in opslint-baseline.json (the vet/race-
+# detector analog the reference gets from the Go toolchain)
+lint-check:
+	$(PYTHON) -m dpu_operator_tpu.analysis
+
+# mypy strict over utils/ ici/ k8s/ ([tool.mypy] in pyproject.toml).
+# The CI image does not ship mypy; the target degrades to a no-op there
+# rather than failing the whole gate on a missing dev tool
+type-check:
+	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
+	  $(PYTHON) -m mypy dpu_operator_tpu/utils dpu_operator_tpu/ici \
+	    dpu_operator_tpu/k8s; \
+	else \
+	  echo "type-check: mypy not installed; skipping (pip install mypy)"; \
+	fi
 
 # flake detector (reference: ginkgo --repeat 4 in `task test`)
 test-repeat: native

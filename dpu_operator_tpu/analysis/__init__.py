@@ -1,0 +1,29 @@
+"""opslint — the repo-native invariant linter (`make lint-check`).
+
+AST checkers enforcing the invariants PR 1/PR 2 established by hand on
+the wire path, plus a static guarded-by lock checker. Run as
+``python -m dpu_operator_tpu.analysis``; rules, pragma and baseline
+workflow are documented in doc/static-analysis.md.
+"""
+
+from .checkers import (ChaosDeterminismChecker, ExceptionHygieneChecker,
+                       MetricsNamingChecker, RetryDisciplineChecker,
+                       WireSeamChecker)
+from .core import Baseline, Checker, Module, Violation, run_checkers
+from .lockcheck import LockDisciplineChecker
+
+ALL_CHECKERS = (
+    WireSeamChecker,
+    RetryDisciplineChecker,
+    ExceptionHygieneChecker,
+    MetricsNamingChecker,
+    ChaosDeterminismChecker,
+    LockDisciplineChecker,
+)
+
+__all__ = [
+    "ALL_CHECKERS", "Baseline", "Checker", "Module", "Violation",
+    "run_checkers", "WireSeamChecker", "RetryDisciplineChecker",
+    "ExceptionHygieneChecker", "MetricsNamingChecker",
+    "ChaosDeterminismChecker", "LockDisciplineChecker",
+]

@@ -1,0 +1,104 @@
+"""CLI: ``python -m dpu_operator_tpu.analysis [paths...]``.
+
+Exit status: 0 when every finding is pragma'd or baselined, 1 when new
+violations fired, 2 on usage errors. ``--write-baseline`` records the
+current findings so the gate starts at zero and ratchets down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import ALL_CHECKERS
+from .core import Baseline, run_checkers
+
+DEFAULT_ROOTS = ("dpu_operator_tpu", "tests")
+DEFAULT_BASELINE = "opslint-baseline.json"
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dpu_operator_tpu.analysis",
+        description="opslint: repo-native invariant linter")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to scan (default: "
+                             "dpu_operator_tpu/ tests/)")
+    parser.add_argument("--repo-root", default=None,
+                        help="repo root for relative paths/baseline "
+                             "(default: auto-detected)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline JSON (default: "
+                             f"{DEFAULT_BASELINE} at the repo root)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline file entirely")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record current findings as the baseline")
+    parser.add_argument("--select", action="append", default=None,
+                        metavar="RULE", help="run only these rules")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    checkers = [cls() for cls in ALL_CHECKERS]
+    if args.list_rules:
+        for c in checkers:
+            print(f"{c.name:20s} {c.description}")
+        return 0
+    if args.select:
+        known = {c.name for c in checkers}
+        unknown = set(args.select) - known
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}; "
+                  f"known: {', '.join(sorted(known))}", file=sys.stderr)
+            return 2
+        checkers = [c for c in checkers if c.name in args.select]
+
+    repo_root = os.path.abspath(args.repo_root or _repo_root())
+    # a subset run (explicit paths or --select) sees only part of the
+    # findings: writing a baseline from it would erase every other
+    # rule's/path's entries, and "stale" cannot be distinguished from
+    # "not scanned"
+    subset = bool(args.paths) or bool(args.select)
+    if args.write_baseline and subset:
+        print("--write-baseline requires a full scan: drop the path "
+              "arguments and --select so the baseline covers every "
+              "rule and file", file=sys.stderr)
+        return 2
+    roots = args.paths or [r for r in DEFAULT_ROOTS
+                           if os.path.exists(os.path.join(repo_root, r))]
+    violations = run_checkers(checkers, roots, repo_root)
+
+    baseline_path = args.baseline or os.path.join(repo_root,
+                                                  DEFAULT_BASELINE)
+    if args.write_baseline:
+        Baseline(baseline_path).write(violations)
+        print(f"wrote {len(violations)} entries to {baseline_path}")
+        return 0
+    if args.no_baseline:
+        new, baselined, stale = violations, [], []
+    else:
+        new, baselined, stale = Baseline(baseline_path).split(violations)
+        if subset:
+            stale = []  # unscanned entries are not stale
+
+    for v in new:
+        print(v.render())
+    for v in baselined:
+        print(f"{v.render()}  (baselined)")
+    for key in stale:
+        print(f"stale baseline entry (fix landed? run --write-baseline "
+              f"to ratchet): {key}")
+    print(f"opslint: {len(new)} new, {len(baselined)} baselined, "
+          f"{len(stale)} stale baseline entries "
+          f"({len(checkers)} rules)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

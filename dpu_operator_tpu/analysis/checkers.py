@@ -1,0 +1,329 @@
+"""opslint rule implementations (the repo-invariant catalog).
+
+Each checker encodes one invariant PR 1/PR 2 established by hand; see
+doc/static-analysis.md for the catalog, rationale and examples. Rules
+only ever inspect the AST — no imports of the checked code, so a broken
+module cannot take the linter down with it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import Checker, Module, Violation, calls_in, dotted_name
+
+# -- wire-seam ----------------------------------------------------------------
+
+#: modules allowed to touch raw transports, and why. Everything else
+#: must ride the pooled apiserver client (k8s/pool.py via k8s/real.py)
+#: or the VSP gRPC seam (vsp/rpc.py) so retries, breakers and metrics
+#: see every wire call.
+WIRE_SEAM_ALLOW = {
+    "dpu_operator_tpu/k8s/pool.py":       # THE pooled apiserver transport
+        "owns http.client/socket for keep-alive connection pooling",
+    "dpu_operator_tpu/k8s/real.py":       # rides pool; requests kept for
+        "requests fallback session (proxies/auth) + TCP_NODELAY setup",
+    "dpu_operator_tpu/vsp/rpc.py":        # the gRPC seam itself
+        "daemon<->VSP gRPC plumbing",
+    "dpu_operator_tpu/cni/server.py":     # unix-socket listener
+        "CNI unix-socket server (socketserver)",
+    "dpu_operator_tpu/cni/shim.py":
+        "standalone shim exec'd by kubelet; must be dependency-free",
+    "dpu_operator_tpu/cni/announce.py":
+        "raw-socket GARP/NA announcements (no HTTP analog exists)",
+    "dpu_operator_tpu/vsp/native_dp.py":
+        "native cp-agent unix-socket framing",
+    "dpu_operator_tpu/utils/resilience.py":
+        "imports http.client exception types for transient classification",
+}
+
+_RAW_TRANSPORT_MODULES = {
+    "socket", "socketserver", "http.client", "requests",
+    "urllib.request", "urllib3", "httpx", "aiohttp",
+}
+
+
+class WireSeamChecker(Checker):
+    name = "wire-seam"
+    description = ("raw transport modules (socket/http.client/requests/...) "
+                   "may only be used at the pooled-client and VSP seams")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if module.is_test or module.relpath in WIRE_SEAM_ALLOW:
+            return
+        if not module.relpath.startswith("dpu_operator_tpu/"):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    hit = self._match(alias.name)
+                    if hit:
+                        yield self.violation(
+                            module, node,
+                            f"import of raw transport module {hit!r}: wire "
+                            "I/O must go through k8s/pool.py or vsp/rpc.py "
+                            "(see WIRE_SEAM_ALLOW)")
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                hit = self._match(node.module)
+                if hit:
+                    yield self.violation(
+                        module, node,
+                        f"import from raw transport module {hit!r}: wire "
+                        "I/O must go through k8s/pool.py or vsp/rpc.py "
+                        "(see WIRE_SEAM_ALLOW)")
+
+    @staticmethod
+    def _match(name: str) -> Optional[str]:
+        for banned in _RAW_TRANSPORT_MODULES:
+            if name == banned or name.startswith(banned + "."):
+                return banned
+        return None
+
+
+# -- retry-discipline ---------------------------------------------------------
+
+_RETRY_EXEMPT = {
+    "dpu_operator_tpu/utils/resilience.py",  # the one place backoff lives
+}
+
+_DEADLINE_CALLS = {"time.monotonic", "time.perf_counter", "monotonic",
+                   "perf_counter"}
+
+
+def _is_constant_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+class RetryDisciplineChecker(Checker):
+    name = "retry-discipline"
+    description = ("no unbounded sleep-retry loops: a `while True` that "
+                   "sleeps must check a deadline; use RetryPolicy for "
+                   "wire retries")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if (module.is_test or module.relpath in _RETRY_EXEMPT
+                or module.relpath.startswith("dpu_operator_tpu/testing/")):
+            return
+        if not module.relpath.startswith("dpu_operator_tpu/"):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.While):
+                continue
+            if not _is_constant_true(node.test):
+                continue
+            names = {n for c in calls_in(node)
+                     if (n := dotted_name(c.func))}
+            sleeps = {n for n in names
+                      if n == "time.sleep" or n.endswith(".sleep")}
+            if not sleeps:
+                continue
+            if names & _DEADLINE_CALLS:
+                continue  # deadline-bounded: the PR 1/PR 2 idiom
+            yield self.violation(
+                module, node,
+                "unbounded `while True` retry loop with "
+                f"{sorted(sleeps)[0]}() and no deadline check — use "
+                "utils.resilience.RetryPolicy (bounded attempts + "
+                "deadline budget) or bound the loop on time.monotonic()")
+
+
+# -- exception-hygiene --------------------------------------------------------
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True  # bare except
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    for t in types:
+        name = dotted_name(t) or ""
+        if name.split(".")[-1] in _BROAD_EXC:
+            return True
+    return False
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    """Silent = no call (log/metric/cleanup), no raise, no yield."""
+    for node in handler.body:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Call, ast.Raise, ast.Yield,
+                                ast.YieldFrom, ast.Await)):
+                return False
+    return True
+
+
+class ExceptionHygieneChecker(Checker):
+    name = "exception-hygiene"
+    description = ("no silent broad excepts: `except Exception: pass` "
+                   "must log or bump a metric (swallowed errors on the "
+                   "reconcile/wire path are invisible outages)")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if module.is_test:
+            return
+        if not module.relpath.startswith("dpu_operator_tpu/"):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _handler_is_broad(node) and _handler_is_silent(node):
+                caught = ("bare except" if node.type is None
+                          else f"except {ast.unparse(node.type)}")
+                yield self.violation(
+                    module, node,
+                    f"silent {caught}: log it or bump a metric "
+                    "(e.g. metrics.SWALLOWED_ERRORS) so the failure is "
+                    "observable; narrow the exception type if the case "
+                    "is truly expected")
+
+
+# -- metrics-naming -----------------------------------------------------------
+
+_REGISTRY_METHODS = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram",
+                     "histogram_vec": "histogram"}
+_CTOR_NAMES = {"Counter": "counter", "Gauge": "gauge",
+               "Histogram": "histogram", "HistogramVec": "histogram"}
+
+
+class MetricsNamingChecker(Checker):
+    name = "metrics-naming"
+    description = ("metric names carry the `tpu_` prefix; counters end "
+                   "`_total`; gauges/histograms do not")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if not module.relpath.startswith("dpu_operator_tpu/"):
+            return
+        for call in calls_in(module.tree):
+            kind = self._metric_kind(call)
+            if kind is None:
+                continue
+            name = self._metric_name(call)
+            if name is None:
+                continue
+            if not name.startswith("tpu_"):
+                yield self.violation(
+                    module, call,
+                    f"metric {name!r} lacks the `tpu_` namespace prefix")
+            if kind == "counter" and not name.endswith("_total"):
+                yield self.violation(
+                    module, call,
+                    f"counter {name!r} must end `_total` (Prometheus "
+                    "counter convention)")
+            if kind != "counter" and name.endswith("_total"):
+                yield self.violation(
+                    module, call,
+                    f"{kind} {name!r} must not end `_total` — that "
+                    "suffix marks counters")
+
+    @staticmethod
+    def _metric_kind(call: ast.Call) -> Optional[str]:
+        # needs a literal name AND a help string: two positional strs
+        # (filters out collections.Counter('abc') and friends)
+        if len(call.args) < 2:
+            return None
+        if not all(isinstance(a, ast.Constant) and isinstance(a.value, str)
+                   for a in call.args[:2]):
+            return None
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in _REGISTRY_METHODS:
+                return _REGISTRY_METHODS[call.func.attr]
+            if call.func.attr in _CTOR_NAMES:
+                return _CTOR_NAMES[call.func.attr]
+        elif isinstance(call.func, ast.Name) and call.func.id in _CTOR_NAMES:
+            return _CTOR_NAMES[call.func.id]
+        return None
+
+    @staticmethod
+    def _metric_name(call: ast.Call) -> Optional[str]:
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        return None
+
+
+# -- chaos-determinism --------------------------------------------------------
+
+#: callables whose result differs run-to-run; a chaos test touching one
+#: stops replaying bit-identically from its seed
+_NONDETERMINISTIC = {
+    "time.time", "time.time_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today", "date.today",
+    "os.urandom", "uuid.uuid1", "uuid.uuid4",
+}
+_ALLOWED_RANDOM = {"random.Random"}  # seedable constructor — the idiom
+
+
+def _has_chaos_mark(decorators: list) -> bool:
+    for dec in decorators:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if (dotted_name(target) or "").endswith("pytest.mark.chaos"):
+            return True
+    return False
+
+
+def _module_is_chaos(tree: ast.Module) -> bool:
+    """`pytestmark = pytest.mark.chaos` (or a list containing it)."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "pytestmark"
+                   for t in node.targets):
+            continue
+        values = (node.value.elts if isinstance(node.value, ast.List)
+                  else [node.value])
+        for v in values:
+            target = v.func if isinstance(v, ast.Call) else v
+            if (dotted_name(target) or "").endswith("pytest.mark.chaos"):
+                return True
+    return False
+
+
+class ChaosDeterminismChecker(Checker):
+    name = "chaos-determinism"
+    description = ("chaos-marked tests must not call unseeded random or "
+                   "wall-clock time (seeds must replay bit-identically)")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if not module.is_test:
+            return
+        regions = []
+        if _module_is_chaos(module.tree):
+            regions = [module.tree]
+        else:
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)) \
+                        and _has_chaos_mark(node.decorator_list):
+                    regions.append(node)
+        seen = set()
+        for region in regions:
+            for call in calls_in(region):
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                name = dotted_name(call.func)
+                if name is None:
+                    continue
+                bad = self._classify(name)
+                if bad:
+                    yield self.violation(
+                        module, call,
+                        f"chaos-marked test calls {name}() — {bad}")
+
+    @staticmethod
+    def _classify(name: str) -> Optional[str]:
+        if name in _NONDETERMINISTIC:
+            return ("wall-clock/entropy source; inject a seeded clock or "
+                    "rng (testing.chaos idiom) instead")
+        if name.startswith("random.") and name not in _ALLOWED_RANDOM:
+            return ("unseeded module-level random; use random.Random(SEED) "
+                    "so a failing run replays from its seed")
+        if name.startswith("secrets."):
+            return "OS entropy; chaos tests must be seed-deterministic"
+        return None

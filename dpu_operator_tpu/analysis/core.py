@@ -1,0 +1,201 @@
+"""opslint core: file walking, pragmas, baseline, violation model.
+
+The repo's hard invariants (PR 1/PR 2: all wire I/O rides the pooled
+client and the resilience seams, retries are deadline-bounded, chaos is
+seed-deterministic, shared state is lock-guarded) are encoded as AST
+checkers — the Python analog of the reference dpu-operator leaning on
+`go vet` + the race detector. This module is the framework; the rules
+live in :mod:`.checkers` and :mod:`.lockcheck`.
+
+Suppression model (both are greppable and reviewable):
+
+- pragma — ``# opslint: disable=<rule>[,<rule>...]`` on the offending
+  line silences those rules for that line; a pragma on a line of its own
+  at the top of the file (before any code) silences the rules for the
+  whole file.
+- baseline — a checked-in JSON file of known violations keyed on
+  ``(path, rule, message)`` (line numbers excluded, so unrelated edits
+  do not invalidate entries). Baselined findings are reported as
+  "baselined" but do not fail the run; entries that no longer fire are
+  reported as stale so the baseline only ever shrinks (the ratchet).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import tokenize
+from typing import Iterable, Iterator, Optional
+
+_PRAGMA_RE = re.compile(r"#\s*opslint:\s*disable=([\w\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity: line-number-free so edits above a
+        violation do not churn the baseline."""
+        return f"{self.path}::{self.rule}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """One parsed source file handed to every checker."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._line_pragmas: dict[int, set] = {}
+        self._file_pragmas: set = set()
+        self._scan_pragmas()
+
+    @property
+    def is_test(self) -> bool:
+        return self.relpath.startswith("tests/")
+
+    def _scan_pragmas(self):
+        first_code_line = min(
+            (n.lineno for n in self.tree.body), default=1)
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            bare = line.strip().startswith("#")
+            if bare and lineno < first_code_line:
+                self._file_pragmas |= rules
+            else:
+                self._line_pragmas.setdefault(lineno, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_pragmas:
+            return True
+        return rule in self._line_pragmas.get(line, set())
+
+
+class Checker:
+    """Base checker: subclasses set ``name`` and implement ``check``."""
+
+    name = "base"
+    description = ""
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        raise NotImplementedError
+
+    def violation(self, module: Module, node: ast.AST,
+                  message: str) -> Violation:
+        return Violation(self.name, module.relpath,
+                         getattr(node, "lineno", 1), message)
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def calls_in(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def call_targets(node: ast.AST) -> set:
+    return {name for c in calls_in(node)
+            if (name := dotted_name(c.func)) is not None}
+
+
+# -- file walking -------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "node_modules", ".pytest_cache"}
+
+
+def iter_python_files(roots: Iterable[str], repo_root: str) -> Iterator[str]:
+    for root in roots:
+        root = os.path.join(repo_root, root) if not os.path.isabs(root) \
+            else root
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_module(path: str, repo_root: str) -> Optional[Module]:
+    relpath = os.path.relpath(path, repo_root)
+    try:
+        with tokenize.open(path) as fh:
+            source = fh.read()
+        return Module(path, relpath, source)
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        # unparseable files are collect-check's problem, not opslint's
+        return None
+
+
+# -- baseline -----------------------------------------------------------------
+
+class Baseline:
+    def __init__(self, path: str):
+        self.path = path
+        self.entries: set = set()
+        self.loaded = False
+        if os.path.exists(path):
+            with open(path) as fh:
+                data = json.load(fh)
+            self.entries = set(data.get("entries", []))
+            self.loaded = True
+
+    def write(self, violations: Iterable[Violation]):
+        data = {"version": 1,
+                "entries": sorted({v.key() for v in violations})}
+        with open(self.path, "w") as fh:
+            json.dump(data, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    def split(self, violations: list):
+        """-> (new, baselined, stale_entries)."""
+        fired = {v.key() for v in violations}
+        new = [v for v in violations if v.key() not in self.entries]
+        baselined = [v for v in violations if v.key() in self.entries]
+        stale = sorted(self.entries - fired)
+        return new, baselined, stale
+
+
+def run_checkers(checkers: Iterable[Checker], roots: Iterable[str],
+                 repo_root: str) -> list:
+    """All non-suppressed violations, ordered by (path, line, rule)."""
+    violations = []
+    for path in iter_python_files(roots, repo_root):
+        module = load_module(path, repo_root)
+        if module is None:
+            continue
+        for checker in checkers:
+            for v in checker.check(module):
+                if not module.suppressed(v.rule, v.line):
+                    violations.append(v)
+    return sorted(violations, key=lambda v: (v.path, v.line, v.rule))

@@ -1,0 +1,242 @@
+"""opslint lock-discipline: a static guarded-by checker.
+
+The heuristic mirrors Java's @GuardedBy and Go's "mu protects the fields
+below it" convention, inferred instead of declared: within a class that
+owns a lock, any instance attribute written at least once under `with
+self.<lock>:` is *guarded*; a write to a guarded attribute outside every
+lock block (and outside ``__init__``, which happens-before publication)
+is a candidate race.
+
+Only writes are flagged. Lock-free reads of guarded state are a
+deliberate non-goal: the codebase uses benign racy reads (gauges,
+health checks) widely, and flagging them would bury the real findings.
+
+Recognized lock-acquisition shapes:
+
+- ``with self.<attr>:`` where <attr> was assigned a ``threading.Lock()``
+  / ``RLock()`` / ``Condition()`` in this class, or simply contains
+  "lock"/"cond" in its name (covers locks inherited from a base class,
+  e.g. Gauge using Counter's ``_lock``);
+- methods whose name ends ``_locked`` — the repo-wide convention for
+  "caller holds the lock" helpers (metrics, resilience);
+- a ``try`` block whose preceding statement calls
+  ``self.<lock>.acquire(...)`` and whose finally releases it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import Checker, Module, Violation, dotted_name
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+
+#: method calls that mutate a container in place
+_MUTATORS = {"append", "add", "pop", "popitem", "clear", "update", "remove",
+             "discard", "extend", "insert", "setdefault", "appendleft",
+             "popleft"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for `self.x`, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lockish(attr: str, known_locks: set) -> bool:
+    low = attr.lower()
+    return attr in known_locks or "lock" in low or "cond" in low
+
+
+class _Write:
+    __slots__ = ("attr", "node", "under_lock", "method")
+
+    def __init__(self, attr: str, node: ast.AST, under_lock: bool,
+                 method: str):
+        self.attr = attr
+        self.node = node
+        self.under_lock = under_lock
+        self.method = method
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Collect self-attribute writes in one method, tracking whether
+    each write happens under a recognized lock acquisition."""
+
+    def __init__(self, method_name: str, known_locks: set):
+        self.known_locks = known_locks
+        self.method = method_name
+        # *_locked helpers run with the caller's lock held by contract
+        self.depth = 1 if method_name.endswith("_locked") else 0
+        self.writes: list = []
+
+    # -- lock scopes ----------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        held = 0
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and _lockish(attr, self.known_locks):
+                held += 1
+        self.depth += held
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth -= held
+
+    def visit_Try(self, node: ast.Try):
+        # acquire()/finally-release() shape: self.<lock>.acquire(...)
+        # directly guarding this try means the try body runs locked
+        held = 1 if self._guarded_try(node) else 0
+        self.depth += held
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth -= held
+        for part in (node.handlers, node.orelse, node.finalbody):
+            for stmt in part:
+                self.visit(stmt)
+
+    def _guarded_try(self, node: ast.Try) -> bool:
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute) and \
+                        sub.func.attr == "release":
+                    attr = _self_attr(sub.func.value)
+                    if attr is not None and _lockish(attr,
+                                                     self.known_locks):
+                        return True
+        return False
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # a closure's body does not run where it is defined: timer and
+        # watch-callback closures execute on other threads later, so
+        # scan them with the lock depth RESET — their writes only count
+        # as guarded if the closure itself takes the lock (or is a
+        # *_locked helper by the repo convention)
+        saved = self.depth
+        self.depth = 1 if node.name.endswith("_locked") else 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        saved, self.depth = self.depth, 0
+        self.visit(node.body)
+        self.depth = saved
+
+    # -- writes ---------------------------------------------------------------
+    def _record(self, target: ast.AST):
+        attr = _self_attr(target)
+        if attr is None and isinstance(target, (ast.Subscript,)):
+            attr = _self_attr(target.value)
+        if attr is None or _lockish(attr, self.known_locks):
+            return
+        if attr == "__dict__":
+            # the repo's lazy-init idiom: __dict__.setdefault is atomic
+            # on CPython and deliberately lock-free
+            return
+        self.writes.append(_Write(attr, target, self.depth > 0,
+                                  self.method))
+
+    def visit_Assign(self, node: ast.Assign):
+        for target in node.targets:
+            if isinstance(target, ast.Tuple):
+                for elt in target.elts:
+                    self._record(elt)
+            else:
+                self._record(target)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record(node.target)
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for target in node.targets:
+            self._record(target)
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            self._record(node.func.value)
+        self.generic_visit(node)
+
+
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+    description = ("attributes written under a class's lock anywhere must "
+                   "be written under it everywhere (outside __init__)")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if module.is_test:
+            return
+        if not module.relpath.startswith("dpu_operator_tpu/"):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(module, node)
+
+    def _check_class(self, module: Module,
+                     cls: ast.ClassDef) -> Iterator[Violation]:
+        known_locks = self._lock_attrs(cls)
+        writes: list = []
+        uses_locks = bool(known_locks)
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            scanner = _MethodScanner(item.name, known_locks)
+            for stmt in item.body:
+                scanner.visit(stmt)
+            writes.extend(scanner.writes)
+            if any(w.under_lock for w in scanner.writes) \
+                    or self._has_lock_scope(item, known_locks):
+                uses_locks = True
+        if not uses_locks:
+            return  # lock-free class: nothing to guard
+        guarded = {w.attr for w in writes if w.under_lock}
+        for w in writes:
+            if (w.attr in guarded and not w.under_lock
+                    and w.method != "__init__"):
+                yield self.violation(
+                    module, w.node,
+                    f"attribute `self.{w.attr}` is written under "
+                    f"`{cls.name}`'s lock elsewhere but written here "
+                    f"(in `{w.method}`) without it — either take the "
+                    "lock, or pragma with the happens-before argument")
+
+    @staticmethod
+    def _lock_attrs(cls: ast.ClassDef) -> set:
+        locks = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            if (dotted_name(node.value.func) or "") in _LOCK_CTORS:
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        locks.add(attr)
+        return locks
+
+    @staticmethod
+    def _has_lock_scope(fn: ast.AST, known_locks: set) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and _lockish(attr, known_locks):
+                        return True
+        return False

@@ -25,6 +25,7 @@ from ..cni.ipam import ipam_add, ipam_del
 from ..cni.types import DeviceWiring, PodRequest
 from ..deviceplugin import DevicePlugin
 from ..k8s.manager import Manager
+from ..utils import metrics
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
 from ..vsp.rpc import VspChannel
@@ -182,7 +183,10 @@ class HostSideManager:
                     self._slice_topology = SliceTopology.cached(topo)
                     self._topology_ok_at = now
             except Exception:  # noqa: BLE001 — decoration is best-effort
-                pass
+                metrics.SWALLOWED_ERRORS.inc(
+                    site="hostside.fetch_slice_topology")
+                log.debug("slice-topology refresh failed; serving the "
+                          "last known topology", exc_info=True)
         finally:
             self._topology_lock.release()
         return self._slice_topology

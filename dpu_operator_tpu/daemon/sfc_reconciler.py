@@ -28,7 +28,7 @@ def _condition(ctype: str, ok: bool, reason: str, message: str) -> dict:
 def _already_exists(e: Exception) -> bool:
     """409/AlreadyExists across both client flavors (RealKube raises
     requests.HTTPError with a response; FakeKube raises AlreadyExists)."""
-    from ..k8s.fake import AlreadyExists
+    from ..k8s.client import AlreadyExists
 
     if isinstance(e, AlreadyExists):
         return True

@@ -284,13 +284,17 @@ class TpuSideManager:
             try:
                 channel.close()
             except Exception:  # noqa: BLE001 — teardown is best-effort
-                pass
+                metrics.SWALLOWED_ERRORS.inc(site="tpuside.stop")
+                log.debug("peer channel close failed during stop",
+                          exc_info=True)
         self._repair_stop.set()
         if self._repair_client is not None:
             try:
                 self._repair_client.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                metrics.SWALLOWED_ERRORS.inc(site="tpuside.stop")
+                log.debug("repair client close failed during stop",
+                          exc_info=True)
         if self._manager:
             self._manager.stop()
         self.cni_server.stop()
@@ -799,7 +803,9 @@ class TpuSideManager:
             try:
                 channel.close()
             except Exception:  # noqa: BLE001 — already broken
-                pass
+                metrics.SWALLOWED_ERRORS.inc(site="tpuside.remote_call")
+                log.debug("close of broken peer channel %s failed", addr,
+                          exc_info=True)
             raise
 
     def _unwire_remote(self, addr: str, ids: tuple, context: str):
@@ -1084,6 +1090,10 @@ class TpuSideManager:
                 probe_cache[chip] = {p["port"]: p
                                      for p in self.link_prober(chip)}
             except Exception:  # noqa: BLE001 — telemetry, not control
+                metrics.SWALLOWED_ERRORS.inc(site="tpuside.link_probe")
+                log.debug("link probe for chip %d failed; treating its "
+                          "ports as healthy this pass", chip,
+                          exc_info=True)
                 probe_cache[chip] = {}
         state = probe_cache[chip].get(port)
         # only a WIRED port that lost its link counts as down — unwired

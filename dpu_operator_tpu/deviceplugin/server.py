@@ -235,6 +235,14 @@ class DevicePlugin:
         return self.path_manager.device_plugin_socket(self.resource)
 
     def start(self):
+        # under _lifecycle_lock: a SIGTERM stop() racing the initial
+        # start() must not strand a freshly-built server the stop path
+        # already ran past (the kubelet-watch restart path re-enters via
+        # _start_locked, already holding the lock)
+        with self._lifecycle_lock:
+            self._start_locked()
+
+    def _start_locked(self):
         os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
@@ -352,7 +360,7 @@ class DevicePlugin:
             if self._server is not None:
                 self._server.stop(0.5).wait()
                 self._server = None
-            self.start()
+            self._start_locked()
 
     # -- registration (deviceplugin.go:229-262) -------------------------------
     def register_with_kubelet(self, timeout: float = 10.0):

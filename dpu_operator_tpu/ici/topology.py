@@ -135,7 +135,7 @@ class SliceTopology:
     _CACHE_LOCK: ClassVar[threading.Lock] = threading.Lock()
     _CACHE_MAX: ClassVar[int] = 32
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         self.generation, n = parse_topology(self.topology)
         self.shape = slice_shape(self.topology)
         per_host = CHIPS_PER_HOST[self.generation]
@@ -190,7 +190,7 @@ class SliceTopology:
         new._dict_json = self._dict_json  # immutable string; shareable
         return new
 
-    def _build_indexes(self):
+    def _build_indexes(self) -> None:
         """Precomputed adjacency views (ISSUE: daemon lookups were
         O(links) scans per device-plugin poll). Built by one pass over
         the wired lists so every index preserves global link order —
@@ -219,7 +219,7 @@ class SliceTopology:
             idx = idx * d + c
         return idx
 
-    def _wire(self, dims: int):
+    def _wire(self, dims: int) -> None:
         """Wire torus neighbor links. Dimensions of extent 1 get no links;
         extent-2 dimensions get a single (non-duplicated) link; wraparound on
         every dimension ≥3 (torus) matching v5e 8x8+ / v5p cube semantics."""

@@ -16,6 +16,17 @@ from __future__ import annotations
 from typing import Callable, Optional, Protocol
 
 
+class Conflict(Exception):
+    """Optimistic-concurrency conflict (HTTP 409 Conflict on update)."""
+
+
+class AlreadyExists(Exception):
+    """Create raced another writer (HTTP 409 AlreadyExists). Defined at
+    the client seam — production code (SFC reconciler adopt path) and
+    both client flavors classify against it, so it must not live in the
+    test fake."""
+
+
 def gvk_key(api_version: str, kind: str) -> str:
     return f"{api_version}/{kind}"
 
@@ -99,7 +110,7 @@ def deep_merge(base: dict, patch: dict) -> dict:
     return out
 
 
-def parse_quantity(q) -> float:
+def parse_quantity(q: object) -> float:
     """Parse a Kubernetes resource quantity ('2', '500m', '1Gi')."""
     if isinstance(q, (int, float)):
         return float(q)

@@ -19,19 +19,15 @@ import weakref
 from typing import Callable, Optional
 
 from .client import (
+    AlreadyExists,
+    Conflict,
     deep_merge,
     gvk_key,
     match_labels,
     pod_resource_requests,
 )
 
-
-class Conflict(Exception):
-    pass
-
-
-class AlreadyExists(Exception):
-    pass
+__all__ = ["AlreadyExists", "Conflict", "FakeKube", "FakeNodeAgent"]
 
 
 class FakeKube:
@@ -41,7 +37,7 @@ class FakeKube:
     #: with their tests)
     instances: "weakref.WeakSet[FakeKube]" = None  # set below
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.RLock()
         self._store: dict[tuple, dict] = {}
         self._watchers: dict[str, list[Callable]] = {}
@@ -50,15 +46,16 @@ class FakeKube:
         FakeKube.instances.add(self)
 
     # -- internal -------------------------------------------------------------
-    def _key(self, api_version, kind, namespace, name):
+    def _key(self, api_version: str, kind: str, namespace: Optional[str],
+             name: str) -> tuple:
         return (gvk_key(api_version, kind), namespace or "", name)
 
-    def _notify(self, event: str, obj: dict):
+    def _notify(self, event: str, obj: dict) -> None:
         for cb in list(self._watchers.get(
                 gvk_key(obj.get("apiVersion", ""), obj.get("kind", "")), [])):
             cb(event, copy.deepcopy(obj))
 
-    def _stamp(self, obj: dict, new: bool):
+    def _stamp(self, obj: dict, new: bool) -> None:
         md = obj.setdefault("metadata", {})
         md["resourceVersion"] = str(next(self._rv))
         if new:
@@ -66,12 +63,15 @@ class FakeKube:
             md.setdefault("creationTimestamp", time.time())
 
     # -- KubeClient interface -------------------------------------------------
-    def get(self, api_version, kind, name, namespace=None):
+    def get(self, api_version: str, kind: str, name: str,
+            namespace: Optional[str] = None) -> Optional[dict]:
         with self._lock:
             obj = self._store.get(self._key(api_version, kind, namespace, name))
             return copy.deepcopy(obj) if obj else None
 
-    def list(self, api_version, kind, namespace=None, label_selector=None):
+    def list(self, api_version: str, kind: str,
+             namespace: Optional[str] = None,
+             label_selector: Optional[dict] = None) -> list:
         with self._lock:
             out = []
             for (g, ns, _), obj in self._store.items():
@@ -84,7 +84,7 @@ class FakeKube:
                 out.append(copy.deepcopy(obj))
             return out
 
-    def create(self, obj):
+    def create(self, obj: dict) -> dict:
         obj = copy.deepcopy(obj)
         md = obj.get("metadata", {})
         key = self._key(obj.get("apiVersion"), obj.get("kind"),
@@ -101,7 +101,7 @@ class FakeKube:
         self._fan_out(stored)
         return stored
 
-    def update(self, obj):
+    def update(self, obj: dict) -> dict:
         obj = copy.deepcopy(obj)
         md = obj.get("metadata", {})
         key = self._key(obj.get("apiVersion"), obj.get("kind"),
@@ -122,7 +122,7 @@ class FakeKube:
         self._fan_out(stored)
         return stored
 
-    def apply(self, obj):
+    def apply(self, obj: dict) -> dict:
         """Create-or-merge, tolerant like the reference's ApplyObject path
         (render.go:84-92 swallows AlreadyExists/Conflict): retries on
         concurrent create/update/delete races."""
@@ -146,7 +146,8 @@ class FakeKube:
                 continue
         raise Conflict(f"apply kept racing for {key}")
 
-    def delete(self, api_version, kind, name, namespace=None):
+    def delete(self, api_version: str, kind: str, name: str,
+               namespace: Optional[str] = None) -> None:
         key = self._key(api_version, kind, namespace, name)
         with self._lock:
             obj = self._store.pop(key, None)
@@ -155,7 +156,7 @@ class FakeKube:
         self._notify("DELETED", obj)
         self._gc(obj)
 
-    def update_status(self, obj):
+    def update_status(self, obj: dict) -> dict:
         md = obj.get("metadata", {})
         key = self._key(obj.get("apiVersion"), obj.get("kind"),
                         md.get("namespace"), md.get("name"))
@@ -171,7 +172,8 @@ class FakeKube:
         self._notify("MODIFIED", stored)
         return stored
 
-    def watch(self, api_version, kind, callback):
+    def watch(self, api_version: str, kind: str,
+              callback: Callable) -> Callable[[], None]:
         g = gvk_key(api_version, kind)
         with self._lock:
             self._watchers.setdefault(g, []).append(callback)
@@ -180,7 +182,7 @@ class FakeKube:
         for obj in existing:
             callback("ADDED", obj)
 
-        def cancel():
+        def cancel() -> None:
             with self._lock:
                 try:
                     self._watchers[g].remove(callback)
@@ -189,7 +191,7 @@ class FakeKube:
         return cancel
 
     # -- controller-manager-ish behaviors ------------------------------------
-    def _gc(self, owner: dict):
+    def _gc(self, owner: dict) -> None:
         """ownerReference cascade delete."""
         uid = owner.get("metadata", {}).get("uid")
         if not uid:
@@ -205,7 +207,7 @@ class FakeKube:
             api_version, kind = gv_kind
             self.delete(api_version, kind, name, namespace=ns or None)
 
-    def _fan_out(self, obj: dict):
+    def _fan_out(self, obj: dict) -> None:
         """DaemonSet controller simulation: one pod per node matching the
         nodeSelector (reference relies on the real DS controller;
         bindata/daemon/99.daemonset.yaml:20-21). A Node appearing after the
@@ -263,20 +265,20 @@ class FakeNodeAgent:
     a measurable schedule→Running latency (BASELINE.md p50 metric).
     """
 
-    def __init__(self, kube: FakeKube, startup_delay: float = 0.0):
+    def __init__(self, kube: FakeKube, startup_delay: float = 0.0) -> None:
         self.kube = kube
         self.startup_delay = startup_delay
         self._cancel = None
 
-    def start(self):
+    def start(self) -> None:
         self._cancel = self.kube.watch("v1", "Pod", self._on_pod)
 
-    def stop(self):
+    def stop(self) -> None:
         if self._cancel:
             self._cancel()
 
     def register_node(self, name: str, labels: Optional[dict] = None,
-                      allocatable: Optional[dict] = None):
+                      allocatable: Optional[dict] = None) -> None:
         self.kube.apply({
             "apiVersion": "v1", "kind": "Node",
             "metadata": {"name": name, "labels": labels or {}},
@@ -285,7 +287,7 @@ class FakeNodeAgent:
         })
         self.sync()
 
-    def set_allocatable(self, node: str, resource: str, count: int):
+    def set_allocatable(self, node: str, resource: str, count: int) -> None:
         """Device-plugin registration surfaces here (the fake kubelet's
         equivalent of kubelet updating node allocatable after a device plugin
         registers — reference: dpusidemanager_test.go:22-49 asserts this)."""
@@ -323,11 +325,11 @@ class FakeNodeAgent:
         labels = node.get("metadata", {}).get("labels", {}) or {}
         return all(labels.get(k) == v for k, v in sel.items())
 
-    def _on_pod(self, event, pod):
+    def _on_pod(self, event: str, pod: dict) -> None:
         if event in ("ADDED", "MODIFIED"):
             self.sync()
 
-    def sync(self):
+    def sync(self) -> None:
         """One scheduling + kubelet pass. Idempotent; called on pod events."""
         for pod in self.kube.list("v1", "Pod"):
             phase = pod.get("status", {}).get("phase", "Pending")
@@ -338,8 +340,9 @@ class FakeNodeAgent:
                         spec["nodeName"] = node["metadata"]["name"]
                         try:
                             self.kube.update(pod)
-                        except Exception:
-                            pass
+                        except Exception:  # opslint: disable=exception-hygiene
+                            pass  # fake scheduler lost an update race;
+                            # the next sync() pass re-schedules
                         break
                 else:
                     continue

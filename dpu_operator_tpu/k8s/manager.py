@@ -15,6 +15,7 @@ from dataclasses import dataclass
 from typing import Optional, Protocol
 
 from ..utils import metrics, tracing
+from .client import KubeClient
 
 log = logging.getLogger(__name__)
 
@@ -36,11 +37,12 @@ class Reconciler(Protocol):
     #: (api_version, kind) this reconciler watches
     watches: tuple
 
-    def reconcile(self, client, req: Request) -> ReconcileResult: ...
+    def reconcile(self, client: "KubeClient",
+                  req: Request) -> ReconcileResult: ...
 
 
 class Manager:
-    def __init__(self, client):
+    def __init__(self, client: "KubeClient") -> None:
         self.client = client
         self._reconcilers: list[Reconciler] = []
         self._queue: "queue.Queue[tuple[Reconciler, Request]]" = queue.Queue()
@@ -58,10 +60,10 @@ class Manager:
         #: stack N parallel resync loops for the same object
         self._resync_pending: set = set()
 
-    def add_reconciler(self, rec: Reconciler):
+    def add_reconciler(self, rec: Reconciler) -> None:
         self._reconcilers.append(rec)
 
-    def _enqueue(self, rec: Reconciler, req: Request):
+    def _enqueue(self, rec: Reconciler, req: Request) -> None:
         key = (id(rec), req)
         with self._lock:
             if key in self._pending:
@@ -70,11 +72,13 @@ class Manager:
         self._idle.clear()
         self._queue.put((rec, req))
 
-    def start(self):
+    def start(self) -> None:
         for rec in self._reconcilers:
             api_version, kind = rec.watches
 
-            def cb(event, obj, rec=rec, api_version=api_version, kind=kind):
+            def cb(event: str, obj: dict, rec: Reconciler = rec,
+                   api_version: str = api_version,
+                   kind: str = kind) -> None:
                 md = obj.get("metadata", {})
                 self._enqueue(rec, Request(api_version, kind, md.get("name"),
                                            md.get("namespace") or None))
@@ -83,7 +87,7 @@ class Manager:
                                         name="manager-worker")
         self._thread.start()
 
-    def stop(self):
+    def stop(self) -> None:
         self._stop.set()
         for c in self._cancels:
             c()
@@ -100,7 +104,7 @@ class Manager:
     RETRY_BASE = 0.5
     RETRY_MAX = 60.0
 
-    def _schedule_retry(self, delay: float, rec, req,
+    def _schedule_retry(self, delay: float, rec: Reconciler, req: Request,
                         timers: dict, counts_as_pending: bool = True) -> None:
         """*counts_as_pending*=False for periodic resyncs
         (ReconcileResult.requeue_after): a steady-state resync loop must
@@ -121,7 +125,7 @@ class Manager:
 
         key = object()
 
-        def fire():
+        def fire() -> None:
             if not counts_as_pending:
                 # drop the resync marker BEFORE enqueueing: if the worker
                 # drains the new item and reschedules before we dropped
@@ -144,7 +148,7 @@ class Manager:
         t.start()
         timers[key] = t
 
-    def _run(self):
+    def _run(self) -> None:
         timers: dict = {}
         failures: dict[tuple, int] = {}
         while not self._stop.is_set():

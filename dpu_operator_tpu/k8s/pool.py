@@ -23,7 +23,7 @@ import http.client
 import socket
 import ssl
 import threading
-from typing import Optional
+from typing import Any, Optional
 from urllib.parse import urlencode, urlsplit
 
 from ..utils import metrics, resilience
@@ -68,7 +68,7 @@ class PooledResponse:
     __slots__ = ("status_code", "headers", "content", "_url")
 
     def __init__(self, status_code: int, headers: dict, content: bytes,
-                 url: str):
+                 url: str) -> None:
         self.status_code = status_code
         self.headers = headers
         self.content = content
@@ -78,11 +78,11 @@ class PooledResponse:
     def text(self) -> str:
         return self.content.decode("utf-8", errors="replace")
 
-    def json(self):
+    def json(self) -> Any:
         import json
         return json.loads(self.content or b"null")
 
-    def raise_for_status(self):
+    def raise_for_status(self) -> None:
         if self.status_code >= 400:
             import requests
             raise requests.HTTPError(
@@ -94,7 +94,7 @@ class HttpsConnectionPool:
     """Keep-alive pool of ``http.client.HTTPSConnection`` to one host."""
 
     def __init__(self, base_url: str, context: ssl.SSLContext,
-                 max_idle: int = 8, timeout: float = 30.0):
+                 max_idle: int = 8, timeout: float = 30.0) -> None:
         parts = urlsplit(base_url)
         if parts.scheme != "https":
             raise ValueError(f"pool is HTTPS-only, got {base_url!r}")
@@ -140,7 +140,7 @@ class HttpsConnectionPool:
                 return self._idle.pop(), True
         return self._dial(timeout), False
 
-    def _checkin(self, conn: http.client.HTTPSConnection):
+    def _checkin(self, conn: http.client.HTTPSConnection) -> None:
         with self._lock:
             if not self._closed and len(self._idle) < self.max_idle:
                 self._idle.append(conn)
@@ -236,7 +236,7 @@ class HttpsConnectionPool:
                 "requests_per_connection":
                     round(served / opened, 2) if opened else 0.0}
 
-    def close(self):
+    def close(self) -> None:
         with self._lock:
             self._closed = True
             idle, self._idle = self._idle, []

@@ -22,7 +22,7 @@ import ssl
 import tempfile
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import yaml
 
@@ -81,7 +81,7 @@ def plural(kind: str) -> str:
 
 
 class RealKube:
-    def __init__(self, kubeconfig: Optional[str] = None):
+    def __init__(self, kubeconfig: Optional[str] = None) -> None:
         if requests is None:  # pragma: no cover
             raise RuntimeError("requests not available")
         path = kubeconfig or os.environ.get("KUBECONFIG",
@@ -185,14 +185,18 @@ class RealKube:
             ctx.load_cert_chain(*self.session.cert)
         return ctx
 
-    def _request(self, verb: str, method: str, url: str, params=None,
-                 json_obj=None, data=None, headers=None, timeout=None):
+    def _request(self, verb: str, method: str, url: str,
+                 params: Optional[dict] = None,
+                 json_obj: Optional[dict] = None,
+                 data: Optional[str] = None,
+                 headers: Optional[dict] = None,
+                 timeout: Optional[float] = None) -> Any:
         """One apiserver round trip: pooled fast path when available,
         requests session otherwise; per-verb latency is observed either
         way so the histogram reflects what production actually pays."""
         timeout = timeout or self.request_timeout
 
-        def one_attempt():
+        def one_attempt() -> Any:
             # metrics are per ATTEMPT, inside the retry: the per-verb
             # histogram means wire RTT — folding backoff sleeps and N
             # failed connects into one sample would inflate the p95
@@ -238,7 +242,7 @@ class RealKube:
         return self.pool.stats()
 
     def _url(self, api_version: str, kind: str, namespace: Optional[str],
-             name: Optional[str] = None, subresource: Optional[str] = None):
+             name: Optional[str] = None, subresource: Optional[str] = None) -> str:
         if "/" in api_version:
             prefix = f"{self.base}/apis/{api_version}"
         else:
@@ -253,7 +257,9 @@ class RealKube:
             parts.append(subresource)
         return prefix + "/" + "/".join(parts)
 
-    def get(self, api_version, kind, name, namespace=None, timeout=None):
+    def get(self, api_version: str, kind: str, name: str,
+            namespace: Optional[str] = None,
+            timeout: Optional[float] = None) -> Optional[dict]:
         r = self._request("get", "GET",
                           self._url(api_version, kind, namespace, name),
                           timeout=timeout)
@@ -262,7 +268,9 @@ class RealKube:
         r.raise_for_status()
         return r.json()
 
-    def list(self, api_version, kind, namespace=None, label_selector=None):
+    def list(self, api_version: str, kind: str,
+             namespace: Optional[str] = None,
+             label_selector: Optional[dict] = None) -> list:
         params = {}
         if label_selector:
             params["labelSelector"] = ",".join(
@@ -273,7 +281,7 @@ class RealKube:
         r.raise_for_status()
         return r.json().get("items", [])
 
-    def create(self, obj, timeout=None):
+    def create(self, obj: dict, timeout: Optional[float] = None) -> dict:
         md = obj["metadata"]
         r = self._request(
             "create", "POST",
@@ -282,7 +290,7 @@ class RealKube:
         r.raise_for_status()
         return r.json()
 
-    def update(self, obj, timeout=None):
+    def update(self, obj: dict, timeout: Optional[float] = None) -> dict:
         md = obj["metadata"]
         r = self._request(
             "update", "PUT",
@@ -291,7 +299,7 @@ class RealKube:
         r.raise_for_status()
         return r.json()
 
-    def apply(self, obj):
+    def apply(self, obj: dict) -> dict:
         md = obj["metadata"]
         r = self._request(
             "apply", "PATCH",
@@ -303,13 +311,14 @@ class RealKube:
         r.raise_for_status()
         return r.json()
 
-    def delete(self, api_version, kind, name, namespace=None):
+    def delete(self, api_version: str, kind: str, name: str,
+               namespace: Optional[str] = None) -> None:
         r = self._request("delete", "DELETE",
                           self._url(api_version, kind, namespace, name))
         if r.status_code not in (200, 202, 404):
             r.raise_for_status()
 
-    def update_status(self, obj):
+    def update_status(self, obj: dict) -> dict:
         md = obj["metadata"]
         r = self._request(
             "update_status", "PUT",
@@ -318,16 +327,17 @@ class RealKube:
         r.raise_for_status()
         return r.json()
 
-    def close(self):
+    def close(self) -> None:
         """Release pooled sockets (tests/bench teardown; production
         daemons hold the client for their whole life)."""
         if self.pool is not None:
             self.pool.close()
 
-    def watch(self, api_version, kind, callback: Callable, poll: float = 5.0):
+    def watch(self, api_version: str, kind: str, callback: Callable,
+              poll: float = 5.0) -> Callable[[], None]:
         stop = threading.Event()
 
-        def run():
+        def run() -> None:
             seen: dict[str, tuple[str, dict]] = {}
             while not stop.is_set():
                 try:
@@ -375,7 +385,7 @@ class RealKube:
         import socket as _socket
         identity = identity or f"{_socket.gethostname()}-{os.getpid()}"
 
-        def now():
+        def now() -> str:
             return datetime.datetime.now(datetime.timezone.utc).strftime(
                 "%Y-%m-%dT%H:%M:%S.%fZ")
 
@@ -400,6 +410,8 @@ class RealKube:
                                  "renewTime": now()}}, timeout=rpc_timeout)
                     return True
                 except Exception:  # noqa: BLE001 — lost the create race
+                    log.debug("leader lease create for %s/%s lost the "
+                              "race", namespace, name, exc_info=True)
                     return False
             spec = lease.get("spec", {})
             holder = spec.get("holderIdentity")
@@ -425,6 +437,8 @@ class RealKube:
                 self.update(lease, timeout=rpc_timeout)
                 return True
             except Exception:  # noqa: BLE001 — conflict: someone else won
+                log.debug("leader lease update for %s/%s conflicted",
+                          namespace, name, exc_info=True)
                 return False
 
         while not try_take():
@@ -434,7 +448,7 @@ class RealKube:
 
         stop = threading.Event()
 
-        def lost():
+        def lost() -> None:
             log.critical("leader lease %s/%s lost by %s — stopping",
                          namespace, name, identity)
             if on_lost is not None:
@@ -444,7 +458,7 @@ class RealKube:
 
         renew_deadline = lease_seconds * 2.0 / 3.0
 
-        def renew_loop():
+        def renew_loop() -> None:
             last_renewed = time.monotonic()
             while not stop.wait(lease_seconds / 3):
                 if time.monotonic() - last_renewed >= renew_deadline:

@@ -125,8 +125,13 @@ class FaultPlan:
         with self._lock:
             for k in (key, "*"):
                 script = self._scripts.get(k)
-                if script:
+                while script:
                     fault = script[0]
+                    if fault.times <= 0:
+                        # scripted with times=0 ("no faults" in a
+                        # parameterized matrix): drop WITHOUT applying
+                        script.pop(0)
+                        continue
                     fault.times -= 1
                     if fault.times <= 0:
                         script.pop(0)

@@ -1,0 +1,240 @@
+"""Runtime lock-order tracing: the dynamic half of lock discipline.
+
+The static guarded-by checker (analysis/lockcheck.py) catches off-lock
+writes; it cannot see lock-ORDER inversions — thread A takes L1 then L2
+while thread B takes L2 then L1, a deadlock that only fires under the
+right interleaving. This harness catches them WITHOUT needing the
+interleaving: :class:`LockTracer.install` patches ``threading.Lock`` /
+``threading.RLock`` so every lock created inside the traced region
+records, on each acquire, an edge from every lock the acquiring thread
+already holds. A cycle in that acquisition-order graph is a potential
+deadlock even if the run itself never hung — the Go race detector's
+happens-before trick, applied to lock ordering.
+
+Locks aggregate by ALLOCATION SITE (file:line of the ``Lock()`` call):
+two instances of the same per-object lock are one node. Holding one
+instance while acquiring a *different* instance from the same site
+records a self-loop — a one-node cycle — because no global order exists
+between same-class instances (the classic instance-pair deadlock);
+nest same-site locks only under an external ordering rule, with the
+nesting site excluded from tracing.
+
+Usage (tests)::
+
+    tracer = LockTracer()
+    with tracer.install():
+        ...  # exercise daemon/pool/server code
+    tracer.assert_no_cycles()
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+# the real factories, captured at import so tracer internals never ride
+# a traced lock (and uninstall always restores the originals)
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+#: exact paths whose frames are tracer/stdlib plumbing, not the caller
+#: (exact match, not endswith — a caller file named test_locktrace.py
+#: must still attribute its own allocations)
+_INTERNAL_FILES = (__file__, threading.__file__)
+
+
+class LockOrderViolation(AssertionError):
+    """A cycle in the lock acquisition-order graph (potential deadlock)."""
+
+    def __init__(self, cycles: list, witnesses: dict):
+        self.cycles = cycles
+        lines = ["lock acquisition-order cycle(s) detected:"]
+        for cycle in cycles:
+            lines.append("  cycle: " + " -> ".join(cycle + (cycle[0],)))
+            for a, b in zip(cycle, cycle[1:] + (cycle[0],)):
+                witness = witnesses.get((a, b))
+                if witness:
+                    lines.append(f"    {a} held while acquiring {b} "
+                                 f"(thread {witness[0]}, at {witness[1]})")
+        super().__init__("\n".join(lines))
+
+
+def _allocation_site() -> str:
+    """file:line of the frame that called Lock()/RLock(), skipping
+    frames inside threading.py (Condition/Event/Queue internals name
+    the stdlib caller that actually allocated)."""
+    for frame in reversed(traceback.extract_stack(limit=12)[:-2]):
+        if frame.filename in _INTERNAL_FILES:
+            continue
+        return f"{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _TracedLock:
+    """Wrapper delegating to a real lock while reporting acquire/release
+    to the tracer. Supports the Lock/RLock surface the stdlib relies on
+    (Condition duck-types via acquire/release/_is_owned)."""
+
+    def __init__(self, tracer: "LockTracer", inner, site: str,
+                 reentrant: bool):
+        self._tracer = tracer
+        self._inner = inner
+        self._site = site
+        self._reentrant = reentrant
+        self._holds = 0  # approximate; only steers re-entry bookkeeping
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._tracer._before_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._holds += 1
+            self._tracer._acquired(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._holds -= 1
+        self._tracer._released(self)
+
+    def locked(self):
+        # real RLock has no locked() pre-3.12; emulate for both
+        locked = getattr(self._inner, "locked", None)
+        if locked is not None:
+            return locked()
+        return self._holds > 0
+
+    def _is_owned(self):  # Condition(RLock) support
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __getattr__(self, name: str):
+        # delegate the long tail of stdlib duck-typing (_at_fork_reinit,
+        # _release_save, ...) straight to the real lock
+        return getattr(self._inner, name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<TracedLock {self._site} wrapping {self._inner!r}>"
+
+
+class LockTracer:
+    def __init__(self):
+        self._mu = _REAL_LOCK()  # guards edges/witnesses
+        self._tls = threading.local()
+        #: (held_site, acquired_site) -> ordered edge set
+        self.edges: set = set()
+        #: edge -> (thread name, "file:line" of the acquiring call)
+        self.witnesses: dict = {}
+
+    # -- patching -------------------------------------------------------------
+    @contextmanager
+    def install(self) -> Iterator["LockTracer"]:
+        def traced_lock():
+            return _TracedLock(self, _REAL_LOCK(), _allocation_site(),
+                               reentrant=False)
+
+        def traced_rlock():
+            return _TracedLock(self, _REAL_RLOCK(), _allocation_site(),
+                               reentrant=True)
+
+        threading.Lock = traced_lock
+        threading.RLock = traced_rlock
+        try:
+            yield self
+        finally:
+            threading.Lock = _REAL_LOCK
+            threading.RLock = _REAL_RLOCK
+
+    # -- per-thread held stack ------------------------------------------------
+    def _held(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _before_acquire(self, lock: _TracedLock):
+        held = self._held()
+        if not held:
+            return
+        if lock._reentrant and any(h is lock for h in held):
+            return  # RLock re-entry orders nothing
+        caller = "<unknown>"
+        for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
+            if frame.filename not in _INTERNAL_FILES:
+                caller = (f"{frame.filename.rsplit('/', 1)[-1]}:"
+                          f"{frame.lineno}")
+                break
+        thread = threading.current_thread().name
+        with self._mu:
+            for h in held:
+                if h is lock:
+                    continue  # literal re-acquire, not an ordering
+                # DIFFERENT instances from one allocation site still
+                # record (as a self-loop S->S): two objects of the same
+                # class locked while holding each other's lock is the
+                # classic instance-pair deadlock, and no global order
+                # exists between them
+                edge = (h._site, lock._site)
+                if edge not in self.edges:
+                    self.edges.add(edge)
+                    self.witnesses[edge] = (thread, caller)
+
+    def _acquired(self, lock: _TracedLock):
+        self._held().append(lock)
+
+    def _released(self, lock: _TracedLock):
+        held = self._held()
+        # non-LIFO release (Condition.wait) removes the newest hold
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- analysis -------------------------------------------------------------
+    def find_cycles(self) -> list:
+        """Elementary cycles in the acquisition graph as site tuples
+        (each rotated to start at its smallest node, deduplicated)."""
+        graph: dict = {}
+        for a, b in self.edges:
+            graph.setdefault(a, set()).add(b)
+        cycles = set()
+        for start in sorted(graph):
+            stack = [(start, (start,))]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start:
+                        k = path.index(min(path))
+                        cycles.add(path[k:] + path[:k])
+                    elif nxt not in path and len(path) < 16:
+                        stack.append((nxt, path + (nxt,)))
+        return sorted(cycles)
+
+    def assert_no_cycles(self):
+        cycles = self.find_cycles()
+        if cycles:
+            raise LockOrderViolation(cycles, self.witnesses)
+
+
+@contextmanager
+def traced() -> Iterator[LockTracer]:
+    """``with traced() as tracer: ...`` — install + assert on exit
+    (only when the body itself did not raise)."""
+    tracer = LockTracer()
+    with tracer.install():
+        yield tracer
+    tracer.assert_no_cycles()

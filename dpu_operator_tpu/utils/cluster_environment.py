@@ -18,7 +18,7 @@ class Flavour(str, enum.Enum):
 
 
 class ClusterEnvironment:
-    def __init__(self, client):
+    def __init__(self, client: object) -> None:
         self.client = client
 
     def flavour(self) -> Flavour:

@@ -21,19 +21,19 @@ class NodeNotFound(KeyError):
     pre-existing `except KeyError` call sites keep working, but carries
     a real message instead of a bare node name."""
 
-    def __init__(self, node_name: str):
+    def __init__(self, node_name: str) -> None:
         super().__init__(node_name)
         self.node_name = node_name
 
-    def __str__(self):
+    def __str__(self) -> str:
         return f"node {self.node_name!r} not found"
 
 
 class Drainer:
-    def __init__(self, client):
+    def __init__(self, client: object) -> None:
         self.client = client
 
-    def cordon(self, node_name: str):
+    def cordon(self, node_name: str) -> None:
         node = self.client.get("v1", "Node", node_name)
         if node is None:
             raise NodeNotFound(node_name)
@@ -42,7 +42,7 @@ class Drainer:
         node.setdefault("spec", {})["unschedulable"] = True
         self.client.update(node)
 
-    def uncordon(self, node_name: str):
+    def uncordon(self, node_name: str) -> None:
         """Idempotent: a node that is already schedulable (or was
         deleted while cordoned — resize teardown racing node removal) is
         the desired end state, not an error. The finally-uncordon in

@@ -18,7 +18,7 @@ class FsMode(str, enum.Enum):
 
 
 class FilesystemModeDetector:
-    def __init__(self, root: str = "/"):
+    def __init__(self, root: str = "/") -> None:
         self.root = root
 
     def detect_mode(self) -> FsMode:

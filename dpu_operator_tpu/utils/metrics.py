@@ -13,22 +13,24 @@ import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Any, Callable, Optional, Sequence, TypeVar
+
+_MetricT = TypeVar("_MetricT")
 
 
 class Counter:
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str) -> None:
         self.name = name
         self.help = help_
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
-    def inc(self, amount: float = 1.0, **labels):
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
-    def value(self, **labels) -> float:
+    def value(self, **labels: object) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def _render(self) -> list:
@@ -41,7 +43,7 @@ class Counter:
 
 
 class Gauge(Counter):
-    def set(self, value: float, **labels):
+    def set(self, value: float, **labels: object) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = float(value)
@@ -61,8 +63,9 @@ class Histogram:
     DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                        5.0, 10.0, 30.0, 60.0, 120.0)
 
-    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS,
-                 const_labels: Optional[dict] = None):
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 const_labels: Optional[dict] = None) -> None:
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets)
@@ -72,7 +75,7 @@ class Histogram:
         self._sum = 0.0
         self._lock = threading.Lock()
 
-    def observe(self, value: float):
+    def observe(self, value: float) -> None:
         with self._lock:
             self._sum += value
             for i, b in enumerate(self.buckets):
@@ -82,7 +85,7 @@ class Histogram:
             else:
                 self._counts[-1] += 1
 
-    def time(self):
+    def time(self) -> "_Timer":
         return _Timer(self)
 
     @property
@@ -118,7 +121,7 @@ class HistogramVec:
     emitted once for the family, per Prometheus exposition rules."""
 
     def __init__(self, name: str, help_: str, label: str,
-                 buckets=Histogram.DEFAULT_BUCKETS):
+                 buckets: Sequence[float] = Histogram.DEFAULT_BUCKETS) -> None:
         self.name = name
         self.help = help_
         self.label = label
@@ -135,7 +138,7 @@ class HistogramVec:
                 self._children[value] = child
             return child
 
-    def observe(self, value: str, seconds: float):
+    def observe(self, value: str, seconds: float) -> None:
         self.labels(value).observe(seconds)
 
     def _render(self) -> list:
@@ -149,14 +152,14 @@ class HistogramVec:
 
 
 class _Timer:
-    def __init__(self, hist: Histogram):
+    def __init__(self, hist: Histogram) -> None:
         self.hist = hist
 
-    def __enter__(self):
+    def __enter__(self) -> "_Timer":
         self._start = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> bool:
         self.hist.observe(time.perf_counter() - self._start)
         return False
 
@@ -173,7 +176,7 @@ def _num(v: float) -> str:
 
 
 class Registry:
-    def __init__(self):
+    def __init__(self) -> None:
         self._metrics: list = []
         self._lock = threading.Lock()
 
@@ -183,14 +186,14 @@ class Registry:
     def gauge(self, name: str, help_: str) -> Gauge:
         return self._add(Gauge(name, help_))
 
-    def histogram(self, name: str, help_: str, **kw) -> Histogram:
+    def histogram(self, name: str, help_: str, **kw: Any) -> Histogram:
         return self._add(Histogram(name, help_, **kw))
 
     def histogram_vec(self, name: str, help_: str, label: str,
-                      **kw) -> HistogramVec:
+                      **kw: Any) -> HistogramVec:
         return self._add(HistogramVec(name, help_, label, **kw))
 
-    def _add(self, metric):
+    def _add(self, metric: _MetricT) -> _MetricT:
         with self._lock:
             self._metrics.append(metric)
         return metric
@@ -280,6 +283,12 @@ JOURNAL_RECOVERIES = REGISTRY.counter(
     "Chain-journal startup recoveries by source (primary = journal "
     "read clean; last_good = truncated/corrupt journal, fell back to "
     "the previous snapshot; empty = no readable snapshot at all)")
+# -- static-analysis gate (opslint exception-hygiene rule) -------------------
+SWALLOWED_ERRORS = REGISTRY.counter(
+    "tpu_daemon_swallowed_errors_total",
+    "Exceptions deliberately swallowed on the daemon/reconcile path, "
+    "by site — a rising rate at one site is a failing dependency that "
+    "would otherwise be invisible")
 
 
 class TokenReviewAuth:
@@ -293,7 +302,7 @@ class TokenReviewAuth:
     config/rbac/metrics_reader_role.yaml. Verdicts are cached per token
     for *ttl* seconds (upstream caches the same way)."""
 
-    def __init__(self, client, ttl: float = 60.0):
+    def __init__(self, client: object, ttl: float = 60.0) -> None:
         self.client = client
         self.ttl = ttl
         # keyed by sha256(token): plaintext bearer tokens must not sit
@@ -358,7 +367,7 @@ class MetricsServer:
                  registry: Registry = REGISTRY,
                  ready_check: Optional[Callable[[], bool]] = None,
                  auth: Optional[Callable[[str], bool]] = None,
-                 degraded_check: Optional[Callable[[], list]] = None):
+                 degraded_check: Optional[Callable[[], list]] = None) -> None:
         """*degraded_check* returns the call sites currently degraded
         (open circuit breakers, utils/resilience.py) — surfaced in the
         /healthz body. Degraded is still 200: the process is alive and
@@ -372,16 +381,16 @@ class MetricsServer:
         self.degraded_check = degraded_check
         self._server: Optional[ThreadingHTTPServer] = None
 
-    def start(self):
+    def start(self) -> None:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def log_message(self, fmt, *args):
+            def log_message(self, fmt: str, *args: object) -> None:
                 pass
 
-            def do_GET(self):
+            def do_GET(self) -> None:
                 if self.path == "/metrics":
                     code = 200
                     if outer.auth is not None:
@@ -422,7 +431,7 @@ class MetricsServer:
         threading.Thread(target=self._server.serve_forever, daemon=True,
                          name="metrics").start()
 
-    def stop(self):
+    def stop(self) -> None:
         if self._server:
             self._server.shutdown()
             self._server.server_close()

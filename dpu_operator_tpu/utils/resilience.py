@@ -35,7 +35,7 @@ import random
 import ssl
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from . import metrics
 
@@ -50,7 +50,7 @@ class BreakerOpen(Exception):
     """Short-circuited by an open circuit breaker — the call was NOT
     attempted; the dependency was already failing."""
 
-    def __init__(self, site: str, retry_after: float = 0.0):
+    def __init__(self, site: str, retry_after: float = 0.0) -> None:
         super().__init__(
             f"circuit breaker open for {site!r}"
             + (f" (retry in {retry_after:.1f}s)" if retry_after else ""))
@@ -101,7 +101,7 @@ class RetryPolicy:
                  cap: float = 2.0, deadline: Optional[float] = None,
                  rng: Optional[random.Random] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic) -> None:
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         self.max_attempts = max_attempts
@@ -121,7 +121,8 @@ class RetryPolicy:
              retry_if: Callable[[BaseException], bool] = is_transient,
              breaker: Optional["CircuitBreaker"] = None,
              failure_if: Optional[Callable[[BaseException], bool]] = None,
-             on_retry: Optional[Callable[[BaseException], None]] = None):
+             on_retry: Optional[Callable[[BaseException], None]] = None
+             ) -> Any:
         """Run *fn* under this policy. *on_retry* runs before each retry
         (reconnect hooks); its own errors fold into the next attempt.
 
@@ -133,7 +134,9 @@ class RetryPolicy:
         misconfigured caller in a loop walls off the dependency for
         every other caller on the node."""
         if failure_if is None:
-            def failure_if(e, _retry_if=retry_if):
+            def failure_if(e: BaseException,
+                           _retry_if: Callable[[BaseException], bool]
+                           = retry_if) -> bool:
                 return _retry_if(e) or isinstance(e, TimeoutError)
         start = self.clock()
         attempt = 0
@@ -210,7 +213,7 @@ class CircuitBreaker:
 
     def __init__(self, site: str, failure_threshold: int = 5,
                  reset_timeout: float = 30.0, half_open_max: int = 1,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.site = site
         self.failure_threshold = failure_threshold
         self.reset_timeout = reset_timeout
@@ -224,7 +227,7 @@ class CircuitBreaker:
         metrics.BREAKER_STATE.set(0, site=site)
 
     # -- state machine --------------------------------------------------------
-    def _transition_locked(self, state: str):
+    def _transition_locked(self, state: str) -> None:
         if state == self._state:
             return
         self._state = state
@@ -233,7 +236,7 @@ class CircuitBreaker:
         log.log(logging.WARNING if state != self.CLOSED else logging.INFO,
                 "circuit breaker %s -> %s", self.site, state)
 
-    def _tick_locked(self):
+    def _tick_locked(self) -> None:
         """Open -> half-open once reset_timeout elapsed (a REAL
         transition, not a lazy view: the state gauge and any observer
         must agree on what the breaker is doing)."""
@@ -261,7 +264,7 @@ class CircuitBreaker:
         reset_timeout for the whole length of a sustained outage."""
         return self.state != self.CLOSED
 
-    def before_call(self, site: str = ""):
+    def before_call(self, site: str = "") -> None:
         """Admission check; raises :class:`BreakerOpen` when rejected."""
         with self._lock:
             self._tick_locked()
@@ -277,13 +280,13 @@ class CircuitBreaker:
                 raise BreakerOpen(site or self.site)
             self._probes += 1
 
-    def record_success(self):
+    def record_success(self) -> None:
         with self._lock:
             self._failures = 0
             if self._state != self.CLOSED:
                 self._transition_locked(self.CLOSED)
 
-    def record_failure(self):
+    def record_failure(self) -> None:
         with self._lock:
             if self._state == self.HALF_OPEN:
                 # the probe failed: straight back to open, clock restarts
@@ -296,7 +299,7 @@ class CircuitBreaker:
                 self._opened_at = self.clock()
                 self._transition_locked(self.OPEN)
 
-    def call(self, fn: Callable, *args, **kwargs):
+    def call(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
         """One breaker-guarded call without retry."""
         self.before_call()
         try:

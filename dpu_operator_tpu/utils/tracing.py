@@ -17,7 +17,7 @@ import sys
 import threading
 import time
 import uuid
-from typing import Optional
+from typing import Iterator, Optional
 
 log = logging.getLogger(__name__)
 
@@ -40,14 +40,14 @@ def _setup() -> bool:
     return True
 
 
-def _emit(record: dict):
+def _emit(record: dict) -> None:
     with _lock:
         _sink.write(json.dumps(record) + "\n")
         _sink.flush()
 
 
 @contextlib.contextmanager
-def span(name: str, **attributes):
+def span(name: str, **attributes: object) -> Iterator[Optional[str]]:
     """Record a span around a block; nesting tracked per-thread. No-op
     (≈60 ns) when tracing is disabled."""
     if not _setup():
@@ -73,7 +73,7 @@ def span(name: str, **attributes):
                **({"error": error} if error else {})})
 
 
-def reset_for_tests():
+def reset_for_tests() -> None:
     global _sink, _enabled
     with _lock:
         if _sink not in (None, sys.stderr):

@@ -30,8 +30,9 @@ def _grpc_code_name(exc: BaseException):
     if callable(code):
         try:
             code = code()
-        except Exception:  # noqa: BLE001 — not a live grpc error
-            code = None
+        except Exception:  # opslint: disable=exception-hygiene
+            code = None  # duck-typing probe, not an error path: any
+            # object with a non-grpc `code` attr lands here by design
     return getattr(code, "name", None)
 
 
@@ -124,12 +125,23 @@ class GrpcPlugin:
         slice-attachment server binds; the programmed slice topology (tpu
         mode) lands on ``self.topology``."""
         self._deploy_vsp()
-        self._channel = self._new_channel()
+        # under _channel_lock like every other _channel swap: a chaos
+        # restart's close() racing start must observe either None or the
+        # fresh channel, never tear half an assignment
+        with self._channel_lock:
+            self._channel = self._new_channel()
         deadline = time.monotonic() + self.init_timeout
         last_err: Optional[Exception] = None
         while time.monotonic() < deadline:
+            # snapshot under the lock: close() racing start nulls
+            # _channel — fail fast instead of burning init_timeout
+            # retrying AttributeError as if it were a dial error
+            with self._channel_lock:
+                channel = self._channel
+            if channel is None:
+                raise RuntimeError("VSP plugin closed during Init")
             try:
-                resp = self._channel.call(
+                resp = channel.call(
                     "LifeCycleService", "Init",
                     {"tpu_mode": tpu_mode,
                      "tpu_identifier": self.detection.identifier},
@@ -172,7 +184,8 @@ class GrpcPlugin:
             try:
                 old.close()
             except Exception:  # noqa: BLE001 — old channel already dead
-                pass
+                log.debug("close of wedged VSP channel failed",
+                          exc_info=True)
 
     def degraded_sites(self) -> list:
         """Breakers not yet proven recovered (open OR half-open) — what

@@ -162,7 +162,8 @@ class VspServer:
             try:
                 server.stop(0)
             except Exception:  # noqa: BLE001 — already dead
-                pass
+                log.debug("teardown of half-started VSP server failed",
+                          exc_info=True)
 
     def stop(self, grace: float = 0.5):
         if self._server:

@@ -57,6 +57,22 @@ def _req(name="chaos-sfc"):
                    name, "default")
 
 
+# -- FaultPlan semantics ------------------------------------------------------
+
+def test_fault_plan_times_zero_means_no_fault():
+    """Fail(times=0) — 'no failures' when parameterizing a matrix over a
+    failure count — must pass the call through, not inject once, and a
+    spent head must not shadow the fault scripted behind it."""
+    plan = FaultPlan(seed=SEED)
+    plan.script("op", Fail(times=0))
+    assert plan.run("op", lambda: "ok") == "ok"
+    assert plan.injected == []
+    plan.script("op", Fail(times=0), Fail(times=1))
+    with pytest.raises(ConnectionResetError):
+        plan.run("op", lambda: "ok")
+    assert plan.exhausted()
+
+
 # -- RetryPolicy / CircuitBreaker primitives ---------------------------------
 
 def test_retry_policy_full_jitter_backoff_is_bounded_and_seeded():

@@ -1,0 +1,172 @@
+"""Runtime lock-order tracing tests.
+
+The headline case: an intentional lock-order inversion (A->B in one
+code path, B->A in another) must be detected WITHOUT the run ever
+deadlocking — the tracer records acquisition-order edges and finds the
+cycle statically in the graph.
+"""
+
+import threading
+
+import pytest
+
+from dpu_operator_tpu.testing.locktrace import (LockOrderViolation,
+                                                LockTracer, traced)
+
+
+def test_inversion_is_detected_without_deadlocking():
+    tracer = LockTracer()
+    with tracer.install():
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        # sequential on purpose: the interleaving that would deadlock
+        # never runs, yet the ordering cycle is still recorded
+        t1 = threading.Thread(target=ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start()
+        t2.join()
+    with pytest.raises(LockOrderViolation) as exc:
+        tracer.assert_no_cycles()
+    msg = str(exc.value)
+    assert "cycle" in msg and "held while acquiring" in msg
+
+
+def test_consistent_order_passes():
+    with traced() as tracer:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+    assert tracer.find_cycles() == []
+    assert tracer.edges, "nested acquires must record ordering edges"
+    assert all("test_locktrace.py" in site
+               for edge in tracer.edges for site in edge)
+
+
+def test_same_site_instance_pair_inversion_is_detected():
+    """Two instances of one class (one allocation site) locked while
+    holding each other: no global order exists between them, so the
+    tracer must flag the self-loop — the classic instance-pair
+    deadlock (transfer(a, b) racing transfer(b, a))."""
+    tracer = LockTracer()
+    with tracer.install():
+        class Account:
+            def __init__(self):
+                self.lock = threading.Lock()  # ONE site for all instances
+
+        a, b = Account(), Account()
+        with a.lock:
+            with b.lock:
+                pass
+    with pytest.raises(LockOrderViolation):
+        tracer.assert_no_cycles()
+    assert any(len(c) == 1 for c in tracer.find_cycles())
+
+
+def test_rlock_reentry_is_not_an_edge():
+    with traced() as tracer:
+        lock = threading.RLock()
+        with lock:
+            with lock:  # re-entry must not self-edge or confuse stacks
+                pass
+    assert tracer.edges == set()
+
+
+def test_three_lock_cycle_is_found():
+    tracer = LockTracer()
+    with tracer.install():
+        # distinct lines: locks aggregate by allocation site
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.Lock()
+        locks = [a, b, c]
+        for first, second in ((0, 1), (1, 2), (2, 0)):
+            with locks[first]:
+                with locks[second]:
+                    pass
+    cycles = tracer.find_cycles()
+    assert len(cycles) == 1 and len(cycles[0]) == 3
+
+
+def test_uninstall_restores_real_factories():
+    real_lock, real_rlock = threading.Lock, threading.RLock
+    with LockTracer().install():
+        assert threading.Lock is not real_lock
+    assert threading.Lock is real_lock
+    assert threading.RLock is real_rlock
+
+
+def test_condition_and_event_work_under_tracing():
+    """stdlib sync primitives built on Lock/RLock keep functioning when
+    the traced factories are installed (Condition duck-types acquire/
+    release/_is_owned on the wrapper)."""
+    with traced() as tracer:
+        cond = threading.Condition()
+        hits = []
+
+        def waiter():
+            with cond:
+                while not hits:
+                    cond.wait(timeout=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cond:
+            hits.append(1)
+            cond.notify_all()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+    assert tracer.find_cycles() == []
+
+
+def test_real_component_audit_resilience_seam():
+    """Audit slice: drive RetryPolicy + CircuitBreaker (the shared wire
+    seam) and the metrics registry under the tracer — the lock orderings
+    those components actually take must be acyclic."""
+    from dpu_operator_tpu.utils import resilience
+
+    with traced() as tracer:
+        breaker = resilience.CircuitBreaker("locktrace-audit",
+                                            failure_threshold=2,
+                                            reset_timeout=0.01)
+        policy = resilience.RetryPolicy(max_attempts=2, base=0.0, cap=0.0,
+                                        sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] % 2:
+                raise ConnectionError("boom")
+            return "ok"
+
+        results = []
+
+        def worker():
+            for _ in range(4):
+                try:
+                    results.append(policy.call(
+                        flaky, site="locktrace-audit", breaker=breaker))
+                except (ConnectionError, resilience.BreakerOpen):
+                    pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert tracer.find_cycles() == []

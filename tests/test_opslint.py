@@ -1,0 +1,373 @@
+"""opslint analyzer tests: per-rule pass/fail fixtures, pragma and
+baseline handling, CLI exit codes.
+
+Each fixture is a minimal snippet that must (or must not) trip exactly
+the rule under test; Module is built directly from source so the repo-
+relative path — which drives rule scoping — is explicit.
+"""
+
+import json
+import os
+import textwrap
+
+from dpu_operator_tpu.analysis import (ALL_CHECKERS,
+                                       ChaosDeterminismChecker,
+                                       ExceptionHygieneChecker,
+                                       LockDisciplineChecker,
+                                       MetricsNamingChecker,
+                                       RetryDisciplineChecker,
+                                       WireSeamChecker)
+from dpu_operator_tpu.analysis.__main__ import main as opslint_main
+from dpu_operator_tpu.analysis.core import Baseline, Module
+
+
+def check(checker, source, relpath="dpu_operator_tpu/somemod.py"):
+    module = Module("/x/" + relpath, relpath, textwrap.dedent(source))
+    return [v for v in checker.check(module)
+            if not module.suppressed(v.rule, v.line)]
+
+
+# -- wire-seam ----------------------------------------------------------------
+
+def test_wire_seam_flags_raw_socket_import():
+    violations = check(WireSeamChecker(), """
+        import socket
+    """)
+    assert [v.rule for v in violations] == ["wire-seam"]
+    assert "socket" in violations[0].message
+
+
+def test_wire_seam_flags_requests_and_http_client():
+    src = """
+        import requests
+        from http.client import HTTPConnection
+    """
+    assert len(check(WireSeamChecker(), src)) == 2
+
+
+def test_wire_seam_allows_the_pool_and_rpc_seams():
+    for seam in ("dpu_operator_tpu/k8s/pool.py",
+                 "dpu_operator_tpu/vsp/rpc.py"):
+        assert check(WireSeamChecker(), "import socket\n",
+                     relpath=seam) == []
+
+
+def test_wire_seam_ignores_tests_and_unrelated_imports():
+    assert check(WireSeamChecker(), "import socket\n",
+                 relpath="tests/test_x.py") == []
+    assert check(WireSeamChecker(), "import json, os\n") == []
+
+
+# -- retry-discipline ---------------------------------------------------------
+
+def test_retry_discipline_flags_unbounded_sleep_loop():
+    violations = check(RetryDisciplineChecker(), """
+        import time
+        def dial():
+            while True:
+                try:
+                    return connect()
+                except OSError:
+                    time.sleep(1)
+    """)
+    assert [v.rule for v in violations] == ["retry-discipline"]
+
+
+def test_retry_discipline_allows_deadline_bounded_loop():
+    src = """
+        import time
+        def dial(deadline):
+            while True:
+                try:
+                    return connect()
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.05)
+    """
+    assert check(RetryDisciplineChecker(), src) == []
+
+
+def test_retry_discipline_allows_resilience_module_and_plain_loops():
+    src = "import time\nwhile True:\n    time.sleep(1)\n"
+    assert check(RetryDisciplineChecker(), src,
+                 relpath="dpu_operator_tpu/utils/resilience.py") == []
+    # non-constant loop test: bounded by its own condition
+    assert check(RetryDisciplineChecker(), """
+        import time
+        def wait(stop):
+            while not stop.is_set():
+                time.sleep(1)
+    """) == []
+
+
+# -- exception-hygiene --------------------------------------------------------
+
+def test_exception_hygiene_flags_silent_broad_except():
+    for handler in ("except Exception:", "except BaseException:",
+                    "except:", "except (ValueError, Exception):"):
+        violations = check(ExceptionHygieneChecker(), f"""
+            def f():
+                try:
+                    g()
+                {handler}
+                    pass
+        """)
+        assert [v.rule for v in violations] == ["exception-hygiene"], handler
+
+
+def test_exception_hygiene_allows_logged_and_narrow_handlers():
+    src = """
+        import logging
+        log = logging.getLogger(__name__)
+        def f():
+            try:
+                g()
+            except Exception:
+                log.exception("g failed")
+            try:
+                g()
+            except KeyError:
+                pass
+            try:
+                g()
+            except Exception:
+                raise RuntimeError("wrapped")
+    """
+    assert check(ExceptionHygieneChecker(), src) == []
+
+
+# -- metrics-naming -----------------------------------------------------------
+
+def test_metrics_naming_flags_prefix_and_counter_suffix():
+    violations = check(MetricsNamingChecker(), """
+        FOO = REGISTRY.counter("daemon_foo", "help")
+        BAR = REGISTRY.counter("tpu_bar_count", "help")
+        BAZ = REGISTRY.gauge("tpu_baz_total", "help")
+    """)
+    # daemon_foo fires twice: missing prefix AND missing _total suffix
+    assert sorted(v.rule for v in violations) == ["metrics-naming"] * 4
+
+
+def test_metrics_naming_passes_conventional_names():
+    src = """
+        A = REGISTRY.counter("tpu_daemon_foo_total", "help")
+        B = REGISTRY.gauge("tpu_daemon_bar", "help")
+        C = REGISTRY.histogram("tpu_daemon_baz_seconds", "help")
+        D = REGISTRY.histogram_vec("tpu_x_seconds", "help", label="verb")
+    """
+    assert check(MetricsNamingChecker(), src) == []
+
+
+def test_metrics_naming_ignores_collections_counter():
+    assert check(MetricsNamingChecker(), """
+        from collections import Counter
+        c = Counter("abcabc")
+    """) == []
+
+
+def test_metrics_naming_applies_to_whole_repo_metrics():
+    # the live registry in utils/metrics.py must satisfy its own rule
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "dpu_operator_tpu", "utils", "metrics.py")
+    with open(path) as fh:
+        module = Module(path, "dpu_operator_tpu/utils/metrics.py",
+                        fh.read())
+    assert list(MetricsNamingChecker().check(module)) == []
+
+
+# -- chaos-determinism --------------------------------------------------------
+
+def test_chaos_determinism_flags_unseeded_random_and_wall_clock():
+    violations = check(ChaosDeterminismChecker(), """
+        import pytest, random, time
+        @pytest.mark.chaos
+        def test_storm():
+            jitter = random.random()
+            start = time.time()
+    """, relpath="tests/test_chaos_x.py")
+    assert [v.rule for v in violations] == ["chaos-determinism"] * 2
+
+
+def test_chaos_determinism_module_level_mark_and_seeded_rng_ok():
+    src = """
+        import pytest, random
+        pytestmark = pytest.mark.chaos
+        SEED = 7
+        def test_storm():
+            rng = random.Random(SEED)
+            assert rng.random() < 1.0
+    """
+    violations = check(ChaosDeterminismChecker(), src,
+                       relpath="tests/test_chaos_y.py")
+    # random.Random(SEED) is the idiom; rng.random() is seeded state
+    assert violations == []
+
+
+def test_chaos_determinism_ignores_unmarked_tests():
+    assert check(ChaosDeterminismChecker(), """
+        import random
+        def test_plain():
+            assert random.random() >= 0
+    """, relpath="tests/test_plain.py") == []
+
+
+# -- lock-discipline ----------------------------------------------------------
+
+def test_lock_discipline_flags_off_lock_write_of_guarded_attr():
+    violations = check(LockDisciplineChecker(), """
+        import threading
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._conns = []
+            def put(self, c):
+                with self._lock:
+                    self._conns.append(c)
+            def drop_all(self):
+                self._conns = []
+    """)
+    assert [v.rule for v in violations] == ["lock-discipline"]
+    assert "_conns" in violations[0].message
+
+
+def test_lock_discipline_allows_consistent_guarding_and_init():
+    src = """
+        import threading
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._conns = []
+            def put(self, c):
+                with self._lock:
+                    self._conns.append(c)
+            def drop_all(self):
+                with self._lock:
+                    self._conns = []
+            def _prune_locked(self):
+                self._conns = [c for c in self._conns if c.ok]
+            def try_fast(self):
+                self._lock.acquire()
+                try:
+                    self._conns.append(1)
+                finally:
+                    self._lock.release()
+    """
+    assert check(LockDisciplineChecker(), src) == []
+
+
+def test_lock_discipline_skips_lock_free_classes():
+    assert check(LockDisciplineChecker(), """
+        class Plain:
+            def __init__(self):
+                self.x = 0
+            def bump(self):
+                self.x += 1
+    """) == []
+
+
+# -- pragma -------------------------------------------------------------------
+
+def test_line_pragma_suppresses_one_rule_on_that_line():
+    violations = check(ExceptionHygieneChecker(), """
+        def f():
+            try:
+                g()
+            except Exception:  # opslint: disable=exception-hygiene
+                pass
+    """)
+    assert violations == []
+
+
+def test_file_pragma_suppresses_whole_file():
+    violations = check(WireSeamChecker(), """\
+        # opslint: disable=wire-seam
+        import socket
+        import requests
+    """)
+    assert violations == []
+
+
+def test_pragma_for_other_rule_does_not_suppress():
+    violations = check(WireSeamChecker(), """
+        import socket  # opslint: disable=retry-discipline
+    """)
+    assert len(violations) == 1
+
+
+# -- baseline + CLI -----------------------------------------------------------
+
+def _seeded_tree(tmp_path):
+    pkg = tmp_path / "dpu_operator_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text("import socket\n")
+    return tmp_path
+
+
+def test_cli_nonzero_on_seeded_violation_zero_after_baseline(tmp_path):
+    root = str(_seeded_tree(tmp_path))
+    args = ["--repo-root", root]  # default roots: full scan
+    assert opslint_main(args) == 1
+    assert opslint_main(args + ["--write-baseline"]) == 0
+    assert opslint_main(args) == 0  # baselined: gate stays green
+    data = json.loads((tmp_path / "opslint-baseline.json").read_text())
+    assert len(data["entries"]) == 1
+
+
+def test_cli_baseline_ratchet_reports_stale_entries(tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    args = ["--repo-root", root]
+    assert opslint_main(args + ["--write-baseline"]) == 0
+    (tmp_path / "dpu_operator_tpu" / "bad.py").write_text("import os\n")
+    assert opslint_main(args) == 0  # fixed: still green...
+    out = capsys.readouterr().out
+    assert "stale baseline entry" in out  # ...but the ratchet nags
+
+
+def test_cli_write_baseline_refuses_subset_runs(tmp_path, capsys):
+    """A --select/path-limited scan must not truncate the baseline to
+    the subset it happened to see, and must not call unscanned entries
+    stale."""
+    root = str(_seeded_tree(tmp_path))
+    assert opslint_main(["--repo-root", root, "--write-baseline"]) == 0
+    before = (tmp_path / "opslint-baseline.json").read_text()
+    assert opslint_main(["--repo-root", root, "--write-baseline",
+                         "--select", "metrics-naming"]) == 2
+    assert opslint_main(["--repo-root", root, "--write-baseline",
+                         "dpu_operator_tpu/bad.py"]) == 2
+    assert (tmp_path / "opslint-baseline.json").read_text() == before
+    capsys.readouterr()
+    # subset scan sees no wire-seam findings: entries are NOT stale
+    assert opslint_main(["--repo-root", root,
+                         "--select", "metrics-naming"]) == 0
+    assert "stale baseline entry" not in capsys.readouterr().out
+
+
+def test_cli_select_and_list_rules(tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    assert opslint_main(["--repo-root", root,
+                         "--select", "metrics-naming"]) == 0  # no wire-seam
+    assert opslint_main(["--select", "no-such-rule"]) == 2
+    assert opslint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for cls in ALL_CHECKERS:
+        assert cls.name in out
+
+
+def test_baseline_key_survives_line_drift(tmp_path):
+    root = _seeded_tree(tmp_path)
+    args = ["--repo-root", str(root)]
+    assert opslint_main(args + ["--write-baseline"]) == 0
+    # unrelated lines above the violation must not invalidate the entry
+    (root / "dpu_operator_tpu" / "bad.py").write_text(
+        "import os\nimport json\nimport socket\n")
+    assert opslint_main(args) == 0
+
+
+def test_repo_gate_is_green():
+    """The acceptance bar: the live repo passes with the checked-in
+    (empty) baseline."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert opslint_main(["--repo-root", repo]) == 0
+    baseline = Baseline(os.path.join(repo, "opslint-baseline.json"))
+    assert baseline.loaded and baseline.entries == set()
