@@ -3,9 +3,9 @@ PYTHON ?= python
 REGISTRY ?= localhost:5000
 TAG ?= latest
 
-.PHONY: test fast-test collect-check chaos-check lint-check type-check \
-        bench native traffic-flow images smoke-images deploy undeploy \
-        graft-check clean
+.PHONY: test fast-test collect-check chaos-check obs-check lint-check \
+        type-check bench native traffic-flow images smoke-images deploy \
+        undeploy graft-check clean
 
 test: lint-check native
 	$(PYTHON) -m pytest tests/ -q
@@ -28,6 +28,16 @@ collect-check:
 # a failure reproduces bit-identically.
 chaos-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m chaos \
+	  -p no:randomly -p no:cacheprovider
+
+# trace-propagation e2e (doc/observability.md): with TPU_OPERATOR_TRACE
+# set, one CNI ADD crosses all four wire seams (shim -> CNI server ->
+# VSP gRPC -> pooled apiserver client) and the tests assert a single
+# trace_id on every seam, a flight-recorder snapshot that survives a
+# seeded VSP breaker-open storm, and a valid OpenMetrics exemplar on
+# the CNI latency histogram referencing that trace
+obs-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m obs \
 	  -p no:randomly -p no:cacheprovider
 
 # opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST

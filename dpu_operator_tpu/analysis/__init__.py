@@ -8,12 +8,13 @@ workflow are documented in doc/static-analysis.md.
 
 from .checkers import (ChaosDeterminismChecker, ExceptionHygieneChecker,
                        MetricsNamingChecker, RetryDisciplineChecker,
-                       WireSeamChecker)
+                       TraceContextChecker, WireSeamChecker)
 from .core import Baseline, Checker, Module, Violation, run_checkers
 from .lockcheck import LockDisciplineChecker
 
 ALL_CHECKERS = (
     WireSeamChecker,
+    TraceContextChecker,
     RetryDisciplineChecker,
     ExceptionHygieneChecker,
     MetricsNamingChecker,
@@ -23,7 +24,8 @@ ALL_CHECKERS = (
 
 __all__ = [
     "ALL_CHECKERS", "Baseline", "Checker", "Module", "Violation",
-    "run_checkers", "WireSeamChecker", "RetryDisciplineChecker",
-    "ExceptionHygieneChecker", "MetricsNamingChecker",
-    "ChaosDeterminismChecker", "LockDisciplineChecker",
+    "run_checkers", "WireSeamChecker", "TraceContextChecker",
+    "RetryDisciplineChecker", "ExceptionHygieneChecker",
+    "MetricsNamingChecker", "ChaosDeterminismChecker",
+    "LockDisciplineChecker",
 ]

@@ -36,6 +36,9 @@ WIRE_SEAM_ALLOW = {
         "native cp-agent unix-socket framing",
     "dpu_operator_tpu/utils/resilience.py":
         "imports http.client exception types for transient classification",
+    "dpu_operator_tpu/utils/flight.py":
+        "tpuctl's /debug/flight fetch (local metrics endpoint, no "
+        "retry/breaker semantics apply to a diagnostics dump)",
 }
 
 _RAW_TRANSPORT_MODULES = {
@@ -79,6 +82,71 @@ class WireSeamChecker(Checker):
             if name == banned or name.startswith(banned + "."):
                 return banned
         return None
+
+
+# -- trace-context ------------------------------------------------------------
+
+#: wire-seam modules that SEND requests and therefore must inject the
+#: current trace context (W3C traceparent) on the outgoing wire, so a
+#: refactor cannot silently sever the trace tree at one hop. The CNI
+#: shim is stdlib-only (copied verbatim to the host CNI bin dir), so it
+#: inlines the header rather than calling utils.tracing.
+_TRACE_SEAMS = {
+    "dpu_operator_tpu/k8s/pool.py":
+        "stamps Traceparent on pooled apiserver requests",
+    "dpu_operator_tpu/vsp/rpc.py":
+        "injects traceparent gRPC metadata on every VSP client call",
+    "dpu_operator_tpu/cni/shim.py":
+        "attaches Traceparent to the unix-socket POST (inlined: the "
+        "shim must stay dependency-free)",
+}
+
+#: tracing helpers whose presence satisfies the rule
+_INJECT_CALLS = {"inject_traceparent"}
+
+
+class TraceContextChecker(Checker):
+    name = "trace-context"
+    description = ("wire-seam request senders must inject the current "
+                   "trace context (tracing.inject_traceparent() or a "
+                   "literal traceparent header)")
+
+    #: seams allowed to satisfy the rule with a literal traceparent
+    #: header instead of calling the tracing helper — ONLY the
+    #: dependency-free shim; everywhere else a leftover header-name
+    #: string must not mask a deleted inject call
+    _LITERAL_OK = {"dpu_operator_tpu/cni/shim.py"}
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        reason = _TRACE_SEAMS.get(module.relpath)
+        if reason is None:
+            return
+        for call in calls_in(module.tree):
+            name = dotted_name(call.func) or ""
+            if name.split(".")[-1] in _INJECT_CALLS:
+                return
+        if module.relpath in self._LITERAL_OK:
+            # only a header-BUILDING literal counts ("traceparent:" with
+            # the colon), and never from a bare-string statement: a
+            # deleted header build must not be masked by a docstring
+            # mentioning the header or by the TRACEPARENT env-var key
+            doc_constants = {
+                id(stmt.value) for stmt in ast.walk(module.tree)
+                if isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)}
+            for node in ast.walk(module.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and id(node) not in doc_constants
+                        and "traceparent:" in node.value.lower()):
+                    return
+        anchor = module.tree.body[0] if module.tree.body else module.tree
+        yield self.violation(
+            module, anchor,
+            "wire seam sends requests without trace-context injection "
+            f"({reason}): call tracing.inject_traceparent() and stamp "
+            "the result on the outgoing request, or the trace tree "
+            "severs at this hop")
 
 
 # -- retry-discipline ---------------------------------------------------------

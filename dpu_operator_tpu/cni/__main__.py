@@ -17,6 +17,8 @@ def main(argv=None):
     parser.add_argument("--socket", required=True)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG)
+    from ..utils import tracing
+    tracing.install_log_context()
 
     def echo(req):
         logging.info("CNI %s sandbox=%s if=%s device=%s", req.command,

@@ -10,24 +10,31 @@ from __future__ import annotations
 
 import logging
 
+from ..utils.tracing import TraceContextFilter
+
 _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
            "warning": logging.WARNING, "error": logging.ERROR,
            "panic": logging.CRITICAL}
 
 
 def request_logger(pod_req) -> logging.LoggerAdapter:
-    """Logger for one CNI invocation, labelled and routed per NetConf."""
+    """Logger for one CNI invocation, labelled and routed per NetConf.
+    Records are stamped with the request's trace_id/span_id (the context
+    the CNI server adopted from the shim's traceparent), so a pod's CNI
+    log joins its trace tree."""
     name = f"cni.{pod_req.sandbox_id[:12]}.{pod_req.ifname}"
     logger = logging.getLogger(name)
     nc = pod_req.netconf
     logger.setLevel(_LEVELS.get((nc.log_level or "info").lower(),
                                 logging.INFO))
+    if not any(isinstance(f, TraceContextFilter) for f in logger.filters):
+        logger.addFilter(TraceContextFilter())
     if nc.log_file and not any(
             isinstance(h, logging.FileHandler)
             and h.baseFilename == nc.log_file for h in logger.handlers):
         handler = logging.FileHandler(nc.log_file)
         handler.setFormatter(logging.Formatter(
-            "%(asctime)s %(levelname)s %(message)s"))
+            "%(asctime)s %(levelname)s [trace=%(trace_id)s] %(message)s"))
         logger.addHandler(handler)
     return logging.LoggerAdapter(logger, {
         "container": pod_req.sandbox_id, "netns": pod_req.netns,

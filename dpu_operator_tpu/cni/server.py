@@ -18,7 +18,7 @@ from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutTimeout
 from http.server import BaseHTTPRequestHandler
 from typing import Callable, Optional
 
-from ..utils import metrics, resilience
+from ..utils import metrics, resilience, tracing
 from ..utils.tracing import span
 from .logging import request_logger
 from .types import (
@@ -89,7 +89,13 @@ class CniServer:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    resp = outer._handle(CniRequest.from_dict(body))
+                    # adopt the shim's trace context (W3C traceparent);
+                    # a malformed/hostile header extracts to None and
+                    # the server span roots a fresh trace instead
+                    ctx = tracing.extract_traceparent(
+                        self.headers.get("Traceparent"))
+                    with tracing.context_scope(ctx):
+                        resp = outer._handle(CniRequest.from_dict(body))
                     self._reply(200 if not resp.error else 500, resp)
                 except Exception as e:  # noqa: BLE001
                     log.exception("cni request failed")
@@ -149,7 +155,13 @@ class CniServer:
     def _dispatch(self, handler, pod_req: PodRequest) -> CniResponse:
         deadline = time.monotonic() + self.timeout
         attempt = 0
-        with metrics.CNI_SECONDS.time():
+        # thread-local contexts do not follow work into the dispatch
+        # pool: bind the current (request) context to the handler so
+        # every downstream span — VSP call, pooled apiserver request —
+        # stays on the shim's trace. The exemplar links this request's
+        # latency bucket back to the same trace.
+        handler = tracing.wrap_context(handler)
+        with metrics.CNI_SECONDS.time(exemplar=tracing.exemplar):
             while True:
                 remaining = deadline - time.monotonic()
                 fut = self._pool.submit(handler, pod_req)

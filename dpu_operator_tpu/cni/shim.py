@@ -19,11 +19,63 @@ import os
 import socket
 import sys
 import time
+import uuid
 
 _CNI_ENV_KEYS = ("CNI_COMMAND", "CNI_CONTAINERID", "CNI_NETNS", "CNI_IFNAME",
                  "CNI_ARGS", "CNI_PATH")
 
 DEFAULT_SOCKET = "/var/run/tpu-daemon/tpu-cni-server.sock"
+
+
+# -- trace context (self-contained: utils/tracing.py is not importable
+# here, but the wire format is the same W3C traceparent shape) ---------------
+
+def _trace_context() -> tuple:
+    """(trace_id, span_id, parent_id) rooting the whole pod-ready
+    request: the shim is hop zero, so it MINTS the 128-bit trace id —
+    unless the invoker exported TRACEPARENT (the W3C convention for
+    CLI tools), in which case the shim joins that trace as a child."""
+    trace_id, parent_id = uuid.uuid4().hex, None
+    tp = os.environ.get("TRACEPARENT", "")
+    parts = tp.split("-")
+    # strict per field: int(x, 16) would accept '+'/'_'-padded values,
+    # and only exact lowercase hex survives the server's regex — a
+    # looser check here would orphan the shim span from the request
+    hexdigits = set("0123456789abcdef")
+    if (len(parts) == 4
+            and len(parts[0]) == 2 and set(parts[0]) <= hexdigits
+            and parts[0] != "ff"
+            and len(parts[1]) == 32 and set(parts[1]) <= hexdigits
+            and len(parts[2]) == 16 and set(parts[2]) <= hexdigits
+            and len(parts[3]) == 2 and set(parts[3]) <= hexdigits
+            and parts[1] != "0" * 32 and parts[2] != "0" * 16):
+        trace_id, parent_id = parts[1], parts[2]
+    return trace_id, uuid.uuid4().hex[:16], parent_id
+
+
+def _emit_span(trace_id: str, span_id: str, parent_id, name: str,
+               start: float, duration_s: float, error: str = "",
+               **attributes) -> None:
+    """Append one span record to TPU_OPERATOR_TRACE, matching
+    utils/tracing.py's JSONL shape so one file holds the whole tree.
+    O_APPEND single-write keeps concurrent shims from interleaving."""
+    target = os.environ.get("TPU_OPERATOR_TRACE", "")
+    if not target:
+        return
+    record = {"name": name, "trace_id": trace_id, "span_id": span_id,
+              "parent_id": parent_id, "start": start,
+              "duration_s": round(duration_s, 6),
+              "attributes": attributes,
+              **({"error": error} if error else {})}
+    line = json.dumps(record) + "\n"
+    try:
+        if target == "stderr":
+            sys.stderr.write(line)
+        else:
+            with open(target, "a") as sink:
+                sink.write(line)
+    except OSError:
+        pass  # tracing must never fail the CNI result contract
 
 
 def _connect(sock, socket_path: str, deadline: float):
@@ -41,15 +93,20 @@ def _connect(sock, socket_path: str, deadline: float):
             time.sleep(0.02)
 
 
-def _post(socket_path: str, payload: dict, timeout: float = 120.0) -> dict:
-    """Minimal HTTP-over-unix-socket POST (cnishim.go:59-89)."""
+def _post(socket_path: str, payload: dict, timeout: float = 120.0,
+          traceparent: str = "") -> dict:
+    """Minimal HTTP-over-unix-socket POST (cnishim.go:59-89); the
+    Traceparent header carries the shim's trace context to the daemon's
+    CNI server, which adopts it for every downstream hop."""
     with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
         sock.settimeout(timeout)
         _connect(sock, socket_path, time.monotonic() + timeout)
         body = json.dumps(payload).encode()
+        trace_hdr = (f"Traceparent: {traceparent}\r\n" if traceparent
+                     else "")
         headers = (
             f"POST /cni HTTP/1.1\r\nHost: unix\r\n"
-            f"Content-Type: application/json\r\n"
+            f"Content-Type: application/json\r\n{trace_hdr}"
             f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
         ).encode()
         sock.sendall(headers + body)
@@ -68,6 +125,30 @@ def _post(socket_path: str, payload: dict, timeout: float = 120.0) -> dict:
     return resp
 
 
+def _traced_post(socket_path: str, payload: dict) -> dict:
+    """One traced shim->daemon round trip: mint/adopt the trace context,
+    stamp it on the wire, record the shim-side span."""
+    trace_id, span_id, parent_id = _trace_context()
+    env = payload.get("env") or {}
+    start = time.time()
+    t0 = time.monotonic()
+    error = ""
+    try:
+        resp = _post(socket_path, payload,
+                     traceparent=f"00-{trace_id}-{span_id}-01")
+        if resp.get("error"):
+            error = str(resp["error"])
+        return resp
+    except Exception as e:
+        error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _emit_span(trace_id, span_id, parent_id, "cni.shim", start,
+                   time.monotonic() - t0, error=error,
+                   command=env.get("CNI_COMMAND", ""),
+                   containerid=env.get("CNI_CONTAINERID", ""))
+
+
 class CniShim:
     """Importable wrapper used by tests and the in-package client."""
 
@@ -79,7 +160,7 @@ class CniShim:
         config = json.loads(stdin_data or "{}")
         if env.get("CNI_COMMAND") == "CHECK":
             return CniResponse(result={})
-        raw = _post(self.socket_path, {
+        raw = _traced_post(self.socket_path, {
             "env": {k: env[k] for k in _CNI_ENV_KEYS if k in env},
             "config": config,
         })
@@ -95,7 +176,7 @@ def main(argv=None) -> int:
             print(json.dumps({}))
             return 0
         config = json.loads(sys.stdin.read() or "{}")
-        resp = _post(socket_path, {"env": env, "config": config})
+        resp = _traced_post(socket_path, {"env": env, "config": config})
     except Exception as e:  # noqa: BLE001 — CNI error JSON contract
         print(json.dumps({"cniVersion": "0.4.0", "code": 999,
                           "msg": str(e)}))

@@ -26,6 +26,10 @@ def main(argv=None):
     verbosity = int(os.environ.get("TPU_LOG_LEVEL", "0") or 0)
     logging.basicConfig(
         level=logging.DEBUG if verbosity >= 1 else logging.INFO)
+    # stamp trace_id/span_id on every daemon log record so log lines
+    # join the trace tree and the flight recorder (doc/observability.md)
+    from ..utils import tracing
+    tracing.install_log_context()
 
     # Fail fast when an apiserver is expected (explicit kubeconfig or
     # in-cluster env): silently downgrading to standalone would disable VSP

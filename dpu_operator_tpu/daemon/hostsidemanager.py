@@ -25,7 +25,7 @@ from ..cni.ipam import ipam_add, ipam_del
 from ..cni.types import DeviceWiring, PodRequest
 from ..deviceplugin import DevicePlugin
 from ..k8s.manager import Manager
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
 from ..vsp.rpc import VspChannel
@@ -117,6 +117,13 @@ class HostSideManager:
     def _tpu_daemon_call(self, method: str, req: dict) -> dict:
         if self._tpu_daemon_addr is None:
             raise RuntimeError("VSP not started")
+        # client-side span for the host→tpu cross-boundary hop; the
+        # channel seam (vsp/rpc.py) injects this context as gRPC
+        # metadata, so the tpu-side server span joins the same trace
+        with tracing.span("hostside.tpu_daemon_call", method=method):
+            return self._tpu_daemon_call_traced(method, req)
+
+    def _tpu_daemon_call_traced(self, method: str, req: dict) -> dict:
         ip, port = self._tpu_daemon_addr
         last: Optional[Exception] = None
         for attempt in range(self.dial_retries):

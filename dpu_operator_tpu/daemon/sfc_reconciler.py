@@ -14,7 +14,7 @@ import logging
 
 from ..api.types import API_VERSION, ServiceFunctionChain
 from ..k8s.manager import ReconcileResult, Request
-from ..utils import resilience
+from ..utils import resilience, tracing
 from ..utils import vars as v
 
 log = logging.getLogger(__name__)
@@ -123,6 +123,16 @@ class SfcReconciler:
         if obj is None:
             return ReconcileResult()  # pod GC via owner refs
         sfc = ServiceFunctionChain.from_obj(obj)
+        # root span per reconcile pass, keyed by the CR uid: every
+        # apiserver request below (pod LIST/creates, status write)
+        # carries this trace, so "why did THIS chain's reconcile stall"
+        # is answerable from the trace tree / flight recorder alone
+        with tracing.span("sfc.reconcile", uid=sfc.uid,
+                          namespace=sfc.namespace, name=sfc.name):
+            return self._reconcile_traced(client, obj, sfc)
+
+    def _reconcile_traced(self, client, obj: dict,
+                          sfc: ServiceFunctionChain) -> ReconcileResult:
         scheduled = ready = 0
         # ONE labeled LIST replaces N per-NF GETs (wire-path fast lane:
         # this runs every 5 s resync per chain, and each NF pod carries

@@ -20,7 +20,7 @@ from typing import Optional
 from ..cni import CniServer
 from ..cni.announce import announce_result
 from ..cni.ipam import ipam_add, ipam_del
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..cni.types import PodRequest
 from ..deviceplugin import DevicePlugin
 from ..k8s.manager import Manager
@@ -271,7 +271,11 @@ class TpuSideManager:
     def _repair_loop(self, interval: float):
         while not self._repair_stop.wait(interval):
             try:
-                self.repair_chains()
+                # each pass is its own root trace: repairs triggered by
+                # the loop (vs. AdminService) are distinguishable in the
+                # flight recorder by this span
+                with tracing.span("tpuside.repair_pass"):
+                    self.repair_chains()
             except Exception:  # noqa: BLE001 — keep the loop alive
                 log.exception("chain repair pass failed")
 
@@ -389,6 +393,11 @@ class TpuSideManager:
         a failed wire is re-attempted on the next retry."""
         if not req.device_id:
             raise ValueError("NF CNI ADD without deviceID")
+        with tracing.span("tpuside.nf_add", sandbox=req.sandbox_id,
+                          device=req.device_id):
+            return self._cni_nf_add_traced(req)
+
+    def _cni_nf_add_traced(self, req: PodRequest) -> dict:
         attachment_id = f"nf-{req.sandbox_id[:12]}-{req.device_id}"
         # delegate addressing for the NF's secondary interface before any
         # wiring: NF pods need distinct addresses per interface
@@ -846,6 +855,14 @@ class TpuSideManager:
     def _sync_cross_host(self, namespace: str, name: str, sfc_obj: dict):
         nfs = (sfc_obj.get("spec", {}) or {}).get("networkFunctions") or []
         key = (namespace, name)
+        with tracing.span("tpuside.cross_host_sync", namespace=namespace,
+                          name=name,
+                          uid=(sfc_obj.get("metadata") or {})
+                          .get("uid", "")):
+            self._sync_cross_host_traced(key, nfs, namespace, name)
+
+    def _sync_cross_host_traced(self, key: tuple, nfs: list,
+                                namespace: str, name: str):
         self._retry_mirror_pending()
         with self._attach_lock:
             chain = {i: dict(e)
@@ -1480,6 +1497,11 @@ class TpuSideManager:
         """DEL for one interface removes only that interface's attachment
         (a multus-style per-interface DEL+retry must not discard the other
         interface's state); a DEL without deviceID tears the sandbox down."""
+        with tracing.span("tpuside.nf_del", sandbox=req.sandbox_id,
+                          device=req.device_id or ""):
+            return self._cni_nf_del_traced(req)
+
+    def _cni_nf_del_traced(self, req: PodRequest) -> dict:
         attachment_id = (f"nf-{req.sandbox_id[:12]}-{req.device_id}"
                          if req.device_id else None)
         # Release delegated addresses FIRST, from the ADD-time cached

@@ -26,7 +26,7 @@ import threading
 from typing import Any, Optional
 from urllib.parse import urlencode, urlsplit
 
-from ..utils import metrics, resilience
+from ..utils import metrics, resilience, tracing
 
 #: errors that mark a REUSED connection as stale (server closed the
 #: keep-alive socket while it idled) — retried once on a fresh dial.
@@ -157,6 +157,22 @@ class HttpsConnectionPool:
             path = path + "?" + urlencode(params)
         headers = dict(headers or {})
         headers.setdefault("Accept-Encoding", "gzip")
+        with tracing.span("kube.request", method=method,
+                          path=path.partition("?")[0]):
+            return self._request_inner(method, path, body, headers,
+                                       timeout)
+
+    def _request_inner(self, method: str, path: str,
+                       body: Optional[bytes], headers: dict,
+                       timeout: Optional[float]) -> PooledResponse:
+        # stamp the current trace context on the outgoing apiserver
+        # request (W3C traceparent). Inside the kube.request span, so
+        # the header carries THAT span's id — a server-side collector
+        # parents its hop under kube.request, not its caller — and a
+        # root request (no ambient context) still sends the fresh trace
+        tp = tracing.inject_traceparent()
+        if tp:
+            headers.setdefault("Traceparent", tp)
         fresh_retry = False
         while True:
             if fresh_retry:

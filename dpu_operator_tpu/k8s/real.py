@@ -28,6 +28,7 @@ import yaml
 
 from ..utils import metrics
 from ..utils import resilience
+from ..utils import tracing
 from .pool import HttpsConnectionPool
 
 log = logging.getLogger(__name__)
@@ -217,12 +218,21 @@ class RealKube:
                     return self.pool.request(
                         method, url[len(self.base):], params=params,
                         body=body, headers=hdrs, timeout=timeout)
+                # session fallback stamps the trace context itself (the
+                # pooled path does it inside pool.request)
+                session_headers = dict(headers or {})
+                tp = tracing.inject_traceparent()
+                if tp:
+                    session_headers.setdefault("Traceparent", tp)
                 return self.session.request(
                     method, url, params=params, json=json_obj, data=data,
-                    headers=headers, timeout=timeout)
+                    headers=session_headers or None, timeout=timeout)
             finally:
+                # the exemplar links this verb's latency bucket to the
+                # trace that landed there (OpenMetrics scrapes only)
                 metrics.KUBE_REQUEST_SECONDS.observe(
-                    verb, time.perf_counter() - t0)
+                    verb, time.perf_counter() - t0,
+                    exemplar=tracing.exemplar())
                 metrics.KUBE_REQUESTS.inc(
                     verb=verb,
                     transport="pooled" if self.pool is not None

@@ -72,6 +72,20 @@ def _vsp_cmds(sub):
     p.add_argument("--peer", default="")
     p = sub.add_parser("delete-attachment")
     p.add_argument("name")
+    p = sub.add_parser(
+        "flight",
+        help="dump the daemon's flight recorder (/debug/flight on the "
+             "metrics port): recent spans, breaker transitions, "
+             "swallowed errors, journal recoveries — the post-incident "
+             "snapshot that exists even when no trace sink was "
+             "configured")
+    p.add_argument("--trace", default="",
+                   help="only events of this trace_id")
+    p.add_argument("--kind", default="",
+                   help="only events of this kind "
+                        "(span/breaker/swallowed_error/journal_recovery)")
+    p.add_argument("--token", default="",
+                   help="bearer token when /debug/flight is auth-filtered")
 
 
 def main(argv=None):
@@ -81,6 +95,9 @@ def main(argv=None):
     parser.add_argument("--daemon-addr", default="",
                         help="ip:port of the daemon's cross-boundary "
                              "server (for resize-chips)")
+    parser.add_argument("--metrics-addr", default="127.0.0.1:18001",
+                        help="host:port of the daemon's metrics/health "
+                             "server (for flight)")
     sub = parser.add_subparsers(dest="cmd", required=True)
     _agent_cmds(sub)
     _vsp_cmds(sub)
@@ -124,6 +141,18 @@ def run(args) -> dict:
             return {"unwired": [args.input, args.output]}
         finally:
             client.close()
+
+    if args.cmd == "flight":
+        from .utils.flight import fetch
+        snap = fetch(args.metrics_addr, token=args.token)
+        events = snap.get("events", [])
+        if args.trace:
+            events = [e for e in events
+                      if e.get("trace_id") == args.trace]
+        if args.kind:
+            events = [e for e in events if e.get("kind") == args.kind]
+        return {"capacity": snap.get("capacity"),
+                "recorded": snap.get("recorded"), "events": events}
 
     from .vsp.rpc import VspChannel, unix_target
 

@@ -1,0 +1,137 @@
+"""Flight recorder: a bounded in-memory ring of recent operational events.
+
+Production incidents rarely happen while a trace sink is configured. The
+recorder keeps the last N spans, circuit-breaker transitions, swallowed
+errors and journal recoveries in process memory — always on, no config —
+so a post-incident snapshot exists the moment someone asks: served as
+JSON at ``/debug/flight`` on every :class:`utils.metrics.MetricsServer`
+and dumpable with ``tpuctl flight``.
+
+Event sources (all push, the recorder never polls):
+
+- :mod:`utils.tracing` records every finished span (even when
+  ``TPU_OPERATOR_TRACE`` is unset — the sink gates the *file*, not the
+  ring).
+- :class:`utils.resilience.CircuitBreaker` records each state
+  transition.
+- The ``tpu_daemon_swallowed_errors_total`` and
+  ``tpu_daemon_journal_recoveries_total`` counters record each
+  increment (:mod:`utils.metrics` wraps them).
+
+Events carry the active ``trace_id``/``span_id`` when one exists, so a
+flight dump joins against the trace tree and the structured logs.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Optional
+
+#: ring capacity: large enough to hold a whole CNI-ADD storm's spans plus
+#: the breaker flaps around it, small enough to be dumped over HTTP
+#: without pagination
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring (oldest evicted first)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self.capacity = capacity
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, kind: str, name: str,
+               trace_id: Optional[str] = None,
+               span_id: Optional[str] = None,
+               duration_s: Optional[float] = None,
+               error: str = "",
+               attributes: Optional[dict] = None) -> None:
+        """Append one event. When *trace_id* is not given, the current
+        thread's trace context (if any) is stamped so breaker flips and
+        swallowed errors join the request that triggered them."""
+        if trace_id is None:
+            # lazy import: tracing imports this module at load time
+            from . import tracing
+            ctx = tracing.current()
+            if ctx is not None:
+                trace_id, span_id = ctx.trace_id, ctx.span_id
+        event: dict = {"ts": round(time.time(), 6), "kind": kind,
+                       "name": name}
+        if trace_id:
+            event["trace_id"] = trace_id
+        if span_id:
+            event["span_id"] = span_id
+        if duration_s is not None:
+            event["duration_s"] = duration_s
+        if error:
+            event["error"] = error
+        if attributes:
+            event["attributes"] = attributes
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self._events.append(event)
+
+    def snapshot(self) -> dict:
+        """JSON-ready dump: events oldest-first plus eviction accounting
+        (``recorded - len(events)`` is how much history the ring lost)."""
+        with self._lock:
+            events = list(self._events)
+            recorded = self._seq
+        return {"capacity": self.capacity, "recorded": recorded,
+                "events": events}
+
+    def events(self, kind: Optional[str] = None,
+               trace_id: Optional[str] = None) -> list:
+        """Filtered view (assertions and ``tpuctl flight --trace``)."""
+        with self._lock:
+            events = list(self._events)
+        if kind is not None:
+            events = [e for e in events if e["kind"] == kind]
+        if trace_id is not None:
+            events = [e for e in events if e.get("trace_id") == trace_id]
+        return events
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+
+
+#: process-global recorder (the REGISTRY analog for events)
+RECORDER = FlightRecorder()
+
+
+def record(kind: str, name: str, **kwargs: Any) -> None:
+    """Record on the global ring (see :meth:`FlightRecorder.record`)."""
+    RECORDER.record(kind, name, **kwargs)
+
+
+def fetch(addr: str, timeout: float = 5.0, token: str = "") -> dict:
+    """GET ``/debug/flight`` from a MetricsServer at ``host:port`` —
+    what ``tpuctl flight`` runs. *token* is the bearer token when the
+    endpoint is auth-filtered (same filter as /metrics)."""
+    import http.client
+    import json
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"expected host:port for the metrics endpoint, got {addr!r}")
+    conn = http.client.HTTPConnection(host or "127.0.0.1", int(port),
+                                      timeout=timeout)
+    try:
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        conn.request("GET", "/debug/flight", headers=headers)
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"/debug/flight returned HTTP {resp.status}: "
+                f"{body[:200].decode('utf-8', 'replace')}")
+        return json.loads(body)
+    finally:
+        conn.close()

@@ -15,6 +15,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional, Sequence, TypeVar
 
+from . import flight
+
 _MetricT = TypeVar("_MetricT")
 
 
@@ -33,13 +35,35 @@ class Counter:
     def value(self, **labels: object) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
-    def _render(self) -> list:
-        out = [f"# HELP {self.name} {self.help}",
-               f"# TYPE {self.name} counter"]
+    def _render(self, openmetrics: bool = False) -> list:
+        # OpenMetrics names counter FAMILIES without the _total suffix
+        # (samples keep it); emitting `# TYPE x_total counter` makes
+        # real OM parsers reject the whole scrape as a clashing name
+        family = (self.name[:-len("_total")]
+                  if openmetrics and self.name.endswith("_total")
+                  else self.name)
+        out = [f"# HELP {family} {self.help}",
+               f"# TYPE {family} counter"]
         with self._lock:
             for key, val in sorted(self._values.items()):
                 out.append(f"{self.name}{_labels(key)} {_num(val)}")
         return out
+
+
+class _FlightRecordedCounter(Counter):
+    """Counter whose every increment also lands in the flight recorder
+    (swallowed errors, journal recoveries): the counter says *how many*,
+    the flight event says *when* and under *which trace*."""
+
+    def __init__(self, name: str, help_: str, kind: str) -> None:
+        super().__init__(name, help_)
+        self._flight_kind = kind
+
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
+        super().inc(amount, **labels)
+        flight.record(self._flight_kind, self.name,
+                      attributes={k: str(v) for k, v in labels.items()}
+                      or None)
 
 
 class Gauge(Counter):
@@ -48,7 +72,7 @@ class Gauge(Counter):
         with self._lock:
             self._values[key] = float(value)
 
-    def _render(self) -> list:
+    def _render(self, openmetrics: bool = False) -> list:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} gauge"]
         with self._lock:
@@ -73,20 +97,33 @@ class Histogram:
         self.const_labels = tuple(sorted((const_labels or {}).items()))
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
+        #: per-bucket-index latest exemplar: (labels, observed value) —
+        #: OpenMetrics exemplars link a slow bucket to the trace that
+        #: landed there (rendered only on openmetrics scrapes)
+        self._exemplars: dict[int, tuple[tuple, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[dict] = None) -> None:
         with self._lock:
             self._sum += value
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    idx = i
                     break
             else:
-                self._counts[-1] += 1
+                idx = len(self.buckets)
+            self._counts[idx] += 1
+            if exemplar:
+                self._exemplars[idx] = (tuple(sorted(exemplar.items())),
+                                        value)
 
-    def time(self) -> "_Timer":
-        return _Timer(self)
+    def time(self, exemplar: Optional[Callable[[], Optional[dict]]] = None
+             ) -> "_Timer":
+        """Context-manager timer; *exemplar* (evaluated at exit, inside
+        the timed block's trace context) attaches an exemplar to the
+        observation."""
+        return _Timer(self, exemplar)
 
     @property
     def count(self) -> int:
@@ -95,24 +132,44 @@ class Histogram:
 
     @property
     def sum(self) -> float:
-        return self._sum
+        # under the lock: a read racing observe's `+=` may otherwise see
+        # a torn sum relative to _counts (count/sum drive rate math)
+        with self._lock:
+            return self._sum
 
-    def _render(self, with_header: bool = True) -> list:
+    def _render(self, with_header: bool = True,
+                openmetrics: bool = False) -> list:
         out = ([f"# HELP {self.name} {self.help}",
                 f"# TYPE {self.name} histogram"] if with_header else [])
-        extra = "".join(f',{k}="{v}"' for k, v in self.const_labels)
+        extra = "".join(f',{k}="{_escape(v)}"' for k, v in self.const_labels)
         base = (_labels(self.const_labels) if self.const_labels else "")
         with self._lock:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += self._counts[i]
                 out.append(
-                    f'{self.name}_bucket{{le="{_num(b)}"{extra}}} {cum}')
+                    f'{self.name}_bucket{{le="{_num(b)}"{extra}}} {cum}'
+                    + self._exemplar_suffix(i, openmetrics))
             cum += self._counts[-1]
-            out.append(f'{self.name}_bucket{{le="+Inf"{extra}}} {cum}')
+            out.append(f'{self.name}_bucket{{le="+Inf"{extra}}} {cum}'
+                       + self._exemplar_suffix(len(self.buckets),
+                                               openmetrics))
             out.append(f"{self.name}_sum{base} {_num(self._sum)}")
             out.append(f"{self.name}_count{base} {cum}")
         return out
+
+    def _exemplar_suffix(self, idx: int, openmetrics: bool) -> str:
+        """`` # {trace_id="..."} <value>`` per the OpenMetrics exemplar
+        grammar; empty on classic text-format scrapes (the 0.0.4 parser
+        rejects exemplars) and for buckets without one."""
+        if not openmetrics:
+            return ""
+        hit = self._exemplars.get(idx)
+        if hit is None:
+            return ""
+        labels, value = hit
+        inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+        return f" # {{{inner}}} {_num(value)}"
 
 
 class HistogramVec:
@@ -138,36 +195,53 @@ class HistogramVec:
                 self._children[value] = child
             return child
 
-    def observe(self, value: str, seconds: float) -> None:
-        self.labels(value).observe(seconds)
+    def observe(self, value: str, seconds: float,
+                exemplar: Optional[dict] = None) -> None:
+        self.labels(value).observe(seconds, exemplar=exemplar)
 
-    def _render(self) -> list:
+    def _render(self, openmetrics: bool = False) -> list:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
             children = sorted(self._children.items())
         for _, child in children:
-            out.extend(child._render(with_header=False))
+            out.extend(child._render(with_header=False,
+                                     openmetrics=openmetrics))
         return out
 
 
 class _Timer:
-    def __init__(self, hist: Histogram) -> None:
+    def __init__(self, hist: Histogram,
+                 exemplar: Optional[Callable[[], Optional[dict]]] = None
+                 ) -> None:
         self.hist = hist
+        self.exemplar = exemplar
 
     def __enter__(self) -> "_Timer":
         self._start = time.perf_counter()
         return self
 
     def __exit__(self, *exc: object) -> bool:
-        self.hist.observe(time.perf_counter() - self._start)
+        elapsed = time.perf_counter() - self._start
+        self.hist.observe(
+            elapsed,
+            exemplar=self.exemplar() if self.exemplar is not None else None)
         return False
+
+
+def _escape(v: object) -> str:
+    """Label-value escaping per the Prometheus exposition format: a raw
+    `\\`, `"` or newline in a label value (an error string, a path)
+    would otherwise terminate the quoted value early and corrupt the
+    whole scrape."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
 
 
 def _labels(key: tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
@@ -198,11 +272,15 @@ class Registry:
             self._metrics.append(metric)
         return metric
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """Text exposition; *openmetrics* additionally renders exemplars
+        and the terminating ``# EOF`` the OpenMetrics grammar requires."""
         lines = []
         with self._lock:
             for m in self._metrics:
-                lines.extend(m._render())
+                lines.extend(m._render(openmetrics=openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 
@@ -278,17 +356,19 @@ BREAKER_TRANSITIONS = REGISTRY.counter(
 BREAKER_REJECTIONS = REGISTRY.counter(
     "tpu_resilience_breaker_rejections_total",
     "Calls short-circuited by an open/saturated breaker, by site")
-JOURNAL_RECOVERIES = REGISTRY.counter(
+JOURNAL_RECOVERIES = REGISTRY._add(_FlightRecordedCounter(
     "tpu_daemon_journal_recoveries_total",
     "Chain-journal startup recoveries by source (primary = journal "
     "read clean; last_good = truncated/corrupt journal, fell back to "
-    "the previous snapshot; empty = no readable snapshot at all)")
+    "the previous snapshot; empty = no readable snapshot at all)",
+    kind="journal_recovery"))
 # -- static-analysis gate (opslint exception-hygiene rule) -------------------
-SWALLOWED_ERRORS = REGISTRY.counter(
+SWALLOWED_ERRORS = REGISTRY._add(_FlightRecordedCounter(
     "tpu_daemon_swallowed_errors_total",
     "Exceptions deliberately swallowed on the daemon/reconcile path, "
     "by site — a rising rate at one site is a failing dependency that "
-    "would otherwise be invisible")
+    "would otherwise be invisible",
+    kind="swallowed_error"))
 
 
 class TokenReviewAuth:
@@ -354,8 +434,11 @@ class TokenReviewAuth:
 
 
 class MetricsServer:
-    """/metrics + /healthz + /readyz on one port (the operator binds
-    metrics :18090 and health :18091 separately; one mux suffices here).
+    """/metrics + /healthz + /readyz + /debug/flight on one port (the
+    operator binds metrics :18090 and health :18091 separately; one mux
+    suffices here). /debug/flight serves the flight recorder's bounded
+    ring of recent spans/breaker flips/swallowed errors as JSON — the
+    post-incident snapshot `tpuctl flight` dumps.
 
     With *auth* set (a callable token -> allowed, e.g. TokenReviewAuth),
     /metrics requires a Bearer token — 401 without one, 403 when the
@@ -390,24 +473,48 @@ class MetricsServer:
             def log_message(self, fmt: str, *args: object) -> None:
                 pass
 
+            def _auth_denial(self) -> Optional[tuple]:
+                """(code, body, ctype) denial for the token-filtered
+                endpoints, or None when admitted (/metrics and
+                /debug/flight share the filter: a flight dump exposes
+                the same operational surface a scrape does)."""
+                if outer.auth is None:
+                    return None
+                hdr = self.headers.get("Authorization", "")
+                token = (hdr[len("Bearer "):]
+                         if hdr.startswith("Bearer ") else "")
+                if not token:
+                    return 401, b"Unauthorized", "text/plain"
+                if not outer.auth(token):
+                    return 403, b"Forbidden", "text/plain"
+                return None
+
             def do_GET(self) -> None:
                 if self.path == "/metrics":
-                    code = 200
-                    if outer.auth is not None:
-                        hdr = self.headers.get("Authorization", "")
-                        token = (hdr[len("Bearer "):]
-                                 if hdr.startswith("Bearer ") else "")
-                        if not token:
-                            code = 401
-                        elif not outer.auth(token):
-                            code = 403
-                    if code != 200:
-                        body = b"Unauthorized" if code == 401 \
-                            else b"Forbidden"
-                        ctype = "text/plain"
+                    denied = self._auth_denial()
+                    if denied is not None:
+                        code, body, ctype = denied
                     else:
-                        body = outer.registry.render().encode()
-                        ctype = "text/plain; version=0.0.4"
+                        # OpenMetrics negotiation: exemplars are only
+                        # valid in the OpenMetrics grammar, so they
+                        # render only for scrapers that ask for it
+                        accept = self.headers.get("Accept", "")
+                        om = "application/openmetrics-text" in accept
+                        body = outer.registry.render(
+                            openmetrics=om).encode()
+                        ctype = ("application/openmetrics-text; "
+                                 "version=1.0.0; charset=utf-8" if om
+                                 else "text/plain; version=0.0.4")
+                        code = 200
+                elif self.path == "/debug/flight":
+                    denied = self._auth_denial()
+                    if denied is not None:
+                        code, body, ctype = denied
+                    else:
+                        import json
+                        body = json.dumps(
+                            flight.RECORDER.snapshot()).encode()
+                        ctype, code = "application/json", 200
                 elif self.path == "/healthz":
                     degraded = (outer.degraded_check()
                                 if outer.degraded_check else [])

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-from . import metrics
+from . import flight, metrics
 
 log = logging.getLogger(__name__)
 
@@ -230,9 +230,13 @@ class CircuitBreaker:
     def _transition_locked(self, state: str) -> None:
         if state == self._state:
             return
-        self._state = state
+        from_state, self._state = self._state, state
         metrics.BREAKER_STATE.set(self._STATE_VALUE[state], site=self.site)
         metrics.BREAKER_TRANSITIONS.inc(site=self.site, to=state)
+        # flight-recorded with the active trace (if any): a post-incident
+        # dump shows WHICH request's failure tripped the breaker
+        flight.record("breaker", self.site,
+                      attributes={"from": from_state, "to": state})
         log.log(logging.WARNING if state != self.CLOSED else logging.INFO,
                 "circuit breaker %s -> %s", self.site, state)
 

@@ -1,82 +1,285 @@
-"""Lightweight tracing spans.
+"""Request-scoped tracing: real trace contexts across every wire seam.
 
 The reference has no tracing (SURVEY.md §5 flags this as a gap to fix
-"from day one"). Env-gated (TPU_OPERATOR_TRACE=<file|stderr>) span
-recording with wall-time and nesting — OTel-shaped records (name, start,
-duration, attributes, parent) so an exporter can be swapped in without
+"from day one"). Originally this module recorded anonymous in-process
+spans; it now carries Dapper-style trace contexts — a 128-bit
+``trace_id`` shared by every span of one request plus a 64-bit
+``span_id`` per operation — and ships W3C traceparent-shaped
+inject/extract helpers so the context crosses the four process
+boundaries of a pod-ready request (CNI shim → daemon CNI server → VSP
+gRPC → apiserver) and a real OTel exporter can be swapped in without
 touching call sites.
+
+Span *records* go two places:
+
+- the flight recorder (:mod:`utils.flight`) — always, so a bounded
+  post-incident history exists even with no sink configured;
+- the trace sink — only when ``TPU_OPERATOR_TRACE=<file|stderr>`` is
+  set: JSONL records (name, trace_id, span_id, parent_id, start,
+  duration, attributes, error).
+
+Propagation helpers:
+
+- :func:`inject_traceparent` — header value for the current context
+  (``00-<trace_id>-<span_id>-01``), ``None`` outside any span.
+- :func:`extract_traceparent` — strict parse of an inbound header;
+  malformed/hostile values yield ``None`` (a fresh root), never an
+  exception.
+- :func:`context_scope` — adopt a remote parent on this thread.
+- :func:`wrap_context` — carry the current context across a thread-pool
+  submit (thread-locals don't follow the work item).
+- :class:`TraceContextFilter` — stamps ``trace_id``/``span_id`` on log
+  records so logs and traces join (install via
+  :func:`install_log_context`).
 """
 
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import json
 import logging
 import os
+import re
 import sys
 import threading
 import time
 import uuid
-from typing import Iterator, Optional
+from typing import Any, Callable, Iterator, Optional, TextIO, TypeVar
+
+from . import flight
 
 log = logging.getLogger(__name__)
 
+_F = TypeVar("_F", bound=Callable[..., Any])
+
 _local = threading.local()
 _lock = threading.Lock()
-_sink = None
+_sink: Optional[TextIO] = None
 _enabled: Optional[bool] = None
+
+#: canonical header name (HTTP headers are case-insensitive; gRPC
+#: metadata keys must be lowercase, so the lowercase form is canonical)
+TRACEPARENT_HEADER = "traceparent"
+
+#: W3C traceparent: version "-" 32 hex trace-id "-" 16 hex span-id "-"
+#: 2 hex flags, all lowercase (uppercase is invalid per spec)
+_TRACEPARENT_RE = re.compile(
+    r"\A([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})\Z")
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    """One span's identity within a trace."""
+
+    trace_id: str  # 32 lowercase hex chars (128-bit)
+    span_id: str   # 16 lowercase hex chars (64-bit)
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current() -> Optional[SpanContext]:
+    """The active span context on this thread, if any."""
+    ctx = getattr(_local, "ctx", None)
+    return ctx if isinstance(ctx, SpanContext) else None
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = current()
+    return ctx.trace_id if ctx else None
+
+
+def exemplar() -> Optional[dict]:
+    """Exemplar label set for histogram observations: the trace that is
+    about to land in a latency bucket (OpenMetrics exemplar wiring)."""
+    ctx = current()
+    return {"trace_id": ctx.trace_id} if ctx else None
+
+
+def inject_traceparent() -> Optional[str]:
+    """Header/metadata value carrying the current context to the next
+    hop; ``None`` when no span is active (nothing to propagate)."""
+    ctx = current()
+    return ctx.traceparent() if ctx else None
+
+
+def extract_traceparent(value: object) -> Optional[SpanContext]:
+    """Strict parse of an inbound traceparent. Returns ``None`` for
+    anything malformed or hostile — non-strings, wrong field widths,
+    uppercase hex, the invalid version ``ff``, all-zero trace/span ids,
+    embedded whitespace/newlines (header-splitting attempts) — so a bad
+    peer can at worst orphan its own trace, never corrupt ours."""
+    if not isinstance(value, str) or len(value) > 64:
+        return None
+    m = _TRACEPARENT_RE.match(value)
+    if m is None:
+        return None
+    version, trace_id, span_id, _flags = m.groups()
+    if version == "ff":  # forbidden by the spec
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id, span_id)
+
+
+@contextlib.contextmanager
+def context_scope(ctx: Optional[SpanContext]) -> Iterator[None]:
+    """Adopt *ctx* as this thread's current context (server-side
+    restore after :func:`extract_traceparent`). ``None`` is a no-op so
+    call sites can pass the extract result straight through."""
+    if ctx is None:
+        yield
+        return
+    prev = getattr(_local, "ctx", None)
+    _local.ctx = ctx
+    try:
+        yield
+    finally:
+        _local.ctx = prev
+
+
+def wrap_context(fn: _F) -> _F:
+    """Bind the CURRENT context to *fn* so it survives a thread-pool
+    submit: the CNI server dispatches handlers on worker threads, and a
+    thread-local context would otherwise be lost at the pool boundary."""
+    captured = current()
+
+    def bound(*args: Any, **kwargs: Any) -> Any:
+        with context_scope(captured):
+            return fn(*args, **kwargs)
+
+    return bound  # type: ignore[return-value]
 
 
 def _setup() -> bool:
+    """Idempotent sink init. Fully under ``_lock``: two threads racing
+    the first span previously both saw ``_enabled is None`` and each
+    opened the sink file — the loser's handle leaked and records split
+    across two buffered handles. The double-check keeps the fast path
+    lock-free once initialized (reads of a bound bool are atomic)."""
     global _sink, _enabled
     if _enabled is not None:
         return _enabled
-    target = os.environ.get("TPU_OPERATOR_TRACE", "")
-    if not target:
-        _enabled = False
-        return False
-    _sink = sys.stderr if target == "stderr" else open(target, "a")
-    _enabled = True
+    with _lock:
+        if _enabled is not None:
+            return _enabled
+        target = os.environ.get("TPU_OPERATOR_TRACE", "")
+        if not target:
+            _enabled = False
+            return False
+        try:
+            _sink = (sys.stderr if target == "stderr"
+                     else open(target, "a"))
+        except OSError:
+            # tracing must never fail the instrumented operation (the
+            # shim's rule, applied here too): an unwritable sink path
+            # disables the sink for the process instead of raising an
+            # unrelated OSError out of every span-wrapped request
+            log.exception("cannot open trace sink %r; tracing disabled",
+                          target)
+            _enabled = False
+            return False
+        _enabled = True
     return True
 
 
 def _emit(record: dict) -> None:
     with _lock:
+        if _sink is None:  # reset_for_tests raced a finishing span
+            return
         _sink.write(json.dumps(record) + "\n")
         _sink.flush()
 
 
 @contextlib.contextmanager
-def span(name: str, **attributes: object) -> Iterator[Optional[str]]:
-    """Record a span around a block; nesting tracked per-thread. No-op
-    (≈60 ns) when tracing is disabled."""
-    if not _setup():
-        yield None
-        return
-    span_id = uuid.uuid4().hex[:16]
-    parent = getattr(_local, "current", None)
-    _local.current = span_id
+def span(name: str, /, **attributes: object) -> Iterator[SpanContext]:
+    """Record a span around a block; nesting tracked per-thread.
+
+    Always yields a live :class:`SpanContext` (a fresh root trace when
+    no context is active) and always lands the finished span in the
+    flight recorder; the JSONL sink is written only when
+    ``TPU_OPERATOR_TRACE`` is configured."""
+    parent = current()
+    ctx = SpanContext(parent.trace_id if parent else new_trace_id(),
+                      new_span_id())
+    # _setup before touching _local: even a raising sink init (it
+    # shouldn't — see _setup) must never leak this context onto the
+    # thread past the span's lifetime
+    sink_enabled = _setup()
+    prev = getattr(_local, "ctx", None)
+    _local.ctx = ctx
     start = time.time()
     t0 = time.perf_counter()
     error = ""
     try:
-        yield span_id
+        yield ctx
     except BaseException as e:
         error = f"{type(e).__name__}: {e}"
         raise
     finally:
-        _local.current = parent
-        _emit({"name": name, "span_id": span_id, "parent_id": parent,
-               "start": start,
-               "duration_s": round(time.perf_counter() - t0, 6),
-               "attributes": attributes,
-               **({"error": error} if error else {})})
+        _local.ctx = prev
+        duration = round(time.perf_counter() - t0, 6)
+        flight.record("span", name, trace_id=ctx.trace_id,
+                      span_id=ctx.span_id, duration_s=duration,
+                      error=error,
+                      attributes={k: str(v) for k, v in
+                                  attributes.items()} or None)
+        if sink_enabled:
+            _emit({"name": name, "trace_id": ctx.trace_id,
+                   "span_id": ctx.span_id,
+                   "parent_id": parent.span_id if parent else None,
+                   "start": start, "duration_s": duration,
+                   "attributes": attributes,
+                   **({"error": error} if error else {})})
+
+
+# -- logs <-> traces join -----------------------------------------------------
+
+class TraceContextFilter(logging.Filter):
+    """Stamps ``trace_id``/``span_id`` on every record passing through
+    (``-`` outside any span), so a formatter can render them and a log
+    line greps straight to its trace tree and flight events."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = current()
+        record.trace_id = ctx.trace_id if ctx else "-"
+        record.span_id = ctx.span_id if ctx else "-"
+        return True
+
+
+#: default daemon/CNI/VSP line format once trace stamping is installed
+LOG_FORMAT = ("%(asctime)s %(levelname)s [trace=%(trace_id)s "
+              "span=%(span_id)s] %(name)s: %(message)s")
+
+
+def install_log_context(logger: Optional[logging.Logger] = None,
+                        fmt: str = LOG_FORMAT) -> None:
+    """Attach :class:`TraceContextFilter` + a trace-aware formatter to
+    *logger*'s handlers (root by default). Entrypoints call this right
+    after ``logging.basicConfig`` — idempotent, so embedded use (tests
+    starting several managers) can't stack filters."""
+    target = logger or logging.getLogger()
+    for handler in target.handlers:
+        if not any(isinstance(f, TraceContextFilter)
+                   for f in handler.filters):
+            handler.addFilter(TraceContextFilter())
+        handler.setFormatter(logging.Formatter(fmt))
 
 
 def reset_for_tests() -> None:
     global _sink, _enabled
     with _lock:
         if _sink not in (None, sys.stderr):
-            _sink.close()
+            _sink.close()  # type: ignore[union-attr]
         _sink = None
         _enabled = None
+    _local.ctx = None

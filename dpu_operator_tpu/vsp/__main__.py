@@ -36,6 +36,10 @@ def main(argv=None):
                              "(dev/test harnesses only)")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # logs <-> traces join: VSP records carry the trace the daemon's
+    # gRPC metadata restored server-side (vsp/rpc.py)
+    from ..utils import tracing
+    tracing.install_log_context()
 
     pm = PathManager(args.root)
     sock = args.socket or pm.vendor_plugin_socket()

@@ -16,7 +16,7 @@ import time
 from typing import Optional, Protocol
 
 from ..render import apply_all_from_bindata
-from ..utils import resilience
+from ..utils import resilience, tracing
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
 from .rpc import VspChannel, unix_target
@@ -207,11 +207,16 @@ class GrpcPlugin:
                 raise RuntimeError("plugin closed mid-call")
             return channel.call(service, method, req, timeout=timeout)
 
-        return self.retry.call(attempt, site=f"vsp.{service}.{method}",
-                               retry_if=_vsp_transient,
-                               breaker=self.breaker,
-                               failure_if=_vsp_breaker_failure,
-                               on_retry=self._reconnect)
+        # the client-side span wraps retries AND breaker admission, so
+        # one trace shows the whole story (N attempts, BreakerOpen) and
+        # the channel seam injects this context as gRPC metadata
+        with tracing.span("vsp.call", service=service, method=method):
+            return self.retry.call(attempt,
+                                   site=f"vsp.{service}.{method}",
+                                   retry_if=_vsp_transient,
+                                   breaker=self.breaker,
+                                   failure_if=_vsp_breaker_failure,
+                                   on_retry=self._reconnect)
 
     def get_devices(self) -> dict:
         return self._call("DeviceService", "GetDevices", {}).get("devices", {})

@@ -19,6 +19,8 @@ from typing import Callable, Optional
 
 import grpc
 
+from ..utils import tracing
+
 log = logging.getLogger(__name__)
 
 #: IANA dynamic/ephemeral range the TCP bind retries over when the
@@ -99,9 +101,21 @@ class VspServer:
             if fn is None:
                 continue
 
-            def wrap(fn=fn):
+            def wrap(fn=fn, svc=svc, rpc=rpc):
                 def handler(request, context):
-                    return fn(request) or {}
+                    # restore the caller's trace context from gRPC
+                    # metadata and record the server-side span, so the
+                    # VSP's work appears in the same trace tree as the
+                    # CNI request that triggered it
+                    tp = None
+                    for key, value in (context.invocation_metadata()
+                                       or ()):
+                        if key == tracing.TRACEPARENT_HEADER:
+                            tp = value
+                    ctx = tracing.extract_traceparent(tp)
+                    with tracing.context_scope(ctx), \
+                            tracing.span(f"vsp.{svc}.{rpc}"):
+                        return fn(request) or {}
                 return handler
             methods[f"/tpuvsp.{svc}/{rpc}"] = wrap()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
@@ -205,7 +219,12 @@ class VspChannel:
                     request_serializer=_ser,
                     response_deserializer=_de)
                 self._calls[key] = fn
-        return fn(request, timeout=timeout)
+        # injected at the seam (not per call site) so every client —
+        # GrpcPlugin._call, cross-boundary slice RPCs, tpuctl — carries
+        # the current trace context without knowing about tracing
+        tp = tracing.inject_traceparent()
+        metadata = ((tracing.TRACEPARENT_HEADER, tp),) if tp else None
+        return fn(request, timeout=timeout, metadata=metadata)
 
 
 def unix_target(socket_path: str) -> str:

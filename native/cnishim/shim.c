@@ -16,6 +16,7 @@
 
 #define _DEFAULT_SOURCE  /* usleep under -std=c99 */
 #include <errno.h>
+#include <fcntl.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -204,6 +205,31 @@ static char *json_unescape(const char *span, size_t len) {
     return out;
 }
 
+/* -- trace context --------------------------------------------------------
+ * Strict W3C traceparent validation (cni/shim.py _trace_context parity):
+ * exact field widths, lowercase hex only, version != ff, nonzero ids. */
+static int lhex_field(const char *s, size_t n, int *nonzero) {
+    for (size_t i = 0; i < n; i++) {
+        char c = s[i];
+        if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f')))
+            return 0;
+        if (c != '0') *nonzero = 1;
+    }
+    return 1;
+}
+
+static int tp_valid(const char *tp) {
+    int vz = 0, tz = 0, sz = 0, fz = 0;
+    if (strlen(tp) != 55 || tp[2] != '-' || tp[35] != '-' || tp[52] != '-')
+        return 0;
+    if (!lhex_field(tp, 2, &vz) || !lhex_field(tp + 3, 32, &tz)
+            || !lhex_field(tp + 36, 16, &sz) || !lhex_field(tp + 53, 2, &fz))
+        return 0;
+    if (tp[0] == 'f' && tp[1] == 'f')
+        return 0;
+    return tz && sz; /* all-zero trace or span id is invalid */
+}
+
 int main(void) {
     const char *cmd = getenv("CNI_COMMAND");
     if (cmd && strcmp(cmd, "CHECK") == 0) {
@@ -276,12 +302,41 @@ int main(void) {
     struct timeval tv = {120, 0};
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
     setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
-    char hdr[256];
+    /* Trace context (W3C traceparent): the shim is hop zero of the
+     * pod-ready request, so it mints the 128-bit trace id the daemon's
+     * CNI server adopts and propagates to the VSP and apiserver
+     * (doc/observability.md) — unless the invoker exported TRACEPARENT
+     * (the W3C CLI convention; same strict lowercase-hex validation as
+     * cni/shim.py), in which case that trace is joined with a fresh
+     * span id. Best-effort: no /dev/urandom, no header — the server
+     * then roots the trace itself. */
+    char traceparent[80] = "";
+    {
+        unsigned char rnd[24];
+        int ufd = open("/dev/urandom", O_RDONLY);
+        if (ufd >= 0) {
+            ssize_t got = read(ufd, rnd, sizeof rnd);
+            close(ufd);
+            if (got == (ssize_t)sizeof rnd) {
+                char hex[49];
+                for (size_t i = 0; i < sizeof rnd; i++)
+                    snprintf(hex + 2 * i, 3, "%02x", rnd[i]);
+                const char *tid = hex;
+                const char *env_tp = getenv("TRACEPARENT");
+                if (env_tp && tp_valid(env_tp))
+                    tid = env_tp + 3; /* %.32s stops at the dash */
+                snprintf(traceparent, sizeof traceparent,
+                         "Traceparent: 00-%.32s-%.16s-01\r\n",
+                         tid, hex + 32);
+            }
+        }
+    }
+    char hdr[384];
     snprintf(hdr, sizeof hdr,
              "POST /cni HTTP/1.1\r\nHost: unix\r\n"
-             "Content-Type: application/json\r\n"
+             "Content-Type: application/json\r\n%s"
              "Content-Length: %zu\r\nConnection: close\r\n\r\n",
-             body.len);
+             traceparent, body.len);
     struct buf req = {0};
     buf_str(&req, hdr);
     buf_put(&req, body.p, body.len);

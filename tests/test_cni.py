@@ -38,6 +38,25 @@ def _conf(mode="chip", device="chip-1"):
             "resourceName": "google.com/tpu"}
 
 
+def test_shim_trace_context_rejects_sloppy_hex(monkeypatch):
+    """int(x,16) would accept '+'/'_'-padded fields; a non-strict adopt
+    would orphan the shim span from the server's strictly-parsed
+    trace. Only exact lowercase-hex TRACEPARENT values are joined."""
+    from dpu_operator_tpu.cni.shim import _trace_context
+    good = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+    monkeypatch.setenv("TRACEPARENT", good)
+    trace_id, _, parent_id = _trace_context()
+    assert (trace_id, parent_id) == ("a" * 32, "b" * 16)
+    for bad in ("00-+" + "a" * 31 + "-" + "b" * 16 + "-01",
+                "00-" + "a" * 31 + "_-" + "b" * 16 + "-01",
+                "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                "00-" + "0" * 32 + "-" + "b" * 16 + "-01"):
+        monkeypatch.setenv("TRACEPARENT", bad)
+        trace_id, _, parent_id = _trace_context()
+        assert parent_id is None and trace_id != bad.split("-")[1]
+
+
 def test_pod_request_parsing():
     req = CniRequest(env=_env(), config=_conf())
     pr = PodRequest.from_cni_request(req)

@@ -192,6 +192,26 @@ def test_cache_never_holds_plaintext_tokens():
     assert all(secret not in k for k in auth._cache)
 
 
+def test_cache_evicts_oldest_past_1024_tokens():
+    """Token churn (rotating SA tokens) must bound the verdict cache:
+    the 1025th distinct token pops the OLDEST entry, which then pays a
+    fresh review on its next scrape while a younger entry is still
+    served from cache."""
+    client = _FlakyClient()
+    client.fail_next = False
+    auth = TokenReviewAuth(client, ttl=3600.0)
+    for i in range(1025):
+        assert auth(f"token-{i}") is True
+    assert len(auth._cache) == 1024
+    assert auth._key("token-0") not in auth._cache   # oldest evicted
+    assert auth._key("token-1") in auth._cache       # survivor intact
+    calls = client.calls
+    assert auth("token-1") is True                   # cache hit
+    assert client.calls == calls
+    assert auth("token-0") is True                   # re-reviewed
+    assert client.calls == calls + 2                 # TR + SAR again
+
+
 # -- least-privilege RBAC (the split files are load-bearing) -----------------
 
 @pytest.fixture

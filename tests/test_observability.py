@@ -1,9 +1,12 @@
-"""Metrics, health endpoints, and drain facade tests (SURVEY.md §5)."""
+"""Metrics, health endpoints, flight recorder, and drain facade tests
+(SURVEY.md §5)."""
 
+import json
 import urllib.request
 
 import pytest
 
+from dpu_operator_tpu.utils import flight, tracing
 from dpu_operator_tpu.utils.drain import Drainer
 from dpu_operator_tpu.utils.metrics import (Counter, Gauge, Histogram,
                                             MetricsServer, Registry)
@@ -40,6 +43,131 @@ def test_histogram_buckets():
     assert 'lat_bucket{le="1"} 2' in text
     assert 'lat_bucket{le="+Inf"} 3' in text
     assert "lat_count 3" in text
+
+
+def test_label_values_escaped_per_exposition_format():
+    """A `"`, `\\` or newline in a label value must not terminate the
+    quoted value early and corrupt the whole scrape."""
+    reg = Registry()
+    c = reg.counter("esc_total", "h")
+    c.inc(site='say "hi"\\path\nnewline')
+    text = reg.render()
+    assert r'esc_total{site="say \"hi\"\\path\nnewline"} 1' in text
+    assert "\nnewline" not in text  # no raw newline inside a sample line
+
+
+def test_histogram_sum_consistent_under_lock():
+    h = Histogram("lat", "h", buckets=(1.0,))
+    h.observe(0.25)
+    h.observe(0.5)
+    assert h.sum == 0.75
+    assert h.count == 2
+
+
+def test_exemplars_render_only_on_openmetrics():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar={"trace_id": "a" * 32})
+    h.observe(0.5)  # no exemplar on this bucket
+    classic = reg.render()
+    assert "trace_id" not in classic  # 0.0.4 parsers reject exemplars
+    om = reg.render(openmetrics=True)
+    assert f'lat_seconds_bucket{{le="0.1"}} 1 # {{trace_id="{"a" * 32}"}} '\
+        "0.05" in om
+    assert om.rstrip().endswith("# EOF")
+
+
+def test_openmetrics_counter_family_drops_total_suffix():
+    """OM names counter FAMILIES without _total (samples keep it);
+    `# TYPE x_total counter` makes real OM parsers reject the scrape."""
+    reg = Registry()
+    reg.counter("tpu_thing_total", "h").inc(site="a")
+    om = reg.render(openmetrics=True)
+    assert "# TYPE tpu_thing counter" in om
+    assert "# TYPE tpu_thing_total" not in om
+    assert 'tpu_thing_total{site="a"} 1' in om  # sample keeps the suffix
+    classic = reg.render()
+    assert "# TYPE tpu_thing_total counter" in classic  # 0.0.4 unchanged
+
+
+def test_histogram_vec_exemplar_and_timer_exemplar():
+    from dpu_operator_tpu.utils.metrics import HistogramVec
+    vec = HistogramVec("verb_seconds", "h", label="verb", buckets=(1.0,))
+    vec.observe("get", 0.1, exemplar={"trace_id": "t1"})
+    om = "\n".join(vec._render(openmetrics=True))
+    assert 'trace_id="t1"' in om
+    h = Histogram("timed_seconds", "h", buckets=(10.0,))
+    with h.time(exemplar=lambda: {"trace_id": "t2"}):
+        pass
+    assert 'trace_id="t2"' in "\n".join(h._render(openmetrics=True))
+
+
+def test_flight_recorder_ring_bounds_and_filtering():
+    rec = flight.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("span", f"s{i}", trace_id=f"t{i % 2}")
+    snap = rec.snapshot()
+    assert snap["recorded"] == 10
+    assert [e["name"] for e in snap["events"]] == ["s6", "s7", "s8", "s9"]
+    assert [e["name"] for e in rec.events(trace_id="t1")] == ["s7", "s9"]
+    rec.clear()
+    assert rec.snapshot()["events"] == []
+
+
+def test_flight_endpoint_serves_ring_and_joins_traces():
+    flight.RECORDER.clear()
+    tracing.reset_for_tests()
+    with tracing.span("incident.request") as ctx:
+        flight.record("swallowed_error", "x_total",
+                      attributes={"site": "test"})
+    server = MetricsServer(host="127.0.0.1")
+    server.start()
+    try:
+        snap = flight.fetch(f"127.0.0.1:{server.port}")
+    finally:
+        server.stop()
+    kinds = {e["kind"] for e in snap["events"]}
+    assert {"span", "swallowed_error"} <= kinds
+    # the swallowed error carries the trace it happened under, and the
+    # span ring has the request itself — the join a post-incident
+    # snapshot needs
+    swallowed = [e for e in snap["events"]
+                 if e["kind"] == "swallowed_error"][-1]
+    assert swallowed["trace_id"] == ctx.trace_id
+    assert any(e["kind"] == "span" and e["name"] == "incident.request"
+               and e["trace_id"] == ctx.trace_id for e in snap["events"])
+
+
+def test_flight_endpoint_shares_metrics_auth():
+    server = MetricsServer(host="127.0.0.1", auth=lambda token: False)
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/flight", timeout=5)
+        assert exc.value.code == 401
+    finally:
+        server.stop()
+
+
+def test_openmetrics_content_negotiation():
+    reg = Registry()
+    reg.histogram("neg_seconds", "h", buckets=(1.0,)).observe(
+        0.1, exemplar={"trace_id": "neg"})
+    server = MetricsServer(host="127.0.0.1", registry=reg)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}/metrics"
+        plain = urllib.request.urlopen(base, timeout=5)
+        assert "0.0.4" in plain.headers["Content-Type"]
+        assert b"trace_id" not in plain.read()
+        req = urllib.request.Request(base, headers={
+            "Accept": "application/openmetrics-text"})
+        om = urllib.request.urlopen(req, timeout=5)
+        assert "openmetrics-text" in om.headers["Content-Type"]
+        assert b'trace_id="neg"' in om.read()
+    finally:
+        server.stop()
 
 
 def test_metrics_server_endpoints():

@@ -16,6 +16,7 @@ from dpu_operator_tpu.analysis import (ALL_CHECKERS,
                                        LockDisciplineChecker,
                                        MetricsNamingChecker,
                                        RetryDisciplineChecker,
+                                       TraceContextChecker,
                                        WireSeamChecker)
 from dpu_operator_tpu.analysis.__main__ import main as opslint_main
 from dpu_operator_tpu.analysis.core import Baseline, Module
@@ -56,6 +57,55 @@ def test_wire_seam_ignores_tests_and_unrelated_imports():
     assert check(WireSeamChecker(), "import socket\n",
                  relpath="tests/test_x.py") == []
     assert check(WireSeamChecker(), "import json, os\n") == []
+
+
+# -- trace-context ------------------------------------------------------------
+
+def test_trace_context_flags_seam_without_injection():
+    violations = check(TraceContextChecker(), """
+        def request(method, path):
+            return send(method, path)
+    """, relpath="dpu_operator_tpu/k8s/pool.py")
+    assert [v.rule for v in violations] == ["trace-context"]
+    assert "inject_traceparent" in violations[0].message
+
+
+def test_trace_context_passes_on_inject_call_or_header_literal():
+    with_call = """
+        from ..utils import tracing
+        def request(method, path):
+            tp = tracing.inject_traceparent()
+            return send(method, path, tp)
+    """
+    assert check(TraceContextChecker(), with_call,
+                 relpath="dpu_operator_tpu/k8s/pool.py") == []
+    # the stdlib-only shim inlines the header instead of calling tracing
+    with_literal = """
+        def post(payload):
+            headers = "Traceparent: " + make_tp()
+            return wire(headers, payload)
+    """
+    assert check(TraceContextChecker(), with_literal,
+                 relpath="dpu_operator_tpu/cni/shim.py") == []
+    # ... but ONLY the shim: elsewhere a leftover header-name string
+    # must not mask a deleted inject call
+    assert len(check(TraceContextChecker(), with_literal,
+                     relpath="dpu_operator_tpu/k8s/pool.py")) == 1
+    # and even in the shim, a docstring or env-key mention is NOT a
+    # header build: deleting the injection must fire the rule
+    shim_without_header = '''
+        """Forwards requests. Used to send a Traceparent: header."""
+        import os
+        def post(payload):
+            tp = os.environ.get("TRACEPARENT", "")
+            return wire(payload)
+    '''
+    assert len(check(TraceContextChecker(), shim_without_header,
+                     relpath="dpu_operator_tpu/cni/shim.py")) == 1
+
+
+def test_trace_context_ignores_non_seam_modules():
+    assert check(TraceContextChecker(), "def f():\n    return 1\n") == []
 
 
 # -- retry-discipline ---------------------------------------------------------

@@ -76,6 +76,43 @@ def test_add_roundtrip_with_empty_path(shim_binary, cni_server):
     assert requests[-1].pod_name == "p"
 
 
+def test_shim_sends_traceparent_and_joins_exported_trace(
+        shim_binary, cni_server, tmp_path, monkeypatch):
+    """The static shim is hop zero of the trace: it mints (or, with
+    TRACEPARENT exported, joins) the 128-bit trace id the CNI server
+    adopts — asserted through the server's recorded span."""
+    from dpu_operator_tpu.utils import tracing
+    sock, _ = cni_server
+    trace_file = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv("TPU_OPERATOR_TRACE", trace_file)
+    tracing.reset_for_tests()
+    conf = json.dumps({"cniVersion": "0.4.0", "type": "tpu-cni",
+                       "mode": "chip", "deviceID": "chip-2"})
+    try:
+        # minted: the server adopts SOME remote context (non-null parent)
+        proc = _run_shim(shim_binary, sock, _cni_env(), conf)
+        assert proc.returncode == 0, proc.stderr
+        # joined: an exported (strictly valid) TRACEPARENT wins
+        exported = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        proc = _run_shim(shim_binary, sock,
+                         dict(_cni_env(), TRACEPARENT=exported), conf)
+        assert proc.returncode == 0, proc.stderr
+        # sloppy values are NOT joined (strict lowercase-hex parsing)
+        proc = _run_shim(shim_binary, sock,
+                         dict(_cni_env(), TRACEPARENT="00-+junk-x-01"),
+                         conf)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        tracing.reset_for_tests()
+    adds = [json.loads(l) for l in open(trace_file)
+            if json.loads(l)["name"] == "cni.add"]
+    assert len(adds) == 3
+    minted, joined, sloppy = adds
+    assert minted["parent_id"] and len(minted["trace_id"]) == 32
+    assert joined["trace_id"] == "ab" * 16
+    assert sloppy["trace_id"] not in ("ab" * 16, minted["trace_id"])
+
+
 def test_del_and_check(shim_binary, cni_server):
     sock, requests = cni_server
     conf = json.dumps({"cniVersion": "0.4.0", "type": "tpu-cni",

@@ -1,0 +1,203 @@
+"""Trace-propagation + flight-recorder e2e (`make obs-check`).
+
+One CNI ADD crosses all four process boundaries of a pod-ready request —
+CNI shim → daemon CNI server → VSP gRPC → pooled apiserver client — with
+`TPU_OPERATOR_TRACE` pointed at a file, and the assertions close the
+loop the observability layer promises:
+
+- ONE trace_id stamped by the shim appears in the shim's own span, the
+  CNI server span, the VSP *server* span, and the pooled-client span
+  (propagated via HTTP Traceparent, thread-pool capture, and gRPC
+  metadata respectively);
+- after a seeded VSP breaker-open storm (chaos harness, deterministic
+  from SEED), the flight recorder still replays the original request's
+  spans alongside the breaker transitions — the post-incident snapshot
+  works even though the storm came later;
+- /metrics renders a valid OpenMetrics exemplar on the CNI latency
+  histogram referencing that trace_id.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from dpu_operator_tpu.cni import CniServer, CniShim
+from dpu_operator_tpu.k8s.real import RealKube
+from dpu_operator_tpu.platform import TpuDetector
+from dpu_operator_tpu.testing.chaos import ChaosChannel, Fail, FaultPlan
+from dpu_operator_tpu.utils import flight, metrics, resilience, tracing
+from dpu_operator_tpu.utils.path_manager import PathManager
+from dpu_operator_tpu.vsp import GrpcPlugin, MockTpuVsp, VspServer
+
+from apiserver_fixture import MiniApiServer
+
+pytestmark = pytest.mark.obs
+
+SEED = 1107
+
+
+def _env(container="tracee2e01", ifname="net1"):
+    return {
+        "CNI_COMMAND": "ADD",
+        "CNI_CONTAINERID": container,
+        "CNI_NETNS": "/var/run/netns/x",
+        "CNI_IFNAME": ifname,
+        "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=tracepod",
+    }
+
+
+def _conf():
+    return {"cniVersion": "0.4.0", "name": "tpunfcni-conf",
+            "type": "tpu-cni", "mode": "chip", "deviceID": "chip-1",
+            "resourceName": "google.com/tpu"}
+
+
+@pytest.fixture
+def stack(short_tmp, tmp_path, monkeypatch):
+    """apiserver + pooled RealKube + VSP server/plugin + CNI server whose
+    ADD handler touches the VSP and the apiserver — the daemon's real
+    pod-ready shape, minus hardware."""
+    trace_file = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv("TPU_OPERATOR_TRACE", trace_file)
+    tracing.reset_for_tests()
+    flight.RECORDER.clear()
+
+    apiserver = MiniApiServer()
+    apiserver.start()
+    kube = RealKube(kubeconfig=apiserver.write_kubeconfig(
+        str(tmp_path / "kubeconfig")))
+    assert kube.pool is not None  # the pooled fast lane must be active
+
+    pm = PathManager(short_tmp)
+    vsp_sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(vsp_sock)
+    vsp_server = VspServer(MockTpuVsp(), vsp_sock)
+    vsp_server.start()
+    det = TpuDetector().detection_result(tpu_mode=True,
+                                         identifier="test-tpu")
+    plugin = GrpcPlugin(
+        det, path_manager=pm, init_timeout=5.0,
+        breaker=resilience.CircuitBreaker("vsp", failure_threshold=3,
+                                          reset_timeout=3600.0))
+    plugin.start(tpu_mode=True)
+
+    def add(pod_req):
+        plugin.create_slice_attachment(
+            {"name": f"att-{pod_req.sandbox_id[:8]}", "chip_index": 1})
+        kube.get("v1", "Pod", pod_req.pod_name, namespace="default")
+        return {"cniVersion": pod_req.netconf.cni_version, "ok": True}
+
+    cni_sock = os.path.join(short_tmp, "cni-e2e.sock")
+    cni_server = CniServer(cni_sock, add_handler=add)
+    cni_server.start()
+    try:
+        yield {"trace_file": trace_file, "cni_sock": cni_sock,
+               "plugin": plugin, "kube": kube}
+    finally:
+        cni_server.stop()
+        plugin.close()
+        vsp_server.stop()
+        kube.close()
+        apiserver.stop()
+        tracing.reset_for_tests()
+
+
+def _records(trace_file):
+    with open(trace_file) as fh:
+        return [json.loads(line) for line in fh]
+
+
+def _shim_trace_id(trace_file):
+    return next(r["trace_id"] for r in _records(trace_file)
+                if r["name"] == "cni.shim")
+
+
+def test_one_trace_id_crosses_all_four_seams(stack):
+    resp = CniShim(stack["cni_sock"]).invoke(_env(), json.dumps(_conf()))
+    assert not resp.error
+
+    records = _records(stack["trace_file"])
+    shim_spans = [r for r in records if r["name"] == "cni.shim"]
+    assert len(shim_spans) == 1
+    tid = shim_spans[0]["trace_id"]
+    names = {r["name"] for r in records if r["trace_id"] == tid}
+    # seam 1→2: the shim's Traceparent header, adopted by the CNI server
+    assert "cni.add" in names
+    # seam 3: gRPC metadata → VSP server-side span (plus the client span)
+    assert "vsp.SliceService.CreateSliceAttachment" in names
+    assert "vsp.call" in names
+    # seam 4: the pooled apiserver client
+    assert "kube.request" in names
+    # parent/child links are intact: cni.add's parent is the shim span
+    by_name = {r["name"]: r for r in records if r["trace_id"] == tid}
+    assert by_name["cni.add"]["parent_id"] == shim_spans[0]["span_id"]
+    # and the handler-side spans hang below cni.add (thread-pool capture)
+    assert by_name["vsp.call"]["parent_id"] == by_name["cni.add"]["span_id"]
+
+
+def test_flight_recorder_replays_request_after_breaker_storm(stack):
+    resp = CniShim(stack["cni_sock"]).invoke(_env(), json.dumps(_conf()))
+    assert not resp.error
+    tid = _shim_trace_id(stack["trace_file"])
+
+    # seeded VSP fault storm: every call fails until the breaker opens
+    # and short-circuits the rest (deterministic from SEED)
+    plugin = stack["plugin"]
+    plan = FaultPlan(SEED).script("*", Fail(times=32))
+    real_channel = plugin._channel
+    plugin._new_channel = lambda: ChaosChannel(real_channel.call,
+                                               plan=plan)
+    plugin._reconnect()
+    for _ in range(8):
+        with pytest.raises(Exception):
+            plugin.get_devices()
+    assert plugin.breaker.is_open
+
+    server = metrics.MetricsServer(host="127.0.0.1")
+    server.start()
+    try:
+        snap = flight.fetch(f"127.0.0.1:{server.port}")
+    finally:
+        server.stop()
+    events = snap["events"]
+    # the storm is on the record ...
+    assert any(e["kind"] == "breaker"
+               and e["attributes"]["to"] == "open" for e in events)
+    # ... and the ORIGINAL request still replays from the ring: its CNI,
+    # VSP and apiserver spans all carry the shim-minted trace_id, even
+    # though no collector was attached when it ran
+    replayed = {e["name"] for e in events
+                if e["kind"] == "span" and e.get("trace_id") == tid}
+    assert {"cni.add", "vsp.call", "kube.request"} <= replayed
+
+
+def test_metrics_render_exemplar_for_the_traced_request(stack):
+    resp = CniShim(stack["cni_sock"]).invoke(_env(), json.dumps(_conf()))
+    assert not resp.error
+    tid = _shim_trace_id(stack["trace_file"])
+
+    server = metrics.MetricsServer(host="127.0.0.1")
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        body = urllib.request.urlopen(req, timeout=5).read().decode()
+    finally:
+        server.stop()
+    exemplar_lines = [
+        line for line in body.splitlines()
+        if line.startswith("tpu_daemon_cni_seconds_bucket")
+        and f'# {{trace_id="{tid}"}}' in line]
+    assert exemplar_lines, (
+        "no CNI latency bucket carries this request's exemplar")
+    # grammar check: `<sample> # {<labels>} <value>` with a parseable value
+    sample, _, exemplar = exemplar_lines[0].partition(" # ")
+    assert sample.split()[-1].isdigit()
+    assert float(exemplar.rpartition("} ")[-1]) >= 0
+    # the kube client histogram carries one too
+    assert any(
+        line.startswith("tpu_kube_client_request_seconds_bucket")
+        and f'trace_id="{tid}"' in line for line in body.splitlines())

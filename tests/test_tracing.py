@@ -1,13 +1,14 @@
-"""Tracing span + per-invocation CNI logging tests (SURVEY.md §5 gaps the
-TPU build fills)."""
+"""Tracing span + trace-context propagation + per-invocation CNI logging
+tests (SURVEY.md §5 gaps the TPU build fills)."""
 
 import json
 import logging
 import os
+import threading
 
 import pytest
 
-from dpu_operator_tpu.utils import tracing
+from dpu_operator_tpu.utils import flight, tracing
 
 
 @pytest.fixture(autouse=True)
@@ -18,9 +19,16 @@ def _reset():
     os.environ.pop("TPU_OPERATOR_TRACE", None)
 
 
-def test_span_noop_when_disabled():
-    with tracing.span("x") as sid:
-        assert sid is None
+def test_span_without_sink_still_yields_context(tmp_path):
+    """No TPU_OPERATOR_TRACE: no sink file, but the context is live (it
+    must propagate across seams and feed the flight recorder even with
+    no trace sink configured)."""
+    flight.RECORDER.clear()
+    with tracing.span("x") as ctx:
+        assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+        assert tracing.current() == ctx
+    assert tracing.current() is None
+    assert [e["name"] for e in flight.RECORDER.events(kind="span")] == ["x"]
 
 
 def test_span_records_nesting_and_errors(tmp_path):
@@ -35,10 +43,99 @@ def test_span_records_nesting_and_errors(tmp_path):
     records = [json.loads(l) for l in open(trace_file)]
     by_name = {r["name"]: r for r in records}
     assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["inner"]["trace_id"] == by_name["outer"]["trace_id"]
     assert by_name["outer"]["parent_id"] is None
+    assert by_name["failing"]["trace_id"] != by_name["outer"]["trace_id"]
     assert by_name["outer"]["attributes"] == {"kind": "test"}
     assert "ValueError: boom" in by_name["failing"]["error"]
     assert all(r["duration_s"] >= 0 for r in records)
+
+
+def test_traceparent_inject_extract_round_trip():
+    assert tracing.inject_traceparent() is None  # nothing to propagate
+    with tracing.span("client") as ctx:
+        header = tracing.inject_traceparent()
+        assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+        restored = tracing.extract_traceparent(header)
+        assert restored == ctx
+    # server-side adoption: a child span under the restored context
+    # stays on the client's trace
+    with tracing.context_scope(restored):
+        with tracing.span("server") as server_ctx:
+            assert server_ctx.trace_id == ctx.trace_id
+            assert server_ctx.span_id != ctx.span_id
+
+
+@pytest.mark.parametrize("hostile", [
+    None,                                             # missing header
+    12345,                                            # non-string
+    "",                                               # empty
+    "garbage",                                        # not 4 fields
+    "00-" + "a" * 32 + "-" + "b" * 16,                # missing flags
+    "00-" + "A" * 32 + "-" + "b" * 16 + "-01",        # uppercase hex
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",        # non-hex
+    "00-" + "a" * 31 + "-" + "b" * 16 + "-01",        # short trace id
+    "00-" + "a" * 32 + "-" + "b" * 15 + "-01",        # short span id
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",        # forbidden version
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",        # all-zero trace
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",        # all-zero span
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01\r\nX: y",  # header splitting
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01" + "x" * 40,  # overlong
+])
+def test_extract_traceparent_rejects_hostile_values(hostile):
+    assert tracing.extract_traceparent(hostile) is None
+
+
+def test_wrap_context_carries_trace_across_thread_pool():
+    from concurrent.futures import ThreadPoolExecutor
+
+    seen = {}
+
+    def work(key):
+        with tracing.span("pooled") as ctx:
+            seen[key] = ctx.trace_id
+
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        with tracing.span("request") as ctx:
+            pool.submit(tracing.wrap_context(work), "wrapped").result(5)
+            # unwrapped: the pool thread has no ambient context, so the
+            # span roots a fresh trace instead of joining the request's
+            pool.submit(work, "bare").result(5)
+    assert seen["wrapped"] == ctx.trace_id
+    assert seen["bare"] != ctx.trace_id
+
+
+def test_setup_race_opens_sink_exactly_once(tmp_path, monkeypatch):
+    """Two threads racing the first span must not double-open the sink
+    (the loser's handle used to leak, splitting buffered records)."""
+    import builtins
+
+    trace_file = str(tmp_path / "race.jsonl")
+    os.environ["TPU_OPERATOR_TRACE"] = trace_file
+    opens = []
+    real_open = builtins.open
+
+    def counting_open(path, *a, **kw):
+        if path == trace_file:
+            opens.append(path)
+        return real_open(path, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", counting_open)
+    barrier = threading.Barrier(8)
+
+    def first_span():
+        barrier.wait(5)
+        with tracing.span("racer"):
+            pass
+
+    threads = [threading.Thread(target=first_span) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert opens == [trace_file]
+    records = [json.loads(l) for l in real_open(trace_file)]
+    assert len(records) == 8
 
 
 def test_reconcile_emits_span(kube, tmp_path):
