@@ -3,9 +3,9 @@ PYTHON ?= python
 REGISTRY ?= localhost:5000
 TAG ?= latest
 
-.PHONY: test fast-test collect-check chaos-check obs-check lint-check \
-        type-check bench native traffic-flow images smoke-images deploy \
-        undeploy graft-check clean
+.PHONY: test fast-test collect-check chaos-check obs-check health-check \
+        lint-check type-check bench native traffic-flow images \
+        smoke-images deploy undeploy graft-check clean
 
 test: lint-check native
 	$(PYTHON) -m pytest tests/ -q
@@ -38,6 +38,16 @@ chaos-check:
 # the CNI latency histogram referencing that trace
 obs-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m obs \
+	  -p no:randomly -p no:cacheprovider
+
+# health-engine e2e (doc/observability.md "Health engine"): seeded and
+# clock-injected — a deliberately stalled reconciler is detected by the
+# watchdog within its deadline (stack dump in the flight ring, Event +
+# Degraded CR condition on the fake apiserver), and a seeded error
+# storm fires then clears the kube-client burn-rate alert. No
+# wall-clock sleeps: every assertion advances an injectable clock.
+health-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m health \
 	  -p no:randomly -p no:cacheprovider
 
 # opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST

@@ -49,15 +49,32 @@ def main(argv=None):
     signal.signal(signal.SIGTERM, lambda *_: done.set())
     signal.signal(signal.SIGINT, lambda *_: done.set())
 
+    # health engine: the watchdog must tick in THIS process too — the
+    # manager worker registers its heartbeat here, and without a
+    # checker a wedged TpuOperatorConfig reconcile would freeze the
+    # workqueue while the CR keeps reading Healthy
+    from .api.types import API_VERSION
+    from .k8s import events
+    from .utils import CONFIG_NAME, NAMESPACE, slo, watchdog
+    watchdog.WATCHDOG.start()
+    slo.EVALUATOR.start()
+    events.configure(
+        events.EventRecorder(client, component="tpu-operator",
+                             namespace=NAMESPACE),
+        {"apiVersion": API_VERSION, "kind": "TpuOperatorConfig",
+         "name": CONFIG_NAME})
+
     started = threading.Event()
     # /metrics is authenticated+authorized via TokenReview/
     # SubjectAccessReview (reference: cmd/main.go:66-70 filters metrics
     # with WithAuthenticationAndAuthorization; RBAC:
     # config/rbac/metrics_auth_role.yaml + metrics_reader_role.yaml)
     from .utils.metrics import TokenReviewAuth
-    metrics_server = MetricsServer(port=args.metrics_port,
-                                   ready_check=started.is_set,
-                                   auth=TokenReviewAuth(client))
+    metrics_server = MetricsServer(
+        port=args.metrics_port, ready_check=started.is_set,
+        auth=TokenReviewAuth(client),
+        degraded_check=watchdog.WATCHDOG.degraded_components,
+        health_check=slo.health_snapshot)
     metrics_server.start()
 
     from .webhook import WebhookServer

@@ -6,15 +6,17 @@ the wire path, plus a static guarded-by lock checker. Run as
 workflow are documented in doc/static-analysis.md.
 """
 
-from .checkers import (ChaosDeterminismChecker, ExceptionHygieneChecker,
-                       MetricsNamingChecker, RetryDisciplineChecker,
-                       TraceContextChecker, WireSeamChecker)
+from .checkers import (ChaosDeterminismChecker, EventsSeamChecker,
+                       ExceptionHygieneChecker, MetricsNamingChecker,
+                       RetryDisciplineChecker, TraceContextChecker,
+                       WireSeamChecker)
 from .core import Baseline, Checker, Module, Violation, run_checkers
 from .lockcheck import LockDisciplineChecker
 
 ALL_CHECKERS = (
     WireSeamChecker,
     TraceContextChecker,
+    EventsSeamChecker,
     RetryDisciplineChecker,
     ExceptionHygieneChecker,
     MetricsNamingChecker,
@@ -25,7 +27,7 @@ ALL_CHECKERS = (
 __all__ = [
     "ALL_CHECKERS", "Baseline", "Checker", "Module", "Violation",
     "run_checkers", "WireSeamChecker", "TraceContextChecker",
-    "RetryDisciplineChecker", "ExceptionHygieneChecker",
-    "MetricsNamingChecker", "ChaosDeterminismChecker",
-    "LockDisciplineChecker",
+    "EventsSeamChecker", "RetryDisciplineChecker",
+    "ExceptionHygieneChecker", "MetricsNamingChecker",
+    "ChaosDeterminismChecker", "LockDisciplineChecker",
 ]

@@ -149,6 +149,42 @@ class TraceContextChecker(Checker):
             "severs at this hop")
 
 
+# -- events-seam --------------------------------------------------------------
+
+#: the one module allowed to construct Kubernetes Event objects: the
+#: deduplicating recorder. A raw `client.create({"kind": "Event", ...})`
+#: anywhere else bypasses the count-bumping aggregation and floods the
+#: namespace one object per occurrence.
+_EVENTS_SEAM_ALLOW = {"dpu_operator_tpu/k8s/events.py"}
+
+
+class EventsSeamChecker(Checker):
+    name = "events-seam"
+    description = ("Kubernetes Events may only be created through "
+                   "k8s/events.py (EventRecorder / events.emit) — no "
+                   "raw Event object construction elsewhere")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if module.is_test or module.relpath in _EVENTS_SEAM_ALLOW:
+            return
+        if not module.relpath.startswith("dpu_operator_tpu/"):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for key, value in zip(node.keys, node.values):
+                if (isinstance(key, ast.Constant) and key.value == "kind"
+                        and isinstance(value, ast.Constant)
+                        and value.value == "Event"):
+                    yield self.violation(
+                        module, node,
+                        'raw Event object (`"kind": "Event"`) built '
+                        "outside k8s/events.py: emit through "
+                        "EventRecorder/events.emit so Events "
+                        "deduplicate (count-bump) and carry one "
+                        "source seam")
+
+
 # -- retry-discipline ---------------------------------------------------------
 
 _RETRY_EXEMPT = {

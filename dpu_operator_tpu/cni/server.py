@@ -18,7 +18,7 @@ from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutTimeout
 from http.server import BaseHTTPRequestHandler
 from typing import Callable, Optional
 
-from ..utils import metrics, resilience, tracing
+from ..utils import metrics, resilience, tracing, watchdog
 from ..utils.tracing import span
 from .logging import request_logger
 from .types import (
@@ -68,6 +68,11 @@ class CniServer:
         self._server: Optional[_UnixHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._pool = ThreadPoolExecutor(max_workers=8)
+        #: watchdog heartbeat over the dispatch pool (registered in
+        #: start(): bare CniServer objects in unit tests carry none):
+        #: task-scoped — a dispatch outliving the request deadline
+        #: plus slack means the timeout machinery itself wedged
+        self._heartbeat = None
 
     def start(self):
         os.makedirs(os.path.dirname(self.socket_path), mode=0o700,
@@ -111,6 +116,10 @@ class CniServer:
 
         self._server = _UnixHTTPServer(self.socket_path, Handler)
         os.chmod(self.socket_path, 0o600)  # root-only (cniserver.go:52-67)
+        if self._heartbeat is None:
+            self._heartbeat = watchdog.register(
+                "cni.dispatch", deadline=self.timeout * 1.5,
+                periodic=False)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True, name="cni-server")
         self._thread.start()
@@ -121,6 +130,9 @@ class CniServer:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if self._heartbeat is not None:
+            self._heartbeat.close()
+            self._heartbeat = None
         self._pool.shutdown(wait=False)
 
     # -- request dispatch (cniserver.go:234-263) ------------------------------
@@ -161,7 +173,8 @@ class CniServer:
         # stays on the shim's trace. The exemplar links this request's
         # latency bucket back to the same trace.
         handler = tracing.wrap_context(handler)
-        with metrics.CNI_SECONDS.time(exemplar=tracing.exemplar):
+        with watchdog.task(self._heartbeat), \
+                metrics.CNI_SECONDS.time(exemplar=tracing.exemplar):
             while True:
                 remaining = deadline - time.monotonic()
                 fut = self._pool.submit(handler, pod_req)

@@ -31,10 +31,20 @@ class TpuOperatorConfigReconciler:
     watches = (API_VERSION, "TpuOperatorConfig")
 
     def __init__(self, image_manager, path_manager: PathManager | None = None,
-                 fs_detector: FilesystemModeDetector | None = None):
+                 fs_detector: FilesystemModeDetector | None = None,
+                 health_provider=None):
+        """*health_provider*: callable returning the health-engine
+        snapshot (utils/slo.py health_snapshot shape) folded into the
+        CR's Healthy/Degraded conditions each reconcile; defaults to
+        the in-process engine."""
         self.image_manager = image_manager
         self.path_manager = path_manager or PathManager()
         self.fs_detector = fs_detector or FilesystemModeDetector()
+        if health_provider is None:
+            from ..utils.slo import health_snapshot
+            health_provider = health_snapshot
+        self.health_provider = health_provider
+        self._recorder = None
 
     # -- template vars (reference: yamlVars :131-167) -------------------------
     def _yaml_vars(self, client, cfg: TpuOperatorConfig) -> dict:
@@ -112,6 +122,58 @@ class TpuOperatorConfigReconciler:
         status = dict(obj.get("status", {}))
         status["observedGeneration"] = obj["metadata"].get("generation", 0)
         status["flavour"] = data["Flavour"]
+        self._fold_health(client, obj, status)
         obj["status"] = status
         client.update_status(obj)
         return ReconcileResult()
+
+    # -- health conditions (utils/watchdog.py + utils/slo.py) -----------------
+    def _fold_health(self, client, obj: dict, status: dict):
+        """Fold the health-engine snapshot into Healthy/Degraded
+        conditions with per-component reasons, and emit an Event on
+        each transition — the CR is where cluster operators look first
+        (the flight recorder and /debug/health carry the detail)."""
+        try:
+            snap = self.health_provider() or {}
+        except Exception:  # noqa: BLE001 — a broken snapshot must not
+            log.exception("health snapshot failed")  # fail the ensures
+            return
+        degraded = {
+            name: info for name, info in
+            (snap.get("components") or {}).items()
+            if not info.get("healthy", True)}
+        healthy = not degraded
+        if healthy:
+            message = "all components healthy"
+        else:
+            message = "; ".join(
+                f"{name}: {', '.join(info.get('reasons') or ['degraded'])}"
+                for name, info in sorted(degraded.items()))
+        was_healthy = all(
+            c.get("status") == "True" or c.get("type") != "Healthy"
+            for c in (obj.get("status", {}).get("conditions") or []))
+        status["conditions"] = [
+            {"type": "Healthy",
+             "status": "True" if healthy else "False",
+             "reason": ("AllComponentsHealthy" if healthy
+                        else "ComponentsDegraded"),
+             "message": message},
+            {"type": "Degraded",
+             "status": "False" if healthy else "True",
+             "reason": ("AllComponentsHealthy" if healthy
+                        else "ComponentsDegraded"),
+             "message": message},
+        ]
+        if healthy != was_healthy:
+            from ..k8s.events import EventRecorder, object_reference
+            if self._recorder is None or self._recorder.client is not client:
+                # same namespace as the global seam in __main__.py: the
+                # CR is cluster-scoped (no involvedObject namespace to
+                # inherit), and operators look in the operator's own
+                self._recorder = EventRecorder(client,
+                                               component="tpu-operator",
+                                               namespace=v.NAMESPACE)
+            self._recorder.emit(
+                object_reference(obj),
+                "OperatorHealthy" if healthy else "OperatorDegraded",
+                message, type_="Normal" if healthy else "Warning")

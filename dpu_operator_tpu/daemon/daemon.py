@@ -75,11 +75,14 @@ class Daemon:
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
         self._serve_thread: Optional[threading.Thread] = None
-        #: /metrics + /healthz + /readyz for the daemon (reference: the
-        #: DPU-side daemon's :18001, dpusidemanager.go:271-275). Started
-        #: in serve() when TPU_DAEMON_HEALTH_PORT is set; /healthz
-        #: reports "degraded: <sites>" while a circuit breaker is open,
-        #: so operators see a walled-off VSP instead of discovering it.
+        #: /metrics + /healthz + /readyz + /debug/health for the daemon
+        #: (reference: the DPU-side daemon's :18001,
+        #: dpusidemanager.go:271-275). Started in serve() when
+        #: TPU_DAEMON_HEALTH_PORT is set; while a breaker is open or a
+        #: loop is watchdog-stalled, /healthz serves a structured JSON
+        #: breakdown ({"status": "degraded", "components": [...]}, still
+        #: 200), so operators see a walled-off VSP or a wedged loop
+        #: instead of discovering it.
         self.health_server = None
         # manager teardown must run exactly once, whichever of the
         # signal handler / serve-loop exit gets there first
@@ -151,9 +154,13 @@ class Daemon:
             self._stop.set()
 
     def degraded_sites(self) -> list:
-        """Open circuit breakers across the live side manager."""
+        """Components currently degraded: open circuit breakers across
+        the live side manager plus watchdog-stalled loops — the
+        /healthz structured breakdown."""
         provider = getattr(self.manager, "degraded_sites", None)
-        return list(provider()) if callable(provider) else []
+        sites = list(provider()) if callable(provider) else []
+        from ..utils import watchdog
+        return sites + watchdog.WATCHDOG.degraded_components()
 
     def ready(self) -> bool:
         return (self.manager is not None and self._error is None
@@ -163,11 +170,13 @@ class Daemon:
         port = os.environ.get("TPU_DAEMON_HEALTH_PORT", "")
         if not port or self.health_server is not None:
             return
+        from ..utils import slo
         from ..utils.metrics import MetricsServer
         try:
             self.health_server = MetricsServer(
                 port=int(port), ready_check=self.ready,
-                degraded_check=self.degraded_sites)
+                degraded_check=self.degraded_sites,
+                health_check=slo.health_snapshot)
             self.health_server.start()
             log.info("daemon health/metrics on :%d",
                      self.health_server.port)
@@ -175,10 +184,47 @@ class Daemon:
             self.health_server = None  # the daemon down
             log.exception("daemon health server failed to start")
 
+    def _start_health_engine(self):
+        """Watchdog checker + SLO evaluator threads (idempotent
+        globals) and the Kubernetes Event seam anchored to this node.
+        The health engine must come up even when the apiserver is down
+        — events stay a no-op until configured."""
+        from ..utils import slo, watchdog
+        watchdog.WATCHDOG.start()
+        slo.EVALUATOR.start()
+        if self.client is not None and self.node_name:
+            try:
+                from ..k8s import events
+                events.configure(
+                    events.EventRecorder(self.client,
+                                         component="tpu-daemon"),
+                    events.node_reference(self.node_name))
+            except Exception:  # noqa: BLE001 — observability must not
+                log.exception("event recorder setup failed")  # kill it
+
     def serve(self, block: bool = True):
         """1 Hz detect loop; returns when stopped or a manager errored."""
+        self._start_health_engine()
         self._start_health_server()
+        # watchdog heartbeat for this loop — only in blocking mode,
+        # where the loop actually keeps running (block=False returns
+        # after one pass; a registered heartbeat would read as a stall)
+        heartbeat = None
+        if block:
+            from ..utils import watchdog
+            heartbeat = watchdog.register(
+                "daemon.detect", deadline=max(30.0,
+                                              self.detect_interval * 10))
+        try:
+            self._serve_loop(block, heartbeat)
+        finally:
+            if heartbeat is not None:
+                heartbeat.close()
+
+    def _serve_loop(self, block: bool, heartbeat):
         while not self._stop.is_set():
+            if heartbeat is not None:
+                heartbeat.beat()
             if self.manager is None:
                 detection = self.detect_once()
                 if detection is not None:

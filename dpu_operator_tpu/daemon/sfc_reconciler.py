@@ -26,14 +26,11 @@ def _condition(ctype: str, ok: bool, reason: str, message: str) -> dict:
 
 
 def _already_exists(e: Exception) -> bool:
-    """409/AlreadyExists across both client flavors (RealKube raises
-    requests.HTTPError with a response; FakeKube raises AlreadyExists)."""
-    from ..k8s.client import AlreadyExists
+    """409/AlreadyExists across both client flavors — delegates to the
+    client-seam classifier (shared with the Event recorder)."""
+    from ..k8s.client import is_already_exists
 
-    if isinstance(e, AlreadyExists):
-        return True
-    status = getattr(getattr(e, "response", None), "status_code", None)
-    return status == 409
+    return is_already_exists(e)
 
 
 class SfcReconciler:

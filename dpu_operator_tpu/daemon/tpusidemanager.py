@@ -23,6 +23,7 @@ from ..cni.ipam import ipam_add, ipam_del
 from ..utils import metrics, tracing
 from ..cni.types import PodRequest
 from ..deviceplugin import DevicePlugin
+from ..k8s import events
 from ..k8s.manager import Manager
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
@@ -269,15 +270,22 @@ class TpuSideManager:
             self._repair_thread.start()
 
     def _repair_loop(self, interval: float):
-        while not self._repair_stop.wait(interval):
-            try:
-                # each pass is its own root trace: repairs triggered by
-                # the loop (vs. AdminService) are distinguishable in the
-                # flight recorder by this span
-                with tracing.span("tpuside.repair_pass"):
-                    self.repair_chains()
-            except Exception:  # noqa: BLE001 — keep the loop alive
-                log.exception("chain repair pass failed")
+        from ..utils import watchdog
+        heartbeat = watchdog.register(
+            "tpuside.chain-repair", deadline=max(30.0, interval * 6))
+        try:
+            while not self._repair_stop.wait(interval):
+                heartbeat.beat()
+                try:
+                    # each pass is its own root trace: repairs triggered
+                    # by the loop (vs. AdminService) are distinguishable
+                    # in the flight recorder by this span
+                    with tracing.span("tpuside.repair_pass"):
+                        self.repair_chains()
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    log.exception("chain repair pass failed")
+        finally:
+            heartbeat.close()
 
     def stop(self):
         self._flush_chains()
@@ -1212,6 +1220,11 @@ class TpuSideManager:
             repaired.append((hop_key, old_ids, new_ids))
             log.warning("re-steered SFC hop %s: %s -> %s (link down)",
                         hop_key, old_ids, new_ids)
+            events.emit("ChainRepaired",
+                        f"SFC hop {hop_key[0]}/{hop_key[1]}#{hop_key[2]}"
+                        f" re-steered off a dark ICI link: {old_ids} -> "
+                        f"{new_ids}", type_="Warning",
+                        series=f"{hop_key[0]}/{hop_key[1]}#{hop_key[2]}")
         return repaired
 
     def _save_chains_locked(self):
@@ -1343,10 +1356,20 @@ class TpuSideManager:
                 log.warning("chain journal %s truncated/corrupt; "
                             "recovered from last-good snapshot %s",
                             path, candidate)
+                events.emit("JournalRecovered",
+                            f"chain journal {path} was truncated/"
+                            "corrupt; wire table recovered from the "
+                            "last-good snapshot", type_="Warning",
+                            series="last-good")
             metrics.JOURNAL_RECOVERIES.inc(result=source)
             return data
         log.error("no readable chain journal at %s (primary and "
                   "last-good both unreadable); starting empty", path)
+        events.emit("JournalRecovered",
+                    f"no readable chain journal at {path} (primary and "
+                    "last-good both unreadable); wire table rebuilt "
+                    "from the dataplane alone", type_="Warning",
+                    series="empty")
         metrics.JOURNAL_RECOVERIES.inc(result="empty")
         return None
 

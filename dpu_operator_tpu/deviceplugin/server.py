@@ -327,8 +327,19 @@ class DevicePlugin:
             return None
 
     def _kubelet_watch_loop(self, interval: float):
+        from ..utils import watchdog
+        heartbeat = watchdog.register(
+            f"deviceplugin.kubelet-watch.{self.resource}",
+            deadline=max(30.0, interval * 10))
+        try:
+            self._kubelet_watch_passes(interval, heartbeat)
+        finally:
+            heartbeat.close()
+
+    def _kubelet_watch_passes(self, interval: float, heartbeat):
         last = self._kubelet_sock_id()
         while not self._stop.wait(interval):
+            heartbeat.beat()
             cur = self._kubelet_sock_id()
             if cur is None:
                 last = None  # kubelet down: re-register when it returns

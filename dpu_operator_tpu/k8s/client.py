@@ -27,6 +27,18 @@ class AlreadyExists(Exception):
     test fake."""
 
 
+def is_already_exists(e: BaseException) -> bool:
+    """409/AlreadyExists across both client flavors: FakeKube raises
+    the typed :class:`AlreadyExists`; RealKube surfaces the apiserver's
+    409 as ``requests.HTTPError`` with a response attached. The one
+    classifier both the SFC adopt path and the Event recorder's
+    create-or-bump path use."""
+    if isinstance(e, AlreadyExists):
+        return True
+    status = getattr(getattr(e, "response", None), "status_code", None)
+    return status == 409
+
+
 def gvk_key(api_version: str, kind: str) -> str:
     return f"{api_version}/{kind}"
 

@@ -1,0 +1,258 @@
+"""Deduplicating Kubernetes Event recorder — THE Event seam.
+
+The reference operator surfaces operational state the way cluster
+operators actually watch it: `kubectl get events` / `kubectl describe`.
+This module is the only place Event objects are built (enforced by the
+opslint ``events-seam`` rule): a :class:`EventRecorder` deduplicates
+the way client-go's EventAggregator does — the first occurrence creates
+the Event, repeats bump ``count``/``lastTimestamp`` on the same object —
+so a breaker flapping all night is one Event with count=400, not 400
+objects drowning the namespace.
+
+Works against both FakeKube and RealKube: only ``create``/``get``/
+``update`` on plain dicts. The Event *name* is a deterministic hash of
+the dedup key, so a restarted daemon keeps bumping the same Event
+instead of minting a parallel series (create racing an existing one
+rides the AlreadyExists → bump path).
+
+The module-global emitter (:func:`configure` + :func:`emit`) is how
+deep layers (watchdog stalls, SLO alerts, breaker transitions, journal
+recoveries, chain repairs) emit without threading a recorder through
+every constructor: unconfigured, :func:`emit` is a no-op.
+
+Event catalog (reasons): ``BreakerOpen`` / ``BreakerClosed``,
+``JournalRecovered``, ``ChainRepaired``, ``WatchdogStall`` /
+``WatchdogRecovered``, ``SloAlertFiring`` / ``SloAlertCleared``,
+``OperatorDegraded`` / ``OperatorHealthy`` (doc/observability.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+from .client import is_already_exists
+
+log = logging.getLogger(__name__)
+
+#: dedup series kept in memory; oldest forgotten first (a forgotten
+#: series just starts a fresh Event on its next occurrence)
+MAX_SERIES = 256
+
+
+def object_reference(obj: dict) -> dict:
+    """involvedObject reference for a live object dict."""
+    md = obj.get("metadata", {})
+    ref = {"apiVersion": obj.get("apiVersion", ""),
+           "kind": obj.get("kind", ""), "name": md.get("name", "")}
+    if md.get("namespace"):
+        ref["namespace"] = md["namespace"]
+    if md.get("uid"):
+        ref["uid"] = md["uid"]
+    return ref
+
+
+def node_reference(name: str) -> dict:
+    """involvedObject for a Node (the daemon's anchor object)."""
+    return {"apiVersion": "v1", "kind": "Node", "name": name}
+
+
+class EventRecorder:
+    """Count-bumping Event recorder over one KubeClient."""
+
+    def __init__(self, client: object, component: str,
+                 namespace: str = "default",
+                 clock: Callable[[], float] = time.time) -> None:
+        self.client = client
+        self.component = component
+        self.namespace = namespace
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._series: "dict[tuple, str]" = {}
+
+    def emit(self, involved: dict, reason: str, message: str,
+             type_: str = "Normal", series: str = "") -> Optional[dict]:
+        """Record one occurrence. Never raises: Events are best-effort
+        observability and must not fail the operation they describe.
+
+        The dedup key is (involvedObject, reason, type, *series*) — the
+        free-form *message* is deliberately NOT part of it (client-go's
+        EventAggregator keys the same way): messages carry volatile
+        detail (overdue seconds, burn rates, hop ids) that would mint a
+        new Event per occurrence and defeat the count-bumping. *series*
+        is the stable discriminator when one reason covers several
+        independent streams (the stalled component's name, the breaker
+        site, the SLO name) — repeats bump ``count`` and refresh
+        ``message``/``lastTimestamp`` on the same object."""
+        key = (involved.get("kind", ""), involved.get("namespace", ""),
+               involved.get("name", ""), reason, type_, series)
+        namespace = involved.get("namespace") or self.namespace
+        try:
+            with self._lock:
+                name = self._series.get(key)
+            if name is not None:
+                bumped = self._bump(name, namespace, message)
+                if bumped is not None:
+                    return bumped
+                # the Event was GC'd/aged out server-side: recreate
+            name = self._event_name(involved, reason, key)
+            return self._create_or_bump(name, namespace, involved,
+                                        reason, message, type_, key)
+        except Exception:  # noqa: BLE001 — best-effort by contract
+            log.warning("event %s/%s emission failed", reason,
+                        involved.get("name", ""), exc_info=True)
+            return None
+
+    # -- internals ------------------------------------------------------------
+    def _event_name(self, involved: dict, reason: str,
+                    key: tuple) -> str:
+        digest = hashlib.sha256(
+            "|".join(str(part) for part in key).encode()).hexdigest()
+        base = (involved.get("name") or "cluster").lower()
+        return f"{base}.{reason.lower()}.{digest[:12]}"
+
+    def _create_or_bump(self, name: str, namespace: str, involved: dict,
+                        reason: str, message: str, type_: str,
+                        key: tuple) -> Optional[dict]:
+        now = self.clock()
+        event = {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": name, "namespace": namespace},
+            "involvedObject": dict(involved),
+            "reason": reason, "message": message, "type": type_,
+            "count": 1, "firstTimestamp": now, "lastTimestamp": now,
+            "source": {"component": self.component},
+        }
+        try:
+            stored = self.client.create(event)  # type: ignore[attr-defined]
+        except Exception as e:  # noqa: BLE001 — 409 classified below
+            if not is_already_exists(e):
+                raise
+            # a previous process (or a racing thread) owns this series
+            # — the deterministic name makes the collision expected:
+            # fall through to the bump path against the live object
+            stored = self._bump(name, namespace, message)
+        self._remember(key, name)
+        return stored
+
+    def _bump(self, name: str, namespace: str,
+              message: str) -> Optional[dict]:
+        cur = self.client.get("v1", "Event", name,  # type: ignore[attr-defined]
+                              namespace=namespace)
+        if cur is None:
+            return None
+        cur["count"] = int(cur.get("count", 1)) + 1
+        cur["message"] = message  # latest occurrence's detail wins
+        cur["lastTimestamp"] = self.clock()
+        try:
+            return self.client.update(cur)  # type: ignore[attr-defined]
+        except Exception:  # noqa: BLE001 — a conflict means another
+            # emitter just bumped the same series: the occurrence IS
+            # recorded, just not by us
+            log.debug("event count bump for %s raced; dropped",
+                      name, exc_info=True)
+            return cur
+
+    def _remember(self, key: tuple, name: str) -> None:
+        with self._lock:
+            self._series[key] = name
+            while len(self._series) > MAX_SERIES:
+                self._series.pop(next(iter(self._series)))
+
+
+# -- module-global emitter ----------------------------------------------------
+# emit() is ASYNCHRONOUS: callers are the watchdog checker, the SLO
+# evaluator and daemon loops — threads whose job is detecting incidents.
+# An Event create is wire I/O with a retry budget; doing it inline would
+# serialize stall detection behind a sick apiserver during exactly the
+# incidents it monitors (the same rationale as the breaker-transition
+# notifier thread in utils/resilience.py). The dispatcher thread drains
+# a queue; tests synchronize with flush().
+
+_global_lock = threading.Lock()
+_global: Optional[tuple[EventRecorder, dict]] = None
+_bridge_installed = False
+_queue: "queue.Queue[tuple[str, str, str, str]]" = queue.Queue()
+_dispatcher_started = False
+
+
+def configure(recorder: EventRecorder, involved: dict) -> None:
+    """Install the process-global emitter (*involved* anchors the
+    Events — the daemon uses its Node, the operator its CR), start the
+    dispatcher thread, and bridge circuit-breaker transitions into
+    ``BreakerOpen``/``BreakerClosed`` Events."""
+    global _global, _dispatcher_started
+    with _global_lock:
+        _global = (recorder, involved)
+        start = not _dispatcher_started
+        _dispatcher_started = True
+    if start:
+        threading.Thread(target=_drain, daemon=True,
+                         name="event-emit").start()
+    _install_breaker_bridge()
+
+
+def reset() -> None:
+    """Drop the global emitter (tests)."""
+    global _global
+    with _global_lock:
+        _global = None
+
+
+def emit(reason: str, message: str, type_: str = "Normal",
+         series: str = "") -> None:
+    """Queue an emission for the dispatcher thread; no-op until
+    configured. *series* is the stable dedup discriminator (see
+    :meth:`EventRecorder.emit`)."""
+    with _global_lock:
+        if _global is None:
+            return
+    _queue.put((reason, message, type_, series))
+
+
+def _drain() -> None:
+    while True:
+        reason, message, type_, series = _queue.get()
+        try:
+            with _global_lock:
+                configured = _global
+            if configured is not None:
+                recorder, involved = configured
+                recorder.emit(involved, reason, message, type_=type_,
+                              series=series)
+        finally:
+            _queue.task_done()
+
+
+def flush() -> None:
+    """Test barrier: block until every queued emission has been
+    dispatched (deterministic, no sleeps)."""
+    _queue.join()
+
+
+def _install_breaker_bridge() -> None:
+    global _bridge_installed
+    with _global_lock:
+        if _bridge_installed:
+            return
+        _bridge_installed = True
+    from ..utils import resilience
+    resilience.add_transition_listener(_on_breaker_transition)
+
+
+def _on_breaker_transition(site: str, from_state: str,
+                           to_state: str) -> None:
+    if to_state == "open":
+        emit("BreakerOpen",
+             f"circuit breaker {site} opened (was {from_state}): calls "
+             "short-circuit until a half-open probe succeeds",
+             type_="Warning", series=site)
+    elif to_state == "closed":
+        emit("BreakerClosed",
+             f"circuit breaker {site} closed (recovered from "
+             f"{from_state})", series=site)
+    # half-open is a probe window, not a state change worth an Event

@@ -14,7 +14,7 @@ import threading
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
-from ..utils import metrics, tracing
+from ..utils import metrics, tracing, watchdog
 from .client import KubeClient
 
 log = logging.getLogger(__name__)
@@ -54,6 +54,10 @@ class Manager:
         self._idle = threading.Event()
         self._idle.set()
         self._inflight_timers = 0
+        #: watchdog heartbeat for the worker thread: task-scoped (idle
+        #: between queue items is healthy; a reconcile stuck past
+        #: STALL_DEADLINE is not), registered in start()
+        self._heartbeat: Optional[watchdog.Heartbeat] = None
         #: (id(rec), req) keys with a periodic-resync timer pending —
         #: dedups requeue_after so watch-event storms (including the
         #: MODIFIED events a reconciler's own status writes emit) cannot
@@ -83,6 +87,9 @@ class Manager:
                 self._enqueue(rec, Request(api_version, kind, md.get("name"),
                                            md.get("namespace") or None))
             self._cancels.append(self.client.watch(api_version, kind, cb))
+        self._heartbeat = watchdog.register(
+            "manager.worker", deadline=self.STALL_DEADLINE,
+            periodic=False)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="manager-worker")
         self._thread.start()
@@ -94,6 +101,9 @@ class Manager:
         self._queue.put(None)
         if self._thread:
             self._thread.join(timeout=5)
+        if self._heartbeat is not None:
+            self._heartbeat.close()
+            self._heartbeat = None
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
         """Test helper: block until the workqueue drains."""
@@ -103,6 +113,10 @@ class Manager:
     #: scaled down since our base reconciles are cheap)
     RETRY_BASE = 0.5
     RETRY_MAX = 60.0
+
+    #: a single reconcile past this is a stalled worker (the queue
+    #: behind it is frozen): watchdog dumps stacks + flips degraded
+    STALL_DEADLINE = 60.0
 
     def _schedule_retry(self, delay: float, rec: Reconciler, req: Request,
                         timers: dict, counts_as_pending: bool = True) -> None:
@@ -162,7 +176,8 @@ class Manager:
                 self._pending.discard(fkey)
             try:
                 metrics.RECONCILE_TOTAL.inc(controller=controller)
-                with metrics.RECONCILE_SECONDS.time(), \
+                with watchdog.task(self._heartbeat), \
+                        metrics.RECONCILE_SECONDS.time(), \
                         tracing.span("reconcile", controller=controller,
                                      request=req.name or ""):
                     result = (rec.reconcile(self.client, req)

@@ -86,6 +86,14 @@ def _vsp_cmds(sub):
                         "(span/breaker/swallowed_error/journal_recovery)")
     p.add_argument("--token", default="",
                    help="bearer token when /debug/flight is auth-filtered")
+    p = sub.add_parser(
+        "health",
+        help="render the daemon's /debug/health snapshot: per-component "
+             "verdicts aggregating watchdog stalls, circuit-breaker "
+             "state and SLO burn-rate alerts — the same data the "
+             "TpuOperatorConfig CR's Healthy/Degraded conditions fold")
+    p.add_argument("--token", default="",
+                   help="bearer token when /debug/health is auth-filtered")
 
 
 def main(argv=None):
@@ -141,6 +149,11 @@ def run(args) -> dict:
             return {"unwired": [args.input, args.output]}
         finally:
             client.close()
+
+    if args.cmd == "health":
+        from .utils.flight import fetch
+        return fetch(args.metrics_addr, token=args.token,
+                     path="/debug/health")
 
     if args.cmd == "flight":
         from .utils.flight import fetch

@@ -25,14 +25,46 @@ flight dump joins against the trace tree and the structured logs.
 from __future__ import annotations
 
 import collections
+import logging
+import os
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Mapping, Optional
+
+log = logging.getLogger(__name__)
 
 #: ring capacity: large enough to hold a whole CNI-ADD storm's spans plus
 #: the breaker flaps around it, small enough to be dumped over HTTP
 #: without pagination
 DEFAULT_CAPACITY = 512
+
+#: TPU_FLIGHT_CAPACITY is clamped to this range: below, the ring can't
+#: hold one request's spans; above, a /debug/flight dump stops being a
+#: bounded snapshot
+MIN_CAPACITY, MAX_CAPACITY = 16, 65536
+
+
+def capacity_from_env(env: Optional[Mapping[str, str]] = None) -> int:
+    """Ring capacity from ``TPU_FLIGHT_CAPACITY``: bounded; a
+    non-integer or out-of-range value falls back to the default with a
+    logged warning (observability config must never crash the process
+    it observes)."""
+    raw = (env if env is not None else os.environ).get(
+        "TPU_FLIGHT_CAPACITY", "")
+    if not raw:
+        return DEFAULT_CAPACITY
+    try:
+        value = int(raw)
+    except ValueError:
+        log.warning("TPU_FLIGHT_CAPACITY=%r is not an integer; using "
+                    "the default %d", raw, DEFAULT_CAPACITY)
+        return DEFAULT_CAPACITY
+    if not MIN_CAPACITY <= value <= MAX_CAPACITY:
+        log.warning("TPU_FLIGHT_CAPACITY=%d outside [%d, %d]; using "
+                    "the default %d", value, MIN_CAPACITY, MAX_CAPACITY,
+                    DEFAULT_CAPACITY)
+        return DEFAULT_CAPACITY
+    return value
 
 
 class FlightRecorder:
@@ -102,8 +134,9 @@ class FlightRecorder:
             self._seq = 0
 
 
-#: process-global recorder (the REGISTRY analog for events)
-RECORDER = FlightRecorder()
+#: process-global recorder (the REGISTRY analog for events); sized from
+#: TPU_FLIGHT_CAPACITY when set (bounded, bad values fall back)
+RECORDER = FlightRecorder(capacity_from_env())
 
 
 def record(kind: str, name: str, **kwargs: Any) -> None:
@@ -111,10 +144,12 @@ def record(kind: str, name: str, **kwargs: Any) -> None:
     RECORDER.record(kind, name, **kwargs)
 
 
-def fetch(addr: str, timeout: float = 5.0, token: str = "") -> dict:
-    """GET ``/debug/flight`` from a MetricsServer at ``host:port`` —
-    what ``tpuctl flight`` runs. *token* is the bearer token when the
-    endpoint is auth-filtered (same filter as /metrics)."""
+def fetch(addr: str, timeout: float = 5.0, token: str = "",
+          path: str = "/debug/flight") -> dict:
+    """GET a JSON debug endpoint from a MetricsServer at ``host:port``
+    — ``tpuctl flight`` (``/debug/flight``) and ``tpuctl health``
+    (``/debug/health``) both run this. *token* is the bearer token when
+    the endpoint is auth-filtered (same filter as /metrics)."""
     import http.client
     import json
     host, sep, port = addr.rpartition(":")
@@ -125,12 +160,12 @@ def fetch(addr: str, timeout: float = 5.0, token: str = "") -> dict:
                                       timeout=timeout)
     try:
         headers = {"Authorization": f"Bearer {token}"} if token else {}
-        conn.request("GET", "/debug/flight", headers=headers)
+        conn.request("GET", path, headers=headers)
         resp = conn.getresponse()
         body = resp.read()
         if resp.status != 200:
             raise RuntimeError(
-                f"/debug/flight returned HTTP {resp.status}: "
+                f"{path} returned HTTP {resp.status}: "
                 f"{body[:200].decode('utf-8', 'replace')}")
         return json.loads(body)
     finally:

@@ -35,6 +35,18 @@ class Counter:
     def value(self, **labels: object) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def total(self, where: Optional[Callable[[dict], bool]] = None
+              ) -> float:
+        """Sum across label sets, optionally filtered by a predicate
+        over the label dict (SLO sources aggregate e.g. every
+        ``site=kube.*`` series without enumerating verbs)."""
+        with self._lock:
+            items = list(self._values.items())
+        if where is None:
+            return sum(v for _, v in items)
+        return sum(v for key, v in items
+                   if where({str(k): str(val) for k, val in key}))
+
     def _render(self, openmetrics: bool = False) -> list:
         # OpenMetrics names counter FAMILIES without the _total suffix
         # (samples keep it); emitting `# TYPE x_total counter` makes
@@ -137,6 +149,15 @@ class Histogram:
         with self._lock:
             return self._sum
 
+    def count_above(self, le: float) -> float:
+        """Observations above *le*, at bucket granularity (the "bad
+        events" read for latency SLOs: *le* should be a bucket bound)."""
+        with self._lock:
+            total = sum(self._counts)
+            covered = sum(c for b, c in zip(self.buckets, self._counts)
+                          if b <= le)
+        return float(total - covered)
+
     def _render(self, with_header: bool = True,
                 openmetrics: bool = False) -> list:
         out = ([f"# HELP {self.name} {self.help}",
@@ -198,6 +219,16 @@ class HistogramVec:
     def observe(self, value: str, seconds: float,
                 exemplar: Optional[dict] = None) -> None:
         self.labels(value).observe(seconds, exemplar=exemplar)
+
+    def _snapshot_children(self) -> list:
+        with self._lock:
+            return list(self._children.values())
+
+    def count(self) -> float:
+        return float(sum(c.count for c in self._snapshot_children()))
+
+    def count_above(self, le: float) -> float:
+        return sum(c.count_above(le) for c in self._snapshot_children())
 
     def _render(self, openmetrics: bool = False) -> list:
         out = [f"# HELP {self.name} {self.help}",
@@ -362,6 +393,21 @@ JOURNAL_RECOVERIES = REGISTRY._add(_FlightRecordedCounter(
     "read clean; last_good = truncated/corrupt journal, fell back to "
     "the previous snapshot; empty = no readable snapshot at all)",
     kind="journal_recovery"))
+# -- health engine (utils/watchdog.py + utils/slo.py) ------------------------
+WATCHDOG_STALLS = REGISTRY.counter(
+    "tpu_watchdog_stalls_total",
+    "Heartbeats detected past their deadline by the watchdog, by "
+    "component (each stall dumps all-thread stacks into the flight "
+    "recorder, kind=stall)")
+SLO_BURN_RATE = REGISTRY.gauge(
+    "tpu_slo_burn_rate",
+    "Error-budget burn rate per SLO and window (1.0 = spending the "
+    "budget exactly; SRE Workbook multi-window thresholds fire at "
+    "14.4x/6x)")
+SLO_ALERT_ACTIVE = REGISTRY.gauge(
+    "tpu_slo_alert_active",
+    "1 while a multi-window burn-rate alert is firing, by SLO and "
+    "severity")
 # -- static-analysis gate (opslint exception-hygiene rule) -------------------
 SWALLOWED_ERRORS = REGISTRY._add(_FlightRecordedCounter(
     "tpu_daemon_swallowed_errors_total",
@@ -450,18 +496,23 @@ class MetricsServer:
                  registry: Registry = REGISTRY,
                  ready_check: Optional[Callable[[], bool]] = None,
                  auth: Optional[Callable[[str], bool]] = None,
-                 degraded_check: Optional[Callable[[], list]] = None) -> None:
-        """*degraded_check* returns the call sites currently degraded
-        (open circuit breakers, utils/resilience.py) — surfaced in the
-        /healthz body. Degraded is still 200: the process is alive and
-        partially serving; taking it out of rotation would turn one
-        failing dependency into a total outage."""
+                 degraded_check: Optional[Callable[[], list]] = None,
+                 health_check: Optional[Callable[[], dict]] = None) -> None:
+        """*degraded_check* returns the components currently degraded
+        (open circuit breakers + watchdog-stalled loops) — surfaced as
+        a structured JSON breakdown in the /healthz body. Degraded is
+        still 200: the process is alive and partially serving; taking
+        it out of rotation would turn one failing dependency into a
+        total outage. *health_check* returns the full health-engine
+        snapshot (utils/slo.py health_snapshot) served at
+        /debug/health."""
         self.host = host
         self.port = port
         self.registry = registry
         self.ready_check = ready_check or (lambda: True)
         self.auth = auth
         self.degraded_check = degraded_check
+        self.health_check = health_check
         self._server: Optional[ThreadingHTTPServer] = None
 
     def start(self) -> None:
@@ -515,12 +566,33 @@ class MetricsServer:
                         body = json.dumps(
                             flight.RECORDER.snapshot()).encode()
                         ctype, code = "application/json", 200
+                elif self.path == "/debug/health":
+                    denied = self._auth_denial()
+                    if denied is not None:
+                        code, body, ctype = denied
+                    elif outer.health_check is None:
+                        body = b"no health snapshot configured"
+                        ctype, code = "text/plain", 404
+                    else:
+                        import json
+                        body = json.dumps(outer.health_check()).encode()
+                        ctype, code = "application/json", 200
                 elif self.path == "/healthz":
                     degraded = (outer.degraded_check()
                                 if outer.degraded_check else [])
-                    body = (("degraded: " + ",".join(degraded)).encode()
-                            if degraded else b"ok")
-                    ctype, code = "text/plain", 200
+                    if degraded:
+                        # structured component breakdown, still 200:
+                        # alive-and-partially-serving (kubelet probes
+                        # only look at the status code; operators and
+                        # tooling parse the body)
+                        import json
+                        body = json.dumps(
+                            {"status": "degraded",
+                             "components": sorted(degraded)}).encode()
+                        ctype = "application/json"
+                    else:
+                        body, ctype = b"ok", "text/plain"
+                    code = 200
                 elif self.path == "/readyz":
                     ready = outer.ready_check()
                     body = b"ok" if ready else b"not ready"

@@ -31,15 +31,73 @@ from __future__ import annotations
 
 import http.client
 import logging
+import queue
 import random
 import ssl
 import threading
 import time
+import weakref
 from typing import Any, Callable, Optional
 
 from . import flight, metrics
 
 log = logging.getLogger(__name__)
+
+#: live breakers, for the health snapshot (weak: breakers die with
+#: their owners — test VSPs, short-lived plugins)
+_BREAKERS: "weakref.WeakSet[CircuitBreaker]" = weakref.WeakSet()
+
+
+def breakers() -> list["CircuitBreaker"]:
+    """Every live breaker in the process (``/debug/health``)."""
+    return sorted(_BREAKERS, key=lambda b: b.site)
+
+
+# -- transition listeners -----------------------------------------------------
+# Listeners (the k8s.events bridge) run on a dedicated notifier thread,
+# never under a breaker's lock: an Event create is a wire call, and a
+# slow apiserver must not serialize every breaker admission check in
+# the process behind it — during an incident, which is exactly when
+# breakers transition.
+
+_listener_lock = threading.Lock()
+_listeners: list[Callable[[str, str, str], None]] = []
+_notify_queue: "queue.Queue[tuple[str, str, str]]" = queue.Queue()
+_notifier_started = False
+
+
+def add_transition_listener(fn: Callable[[str, str, str], None]) -> None:
+    """Register ``fn(site, from_state, to_state)`` to run (off-lock, on
+    the notifier thread) after every breaker transition."""
+    global _notifier_started
+    with _listener_lock:
+        _listeners.append(fn)
+        if _notifier_started:
+            return
+        _notifier_started = True
+    threading.Thread(target=_drain_notifications, daemon=True,
+                     name="breaker-notify").start()
+
+
+def _drain_notifications() -> None:
+    while True:
+        item = _notify_queue.get()
+        with _listener_lock:
+            listeners = list(_listeners)
+        for fn in listeners:
+            try:
+                fn(*item)
+            except Exception:  # noqa: BLE001 — one bad listener must
+                # not starve the rest (or wedge the notifier)
+                log.warning("breaker transition listener failed",
+                            exc_info=True)
+        _notify_queue.task_done()
+
+
+def flush_transition_listeners() -> None:
+    """Test barrier: block until every queued transition notification
+    has been dispatched (deterministic, no sleeps)."""
+    _notify_queue.join()
 
 
 class TransientError(Exception):
@@ -225,6 +283,7 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probes = 0
         metrics.BREAKER_STATE.set(0, site=site)
+        _BREAKERS.add(self)
 
     # -- state machine --------------------------------------------------------
     def _transition_locked(self, state: str) -> None:
@@ -237,6 +296,10 @@ class CircuitBreaker:
         # dump shows WHICH request's failure tripped the breaker
         flight.record("breaker", self.site,
                       attributes={"from": from_state, "to": state})
+        if _notifier_started:
+            # handed to the notifier thread: listeners (the Event
+            # bridge) do wire I/O and must not run under this lock
+            _notify_queue.put((self.site, from_state, state))
         log.log(logging.WARNING if state != self.CLOSED else logging.INFO,
                 "circuit breaker %s -> %s", self.site, state)
 

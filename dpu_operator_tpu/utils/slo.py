@@ -1,0 +1,382 @@
+"""Multi-window, multi-burn-rate SLO evaluation over the live registry.
+
+The repo *collects* latency/error series (histograms, breaker counters)
+but nothing judges them. This module implements the SRE Workbook's
+(ch. 5) multi-window multi-burn-rate alerting against the in-process
+metrics — no Prometheus required:
+
+- an :class:`Slo` names an objective (e.g. 99% of CNI ADDs under 1 s)
+  as two monotone counter reads: ``total_fn`` (all events) and
+  ``bad_fn`` (budget-burning events);
+- the :class:`SloEvaluator` samples both on every tick and computes the
+  **burn rate** per window — the ratio of the observed bad fraction to
+  the error budget (burn 1.0 = exactly spending the budget; 14.4 =
+  spending a 30-day budget in ~2 days);
+- an :class:`AlertRule` fires only when *every* window in its pair
+  exceeds the threshold (long window = sustained, short window = still
+  happening → alerts auto-clear fast once the storm ends).
+
+State is exported as ``tpu_slo_burn_rate`` / ``tpu_slo_alert_active``
+gauges, flight-recorded (kind=``slo``), emitted as Kubernetes Events
+(``SloAlertFiring`` / ``SloAlertCleared``) and aggregated — together
+with watchdog stalls and open breakers — into the ``/debug/health``
+snapshot by :func:`health_snapshot`.
+
+The clock and the window durations are injectable, so `make
+health-check` replays a seeded error storm firing and clearing an
+alert in milliseconds of wall time.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from . import flight, metrics
+from .watchdog import emit_health_event
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnWindow:
+    """One look-back window with its burn-rate threshold."""
+
+    label: str        # rendered on the tpu_slo_burn_rate gauge
+    seconds: float
+    threshold: float
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """Fires when every window's burn rate exceeds its threshold."""
+
+    severity: str               # "page" | "ticket"
+    windows: tuple[BurnWindow, ...]
+
+
+def default_rules(scale: float = 1.0) -> tuple[AlertRule, ...]:
+    """The SRE Workbook's recommended pairs (table 5-6) for a 30-day
+    budget: page on 14.4x over (5m AND 1h), ticket on 6x over (30m AND
+    6h). *scale* shrinks the windows uniformly (test time)."""
+    return (
+        AlertRule("page", (BurnWindow("5m", 300 * scale, 14.4),
+                           BurnWindow("1h", 3600 * scale, 14.4))),
+        AlertRule("ticket", (BurnWindow("30m", 1800 * scale, 6.0),
+                             BurnWindow("6h", 21600 * scale, 6.0))),
+    )
+
+
+class Slo:
+    """One objective over two monotone counter reads."""
+
+    def __init__(self, name: str, component: str, objective: float,
+                 total_fn: Callable[[], float],
+                 bad_fn: Callable[[], float],
+                 rules: Optional[tuple[AlertRule, ...]] = None,
+                 description: str = "") -> None:
+        if not 0.0 < objective < 1.0:
+            raise ValueError("objective must be in (0, 1)")
+        self.name = name
+        self.component = component
+        self.objective = objective
+        self.error_budget = 1.0 - objective
+        self.total_fn = total_fn
+        self.bad_fn = bad_fn
+        self.rules = rules if rules is not None else default_rules()
+        self.description = description
+        # the burn map is keyed by label: two rules reusing a label for
+        # DIFFERENT durations would silently evaluate one rule's
+        # threshold against the other's window — reject at build time
+        seen: dict[str, float] = {}
+        for rule in self.rules:
+            for w in rule.windows:
+                if seen.setdefault(w.label, w.seconds) != w.seconds:
+                    raise ValueError(
+                        f"window label {w.label!r} reused with a "
+                        f"different duration ({seen[w.label]}s vs "
+                        f"{w.seconds}s) across rules of SLO {name!r}")
+
+    def windows(self) -> list[BurnWindow]:
+        seen_labels: dict[str, BurnWindow] = {}
+        for rule in self.rules:
+            for w in rule.windows:
+                seen_labels.setdefault(w.label, w)
+        return list(seen_labels.values())
+
+
+class SloEvaluator:
+    """Samples every registered SLO per tick and drives alert state.
+
+    ``evaluate()`` is the unit of progress (injectable clock for
+    tests); ``start()`` runs it periodically in production."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._slos: list[Slo] = []
+        # per-SLO monotone samples: deque of (t, bad, total), pruned to
+        # one sample at/beyond the longest window (the delta reference)
+        self._samples: dict[str, "collections.deque[tuple]"] = {}
+        self._active: dict[tuple[str, str], bool] = {}
+        self._last: dict[str, dict] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def add(self, slo: Slo) -> Slo:
+        with self._lock:
+            self._slos.append(slo)
+            self._samples[slo.name] = collections.deque()
+        return slo
+
+    # -- one tick -------------------------------------------------------------
+    def evaluate(self) -> dict:
+        """Sample, compute burn rates, transition alerts. Returns the
+        per-SLO state dict also served on ``/debug/health``."""
+        now = self.clock()
+        with self._lock:
+            slos = list(self._slos)
+        out: dict[str, dict] = {}
+        for slo in slos:
+            try:
+                bad, total = float(slo.bad_fn()), float(slo.total_fn())
+            except Exception:  # noqa: BLE001 — a broken source must not
+                # take the whole evaluation loop (and its alerts) down
+                metrics.SWALLOWED_ERRORS.inc(site="slo.sample")
+                log.exception("SLO %s sample failed; skipping this tick",
+                              slo.name)
+                continue
+            horizon = max(w.seconds for w in slo.windows())
+            with self._lock:
+                samples = self._samples[slo.name]
+                samples.append((now, bad, total))
+                # keep exactly one sample at/earlier than the horizon:
+                # it is the delta reference for the longest window
+                while (len(samples) >= 2
+                       and samples[1][0] <= now - horizon):
+                    samples.popleft()
+                window_samples = list(samples)
+            burns = {w.label: self._burn(window_samples, now, w.seconds,
+                                         slo.error_budget)
+                     for w in slo.windows()}
+            for label, burn in burns.items():
+                metrics.SLO_BURN_RATE.set(burn, slo=slo.name,
+                                          window=label)
+            alerts = {rule.severity: self._transition(slo, rule, burns)
+                      for rule in slo.rules}
+            state = {"component": slo.component,
+                     "objective": slo.objective,
+                     "burn_rates": burns, "alerts": alerts,
+                     "bad": bad, "total": total}
+            out[slo.name] = state
+        with self._lock:
+            self._last.update(out)
+        return out
+
+    @staticmethod
+    def _burn(samples: list, now: float, window: float,
+              error_budget: float) -> float:
+        """Burn rate over [now - window, now]: bad fraction of the
+        events in the window, divided by the error budget. The delta
+        reference is the newest sample at/before the window start (or
+        the oldest available while the series is younger than the
+        window)."""
+        if not samples:
+            return 0.0
+        ref = samples[0]
+        for s in samples:
+            if s[0] <= now - window:
+                ref = s
+            else:
+                break
+        latest = samples[-1]
+        d_bad = latest[1] - ref[1]
+        d_total = latest[2] - ref[2]
+        if d_total <= 0 or error_budget <= 0:
+            return 0.0
+        return (d_bad / d_total) / error_budget
+
+    def _transition(self, slo: Slo, rule: AlertRule,
+                    burns: dict) -> bool:
+        firing = all(burns[w.label] > w.threshold for w in rule.windows)
+        key = (slo.name, rule.severity)
+        with self._lock:
+            was = self._active.get(key, False)
+            self._active[key] = firing
+        metrics.SLO_ALERT_ACTIVE.set(1.0 if firing else 0.0,
+                                     slo=slo.name, severity=rule.severity)
+        if firing == was:
+            return firing
+        worst = max(burns[w.label] for w in rule.windows)
+        detail = ", ".join(f"{w.label}={burns[w.label]:.1f}x"
+                           f" (>{w.threshold:g})" for w in rule.windows)
+        flight.record("slo", slo.name, attributes={
+            "severity": rule.severity,
+            "state": "firing" if firing else "cleared",
+            "burn_rates": detail})
+        series = f"{slo.name}/{rule.severity}"
+        if firing:
+            log.error("SLO alert firing: %s [%s] burn %s", slo.name,
+                      rule.severity, detail)
+            emit_health_event("SloAlertFiring",
+                              f"SLO {slo.name} ({slo.component}) "
+                              f"burning {worst:.1f}x its error budget "
+                              f"[{rule.severity}]: {detail}", "Warning",
+                              series=series)
+        else:
+            log.warning("SLO alert cleared: %s [%s]", slo.name,
+                        rule.severity)
+            emit_health_event("SloAlertCleared",
+                              f"SLO {slo.name} ({slo.component}) back "
+                              f"within budget [{rule.severity}]",
+                              "Normal", series=series)
+        return firing
+
+    # -- state views ----------------------------------------------------------
+    def active_alerts(self) -> list[tuple[str, str]]:
+        """(slo name, severity) pairs currently firing."""
+        with self._lock:
+            return sorted(k for k, v in self._active.items() if v)
+
+    def state(self) -> dict:
+        """Last evaluated per-SLO state (``/debug/health``)."""
+        with self._lock:
+            return {name: dict(s) for name, s in self._last.items()}
+
+    # -- production loop ------------------------------------------------------
+    def start(self, interval: float = 10.0) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, args=(interval,), daemon=True,
+                name="slo-evaluator")
+            thread = self._thread
+        thread.start()
+
+    def _run(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.evaluate()
+            except Exception:  # noqa: BLE001 — keep evaluating
+                log.exception("SLO evaluation pass failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
+
+
+# -- the repo's standing objectives -------------------------------------------
+
+#: a CNI ADD/DEL slower than this burns the cni-latency budget (kubelet
+#: serializes pod sandbox setup behind it)
+CNI_SLOW_SECONDS = 1.0
+#: an apiserver round-trip slower than this burns the kube-client
+#: budget (reconcile loops and CNI ADDs sit behind these calls)
+KUBE_SLOW_SECONDS = 0.5
+
+
+def default_slos(rules: Optional[tuple[AlertRule, ...]] = None) -> list[Slo]:
+    """The standing SLOs over the live registry series (the table in
+    doc/observability.md): CNI handler latency, apiserver client
+    error+latency, and breaker rejections across all wire seams."""
+
+    def kube_bad() -> float:
+        slow = metrics.KUBE_REQUEST_SECONDS.count_above(KUBE_SLOW_SECONDS)
+        errors = metrics.RESILIENCE_RETRIES.total(
+            lambda lb: lb.get("site", "").startswith("kube.")
+            and lb.get("outcome") in ("gave_up", "aborted"))
+        return slow + errors
+
+    def rejection_total() -> float:
+        # denominator: calls that flowed through the wire seams plus
+        # the rejected ones themselves (a rejection never reaches a
+        # per-seam request counter)
+        return (metrics.BREAKER_REJECTIONS.total()
+                + metrics.KUBE_REQUESTS.total()
+                + metrics.CNI_REQUESTS.total())
+
+    return [
+        Slo("cni-latency", component="cni", objective=0.99,
+            total_fn=lambda: float(metrics.CNI_SECONDS.count),
+            bad_fn=lambda: metrics.CNI_SECONDS.count_above(
+                CNI_SLOW_SECONDS),
+            rules=rules,
+            description=f"99% of CNI ops under {CNI_SLOW_SECONDS:g}s"),
+        Slo("kube-client", component="kube-client", objective=0.995,
+            total_fn=metrics.KUBE_REQUEST_SECONDS.count, bad_fn=kube_bad,
+            rules=rules,
+            description=f"99.5% of apiserver requests under "
+                        f"{KUBE_SLOW_SECONDS:g}s and not erroring out"),
+        Slo("breaker-rejections", component="resilience",
+            objective=0.999, total_fn=rejection_total,
+            bad_fn=metrics.BREAKER_REJECTIONS.total, rules=rules,
+            description="99.9% of wire-seam calls not short-circuited "
+                        "by an open breaker"),
+    ]
+
+
+#: process-global evaluator over the standing SLOs (the REGISTRY analog)
+EVALUATOR = SloEvaluator()
+for _slo in default_slos():
+    EVALUATOR.add(_slo)
+del _slo
+
+
+# -- /debug/health aggregation ------------------------------------------------
+
+def health_snapshot(watchdog: Optional[object] = None,
+                    evaluator: Optional[SloEvaluator] = None) -> dict:
+    """The one JSON verdict: watchdog + breaker + SLO state folded into
+    a per-component breakdown. Served at ``/debug/health``, rendered by
+    ``tpuctl health``, and folded into the TpuOperatorConfig CR's
+    ``Healthy``/``Degraded`` conditions by the controller."""
+    from . import resilience
+    from . import watchdog as wd
+    dog = watchdog if watchdog is not None else wd.WATCHDOG
+    ev = evaluator if evaluator is not None else EVALUATOR
+
+    components: dict[str, dict] = {}
+
+    def comp(name: str) -> dict:
+        return components.setdefault(
+            name, {"healthy": True, "reasons": []})
+
+    heartbeat_rows = dog.snapshot()  # type: ignore[attr-defined]
+    for row in heartbeat_rows:
+        entry = comp(str(row["name"]))
+        if row.get("stalled"):
+            entry["healthy"] = False
+            entry["reasons"].append(
+                f"WatchdogStall: no heartbeat within "
+                f"{row['deadline_s']:g}s")
+    breakers = {}
+    for br in resilience.breakers():
+        state = br.state
+        breakers[br.site] = state
+        entry = comp(br.site)
+        if state != resilience.CircuitBreaker.CLOSED:
+            entry["healthy"] = False
+            entry["reasons"].append(f"CircuitBreaker{state.title().replace('-', '')}")
+    slo_state = ev.state()
+    for name, severity in ev.active_alerts():
+        entry = comp(slo_state.get(name, {}).get("component", name))
+        entry["healthy"] = False
+        entry["reasons"].append(f"SloAlert:{name}:{severity}")
+    return {
+        "healthy": all(c["healthy"] for c in components.values()),
+        "components": components,
+        "heartbeats": heartbeat_rows,
+        "breakers": breakers,
+        "slo": slo_state,
+    }
+
+

@@ -1,0 +1,306 @@
+"""Watchdog: named heartbeats with deadlines → stall detection.
+
+The operator is a pile of long-lived loops (daemon detect loop, manager
+reconcile worker, chain-repair pass, device-plugin kubelet watch, CNI
+dispatch pool, VSP serve loop). Any of them can wedge — a deadlock, a
+hung dependency call that dodged its timeout, a worker thread stuck on
+a poisoned queue item — and the process keeps answering ``/healthz``
+because the *HTTP server* thread is fine. The watchdog closes that gap:
+
+- every loop registers a named :class:`Heartbeat` with a deadline;
+  periodic loops call :meth:`Heartbeat.beat` each iteration, request-
+  driven workers wrap each unit of work in :meth:`Heartbeat.task`;
+- one :class:`Watchdog` checker detects heartbeats past their deadline,
+  dumps **all thread stacks** into the flight recorder (kind=``stall``,
+  truncated to :data:`MAX_DUMP_CHARS` so one stall cannot blow the
+  bounded ring), bumps ``tpu_watchdog_stalls_total`` and flips the
+  component degraded (surfaced on ``/healthz``, ``/debug/health``, CR
+  conditions and a Kubernetes Event);
+- recovery (the heartbeat resumes) clears the degraded flag and emits
+  the matching recovery Event.
+
+The clock is injectable so `make health-check` drives stall → dump →
+recover deterministically, no wall-clock sleeps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import logging
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, ContextManager, Iterator, Optional
+
+from . import flight, metrics
+
+log = logging.getLogger(__name__)
+
+#: a stack dump landing in the flight ring is truncated to this many
+#: characters: the ring is a bounded in-memory buffer dumped over HTTP,
+#: and one stall on a thread-heavy daemon must not balloon it
+MAX_DUMP_CHARS = 8000
+
+
+def dump_all_stacks(limit: int = MAX_DUMP_CHARS) -> str:
+    """Formatted stacks of every live thread (the post-incident answer
+    to "what was everyone doing when X stalled"), truncated to *limit*
+    characters with an explicit truncation marker."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts: list[str] = []
+    for ident, frame in sys._current_frames().items():
+        parts.append(f"-- thread {names.get(ident, '?')} ({ident}) --")
+        parts.extend(line.rstrip()
+                     for line in traceback.format_stack(frame))
+    text = "\n".join(parts)
+    if len(text) > limit:
+        text = (text[:limit]
+                + f"\n... [truncated {len(text) - limit} chars]")
+    return text
+
+
+class Heartbeat:
+    """One named liveness contract with the watchdog.
+
+    Two shapes, matching the two kinds of long-lived component:
+
+    - **periodic** (``periodic=True``): the loop must call :meth:`beat`
+      at least every ``deadline`` seconds; a stale beat is a stall.
+    - **task-scoped** (``periodic=False``): idle is healthy no matter
+      how long; each unit of work runs inside ``with hb.task():`` and
+      stalls only when a task outlives ``deadline``. Concurrent tasks
+      (a dispatch pool) are tracked individually — the *oldest* running
+      task decides.
+    """
+
+    def __init__(self, name: str, deadline: float, owner: "Watchdog",
+                 periodic: bool = True) -> None:
+        self.name = name
+        self.deadline = deadline
+        self.periodic = periodic
+        self._owner = owner
+        self._clock = owner.clock
+        self._lock = threading.Lock()
+        self._tokens = itertools.count(1)
+        self._last = self._clock()
+        self._tasks: dict[int, float] = {}
+        self._closed = False
+
+    def beat(self) -> None:
+        """Mark the loop alive (periodic heartbeats, once per pass)."""
+        with self._lock:
+            self._last = self._clock()
+
+    @contextlib.contextmanager
+    def task(self) -> Iterator[None]:
+        """Arm the deadline for one unit of work; disarm on exit (even
+        on error — a *failed* task is not a *stalled* one)."""
+        token = next(self._tokens)
+        now = self._clock()
+        with self._lock:
+            self._tasks[token] = now
+            self._last = now
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._tasks.pop(token, None)
+                self._last = self._clock()
+
+    def overdue(self, now: float) -> bool:
+        with self._lock:
+            if self._closed:
+                return False
+            if self._tasks:
+                return now - min(self._tasks.values()) > self.deadline
+            if self.periodic:
+                return now - self._last > self.deadline
+            return False
+
+    def state(self, now: float) -> dict:
+        """Snapshot row for ``/debug/health``."""
+        with self._lock:
+            busy = (round(now - min(self._tasks.values()), 3)
+                    if self._tasks else None)
+            return {"name": self.name, "deadline_s": self.deadline,
+                    "periodic": self.periodic,
+                    "age_s": round(now - self._last, 3),
+                    "busy_s": busy}
+
+    def close(self) -> None:
+        """Unregister: a stopped loop must not read as a stalled one."""
+        with self._lock:
+            self._closed = True
+        self._owner.unregister(self)
+
+
+class Watchdog:
+    """Single checker over all registered heartbeats.
+
+    :meth:`check` is the unit of progress — call it from a test with an
+    injectable clock, or let :meth:`start` run it on a background
+    thread in production. A heartbeat crossing its deadline triggers,
+    exactly once per stall episode: an all-thread stack dump into the
+    flight recorder (kind=``stall``), a ``tpu_watchdog_stalls_total``
+    bump, a ``WatchdogStall`` Kubernetes Event (when an emitter is
+    configured, :mod:`dpu_operator_tpu.k8s.events`), and membership in
+    :meth:`degraded_components` until the heartbeat resumes.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._beats: list[Heartbeat] = []
+        self._stalled: "set[Heartbeat]" = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def register(self, name: str, deadline: float,
+                 periodic: bool = True) -> Heartbeat:
+        hb = Heartbeat(name, deadline, self, periodic=periodic)
+        with self._lock:
+            self._beats.append(hb)
+        return hb
+
+    def unregister(self, hb: Heartbeat) -> None:
+        with self._lock:
+            if hb in self._beats:
+                self._beats.remove(hb)
+            self._stalled.discard(hb)
+
+    def check(self) -> tuple[list[Heartbeat], list[Heartbeat]]:
+        """One detection pass → (newly stalled, newly recovered)."""
+        now = self.clock()
+        with self._lock:
+            beats = list(self._beats)
+        stalled: list[Heartbeat] = []
+        recovered: list[Heartbeat] = []
+        for hb in beats:
+            overdue = hb.overdue(now)
+            with self._lock:
+                was = hb in self._stalled
+                if overdue and not was:
+                    self._stalled.add(hb)
+                    stalled.append(hb)
+                elif not overdue and was:
+                    self._stalled.discard(hb)
+                    recovered.append(hb)
+        for hb in stalled:
+            self._on_stall(hb, now)
+        for hb in recovered:
+            self._on_recover(hb)
+        return stalled, recovered
+
+    def _on_stall(self, hb: Heartbeat, now: float) -> None:
+        state = hb.state(now)
+        silent_s = (state["busy_s"] if state["busy_s"] is not None
+                    else state["age_s"])
+        # "overdue" = time PAST the deadline, not the total silence: a
+        # 61s-silent heartbeat with a 60s deadline is 1s overdue
+        overdue_s = round(max(float(silent_s) - hb.deadline, 0.0), 3)
+        metrics.WATCHDOG_STALLS.inc(component=hb.name)
+        # the dump goes into the bounded flight ring: truncated so one
+        # stall cannot evict the whole history it is meant to explain
+        flight.record("stall", hb.name, attributes={
+            "deadline_s": str(hb.deadline),
+            "overdue_s": str(overdue_s),
+            "stacks": dump_all_stacks()})
+        log.error("watchdog: %s stalled (%.1fs past its %.1fs deadline); "
+                  "all-thread stacks recorded in the flight ring",
+                  hb.name, overdue_s, hb.deadline)
+        emit_health_event("WatchdogStall",
+                          f"component {hb.name} stalled: no heartbeat "
+                          f"within its {hb.deadline:g}s deadline "
+                          f"({overdue_s}s overdue); all-thread stack "
+                          "dump in the flight recorder (kind=stall)",
+                          "Warning", series=hb.name)
+
+    def _on_recover(self, hb: Heartbeat) -> None:
+        flight.record("stall", hb.name,
+                      attributes={"recovered": "true"})
+        log.warning("watchdog: %s recovered (heartbeat resumed)",
+                    hb.name)
+        emit_health_event("WatchdogRecovered",
+                          f"component {hb.name} recovered: heartbeat "
+                          "resumed", "Normal", series=hb.name)
+
+    def degraded_components(self) -> list[str]:
+        with self._lock:
+            return sorted({hb.name for hb in self._stalled})
+
+    def snapshot(self) -> list[dict]:
+        """Per-heartbeat state rows for ``/debug/health``."""
+        now = self.clock()
+        with self._lock:
+            beats = list(self._beats)
+            stalled = set(self._stalled)
+        rows = []
+        for hb in beats:
+            row = hb.state(now)
+            row["stalled"] = hb in stalled
+            rows.append(row)
+        return sorted(rows, key=lambda r: str(r["name"]))
+
+    def start(self, interval: float = 1.0) -> None:
+        """Idempotent: run :meth:`check` every *interval* seconds on a
+        daemon thread (production; tests call :meth:`check` directly)."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, args=(interval,), daemon=True,
+                name="watchdog")
+            thread = self._thread
+        thread.start()
+
+    def _run(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.check()
+            except Exception:  # noqa: BLE001 — the watchdog itself
+                # must outlive a bad heartbeat snapshot
+                log.exception("watchdog check pass failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
+
+
+#: process-global watchdog (the REGISTRY/RECORDER analog): loops
+#: register here unless they are handed an explicit instance
+WATCHDOG = Watchdog()
+
+
+def register(name: str, deadline: float,
+             periodic: bool = True) -> Heartbeat:
+    """Register on the global watchdog (see :meth:`Watchdog.register`)."""
+    return WATCHDOG.register(name, deadline, periodic=periodic)
+
+
+def task(heartbeat: Optional[Heartbeat]) -> ContextManager[None]:
+    """``heartbeat.task()`` — or a no-op scope when no heartbeat is
+    registered (bare servers in unit tests): the one guard every
+    task-scoped call site shares."""
+    if heartbeat is None:
+        return contextlib.nullcontext()
+    return heartbeat.task()
+
+
+def emit_health_event(reason: str, message: str, type_: str,
+                      series: str = "") -> None:
+    """Shared health-engine Event emitter (watchdog + SLO): lazy import
+    — k8s.events pulls in the k8s package, and this module must stay
+    importable from anything (flight.py does the same for tracing) —
+    and swallow-with-log, because event emission is best-effort by
+    contract. events.emit is a no-op until a recorder is configured."""
+    try:
+        from ..k8s import events
+        events.emit(reason, message, type_=type_, series=series)
+    except Exception:  # noqa: BLE001 — event emission is best-effort
+        log.debug("health event emission failed", exc_info=True)

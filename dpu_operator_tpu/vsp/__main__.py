@@ -70,7 +70,19 @@ def main(argv=None):
     server = VspServer(impl, sock)
     server.start()
     logging.info("VSP serving on %s", sock)
-    stop.wait()
+    # health engine: real stall coverage comes from the task-scoped
+    # vsp.rpc heartbeat VspServer wraps around every handler (a wedged
+    # handler is detected and stack-dumped); vsp.serve below only
+    # attests the main thread's stop-loop — process liveness, not
+    # serving capacity
+    from ..utils import watchdog
+    watchdog.WATCHDOG.start()
+    heartbeat = watchdog.register("vsp.serve", deadline=30.0)
+    try:
+        while not stop.wait(2.0):
+            heartbeat.beat()
+    finally:
+        heartbeat.close()
     server.stop()
     if agent_proc:
         agent_proc.stop()

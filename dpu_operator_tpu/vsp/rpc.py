@@ -19,7 +19,7 @@ from typing import Callable, Optional
 
 import grpc
 
-from ..utils import tracing
+from ..utils import tracing, watchdog
 
 log = logging.getLogger(__name__)
 
@@ -89,6 +89,14 @@ class VspServer:
         self.tcp_addr = tcp_addr
         self._server: Optional[grpc.Server] = None
         self.bound_port: Optional[int] = None
+        #: task-scoped watchdog heartbeat over the RPC handler pool: a
+        #: handler wedged past the deadline (deadlocked impl, hung
+        #: dataplane call) is a genuine stall — idle is healthy
+        self._heartbeat = None
+
+    #: an RPC handler running longer than this is stalled (clients give
+    #: up at 30 s; 2x leaves room for the long admin calls)
+    HANDLER_DEADLINE = 60.0
 
     def start(self):
         if self.socket_path:
@@ -113,11 +121,16 @@ class VspServer:
                         if key == tracing.TRACEPARENT_HEADER:
                             tp = value
                     ctx = tracing.extract_traceparent(tp)
-                    with tracing.context_scope(ctx), \
+                    with watchdog.task(self._heartbeat), \
+                            tracing.context_scope(ctx), \
                             tracing.span(f"vsp.{svc}.{rpc}"):
                         return fn(request) or {}
                 return handler
             methods[f"/tpuvsp.{svc}/{rpc}"] = wrap()
+        if self._heartbeat is None:
+            self._heartbeat = watchdog.register(
+                "vsp.rpc", deadline=self.HANDLER_DEADLINE,
+                periodic=False)
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers((_GenericHandler(methods),))
         try:
@@ -183,6 +196,9 @@ class VspServer:
         if self._server:
             self._server.stop(grace).wait()
             self._server = None
+        if self._heartbeat is not None:
+            self._heartbeat.close()
+            self._heartbeat = None
 
 
 class VspChannel:

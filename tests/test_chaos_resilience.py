@@ -435,8 +435,11 @@ def test_open_breaker_surfaces_degraded_condition_on_sfc(kube):
 
 
 def test_healthz_reports_degraded_sites_while_breaker_open():
-    """Operators see degradation on /healthz (still 200 — alive and
-    partially serving), not discover it from missing wires."""
+    """Operators see degradation on /healthz as a structured JSON
+    component breakdown (still 200 — alive and partially serving, and
+    kubelet probes only read the status code), not discover it from
+    missing wires."""
+    import json
     import urllib.request
 
     sites = ["vsp"]
@@ -447,7 +450,8 @@ def test_healthz_reports_degraded_sites_while_breaker_open():
         url = f"http://127.0.0.1:{srv.port}/healthz"
         with urllib.request.urlopen(url) as r:
             assert r.status == 200
-            assert r.read() == b"degraded: vsp"
+            assert json.loads(r.read()) == {"status": "degraded",
+                                            "components": ["vsp"]}
         sites.clear()
         with urllib.request.urlopen(url) as r:
             assert r.read() == b"ok"

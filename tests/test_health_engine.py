@@ -1,0 +1,686 @@
+"""Health engine e2e + units (`make health-check`).
+
+Deterministic by construction: every time-dependent assertion advances
+an injectable clock (no wall-clock sleeps); the only waits are on real
+thread signals with bounded timeouts. The flagship scenarios the
+acceptance bar names:
+
+- a deliberately stalled reconciler is detected by the watchdog within
+  its deadline, its all-thread stack dump lands in the flight recorder
+  (kind=``stall``) and is retrievable via ``tpuctl flight --kind
+  stall``, and the corresponding Kubernetes Event and CR ``Degraded``
+  condition appear on the fake apiserver;
+- a seeded error storm fires, then clears, the kube-client burn-rate
+  alert.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import urllib.request
+
+import pytest
+
+from dpu_operator_tpu.k8s import events
+from dpu_operator_tpu.k8s.fake import FakeKube
+from dpu_operator_tpu.k8s.manager import Manager, ReconcileResult
+from dpu_operator_tpu.utils import flight, metrics, resilience, slo, watchdog
+
+pytestmark = pytest.mark.health
+
+
+class Clock:
+    """Injectable monotone clock."""
+
+    def __init__(self, now: float = 0.0) -> None:
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+@pytest.fixture(autouse=True)
+def _reset_event_seam():
+    events.flush()  # drain any stragglers before stealing the seam
+    events.reset()
+    yield
+    events.flush()  # don't let this test's emissions leak forward
+    events.reset()
+
+
+# -- watchdog -----------------------------------------------------------------
+
+def test_periodic_heartbeat_stall_and_recovery_lifecycle():
+    clock = Clock()
+    dog = watchdog.Watchdog(clock=clock)
+    hb = dog.register("loop-a", deadline=5.0)
+    hb.beat()
+    assert dog.check() == ([], [])
+    assert dog.degraded_components() == []
+
+    before = metrics.WATCHDOG_STALLS.value(component="loop-a")
+    clock.advance(6.0)
+    stalled, recovered = dog.check()
+    assert [h.name for h in stalled] == ["loop-a"] and recovered == []
+    # exactly once per episode
+    assert dog.check() == ([], [])
+    assert metrics.WATCHDOG_STALLS.value(component="loop-a") == before + 1
+    assert dog.degraded_components() == ["loop-a"]
+    dumps = [e for e in flight.RECORDER.events(kind="stall")
+             if e["name"] == "loop-a" and "stacks" in e["attributes"]]
+    assert dumps, "stall must dump all-thread stacks into the flight ring"
+    assert "-- thread" in dumps[-1]["attributes"]["stacks"]
+    # overdue = time PAST the deadline (6s silent, 5s deadline -> 1s)
+    assert dumps[-1]["attributes"]["overdue_s"] == "1.0"
+
+    hb.beat()
+    stalled, recovered = dog.check()
+    assert stalled == [] and [h.name for h in recovered] == ["loop-a"]
+    assert dog.degraded_components() == []
+    hb.close()
+    assert dog.snapshot() == []
+
+
+def test_task_scoped_heartbeat_only_stalls_while_busy():
+    clock = Clock()
+    dog = watchdog.Watchdog(clock=clock)
+    hb = dog.register("worker", deadline=2.0, periodic=False)
+    # idle forever is healthy
+    clock.advance(1000.0)
+    assert dog.check() == ([], [])
+    # a task outliving the deadline is a stall; finishing recovers
+    cm = hb.task()
+    cm.__enter__()
+    clock.advance(3.0)
+    stalled, _ = dog.check()
+    assert [h.name for h in stalled] == ["worker"]
+    cm.__exit__(None, None, None)
+    _, recovered = dog.check()
+    assert [h.name for h in recovered] == ["worker"]
+
+
+def test_concurrent_tasks_oldest_governs():
+    clock = Clock()
+    dog = watchdog.Watchdog(clock=clock)
+    hb = dog.register("pool", deadline=10.0, periodic=False)
+    old = hb.task()
+    old.__enter__()
+    clock.advance(8.0)
+    fresh = hb.task()
+    fresh.__enter__()
+    clock.advance(4.0)  # old task now 12s > deadline; fresh only 4s
+    stalled, _ = dog.check()
+    assert [h.name for h in stalled] == ["pool"]
+    old.__exit__(None, None, None)
+    _, recovered = dog.check()  # fresh task alone is within deadline
+    assert [h.name for h in recovered] == ["pool"]
+    fresh.__exit__(None, None, None)
+
+
+def test_stack_dump_truncates_to_limit():
+    dump = watchdog.dump_all_stacks(limit=200)
+    assert "-- thread" in dump
+    assert len(dump) <= 200 + len("\n... [truncated 99999999 chars]")
+    assert "[truncated" in dump
+    full = watchdog.dump_all_stacks()
+    assert len(full) <= watchdog.MAX_DUMP_CHARS + 64
+
+
+# -- flight-recorder capacity (satellite) -------------------------------------
+
+def test_flight_capacity_from_env_accepts_bounded_values():
+    assert flight.capacity_from_env({}) == flight.DEFAULT_CAPACITY
+    assert flight.capacity_from_env({"TPU_FLIGHT_CAPACITY": "64"}) == 64
+    assert flight.capacity_from_env(
+        {"TPU_FLIGHT_CAPACITY": str(flight.MAX_CAPACITY)}) \
+        == flight.MAX_CAPACITY
+
+
+@pytest.mark.parametrize("bad", ["zilch", "-5", "0", "1e9", "999999999"])
+def test_flight_capacity_bad_values_fall_back_with_warning(bad, caplog):
+    with caplog.at_level(logging.WARNING,
+                         logger="dpu_operator_tpu.utils.flight"):
+        assert flight.capacity_from_env(
+            {"TPU_FLIGHT_CAPACITY": bad}) == flight.DEFAULT_CAPACITY
+    assert any("TPU_FLIGHT_CAPACITY" in r.message for r in caplog.records)
+
+
+def test_flight_ring_respects_configured_capacity():
+    ring = flight.FlightRecorder(
+        flight.capacity_from_env({"TPU_FLIGHT_CAPACITY": "32"}))
+    for i in range(100):
+        ring.record("span", f"e{i}")
+    snap = ring.snapshot()
+    assert snap["capacity"] == 32
+    assert len(snap["events"]) == 32 and snap["recorded"] == 100
+
+
+def test_stall_dump_fits_flight_ring():
+    """A recorded stall dump is truncated (MAX_DUMP_CHARS), so even a
+    minimum-capacity ring holds it plus history."""
+    clock = Clock()
+    dog = watchdog.Watchdog(clock=clock)
+    hb = dog.register("fat-stack", deadline=1.0)
+    clock.advance(5.0)
+    dog.check()
+    dump = [e for e in flight.RECORDER.events(kind="stall")
+            if e["name"] == "fat-stack"][-1]
+    assert len(dump["attributes"]["stacks"]) <= watchdog.MAX_DUMP_CHARS + 64
+    hb.close()
+
+
+# -- /healthz + /debug/health (satellite + tentpole) --------------------------
+
+def test_healthz_degraded_body_is_structured_json():
+    sites = ["vsp", "daemon.detect"]
+    srv = metrics.MetricsServer(host="127.0.0.1", port=0,
+                                degraded_check=lambda: sites)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/healthz"
+        with urllib.request.urlopen(url) as r:
+            assert r.status == 200  # alive-and-partially-serving
+            assert r.headers.get("Content-Type") == "application/json"
+            body = json.loads(r.read())
+        assert body == {"status": "degraded",
+                        "components": ["daemon.detect", "vsp"]}
+        sites.clear()
+        with urllib.request.urlopen(url) as r:
+            assert r.status == 200 and r.read() == b"ok"
+    finally:
+        srv.stop()
+
+
+def test_debug_health_serves_snapshot_and_404s_unconfigured():
+    snap = {"healthy": False,
+            "components": {"vsp": {"healthy": False,
+                                   "reasons": ["CircuitBreakerOpen"]}}}
+    srv = metrics.MetricsServer(host="127.0.0.1", port=0,
+                                health_check=lambda: snap)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/debug/health"
+        with urllib.request.urlopen(url) as r:
+            assert json.loads(r.read()) == snap
+    finally:
+        srv.stop()
+    srv = metrics.MetricsServer(host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/debug/health"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url)
+        assert err.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_tpuctl_health_renders_snapshot():
+    snap = {"healthy": True, "components": {}}
+    srv = metrics.MetricsServer(host="127.0.0.1", port=0,
+                                health_check=lambda: snap)
+    srv.start()
+    try:
+        from dpu_operator_tpu import tpuctl
+        out = tpuctl.run(argparse.Namespace(
+            cmd="health", metrics_addr=f"127.0.0.1:{srv.port}", token=""))
+        assert out == snap
+    finally:
+        srv.stop()
+
+
+# -- Event recorder (tentpole piece 3) ----------------------------------------
+
+def test_event_recorder_dedup_bumps_count(kube):
+    clock = Clock(1000.0)
+    rec = events.EventRecorder(kube, component="tpu-daemon", clock=clock)
+    ref = events.node_reference("worker-0")
+    first = rec.emit(ref, "BreakerOpen", "breaker vsp opened",
+                     type_="Warning")
+    assert first["count"] == 1 and first["type"] == "Warning"
+    assert first["source"] == {"component": "tpu-daemon"}
+    clock.advance(60.0)
+    second = rec.emit(ref, "BreakerOpen", "breaker vsp opened",
+                      type_="Warning")
+    stored = kube.list("v1", "Event")
+    assert len(stored) == 1
+    assert second["count"] == 2
+    assert second["lastTimestamp"] == 1060.0
+    assert second["firstTimestamp"] == 1000.0
+    # the MESSAGE is not part of the dedup key (it carries volatile
+    # detail — overdue seconds, burn rates): same reason+series bumps
+    # the same Event and the latest message wins
+    third = rec.emit(ref, "BreakerOpen", "breaker vsp opened (again)",
+                     type_="Warning")
+    assert len(kube.list("v1", "Event")) == 1
+    assert third["count"] == 3
+    assert third["message"] == "breaker vsp opened (again)"
+    # a different SERIES discriminator is a separate stream
+    rec.emit(ref, "BreakerOpen", "breaker kube opened", type_="Warning",
+             series="kube.pool")
+    assert len(kube.list("v1", "Event")) == 2
+
+
+def test_event_recorder_dedups_across_process_restart(kube):
+    """The Event name is a deterministic hash of the series key: a
+    restarted daemon bumps the same Event (AlreadyExists -> bump)
+    instead of minting a parallel series."""
+    ref = events.node_reference("worker-0")
+    events.EventRecorder(kube, "d").emit(ref, "ChainRepaired", "hop 0")
+    fresh = events.EventRecorder(kube, "d")  # empty in-memory cache
+    bumped = fresh.emit(ref, "ChainRepaired", "hop 0")
+    assert bumped["count"] == 2
+    assert len(kube.list("v1", "Event")) == 1
+
+
+def test_event_recorder_never_raises(kube):
+    class Boom:
+        def get(self, *a, **k):
+            raise RuntimeError("apiserver down")
+
+        def create(self, obj):
+            raise RuntimeError("apiserver down")
+
+    rec = events.EventRecorder(Boom(), "d")
+    assert rec.emit(events.node_reference("n"), "R", "m") is None
+
+
+def test_global_emitter_noop_until_configured(kube):
+    events.emit("WatchdogStall", "nothing happens")
+    events.flush()
+    assert kube.list("v1", "Event") == []
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("worker-0"))
+    events.emit("WatchdogStall", "component x stalled", type_="Warning")
+    events.flush()  # emission is async (dispatcher thread)
+    stored = kube.list("v1", "Event")
+    assert len(stored) == 1 and stored[0]["reason"] == "WatchdogStall"
+    assert stored[0]["involvedObject"]["name"] == "worker-0"
+
+
+def test_breaker_transitions_emit_deduplicated_events(kube):
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("worker-0"))
+    clock = Clock()
+    breaker = resilience.CircuitBreaker("t.events-seam",
+                                        failure_threshold=1,
+                                        reset_timeout=5.0, clock=clock)
+    breaker.record_failure()  # -> open
+    resilience.flush_transition_listeners()
+    events.flush()  # the bridge listener itself emits asynchronously
+    reasons = {e["reason"]: e for e in kube.list("v1", "Event")}
+    assert "BreakerOpen" in reasons
+    assert "t.events-seam" in reasons["BreakerOpen"]["message"]
+    clock.advance(6.0)
+    assert breaker.state == resilience.CircuitBreaker.HALF_OPEN
+    breaker.record_success()  # probe succeeded -> closed
+    resilience.flush_transition_listeners()
+    events.flush()
+    reasons = {e["reason"] for e in kube.list("v1", "Event")}
+    assert reasons == {"BreakerOpen", "BreakerClosed"}
+
+
+def test_repeated_stall_episodes_bump_one_event(kube):
+    """The stall message carries per-episode overdue seconds; dedup
+    keys on the component (series), so a loop flapping all night is
+    ONE Event with a rising count, not a flood."""
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("worker-0"))
+    clock = Clock()
+    dog = watchdog.Watchdog(clock=clock)
+    hb = dog.register("flappy", deadline=1.0)
+    for _ in range(3):
+        clock.advance(5.0)
+        dog.check()       # stall (different overdue_s each episode)
+        hb.beat()
+        dog.check()       # recover
+    events.flush()
+    stalls = [e for e in kube.list("v1", "Event")
+              if e["reason"] == "WatchdogStall"]
+    assert len(stalls) == 1 and stalls[0]["count"] == 3
+    recoveries = [e for e in kube.list("v1", "Event")
+                  if e["reason"] == "WatchdogRecovered"]
+    assert len(recoveries) == 1 and recoveries[0]["count"] == 3
+    hb.close()
+
+
+def test_journal_recovery_emits_event(kube, tmp_path):
+    from dpu_operator_tpu.daemon.tpusidemanager import TpuSideManager
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("worker-0"))
+    path = str(tmp_path / "chains.json")
+    good = {"chains": [], "sandboxes": {}, "hops": []}
+    with open(path + ".last-good", "w") as f:
+        json.dump(good, f)
+    with open(path, "w") as f:
+        f.write('{"chains": [truncated')  # corrupt primary
+    assert TpuSideManager._load_journal(path) == good
+    events.flush()
+    stored = kube.list("v1", "Event")
+    assert [e["reason"] for e in stored] == ["JournalRecovered"]
+    assert stored[0]["type"] == "Warning"
+
+
+# -- SLO burn-rate engine -----------------------------------------------------
+
+def _fast_rules():
+    """SRE thresholds over shrunken windows (injectable clock makes the
+    absolute durations irrelevant; the pairing logic is what's under
+    test)."""
+    return (
+        slo.AlertRule("page", (slo.BurnWindow("5m", 30.0, 14.4),
+                               slo.BurnWindow("1h", 360.0, 14.4))),
+    )
+
+
+def test_slo_rejects_window_label_reuse_across_rules():
+    """Burn rates are keyed by window label: reusing a label for a
+    different duration would evaluate one rule's threshold against the
+    other rule's window — rejected at construction."""
+    rules = (
+        slo.AlertRule("page", (slo.BurnWindow("1h", 3600.0, 14.4),)),
+        slo.AlertRule("ticket", (slo.BurnWindow("1h", 21600.0, 6.0),)),
+    )
+    with pytest.raises(ValueError, match="reused with a different"):
+        slo.Slo("t", "comp", 0.99, lambda: 0.0, lambda: 0.0,
+                rules=rules)
+
+
+def test_burn_rate_math_over_windows():
+    clock = Clock()
+    ev = slo.SloEvaluator(clock=clock)
+    bad, total = [0.0], [0.0]
+    s = ev.add(slo.Slo("t", "comp", 0.99, lambda: total[0],
+                       lambda: bad[0], rules=_fast_rules()))
+    assert s.error_budget == pytest.approx(0.01)
+    # 10 ticks of 100% good traffic -> burn 0 everywhere
+    for _ in range(10):
+        clock.advance(10.0)
+        total[0] += 100
+        state = ev.evaluate()["t"]
+    assert state["burn_rates"] == {"5m": 0.0, "1h": 0.0}
+    # 2% bad traffic -> burn 2.0 on the short window
+    for _ in range(3):
+        clock.advance(10.0)
+        total[0] += 100
+        bad[0] += 2
+        state = ev.evaluate()["t"]
+    assert state["burn_rates"]["5m"] == pytest.approx(2.0)
+    assert ev.active_alerts() == []
+
+
+def test_seeded_error_storm_fires_then_clears_kube_client_alert(kube):
+    """The acceptance-bar scenario: a seeded storm of slow/erroring
+    apiserver requests fires the kube-client page alert (both windows
+    over 14.4x), the storm ends, traffic goes clean, the alert clears
+    — Events emitted on both edges."""
+    import random
+    rng = random.Random(7)
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("worker-0"))
+    clock = Clock()
+    ev = slo.SloEvaluator(clock=clock)
+    target = [s for s in slo.default_slos(rules=_fast_rules())
+              if s.name == "kube-client"][0]
+    ev.add(target)
+    verbs = ("get", "list", "create", "update")
+
+    def tick(bad_fraction):
+        clock.advance(5.0)
+        for _ in range(20):
+            verb = verbs[rng.randrange(len(verbs))]
+            slow = rng.random() < bad_fraction
+            metrics.KUBE_REQUEST_SECONDS.observe(
+                verb, 2.0 if slow else 0.002)
+        return ev.evaluate()["kube-client"]
+
+    # clean baseline
+    for _ in range(8):
+        state = tick(0.0)
+    assert ev.active_alerts() == []
+    # the storm: ~60% of requests slow -> burn ~120x the 0.5% budget
+    for _ in range(80):
+        state = tick(0.6)
+    assert ("kube-client", "page") in ev.active_alerts(), state
+    assert metrics.SLO_ALERT_ACTIVE.value(
+        slo="kube-client", severity="page") == 1.0
+    events.flush()
+    firing = [e for e in kube.list("v1", "Event")
+              if e["reason"] == "SloAlertFiring"]
+    assert firing and "kube-client" in firing[0]["message"]
+    # storm over: clean traffic slides both windows past the storm
+    for _ in range(100):
+        state = tick(0.0)
+    assert ev.active_alerts() == [], state
+    assert metrics.SLO_ALERT_ACTIVE.value(
+        slo="kube-client", severity="page") == 0.0
+    events.flush()
+    assert any(e["reason"] == "SloAlertCleared"
+               for e in kube.list("v1", "Event"))
+    # the edge transitions are flight-recorded too
+    kinds = [e["attributes"]["state"]
+             for e in flight.RECORDER.events(kind="slo")
+             if e["name"] == "kube-client"]
+    assert "firing" in kinds and "cleared" in kinds
+
+
+def test_multiwindow_requires_both_windows():
+    """A short blip exceeds the 5m window but not the 1h window: no
+    page (the long window is what separates storms from blips)."""
+    clock = Clock()
+    ev = slo.SloEvaluator(clock=clock)
+    bad, total = [0.0], [0.0]
+    ev.add(slo.Slo("t", "comp", 0.99, lambda: total[0], lambda: bad[0],
+                   rules=_fast_rules()))
+    # long clean history fills the 1h window
+    for _ in range(72):
+        clock.advance(5.0)
+        total[0] += 100
+        ev.evaluate()
+    # one 20s blip of 50% bad: 5m burn huge, 1h burn diluted under 14.4
+    for _ in range(4):
+        clock.advance(5.0)
+        total[0] += 100
+        bad[0] += 50
+        state = ev.evaluate()["t"]
+    assert state["burn_rates"]["5m"] > 14.4
+    assert state["burn_rates"]["1h"] < 14.4
+    assert ev.active_alerts() == []
+
+
+def test_health_snapshot_aggregates_watchdog_breakers_slo():
+    clock = Clock()
+    dog = watchdog.Watchdog(clock=clock)
+    hb = dog.register("daemon.detect", deadline=1.0)
+    clock.advance(5.0)
+    dog.check()
+    ev = slo.SloEvaluator(clock=clock)
+    bad, total = [0.0], [0.0]
+    ev.add(slo.Slo("t-slo", "t-comp", 0.99, lambda: total[0],
+                   lambda: bad[0], rules=_fast_rules()))
+    for _ in range(10):
+        clock.advance(40.0)
+        total[0] += 10
+        bad[0] += 9
+        ev.evaluate()
+    breaker = resilience.CircuitBreaker("t.snapshot-seam",
+                                        failure_threshold=1, clock=clock)
+    breaker.record_failure()
+    snap = slo.health_snapshot(watchdog=dog, evaluator=ev)
+    assert snap["healthy"] is False
+    comps = snap["components"]
+    assert comps["daemon.detect"]["reasons"][0].startswith("WatchdogStall")
+    assert comps["t.snapshot-seam"]["reasons"] == ["CircuitBreakerOpen"]
+    assert any(r.startswith("SloAlert:t-slo")
+               for r in comps["t-comp"]["reasons"])
+    assert snap["breakers"]["t.snapshot-seam"] == "open"
+    assert snap["slo"]["t-slo"]["alerts"]["page"] is True
+    hb.close()
+    breaker.record_success()
+
+
+# -- the flagship e2e: stall a reconciler on purpose --------------------------
+
+class _BlockingReconciler:
+    watches = ("config.tpu.openshift.io/v1", "ServiceFunctionChain")
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.gate = threading.Event()
+
+    def reconcile(self, client, req):
+        self.entered.set()
+        assert self.gate.wait(timeout=30.0), "test forgot to open gate"
+        return ReconcileResult()
+
+
+def test_stalled_reconciler_detected_evented_and_conditioned(
+        kube, images, tmp_path, monkeypatch):
+    clock = Clock()
+    dog = watchdog.Watchdog(clock=clock)
+    monkeypatch.setattr(watchdog, "WATCHDOG", dog)
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("worker-0"))
+
+    blocker = _BlockingReconciler()
+    mgr = Manager(kube)
+    mgr.add_reconciler(blocker)
+    mgr.start()
+    try:
+        kube.create({"apiVersion": "config.tpu.openshift.io/v1",
+                     "kind": "ServiceFunctionChain",
+                     "metadata": {"name": "stuck", "namespace": "default"},
+                     "spec": {"networkFunctions": []}})
+        assert blocker.entered.wait(timeout=10.0)
+        # the worker is now wedged inside reconcile(); cross the deadline
+        clock.advance(Manager.STALL_DEADLINE + 1.0)
+        stalled, _ = dog.check()
+        assert [h.name for h in stalled] == ["manager.worker"]
+
+        # 1) stack dump in the flight ring, naming the wedged frame
+        dumps = [e for e in flight.RECORDER.events(kind="stall")
+                 if e["name"] == "manager.worker"
+                 and "stacks" in e.get("attributes", {})]
+        assert dumps and "reconcile" in dumps[-1]["attributes"]["stacks"]
+
+        # 2) retrievable via `tpuctl flight --kind stall`
+        srv = metrics.MetricsServer(host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            from dpu_operator_tpu import tpuctl
+            out = tpuctl.run(argparse.Namespace(
+                cmd="flight", metrics_addr=f"127.0.0.1:{srv.port}",
+                trace="", kind="stall", token=""))
+        finally:
+            srv.stop()
+        assert any(e["name"] == "manager.worker"
+                   and "stacks" in e.get("attributes", {})
+                   for e in out["events"])
+
+        # 3) Kubernetes Event on the fake apiserver (async dispatch)
+        events.flush()
+        stall_events = [e for e in kube.list("v1", "Event")
+                        if e["reason"] == "WatchdogStall"]
+        assert stall_events and "manager.worker" in \
+            stall_events[0]["message"]
+        # a second stall episode of the same component bumps the SAME
+        # Event (volatile overdue-seconds in the message must not mint
+        # a parallel series)
+        assert stall_events[0]["count"] == 1
+
+        # 4) Degraded condition folded onto the CR by the controller
+        from dpu_operator_tpu.controller import TpuOperatorConfigReconciler
+        from dpu_operator_tpu.api import (TpuOperatorConfig,
+                                          TpuOperatorConfigSpec)
+        from dpu_operator_tpu.k8s.manager import Request
+        from dpu_operator_tpu.utils.filesystem_mode_detector import (
+            FilesystemModeDetector)
+        from dpu_operator_tpu.utils.path_manager import PathManager
+        kube.create(TpuOperatorConfig(
+            spec=TpuOperatorConfigSpec(mode="host")).to_obj())
+        ev = slo.SloEvaluator(clock=clock)
+        rec = TpuOperatorConfigReconciler(
+            images, path_manager=PathManager(str(tmp_path)),
+            fs_detector=FilesystemModeDetector(str(tmp_path)),
+            health_provider=lambda: slo.health_snapshot(
+                watchdog=dog, evaluator=ev))
+        rec.reconcile(kube, Request("config.tpu.openshift.io/v1",
+                                    "TpuOperatorConfig",
+                                    "tpu-operator-config"))
+        obj = kube.get("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                       "tpu-operator-config")
+        conds = {c["type"]: c for c in obj["status"]["conditions"]}
+        assert conds["Healthy"]["status"] == "False"
+        assert conds["Degraded"]["status"] == "True"
+        assert "manager.worker" in conds["Degraded"]["message"]
+        assert any(e["reason"] == "OperatorDegraded"
+                   for e in kube.list("v1", "Event"))
+
+        # release the reconciler: recovery clears everything
+        blocker.gate.set()
+        assert mgr.wait_idle(timeout=10.0)
+        _, recovered = dog.check()
+        assert [h.name for h in recovered] == ["manager.worker"]
+        events.flush()
+        assert any(e["reason"] == "WatchdogRecovered"
+                   for e in kube.list("v1", "Event"))
+        rec.reconcile(kube, Request("config.tpu.openshift.io/v1",
+                                    "TpuOperatorConfig",
+                                    "tpu-operator-config"))
+        obj = kube.get("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                       "tpu-operator-config")
+        conds = {c["type"]: c for c in obj["status"]["conditions"]}
+        assert conds["Healthy"]["status"] == "True"
+        assert any(e["reason"] == "OperatorHealthy"
+                   for e in kube.list("v1", "Event"))
+    finally:
+        blocker.gate.set()
+        mgr.stop()
+
+
+def test_controller_health_conditions_with_injected_snapshot(
+        kube, images, tmp_path):
+    from dpu_operator_tpu.api import (TpuOperatorConfig,
+                                      TpuOperatorConfigSpec)
+    from dpu_operator_tpu.controller import TpuOperatorConfigReconciler
+    from dpu_operator_tpu.k8s.manager import Request
+    from dpu_operator_tpu.utils.filesystem_mode_detector import (
+        FilesystemModeDetector)
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    snap = {"healthy": True, "components": {}}
+    rec = TpuOperatorConfigReconciler(
+        images, path_manager=PathManager(str(tmp_path)),
+        fs_detector=FilesystemModeDetector(str(tmp_path)),
+        health_provider=lambda: snap)
+    kube.create(TpuOperatorConfig(
+        spec=TpuOperatorConfigSpec(mode="host")).to_obj())
+    req = Request("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                  "tpu-operator-config")
+    rec.reconcile(kube, req)
+    obj = kube.get("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                   "tpu-operator-config")
+    conds = {c["type"]: c for c in obj["status"]["conditions"]}
+    assert conds["Healthy"]["status"] == "True"
+    assert conds["Healthy"]["reason"] == "AllComponentsHealthy"
+    assert conds["Degraded"]["status"] == "False"
+    assert kube.list("v1", "Event") == []  # healthy->healthy: no Event
+
+    snap = {"healthy": False, "components": {
+        "vsp": {"healthy": False, "reasons": ["CircuitBreakerOpen"]},
+        "cni": {"healthy": True, "reasons": []}}}
+    rec.health_provider = lambda: snap
+    rec.reconcile(kube, req)
+    obj = kube.get("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                   "tpu-operator-config")
+    conds = {c["type"]: c for c in obj["status"]["conditions"]}
+    assert conds["Degraded"]["status"] == "True"
+    assert conds["Degraded"]["message"] == "vsp: CircuitBreakerOpen"
+    degraded = [e for e in kube.list("v1", "Event")
+                if e["reason"] == "OperatorDegraded"]
+    assert len(degraded) == 1 and degraded[0]["type"] == "Warning"

@@ -241,3 +241,84 @@ def test_drain_evicts_only_tpu_consumers(kube, node_agent):
 def test_drain_missing_node_raises(kube):
     with pytest.raises(KeyError):
         Drainer(kube).cordon("ghost")
+
+
+# -- render vs. observe storm (health-engine satellite) -----------------------
+
+#: one exposition line: comment, blank, or `name{labels} value [exemplar]`
+import re  # noqa: E402
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                  # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'  # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' -?[0-9.e+\-]+(?:inf|nan)?'                 # value
+    r'( # \{[^}]*\} -?[0-9.e+\-]+)?$')            # optional exemplar
+
+
+def _assert_grammar_valid(text, openmetrics):
+    lines = text.splitlines()
+    assert lines, "render produced nothing"
+    if openmetrics:
+        assert lines[-1] == "# EOF"
+        lines = lines[:-1]
+    for line in lines:
+        if not line or line.startswith("# HELP") \
+                or line.startswith("# TYPE"):
+            continue
+        assert _SAMPLE_RE.match(line), f"malformed sample line: {line!r}"
+
+
+def test_concurrent_render_vs_observe_storm_stays_grammar_valid():
+    """Seeded writer threads hammer Histogram.observe/Gauge.set while
+    the main thread renders both exposition formats: no exception, and
+    every intermediate render parses (a torn render corrupts the whole
+    scrape for real collectors)."""
+    import random
+    import threading
+
+    registry = Registry()
+    hist = registry.histogram("tpu_storm_seconds", "storm latencies")
+    gauge = registry.gauge("tpu_storm_level", "storm gauge")
+    counter = registry.counter("tpu_storm_total", "storm counter")
+    vec = registry.histogram_vec("tpu_storm_by_verb_seconds",
+                                 "per-verb storm", label="verb")
+    start = threading.Barrier(5)
+    errors = []
+
+    def writer(seed):
+        rng = random.Random(seed)
+        try:
+            start.wait(timeout=10)
+            for i in range(400):
+                v = rng.random() * 10
+                hist.observe(v, exemplar={"trace_id": f"{seed:032x}"}
+                             if rng.random() < 0.3 else None)
+                gauge.set(v, shard=str(seed))
+                counter.inc(result="ok" if rng.random() < 0.9
+                            else 'err"\\\n')  # hostile label value
+                vec.observe(("get", "list")[i % 2], v)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(seed,))
+               for seed in range(4)]
+    for t in threads:
+        t.start()
+    start.wait(timeout=10)
+    renders = []
+    for i in range(50):
+        om = i % 2 == 1
+        renders.append((registry.render(openmetrics=om), om))
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert all(not t.is_alive() for t in threads)
+    # final render plus every mid-storm render is grammar-valid
+    renders.append((registry.render(openmetrics=False), False))
+    renders.append((registry.render(openmetrics=True), True))
+    for text, om in renders:
+        _assert_grammar_valid(text, om)
+    # post-join totals are exact: nothing torn or lost
+    assert hist.count == 4 * 400
+    assert counter.total() == 4 * 400

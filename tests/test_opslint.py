@@ -12,6 +12,7 @@ import textwrap
 
 from dpu_operator_tpu.analysis import (ALL_CHECKERS,
                                        ChaosDeterminismChecker,
+                                       EventsSeamChecker,
                                        ExceptionHygieneChecker,
                                        LockDisciplineChecker,
                                        MetricsNamingChecker,
@@ -106,6 +107,49 @@ def test_trace_context_passes_on_inject_call_or_header_literal():
 
 def test_trace_context_ignores_non_seam_modules():
     assert check(TraceContextChecker(), "def f():\n    return 1\n") == []
+
+
+# -- events-seam --------------------------------------------------------------
+
+def test_events_seam_flags_raw_event_construction():
+    violations = check(EventsSeamChecker(), """
+        def alert(client, node):
+            client.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": "x"},
+                "involvedObject": {"kind": "Node", "name": node},
+                "reason": "Oops",
+            })
+    """)
+    assert [v.rule for v in violations] == ["events-seam"]
+    assert "k8s/events.py" in violations[0].message
+
+
+def test_events_seam_flags_event_dict_even_without_create():
+    # building the object at all is the violation: it WILL be fed to a
+    # client eventually, bypassing the dedup seam
+    violations = check(EventsSeamChecker(), """
+        EV = {"kind": "Event", "apiVersion": "v1"}
+    """)
+    assert [v.rule for v in violations] == ["events-seam"]
+
+
+def test_events_seam_allows_the_recorder_module_and_tests():
+    src = 'EV = {"kind": "Event", "apiVersion": "v1"}\n'
+    assert check(EventsSeamChecker(), src,
+                 relpath="dpu_operator_tpu/k8s/events.py") == []
+    assert check(EventsSeamChecker(), src,
+                 relpath="tests/test_x.py") == []
+
+
+def test_events_seam_ignores_other_kinds_and_dynamic_kind():
+    src = """
+        POD = {"kind": "Pod", "apiVersion": "v1"}
+        REF = {"kind": "Node", "name": "n"}
+        def mk(kind):
+            return {"kind": kind}
+    """
+    assert check(EventsSeamChecker(), src) == []
 
 
 # -- retry-discipline ---------------------------------------------------------

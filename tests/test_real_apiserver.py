@@ -179,23 +179,40 @@ def test_leader_lease_over_http(real_kube, apiserver, tmp_path):
     kube2 = RealKube(
         kubeconfig=apiserver.write_kubeconfig(str(tmp_path / "kc2")))
     acquired2 = threading.Event()
-    t = threading.Thread(
-        target=lambda: (kube2.acquire_leader_lease(
-            "tpu-operator-lock", namespace="default", lease_seconds=2,
-            poll=0.1, identity="contender", on_lost=lambda: None),
-            acquired2.set()),
-        daemon=True)
-    t.start()
-    time.sleep(1.0)
-    assert not acquired2.is_set()
-    assert not lost.is_set()
+    cancel2 = []  # the contender's renew-loop cancel fn, once acquired
 
-    # holder releases (stops renewing) → contender takes over after expiry
-    cancel()
-    assert acquired2.wait(10.0)
-    lease = real_kube.get("coordination.k8s.io/v1", "Lease",
-                          "tpu-operator-lock", namespace="default")
-    assert lease["spec"]["holderIdentity"] == "contender"
+    def contend():
+        cancel2.append(kube2.acquire_leader_lease(
+            "tpu-operator-lock", namespace="default", lease_seconds=2,
+            poll=0.1, identity="contender", on_lost=lambda: None))
+        acquired2.set()
+
+    t = threading.Thread(target=contend, daemon=True)
+    t.start()
+    try:
+        time.sleep(1.0)
+        assert not acquired2.is_set()
+        assert not lost.is_set()
+
+        # holder releases (stops renewing) → contender takes over after
+        # expiry
+        cancel()
+        assert acquired2.wait(10.0)
+        lease = real_kube.get("coordination.k8s.io/v1", "Lease",
+                              "tpu-operator-lock", namespace="default")
+        assert lease["spec"]["holderIdentity"] == "contender"
+    finally:
+        cancel()  # idempotent: stop the holder even on early failure
+        # stop the CONTENDER's renew loop too: leaked, it keeps hitting
+        # the apiserver every lease_seconds/3 for the rest of the suite
+        # (and its kube.request spans pollute later tests' trace sinks).
+        # Join first: on an early assertion failure the contender may
+        # not have acquired YET — with the holder cancelled above it
+        # will within its poll interval, and cancelling before it does
+        # would miss the renew loop it then starts.
+        t.join(timeout=15.0)
+        for c in cancel2:
+            c()
 
 
 # -- the controller over the wire --------------------------------------------

@@ -135,7 +135,9 @@ def test_setup_race_opens_sink_exactly_once(tmp_path, monkeypatch):
         t.join(5)
     assert opens == [trace_file]
     records = [json.loads(l) for l in real_open(trace_file)]
-    assert len(records) == 8
+    # count only our racers: a background span (a lease renewer, a
+    # health-engine tick) landing in the sink window must not flake this
+    assert len([r for r in records if r["name"] == "racer"]) == 8
 
 
 def test_reconcile_emits_span(kube, tmp_path):
