@@ -4,8 +4,8 @@ REGISTRY ?= localhost:5000
 TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
-        lint-check type-check bench native traffic-flow images \
-        smoke-images deploy undeploy graft-check clean
+        upgrade-check lint-check type-check bench native traffic-flow \
+        images smoke-images deploy undeploy graft-check clean
 
 test: lint-check native
 	$(PYTHON) -m pytest tests/ -q
@@ -48,6 +48,17 @@ obs-check:
 # wall-clock sleeps: every assertion advances an injectable clock.
 health-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m health \
+	  -p no:randomly -p no:cacheprovider
+
+# zero-downtime upgrade gate (doc/architecture.md "Upgrades and state
+# handoff"): a full daemon->daemon live handoff under the chaos harness
+# must show zero pod sandbox re-setups, zero chain re-steers and zero
+# spurious kubelet device deletions; the kill-9-mid-transfer case must
+# recover via .last-good with a HandoffFallback flight entry and a
+# Degraded-then-Healthy transition; plus the blue-green VSP rollout's
+# stage/hold/promote machine. Seeded, no wall-clock sleeps.
+upgrade-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m upgrade \
 	  -p no:randomly -p no:cacheprovider
 
 # opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST

@@ -7,9 +7,10 @@ workflow are documented in doc/static-analysis.md.
 """
 
 from .checkers import (ChaosDeterminismChecker, EventsSeamChecker,
-                       ExceptionHygieneChecker, MetricsNamingChecker,
-                       RetryDisciplineChecker, TraceContextChecker,
-                       WireSeamChecker)
+                       ExceptionHygieneChecker,
+                       HandoffStateDisciplineChecker,
+                       MetricsNamingChecker, RetryDisciplineChecker,
+                       TraceContextChecker, WireSeamChecker)
 from .core import Baseline, Checker, Module, Violation, run_checkers
 from .lockcheck import LockDisciplineChecker
 
@@ -17,6 +18,7 @@ ALL_CHECKERS = (
     WireSeamChecker,
     TraceContextChecker,
     EventsSeamChecker,
+    HandoffStateDisciplineChecker,
     RetryDisciplineChecker,
     ExceptionHygieneChecker,
     MetricsNamingChecker,
@@ -27,7 +29,8 @@ ALL_CHECKERS = (
 __all__ = [
     "ALL_CHECKERS", "Baseline", "Checker", "Module", "Violation",
     "run_checkers", "WireSeamChecker", "TraceContextChecker",
-    "EventsSeamChecker", "RetryDisciplineChecker",
-    "ExceptionHygieneChecker", "MetricsNamingChecker",
-    "ChaosDeterminismChecker", "LockDisciplineChecker",
+    "EventsSeamChecker", "HandoffStateDisciplineChecker",
+    "RetryDisciplineChecker", "ExceptionHygieneChecker",
+    "MetricsNamingChecker", "ChaosDeterminismChecker",
+    "LockDisciplineChecker",
 ]

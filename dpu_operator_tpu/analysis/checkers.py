@@ -39,6 +39,10 @@ WIRE_SEAM_ALLOW = {
     "dpu_operator_tpu/utils/flight.py":
         "tpuctl's /debug/flight fetch (local metrics endpoint, no "
         "retry/breaker semantics apply to a diagnostics dump)",
+    "dpu_operator_tpu/daemon/handoff.py":
+        "daemon-to-daemon handoff unix socket on the same host (one "
+        "framed transfer; retries belong to the fallback path, not a "
+        "wire policy)",
 }
 
 _RAW_TRANSPORT_MODULES = {
@@ -183,6 +187,99 @@ class EventsSeamChecker(Checker):
                         "EventRecorder/events.emit so Events "
                         "deduplicate (count-bump) and carry one "
                         "source seam")
+
+
+# -- handoff-state-discipline -------------------------------------------------
+
+#: modules that own files under the daemon's state dirs (NetConf cache,
+#: chip-allocation locks, chain journal, handoff artifacts). A raw
+#: `open(path, "w")` there can be killed mid-write and leave a
+#: truncated file that poisons the next daemon's recovery/adoption —
+#: every write must ride utils/atomicfile.py (temp + fsync + atomic
+#: rename, or the hardlink claim).
+STATE_WRITER_MODULES = {
+    "dpu_operator_tpu/cni/cache.py":
+        "NetConf cache + chip-allocation locks",
+    "dpu_operator_tpu/cni/ipam.py":
+        "host-local IPAM lease files",
+    "dpu_operator_tpu/daemon/tpusidemanager.py":
+        "chain wire-table journal (+ .last-good)",
+    "dpu_operator_tpu/daemon/handoff.py":
+        "handoff bundle restore writes during adoption",
+}
+
+#: write modes for the builtin open(); "r+"/"a" style appends count too
+#: — any in-place mutation of a state file can be torn by kill -9
+_WRITE_MODES = ("w", "a", "x", "r+", "w+", "a+")
+
+#: os.open flags that create or mutate a file — a raw
+#: os.open(path, O_CREAT|O_EXCL|O_WRONLY) + write is exactly the torn-
+#: write shape the rule exists for (kill -9 between open and write
+#: leaves an empty file at the final path)
+_OS_WRITE_FLAGS = {"O_WRONLY", "O_RDWR", "O_CREAT", "O_TRUNC", "O_APPEND"}
+
+
+class HandoffStateDisciplineChecker(Checker):
+    name = "handoff-state-discipline"
+    description = ("state-dir writers must use utils/atomicfile.py "
+                   "(temp + fsync + atomic rename) — a raw "
+                   "open(..., 'w') can be killed mid-write and poison "
+                   "the next daemon's recovery/adoption")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        reason = STATE_WRITER_MODULES.get(module.relpath)
+        if reason is None:
+            return
+        for call in calls_in(module.tree):
+            name = dotted_name(call.func) or ""
+            if name == "os.open":
+                if self._os_open_writes(call):
+                    yield self.violation(
+                        module, call,
+                        f"raw os.open with write/create flags in a "
+                        f"state-dir writer ({reason}): a kill -9 "
+                        "between open and write leaves an empty file "
+                        "at the final path — write through "
+                        "utils.atomicfile.atomic_write/atomic_claim")
+                continue
+            if name not in ("open", "io.open"):
+                continue
+            mode = self._open_mode(call)
+            if mode is None:
+                continue
+            base = mode.replace("b", "").replace("t", "")
+            if base in _WRITE_MODES or "+" in base:
+                yield self.violation(
+                    module, call,
+                    f"raw open(..., {mode!r}) in a state-dir writer "
+                    f"({reason}): a kill -9 mid-write leaves a "
+                    "truncated file — write through "
+                    "utils.atomicfile.atomic_write/atomic_claim")
+
+    @staticmethod
+    def _os_open_writes(call: ast.Call) -> bool:
+        if len(call.args) < 2:
+            return False
+        for node in ast.walk(call.args[1]):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _OS_WRITE_FLAGS:
+                return True
+            if isinstance(node, ast.Name) and node.id in _OS_WRITE_FLAGS:
+                return True
+        return False
+
+    @staticmethod
+    def _open_mode(call: ast.Call) -> Optional[str]:
+        if len(call.args) >= 2:
+            arg = call.args[1]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+            return None
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        return None  # default mode "r": reads are fine
 
 
 # -- retry-discipline ---------------------------------------------------------

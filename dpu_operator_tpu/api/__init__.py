@@ -3,7 +3,9 @@ from .types import (
     TpuOperatorConfigSpec,
     ServiceFunctionChain,
     NetworkFunction,
+    UpgradeStrategy,
     MODES,
+    UPGRADE_TYPES,
 )
 from .webhook import validate_tpu_operator_config, ValidationError
 
@@ -12,7 +14,9 @@ __all__ = [
     "TpuOperatorConfigSpec",
     "ServiceFunctionChain",
     "NetworkFunction",
+    "UpgradeStrategy",
     "MODES",
+    "UPGRADE_TYPES",
     "validate_tpu_operator_config",
     "ValidationError",
 ]

@@ -31,6 +31,47 @@ MODES = ("host", "tpu", "auto")
 #: reference: networkfn.go:233-317 delegates to the NetConf's IPAM)
 DEFAULT_NF_IPAM = {"type": "host-local", "subnet": "10.56.0.0/24"}
 
+#: upgradeStrategy.type values: blueGreen stages the new VSP next to the
+#: old one and promotes only once the health engine reports it Healthy;
+#: recreate replaces in place (dev clusters — brief dataplane gap).
+UPGRADE_TYPES = ("blueGreen", "recreate")
+
+
+@dataclass
+class UpgradeStrategy:
+    """spec.upgradeStrategy: controller-driven VSP replacement.
+
+    ``vsp_image`` names the TARGET VSP image; whenever it differs from
+    ``status.upgrade.currentImage`` the controller runs the staged
+    rollout (controller/vsp_rollout.py): stage the new VSP, gate on the
+    health engine (/debug/health fold — a burn-rate alert or degraded
+    breaker holds the rollout with an ``UpgradeHeld`` Event), then
+    drain the old one. Empty ``vsp_image`` = no controller-driven VSP
+    management (the daemons deploy their own, the pre-upgrade
+    behavior)."""
+    type: str = "blueGreen"
+    vsp_image: str = ""
+    #: gate promotion on the health engine snapshot (disable only in
+    #: dev clusters with no health engine running)
+    health_gate: bool = True
+    #: how long the controller waits between gate checks while the new
+    #: VSP stages (ReconcileResult.requeue_after)
+    check_interval: float = 5.0
+
+    def to_dict(self) -> dict:
+        return {"type": self.type, "vspImage": self.vsp_image,
+                "healthGate": self.health_gate,
+                "checkIntervalSeconds": self.check_interval}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "UpgradeStrategy":
+        return cls(
+            type=d.get("type", "blueGreen"),
+            vsp_image=d.get("vspImage", ""),
+            health_gate=bool(d.get("healthGate", True)),
+            check_interval=float(d.get("checkIntervalSeconds", 5.0)),
+        )
+
 
 @dataclass
 class TpuOperatorConfigSpec:
@@ -42,22 +83,30 @@ class TpuOperatorConfigSpec:
     #: IPAM config embedded into the network-function NAD (host-local or
     #: static); defaults to DEFAULT_NF_IPAM.
     nf_ipam: dict = field(default_factory=lambda: dict(DEFAULT_NF_IPAM))
+    #: controller-driven blue-green VSP replacement; None = unmanaged.
+    upgrade_strategy: "UpgradeStrategy | None" = None
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "mode": self.mode,
             "logLevel": self.log_level,
             "sliceTopology": self.slice_topology,
             "nfIpam": dict(self.nf_ipam),
         }
+        if self.upgrade_strategy is not None:
+            out["upgradeStrategy"] = self.upgrade_strategy.to_dict()
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "TpuOperatorConfigSpec":
+        strategy = d.get("upgradeStrategy")
         return cls(
             mode=d.get("mode", "auto"),
             log_level=d.get("logLevel", 0),
             slice_topology=d.get("sliceTopology", ""),
             nf_ipam=dict(d.get("nfIpam") or DEFAULT_NF_IPAM),
+            upgrade_strategy=(UpgradeStrategy.from_dict(strategy)
+                              if strategy else None),
         )
 
 

@@ -113,6 +113,33 @@ def validate_tpu_operator_config(obj: dict) -> None:
                     ipaddress.ip_interface(a["address"])
                 except ValueError as e:
                     raise ValidationError(f"invalid nfIpam: {e}") from e
+    strategy = spec.get("upgradeStrategy")
+    if strategy is not None:
+        if not isinstance(strategy, dict):
+            raise ValidationError("upgradeStrategy must be a mapping")
+        from .types import UPGRADE_TYPES
+        stype = strategy.get("type", "blueGreen")
+        if stype not in UPGRADE_TYPES:
+            raise ValidationError(
+                f"invalid upgradeStrategy.type {stype!r}: want one of "
+                f"{UPGRADE_TYPES}")
+        image = strategy.get("vspImage", "")
+        if not isinstance(image, str):
+            raise ValidationError(
+                f"invalid upgradeStrategy.vspImage {image!r}: want a "
+                "string (a malformed value would wedge the rollout at "
+                "DaemonSet apply time instead of failing admission)")
+        gate = strategy.get("healthGate", True)
+        if not isinstance(gate, bool):
+            raise ValidationError(
+                f"invalid upgradeStrategy.healthGate {gate!r}: want a "
+                "boolean")
+        interval = strategy.get("checkIntervalSeconds", 5.0)
+        if (not isinstance(interval, (int, float))
+                or isinstance(interval, bool) or interval <= 0):
+            raise ValidationError(
+                f"invalid upgradeStrategy.checkIntervalSeconds "
+                f"{interval!r}: want a positive number")
 
 
 #: boundary attachments follow the slice-attachment naming contract the

@@ -9,7 +9,10 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Optional
+
+from ..utils.atomicfile import atomic_claim, atomic_write
 
 
 class NetConfCache:
@@ -20,11 +23,11 @@ class NetConfCache:
         return os.path.join(self.cache_dir, f"{sandbox_id}-{ifname}.json")
 
     def save(self, sandbox_id: str, ifname: str, data: dict):
+        # crash-safe: temp file + fsync + atomic rename (a kill -9
+        # mid-save must never leave a truncated JSON that poisons the
+        # DEL-time load of this sandbox after the next daemon start)
         os.makedirs(self.cache_dir, exist_ok=True)
-        tmp = self._path(sandbox_id, ifname) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        os.replace(tmp, self._path(sandbox_id, ifname))
+        atomic_write(self._path(sandbox_id, ifname), json.dumps(data))
 
     def load(self, sandbox_id: str, ifname: str) -> Optional[dict]:
         try:
@@ -89,29 +92,54 @@ class ChipAllocator:
 
     def __init__(self, alloc_dir: str):
         self.alloc_dir = alloc_dir
+        # serializes poison recovery: without it, two concurrent
+        # allocates seeing the same empty lock could each unlink-and-
+        # claim, the second unlink deleting the first's VALID claim and
+        # double-allocating the chip. Cross-process overlap is excluded
+        # by design: during a handoff the outgoing daemon is frozen.
+        self._poison_lock = threading.Lock()
 
     def _path(self, chip_id: str) -> str:
         return os.path.join(self.alloc_dir, chip_id.replace("/", "_"))
 
     def allocate(self, chip_id: str, owner: str) -> bool:
         """Record *owner* (sandbox id) as holding *chip_id*; False if held
-        by someone else."""
+        by someone else. Crash-safe O_EXCL: the owner string is written
+        and fsynced to a temp file first, then hardlinked into place —
+        a kill -9 mid-allocate can no longer leave an empty lock file
+        whose ``owner()`` reads as ``""`` and blocks every later claim."""
         os.makedirs(self.alloc_dir, exist_ok=True)
         path = self._path(chip_id)
-        try:
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
-        except FileExistsError:
-            return self.owner(chip_id) == owner
-        with os.fdopen(fd, "w") as f:
-            f.write(owner)
-        return True
+        if atomic_claim(path, owner):
+            return True
+        cur = self.owner(chip_id)
+        if cur is None:
+            # truncated/empty lock left by a pre-atomic_claim crash:
+            # nobody owns it — clear the poison and claim again, under
+            # the lock so a racing allocate cannot unlink OUR fresh
+            # claim (it re-reads the owner once we are done)
+            with self._poison_lock:
+                cur = self.owner(chip_id)
+                if cur is not None:
+                    return cur == owner
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return (atomic_claim(path, owner)
+                        or self.owner(chip_id) == owner)
+        return cur == owner
 
     def owner(self, chip_id: str) -> Optional[str]:
         try:
             with open(self._path(chip_id)) as f:
-                return f.read().strip()
+                content = f.read().strip()
         except OSError:
             return None
+        # a truncated/empty lock (pre-atomic_claim daemons could leave
+        # one) is a poisoned claim, not an owner — treat as unowned so
+        # release()/re-allocate can recover the chip
+        return content or None
 
     def release(self, chip_id: str, owner: str) -> bool:
         cur = self.owner(chip_id)

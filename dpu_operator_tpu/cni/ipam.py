@@ -23,6 +23,8 @@ import os
 import subprocess
 from typing import Optional
 
+from ..utils.atomicfile import atomic_claim
+
 __all__ = ["IpamError", "ipam_add", "ipam_del", "HostLocalIpam",
            "StaticIpam", "ExecIpam", "find_plugin_binary"]
 
@@ -79,7 +81,9 @@ class HostLocalIpam:
         idempotency check is not atomic on its own, so two concurrent ADDs
         for the same sandbox+ifname (overlapping kubelet retries) could each
         miss the owner scan and claim two different IPs, leaking one."""
-        fd = os.open(os.path.join(net_dir, ".lock"),
+        # not state: a flock handle that is never written — empty is
+        # its normal, complete content, so no torn-write hazard
+        fd = os.open(os.path.join(net_dir, ".lock"),  # opslint: disable=handoff-state-discipline
                      os.O_CREAT | os.O_WRONLY, 0o600)
         try:
             fcntl.flock(fd, fcntl.LOCK_EX)
@@ -114,14 +118,12 @@ class HostLocalIpam:
                 continue
         for ip, net in self._iter_candidates(cfg):
             path = os.path.join(net_dir, str(ip))
-            try:
-                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
-                             0o600)
-            except FileExistsError:
-                continue
-            with os.fdopen(fd, "w") as f:
-                f.write(owner)
-            return self._result(cfg, ip, net)
+            # crash-safe claim: a kill -9 between a raw O_EXCL open and
+            # the write would leave an empty lease that burns the slot
+            # forever — atomic_claim publishes the complete content or
+            # nothing (utils/atomicfile.py)
+            if atomic_claim(path, owner):
+                return self._result(cfg, ip, net)
         raise IpamError(f"host-local range exhausted in {cfg.get('subnet')}")
 
     def _result(self, cfg: dict, ip, net) -> dict:

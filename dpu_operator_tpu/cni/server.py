@@ -46,6 +46,29 @@ class _UnixHTTPServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer
         return request, ("unix", 0)
 
 
+def handoff_key(pod_req: PodRequest) -> str:
+    """Stable identity of a mutating CNI request across the handoff
+    wire: the outgoing daemon queues the request under this key, the
+    incoming daemon applies it exactly once and acks the result back
+    under the same key."""
+    return f"{pod_req.command}:{pod_req.sandbox_id}:{pod_req.ifname}"
+
+
+class _FrozenRequest:
+    """One mutating CNI request parked by the handoff freeze window.
+    The server thread blocks on ``done``; whoever completes the handoff
+    (or aborts it) supplies the response."""
+
+    def __init__(self, pod_req: PodRequest):
+        self.pod_req = pod_req
+        self.done = threading.Event()
+        self.response: Optional[CniResponse] = None
+
+    def complete(self, response: CniResponse) -> None:
+        self.response = response
+        self.done.set()
+
+
 class CniServer:
     #: in-dispatch retry budget for ADD: kubelet DOES retry failed ADDs,
     #: but each kubelet retry tears down and recreates the sandbox —
@@ -68,6 +91,24 @@ class CniServer:
         self._server: Optional[_UnixHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._pool = ThreadPoolExecutor(max_workers=8)
+        # handoff freeze window: while frozen, mutating requests
+        # (ADD/DEL) queue instead of dispatching — the outgoing daemon
+        # of a live upgrade must stop mutating the dataplane the moment
+        # it starts serializing its state bundle, but kubelet's blocked
+        # CNI call still gets a real answer (daemon/handoff.py)
+        self._freeze_lock = threading.Lock()
+        self._frozen = False
+        self._frozen_queue: list[_FrozenRequest] = []
+        #: latched by complete_frozen: this daemon's state now lives in
+        #: the incoming daemon — any late mutating request here must
+        #: fail fast (retryable) so kubelet re-drives it against the
+        #: new daemon's socket, never mutating handed-off state
+        self._handed_off = False
+        #: mutating dispatches currently past the freeze check — the
+        #: freeze must DRAIN these before the bundle is serialized, or
+        #: an in-flight ADD could wire a hop the bundle never sees
+        self._inflight_mutations = 0
+        self._drained = threading.Condition(self._freeze_lock)
         #: watchdog heartbeat over the dispatch pool (registered in
         #: start(): bare CniServer objects in unit tests carry none):
         #: task-scoped — a dispatch outliving the request deadline
@@ -135,6 +176,104 @@ class CniServer:
             self._heartbeat = None
         self._pool.shutdown(wait=False)
 
+    # -- handoff freeze window (daemon/handoff.py) ----------------------------
+    def freeze(self) -> None:
+        """Queue mutating requests instead of dispatching them. Reads
+        (CHECK) keep flowing; ADD/DEL park until :meth:`complete_frozen`
+        (handoff adopted: the incoming daemon's results answer them) or
+        :meth:`unfreeze` (handoff aborted: dispatched locally)."""
+        with self._freeze_lock:
+            self._frozen = True
+
+    @property
+    def frozen(self) -> bool:
+        with self._freeze_lock:
+            return self._frozen
+
+    def frozen_requests(self) -> list:
+        """Snapshot of queued mutating requests (bundle export)."""
+        with self._freeze_lock:
+            return [fr.pod_req for fr in self._frozen_queue]
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until every mutating dispatch that was already past
+        the freeze check has finished (call after :meth:`freeze`: no
+        new ones can start, so the count only falls). False on timeout
+        — a wedged dispatch is the watchdog's problem, not a reason to
+        wedge the handoff."""
+        deadline = time.monotonic() + timeout
+        with self._freeze_lock:
+            while self._inflight_mutations:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._drained.wait(remaining)
+            return True
+
+    def complete_frozen(self, results: dict) -> int:
+        """Finish the freeze window after a successful handoff: each
+        queued request is answered with the result the INCOMING daemon
+        computed for it (keyed by :func:`handoff_key`) — the request was
+        applied exactly once, over there. A request the incoming daemon
+        never saw (it raced the bundle serialization) gets a retryable
+        error so kubelet re-drives it against the new daemon. Returns
+        the number of requests completed."""
+        with self._freeze_lock:
+            queue, self._frozen_queue = self._frozen_queue, []
+            self._frozen = False
+            self._handed_off = True
+        for fr in queue:
+            outcome = results.get(handoff_key(fr.pod_req))
+            if outcome is None:
+                fr.complete(CniResponse(error=(
+                    "daemon handed off mid-request; retry against the "
+                    "new daemon")))
+            elif outcome.get("error"):
+                fr.complete(CniResponse(error=str(outcome["error"])))
+            else:
+                fr.complete(CniResponse(result=outcome.get("result") or {}))
+        return len(queue)
+
+    def unfreeze(self, dispatch_queued: bool = True) -> None:
+        """Abort the freeze window (handoff failed/timed out): queued
+        requests are dispatched locally, in arrival order — this daemon
+        is still the owner of the dataplane.
+
+        *dispatch_queued*=False for the ambiguous abort (bundle sent,
+        ACK lost): the peer may have already applied these requests, so
+        they are failed back to kubelet as retryable instead of risking
+        double application."""
+        with self._freeze_lock:
+            queue, self._frozen_queue = self._frozen_queue, []
+            self._frozen = False
+        for fr in queue:
+            if not dispatch_queued:
+                fr.complete(CniResponse(error=(
+                    "daemon handoff interrupted after the state bundle "
+                    "was transferred; retry")))
+                continue
+            try:
+                fr.complete(self.dispatch_direct(fr.pod_req))
+            except Exception as e:  # noqa: BLE001 — surface to kubelet
+                log.exception("post-abort dispatch of queued CNI %s "
+                              "failed", fr.pod_req.command)
+                fr.complete(CniResponse(error=str(e)))
+
+    def dispatch_direct(self, pod_req: PodRequest) -> CniResponse:
+        """Dispatch *pod_req* through the full machinery — DEL
+        already-gone-is-success, bounded transient-ADD retries, CNI
+        metrics — WITHOUT the freeze/handed-off gate: the adoption path
+        applies the outgoing daemon's freeze-window queue on adopted
+        state before this server starts, and a raw handler call there
+        would turn an idempotent-DEL success into a 500 kubelet
+        re-drives forever. May raise (non-transient handler failure),
+        like :meth:`_dispatch`."""
+        handler = (self.add_handler if pod_req.command == "ADD"
+                   else self.del_handler)
+        if handler is None:
+            return CniResponse(error=f"no handler for {pod_req.command}")
+        return self._dispatch(handler, pod_req)
+
     # -- request dispatch (cniserver.go:234-263) ------------------------------
     def _handle(self, req: CniRequest) -> CniResponse:
         pod_req = PodRequest.from_cni_request(req)
@@ -144,11 +283,54 @@ class CniServer:
                    else self.del_handler)
         if handler is None:
             return CniResponse(error=f"no handler for {pod_req.command}")
-        request_logger(pod_req).debug("CNI %s device=%s", pod_req.command,
-                                      pod_req.device_id)
-        with span("cni." + pod_req.command.lower(),
-                  sandbox=pod_req.sandbox_id, ifname=pod_req.ifname):
-            return self._dispatch(handler, pod_req)
+        with self._freeze_lock:
+            if self._handed_off:
+                # this daemon's state was adopted by its successor: a
+                # late mutation here would steer state the new daemon
+                # never learns about — fail fast, kubelet's retry hits
+                # the socket the new daemon has (re)bound
+                metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                                         result="handed_off")
+                return CniResponse(error=(
+                    "daemon handed off; retry against the new daemon"))
+            if self._frozen:
+                frozen = _FrozenRequest(pod_req)
+                self._frozen_queue.append(frozen)
+            else:
+                frozen = None
+                # claimed under the same lock acquisition as the frozen
+                # check: a freeze beginning after this point sees the
+                # dispatch in drain()'s count
+                self._inflight_mutations += 1
+        if frozen is not None:
+            metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                                     result="queued_handoff")
+            if not frozen.done.wait(timeout=self.timeout):
+                with self._freeze_lock:
+                    # withdraw so a later unfreeze() cannot apply a
+                    # mutation whose caller already got this error (a
+                    # completion that ALREADY claimed the queue keeps
+                    # the entry — kubelet's retry is idempotent)
+                    try:
+                        self._frozen_queue.remove(frozen)
+                    except ValueError:
+                        pass
+                return CniResponse(error=(
+                    f"CNI {pod_req.command} queued during handoff "
+                    f"freeze window; no adoption within {self.timeout}s"))
+            return frozen.response or CniResponse(error="handoff lost "
+                                                        "the request")
+        try:
+            request_logger(pod_req).debug("CNI %s device=%s",
+                                          pod_req.command,
+                                          pod_req.device_id)
+            with span("cni." + pod_req.command.lower(),
+                      sandbox=pod_req.sandbox_id, ifname=pod_req.ifname):
+                return self._dispatch(handler, pod_req)
+        finally:
+            with self._freeze_lock:
+                self._inflight_mutations -= 1
+                self._drained.notify_all()
 
     @staticmethod
     def _already_gone(exc: BaseException) -> bool:

@@ -45,6 +45,10 @@ class TpuOperatorConfigReconciler:
             health_provider = health_snapshot
         self.health_provider = health_provider
         self._recorder = None
+        # blue-green VSP replacement (spec.upgradeStrategy): staged,
+        # gated on the same health snapshot the CR conditions fold
+        from .vsp_rollout import VspRollout
+        self.vsp_rollout = VspRollout(health_provider=health_provider)
 
     # -- template vars (reference: yamlVars :131-167) -------------------------
     def _yaml_vars(self, client, cfg: TpuOperatorConfig) -> dict:
@@ -122,10 +126,14 @@ class TpuOperatorConfigReconciler:
         status = dict(obj.get("status", {}))
         status["observedGeneration"] = obj["metadata"].get("generation", 0)
         status["flavour"] = data["Flavour"]
+        # staged VSP replacement: one rollout step per reconcile, with
+        # the returned delay re-driving the gate while one is in flight
+        requeue = self.vsp_rollout.reconcile(
+            client, obj, cfg.spec.upgrade_strategy, status)
         self._fold_health(client, obj, status)
         obj["status"] = status
         client.update_status(obj)
-        return ReconcileResult()
+        return ReconcileResult(requeue_after=requeue)
 
     # -- health conditions (utils/watchdog.py + utils/slo.py) -----------------
     def _fold_health(self, client, obj: dict, status: dict):

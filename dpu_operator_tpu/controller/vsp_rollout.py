@@ -1,0 +1,301 @@
+"""Blue-green VSP rollout: controller-driven, health-gated replacement.
+
+The VSP is the dataplane's long-lived process; replacing it is the
+riskiest step of any upgrade. ``TpuOperatorConfig.spec.upgradeStrategy``
+hands that replacement to the controller as a staged, observable state
+machine instead of a blind DaemonSet image bump:
+
+1. **Stage** — the target image is applied as a SECOND DaemonSet (the
+   inactive color: blue↔green) next to the serving one; an
+   ``UpgradeStarted`` Event marks the transition.
+2. **Gate** — the staged VSP must prove itself: its pods Running on
+   the target image, no SFC CR carrying a True Degraded/ChainDegraded
+   condition (the node daemons' own health verdicts, visible through
+   the apiserver from any process), AND the operator's health-engine
+   snapshot (the same ``/debug/health`` fold the CR conditions use)
+   clean. A burn-rate alert, watchdog stall or open breaker during the
+   rollout **holds** it — the old VSP keeps serving,
+   ``status.upgrade.phase = Held``, an ``UpgradeHeld`` Event fires, and
+   the controller re-checks on ``checkIntervalSeconds``.
+3. **Promote** — only then is the old color drained (DaemonSet deleted;
+   its pods GC with it) and ``status.upgrade.currentImage`` advanced,
+   with an ``UpgradeCompleted`` Event.
+
+``type: recreate`` is the dev-cluster escape hatch: replace in place,
+accepting a brief dataplane gap, still recorded by the same Events.
+
+The daemons' own handoff (daemon/handoff.py) makes the *daemon* side of
+the upgrade invisible; this module makes the *VSP* side safe. Together
+they are the zero-downtime upgrade path (doc/architecture.md
+"Upgrades and state handoff").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api.types import UpgradeStrategy
+from ..utils import vars as v
+
+log = logging.getLogger(__name__)
+
+BLUE, GREEN = "blue", "green"
+
+
+def _other(color: str) -> str:
+    return GREEN if color == BLUE else BLUE
+
+
+class VspRollout:
+    """Reconciles ``spec.upgradeStrategy`` into staged VSP DaemonSets.
+
+    Stateless between reconciles: every decision derives from
+    ``status.upgrade`` + live cluster objects, so a restarted operator
+    resumes a half-done rollout exactly where it stood."""
+
+    def __init__(self, health_provider=None,
+                 namespace: str = v.NAMESPACE) -> None:
+        # health_provider sees THIS process's health engine only; the
+        # node daemons' verdicts reach the gate as SFC CR conditions
+        # (_degraded_chains). Deployments that scrape the daemons'
+        # /debug/health endpoints can inject an aggregating provider
+        if health_provider is None:
+            from ..utils.slo import health_snapshot
+            health_provider = health_snapshot
+        self.health_provider = health_provider
+        self.namespace = namespace
+        self._recorder = None
+
+    # -- objects --------------------------------------------------------------
+    @staticmethod
+    def ds_name(color: str) -> str:
+        return f"tpu-vsp-{color}"
+
+    def _render_ds(self, color: str, image: str) -> dict:
+        labels = {"app": "tpu-vsp", "tpu.openshift.io/vsp-color": color}
+        return {
+            "apiVersion": "apps/v1", "kind": "DaemonSet",
+            "metadata": {"name": self.ds_name(color),
+                         "namespace": self.namespace,
+                         "labels": dict(labels)},
+            "spec": {
+                "selector": {"matchLabels": dict(labels)},
+                "template": {
+                    "metadata": {"labels": dict(labels)},
+                    "spec": {
+                        "nodeSelector": {v.NODE_LABEL_KEY:
+                                         v.NODE_LABEL_VALUE},
+                        "hostNetwork": True,
+                        "containers": [{
+                            "name": "vsp", "image": image,
+                            "securityContext": {"privileged": True},
+                        }],
+                    },
+                },
+            },
+        }
+
+    def _apply_ds(self, client, cfg_obj: dict, color: str,
+                  image: str) -> None:
+        ds = self._render_ds(color, image)
+        from ..k8s.client import set_owner_reference
+        set_owner_reference(cfg_obj, ds)
+        client.apply(ds)
+
+    def _emit(self, client, cfg_obj: dict, reason: str, message: str,
+              type_: str = "Normal", series: str = "") -> None:
+        from ..k8s.events import EventRecorder, object_reference
+        try:
+            if self._recorder is None or self._recorder.client is not client:
+                self._recorder = EventRecorder(client,
+                                               component="tpu-operator",
+                                               namespace=self.namespace)
+            self._recorder.emit(object_reference(cfg_obj), reason, message,
+                                type_=type_, series=series)
+        except Exception:  # noqa: BLE001 — Events are best-effort
+            log.exception("upgrade event %s emission failed", reason)
+
+    # -- gate -----------------------------------------------------------------
+    def _gate(self, client, strategy: UpgradeStrategy, color: str,
+              image: str) -> str:
+        """Empty string when the staged VSP may be promoted; otherwise
+        the hold reason (surfaced in status + the UpgradeHeld Event)."""
+        pods = client.list(
+            "v1", "Pod", namespace=self.namespace,
+            label_selector={"tpu.openshift.io/vsp-color": color})
+        if not pods:
+            return "staged VSP has no pods scheduled yet"
+        not_running = [p["metadata"]["name"] for p in pods
+                       if p.get("status", {}).get("phase") != "Running"]
+        if not_running:
+            return ("staged VSP pod(s) not Running: "
+                    + ", ".join(sorted(not_running)))
+        # Running is not enough: after a mid-rollout retarget (or with
+        # a leftover stale DS) the color's pods can still be running
+        # the PREVIOUS image while the DS controller catches up —
+        # promoting on them would drain the old VSP for an unverified
+        # one
+        # match the "vsp" container BY NAME (_render_ds): an admission
+        # webhook can inject a sidecar at index 0, and checking the
+        # wrong container either holds forever or promotes unverified
+        stale = [p["metadata"]["name"] for p in pods
+                 if next((c.get("image") for c
+                          in p.get("spec", {}).get("containers") or []
+                          if c.get("name") == "vsp"), None) != image]
+        if stale:
+            return ("staged VSP pod(s) not yet on target image: "
+                    + ", ".join(sorted(stale)))
+        # fleet-level signal first: the node daemons fold THEIR health
+        # engines into Degraded (open breaker = walled-off VSP) and
+        # ChainDegraded (hops re-steered off dark links) conditions on
+        # the SFC CRs they reconcile — the apiserver's view of
+        # dataplane health, which the operator-local snapshot below
+        # cannot see (daemons and the staged VSP run in other
+        # processes on other nodes). NOT behind healthGate: that flag
+        # disables only the operator-local health-engine snapshot (its
+        # stated purpose: dev clusters with no engine running) — this
+        # signal exists whenever daemons do, and a staged VSP that
+        # walled itself off must never promote by draining the last
+        # working one
+        degraded_crs = self._degraded_chains(client)
+        if degraded_crs:
+            return ("dataplane degraded on SFC CR(s): "
+                    + ", ".join(degraded_crs))
+        if not strategy.health_gate:
+            return ""
+        try:
+            snap = self.health_provider() or {}
+        except Exception:  # noqa: BLE001 — an unreadable health engine
+            log.exception("health snapshot failed during rollout gate")
+            return "health snapshot unavailable"  # is a HOLD, not a pass
+        degraded = sorted(
+            name for name, info in (snap.get("components") or {}).items()
+            if not info.get("healthy", True))
+        if degraded:
+            # a burn-rate alert / stall / open breaker DURING the
+            # rollout: automatic hold until the engine reports clean
+            return "health engine degraded: " + ", ".join(degraded)
+        return ""
+
+    def _degraded_chains(self, client) -> list:
+        """SFC CRs carrying a True Degraded/ChainDegraded condition —
+        the daemons' own health verdicts, readable from any process."""
+        from ..api.types import API_VERSION
+        try:
+            sfcs = client.list(API_VERSION, "ServiceFunctionChain") or []
+        except Exception:  # noqa: BLE001 — an unlistable dataplane
+            log.exception("SFC list failed during rollout gate")
+            return ["<SFC CRs unlistable>"]  # holds, never passes
+        out = []
+        for obj in sfcs:
+            conds = (obj.get("status") or {}).get("conditions") or []
+            bad = sorted({c.get("type") for c in conds
+                          if c.get("type") in ("Degraded", "ChainDegraded")
+                          and c.get("status") == "True"})
+            if bad:
+                md = obj.get("metadata") or {}
+                out.append(f"{md.get('namespace', '')}/"
+                           f"{md.get('name', '?')} ({', '.join(bad)})")
+        return sorted(out)
+
+    # -- reconcile ------------------------------------------------------------
+    def reconcile(self, client, cfg_obj: dict,
+                  strategy: Optional[UpgradeStrategy],
+                  status: dict) -> Optional[float]:
+        """One rollout step. Mutates ``status['upgrade']`` in place and
+        returns the requeue delay while a rollout is in flight (None at
+        steady state)."""
+        if strategy is None or not strategy.vsp_image:
+            # controller-driven VSP management switched off; if that
+            # happened MID-rollout, the staged other-color DS must not
+            # keep running the abandoned image (the serving color is
+            # deliberately left alone — never tear down a live
+            # dataplane on a spec removal)
+            up = dict(status.get("upgrade") or {})
+            if up.get("targetImage"):
+                color = up.get("color") or BLUE
+                client.delete("apps/v1", "DaemonSet",
+                              self.ds_name(_other(color)),
+                              namespace=self.namespace)
+                up.update(phase="Complete", targetImage="",
+                          heldReason="")
+                status["upgrade"] = up
+            return None
+        up = dict(status.get("upgrade") or {})
+        status["upgrade"] = up
+        target = strategy.vsp_image
+        current = up.get("currentImage", "")
+        color = up.get("color") or BLUE
+        if not current:
+            # first controller-managed deploy: nothing to drain
+            self._apply_ds(client, cfg_obj, color, target)
+            up.update(currentImage=target, color=color, phase="Complete",
+                      targetImage="", heldReason="")
+            return None
+        if target == current:
+            # steady state: re-assert the serving DaemonSet (a deleted
+            # DS heals on resync, like every other ensure)
+            self._apply_ds(client, cfg_obj, color, current)
+            if up.get("targetImage"):
+                # a rollout was abandoned mid-flight (the target was
+                # reverted to the serving image): the staged other-color
+                # DS would otherwise keep running the dead image on
+                # every node forever
+                client.delete("apps/v1", "DaemonSet",
+                              self.ds_name(_other(color)),
+                              namespace=self.namespace)
+            up.update(phase="Complete", targetImage="", heldReason="")
+            return None
+        if strategy.type == "recreate":
+            return self._recreate(client, cfg_obj, up, color, current,
+                                  target)
+        return self._blue_green(client, cfg_obj, strategy, up, color,
+                                current, target)
+
+    def _recreate(self, client, cfg_obj: dict, up: dict, color: str,
+                  current: str, target: str) -> Optional[float]:
+        self._emit(client, cfg_obj, "UpgradeStarted",
+                   f"VSP recreate: {current} -> {target} (in-place; "
+                   "brief dataplane gap accepted)", series=target)
+        self._apply_ds(client, cfg_obj, color, target)
+        up.update(currentImage=target, phase="Complete", targetImage="",
+                  heldReason="")
+        self._emit(client, cfg_obj, "UpgradeCompleted",
+                   f"VSP recreated on {target}", series=target)
+        return None
+
+    def _blue_green(self, client, cfg_obj: dict,
+                    strategy: UpgradeStrategy, up: dict, color: str,
+                    current: str, target: str) -> Optional[float]:
+        staged = _other(color)
+        if up.get("targetImage") != target:
+            # a NEW target (first sight, or the target changed under a
+            # half-done rollout): restage from scratch
+            self._emit(client, cfg_obj, "UpgradeStarted",
+                       f"VSP blue-green rollout: {current} ({color}) -> "
+                       f"{target} (staging as {staged})", series=target)
+            up.update(targetImage=target, phase="Staging", heldReason="")
+        self._apply_ds(client, cfg_obj, staged, target)
+        hold = self._gate(client, strategy, staged, target)
+        if hold:
+            if up.get("phase") != "Held":
+                self._emit(client, cfg_obj, "UpgradeHeld",
+                           f"VSP rollout to {target} held: {hold} — old "
+                           "VSP keeps serving; retrying in "
+                           f"{strategy.check_interval:g}s",
+                           type_="Warning", series=target)
+            up.update(phase="Held", heldReason=hold)
+            return strategy.check_interval
+        # promote: the staged VSP proved Healthy — drain the old color
+        # (make-before-break at the fleet level: the break happens only
+        # after the make passed its gate)
+        client.delete("apps/v1", "DaemonSet", self.ds_name(color),
+                      namespace=self.namespace)
+        up.update(currentImage=target, color=staged, phase="Complete",
+                  targetImage="", heldReason="")
+        self._emit(client, cfg_obj, "UpgradeCompleted",
+                   f"VSP rollout complete: {target} now serving as "
+                   f"{staged}; {current} ({color}) drained",
+                   series=target)
+        return None

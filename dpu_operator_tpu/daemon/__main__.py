@@ -63,6 +63,13 @@ def main(argv=None):
     # serve() loop observes the event and runs the orderly teardown.
     signal.signal(signal.SIGTERM, lambda *_: daemon.request_stop())
     signal.signal(signal.SIGINT, lambda *_: daemon.request_stop())
+    # zero-downtime upgrade: SIGUSR2 freezes mutations and serves the
+    # live state bundle on the handoff socket; the incoming daemon
+    # adopts it and this process exits once adoption is ACKed
+    # (daemon/handoff.py — `tpuctl handoff begin` sends the same
+    # request over the admin plane). The handler only spawns the serve
+    # thread; nothing blocking runs in signal context.
+    signal.signal(signal.SIGUSR2, lambda *_: daemon.begin_handoff())
     daemon.prepare_and_serve()
 
 

@@ -156,11 +156,26 @@ class Daemon:
     def degraded_sites(self) -> list:
         """Components currently degraded: open circuit breakers across
         the live side manager plus watchdog-stalled loops — the
-        /healthz structured breakdown."""
+        /healthz structured breakdown. (A handoff fallback rides the
+        side manager's degraded_sites.)"""
         provider = getattr(self.manager, "degraded_sites", None)
         sites = list(provider()) if callable(provider) else []
         from ..utils import watchdog
         return sites + watchdog.WATCHDOG.degraded_components()
+
+    def begin_handoff(self, timeout: float = 30.0) -> bool:
+        """SIGUSR2 / admin entry point for a zero-downtime upgrade:
+        the live side manager freezes mutations and serves its state
+        bundle on the handoff socket (daemon/handoff.py); once the
+        incoming daemon ACKs adoption this daemon requests its own
+        orderly stop (kubernetes then lets the new pod take over).
+        Returns False when no side manager is live yet or a handoff is
+        already in flight."""
+        starter = getattr(self.manager, "begin_handoff", None)
+        if not callable(starter):
+            log.warning("handoff requested but no side manager is live")
+            return False
+        return starter(timeout=timeout, on_complete=self.request_stop)
 
     def ready(self) -> bool:
         return (self.manager is not None and self._error is None
@@ -232,6 +247,12 @@ class Daemon:
                              detection.vendor, detection.tpu_mode,
                              detection.identifier)
                     self.manager = self._create_manager(detection)
+                    # a served handoff must stop THIS process no matter
+                    # how it was triggered: SIGUSR2 goes through
+                    # Daemon.begin_handoff, but `tpuctl handoff begin`
+                    # reaches the side manager directly over the admin
+                    # plane (AdminService.BeginHandoff)
+                    self.manager.handoff_on_complete = self.request_stop
                     if self._stop.is_set():
                         # SIGTERM raced detection: never start a manager
                         # the shutdown path has already run past — the

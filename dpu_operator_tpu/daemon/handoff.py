@@ -1,0 +1,740 @@
+"""Zero-downtime daemon upgrade: live state handoff over a local socket.
+
+A DaemonSet's steady state is *being upgraded* — and before this module
+existed, a ``tpu-daemon`` restart dropped the dataplane: pod netconfs,
+chip allocations, SFC steering and the kubelet device-plugin allocation
+view were all rebuilt from scratch. The handoff protocol makes an
+upgrade invisible to running pods:
+
+**Outgoing daemon** (on SIGUSR2 or ``tpuctl handoff begin``):
+
+1. freezes mutations — CNI ADD/DEL queue (:meth:`cni.server.CniServer
+   .freeze`), the embedded reconciler pauses (:meth:`k8s.manager
+   .Manager.pause`) — while reads keep flowing;
+2. serves a **versioned state bundle** on a local unix socket
+   (:func:`serve_handoff`): NetConf cache entries, chip-allocation
+   ownerships, the device-plugin allocation snapshot, the SFC wire
+   table (chain journal position), and breaker states — one
+   length-prefixed, sha256-checksummed, schema-versioned frame
+   (:func:`send_frame`/:func:`recv_frame`);
+3. keeps serving reads until the incoming daemon ACKs adoption, then
+   answers the queued CNI requests with the results the incoming daemon
+   computed for them (exactly-once application) and exits.
+
+**Incoming daemon** (at ``listen()`` time, before any server binds):
+:func:`adopt_into` dials the handoff socket. On success it adopts the
+bundle — no pod sandbox re-setup, no chain re-steer, and kubelet
+re-registers against the *same* allocation snapshot so ListAndWatch
+emits zero spurious deletions — then reconciles the adopted state
+against reality: discrepancies land in the flight recorder
+(``kind=adoption``), bump ``tpu_daemon_adoption_discrepancies_total``,
+emit an ``AdoptionDiscrepancy`` Event, and are repaired through the
+existing repair pass. When the bundle is missing, truncated, or from an
+incompatible schema version, the incoming daemon falls back to the
+cold-start journal/``.last-good`` recovery — degraded
+(``HandoffFallback`` flight entry + a Degraded-then-Healthy condition
+transition), never wedged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import logging
+import os
+import socket  # local daemon-to-daemon unix socket (WIRE_SEAM_ALLOW)
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..cni.server import handoff_key
+from ..cni.types import NetConf, PodRequest
+from ..k8s import events
+from ..utils import flight, metrics, resilience
+from ..utils.atomicfile import atomic_claim, atomic_write
+
+log = logging.getLogger(__name__)
+
+#: bundle schema version. Bump on ANY incompatible change to the bundle
+#: layout; an incoming daemon speaking a different version rejects the
+#: bundle and cold-starts (never adopts state it cannot interpret).
+SCHEMA_VERSION = 1
+
+MAGIC = b"TPUH"
+_HEADER = struct.Struct("!4sHI")  # magic, schema version, payload length
+_DIGEST_SIZE = 32
+#: bundles are bounded: a daemon's full state is KBs-to-MBs; anything
+#: bigger is a corrupt length field, not a real bundle
+MAX_FRAME = 64 << 20
+
+
+class HandoffError(Exception):
+    """Base for handoff protocol failures."""
+
+
+class FrameError(HandoffError):
+    """Malformed/truncated frame (a killed peer, a corrupt stream)."""
+
+
+class SchemaMismatch(HandoffError):
+    """The peer speaks an incompatible bundle schema version."""
+
+
+# -- frame protocol -----------------------------------------------------------
+
+def send_frame(sock: socket.socket, payload: dict,
+               version: int = SCHEMA_VERSION) -> int:
+    """Serialize *payload* as one checksummed frame; returns the body
+    size in bytes."""
+    body = json.dumps(payload, sort_keys=True).encode()
+    digest = hashlib.sha256(body).digest()
+    sock.sendall(_HEADER.pack(MAGIC, version, len(body)) + digest + body)
+    return len(body)
+
+
+def _recv_exactly(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise FrameError(
+                f"stream truncated: wanted {n} bytes, got {len(buf)} "
+                "(peer died mid-transfer?)")
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket,
+               expect_version: int = SCHEMA_VERSION) -> tuple[dict, int]:
+    """Read one frame; returns (payload, body size). Raises
+    :class:`SchemaMismatch` on a version other than *expect_version*
+    (the exception carries the received version as ``.version`` so a
+    reject reply can be framed in the PEER's dialect),
+    :class:`FrameError` on truncation/corruption."""
+    magic, version, length = _HEADER.unpack(
+        _recv_exactly(sock, _HEADER.size))
+    if magic != MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if version != expect_version:
+        exc = SchemaMismatch(
+            f"bundle schema v{version}; this daemon speaks "
+            f"v{expect_version}")
+        exc.version = version
+        raise exc
+    if length > MAX_FRAME:
+        raise FrameError(f"frame length {length} exceeds {MAX_FRAME}")
+    digest = _recv_exactly(sock, _DIGEST_SIZE)
+    body = _recv_exactly(sock, length)
+    if hashlib.sha256(body).digest() != digest:
+        raise FrameError("frame checksum mismatch (corrupt transfer)")
+    try:
+        payload = json.loads(body)
+    except ValueError as e:
+        raise FrameError(f"frame body is not JSON: {e}") from e
+    if not isinstance(payload, dict):
+        raise FrameError("frame body is not an object")
+    return payload, length
+
+
+# -- handoff status (degraded-until-recovered surfacing) ----------------------
+
+class HandoffStatus:
+    """Process-global record of the last handoff attempt. A fallback
+    marks the ``handoff`` component degraded until the cold-start
+    recovery completes — the Degraded-then-Healthy transition the
+    upgrade gate asserts — and ``history`` keeps the phase trail for
+    tests and ``tpuctl handoff status``."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._degraded_reason = ""
+        self.history: list[str] = []
+
+    def note(self, phase: str) -> None:
+        with self._lock:
+            self.history.append(phase)
+
+    def begin_fallback(self, reason: str) -> None:
+        with self._lock:
+            self._degraded_reason = reason or "handoff fallback"
+            self.history.append("fallback")
+
+    def mark_recovered(self) -> None:
+        """Cold-start recovery finished: clear the degraded marker.
+        No-op when no fallback was in flight (a plain first boot)."""
+        with self._lock:
+            if not self._degraded_reason:
+                return
+            self._degraded_reason = ""
+            self.history.append("recovered")
+
+    def degraded_components(self) -> list[str]:
+        with self._lock:
+            if self._degraded_reason:
+                return [f"handoff: {self._degraded_reason}"]
+            return []
+
+    def reset(self) -> None:
+        with self._lock:
+            self._degraded_reason = ""
+            self.history = []
+
+
+STATUS = HandoffStatus()
+
+
+def freeze_mutations(cni_server, manager) -> bool:
+    """Shared freeze sequence for both side managers: queue CNI
+    mutations, pause the reconciler, then DRAIN both so nothing is
+    mid-mutation when the bundle serializes. Returns False when
+    something was still mid-mutation at the drain deadline — the
+    caller must NOT serialize a bundle until a later
+    :func:`drain_mutations` succeeds (a slow-but-legal dispatch, e.g.
+    an ADD in transient-retry backoff, can legitimately outlive the
+    first drain window)."""
+    cni_server.freeze()
+    if manager is not None:
+        manager.pause()
+    drained = cni_server.drain()
+    if not drained:
+        log.warning("handoff freeze: in-flight CNI dispatch did not "
+                    "drain yet (serve path re-checks before "
+                    "serializing; watchdog owns wedged dispatches)")
+    if manager is not None and not manager.drain():
+        drained = False
+        log.warning("handoff freeze: in-flight reconcile did not drain "
+                    "yet (serve path re-checks before serializing)")
+    return drained
+
+
+def drain_mutations(cni_server, manager, timeout: float = 5.0) -> bool:
+    """Re-check the freeze drain (dispatch pool + reconciler) with a
+    fresh *timeout* — the serve path converts the time spent waiting
+    for the incoming daemon to connect into extra drain budget."""
+    drained = cni_server.drain(timeout=timeout)
+    if manager is not None:
+        drained = manager.drain(timeout=timeout) and drained
+    return drained
+
+
+def thaw_mutations(cni_server, manager,
+                   dispatch_queued: bool = True) -> None:
+    """Shared abort-path thaw. *dispatch_queued*=False when the bundle
+    already reached the peer and the ACK was lost: the peer may have
+    applied the queued mutations, so re-applying them here could
+    double-steer — they are failed back to kubelet (retryable)
+    instead."""
+    if manager is not None:
+        manager.resume()
+    cni_server.unfreeze(dispatch_queued=dispatch_queued)
+
+
+class HandoffStarter:
+    """Per-manager guard: at most one live handoff serve thread.
+
+    Both side managers delegate ``begin_handoff`` here so the
+    thread/lock lifecycle lives in one place instead of two diverging
+    copies."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def begin(self, manager, socket_path: str, timeout: float = 30.0,
+              on_complete: Optional[Callable[[], None]] = None) -> bool:
+        """Serve *manager*'s state bundle in a background thread
+        (SIGUSR2 / AdminService.BeginHandoff). Returns False when a
+        handoff is already in flight."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return False
+            thread = threading.Thread(
+                target=serve_handoff, args=(manager, socket_path),
+                kwargs={"timeout": timeout, "on_complete": on_complete},
+                daemon=True, name="handoff-serve")
+            self._thread = thread
+            thread.start()
+        return True
+
+
+# -- bundle collection --------------------------------------------------------
+
+def _pod_req_to_dict(req: PodRequest) -> dict:
+    return {"command": req.command, "podNamespace": req.pod_namespace,
+            "podName": req.pod_name, "sandboxId": req.sandbox_id,
+            "netns": req.netns, "ifname": req.ifname,
+            "deviceId": req.device_id, "netconf": req.netconf.to_dict()}
+
+
+def _pod_req_from_dict(d: dict) -> PodRequest:
+    return PodRequest(
+        command=d.get("command", ""),
+        pod_namespace=d.get("podNamespace", ""),
+        pod_name=d.get("podName", ""),
+        sandbox_id=d.get("sandboxId", ""),
+        netns=d.get("netns", ""),
+        ifname=d.get("ifname", ""),
+        device_id=d.get("deviceId", ""),
+        netconf=NetConf.from_dict(d.get("netconf") or {}))
+
+
+def _dump_state_dir(path: str) -> dict:
+    """{filename: content} for the regular files of one state dir
+    (subdirectories — ipam/, alloc/ — are their own concerns)."""
+    out: dict = {}
+    try:
+        names = sorted(os.listdir(path))
+    except OSError:
+        return out
+    for name in names:
+        full = os.path.join(path, name)
+        if not os.path.isfile(full) or ".tmp" in name or ".claim" in name:
+            continue
+        try:
+            with open(full) as f:
+                out[name] = f.read()
+        except OSError:
+            log.warning("handoff bundle: unreadable state file %s "
+                        "skipped", full)
+    return out
+
+
+def collect_bundle(manager, pending_cni: tuple = ()) -> dict:
+    """Assemble the versioned state bundle from a live side manager
+    (duck-typed: tpu- and host-side managers carry different subsets)."""
+    bundle: dict = {"schema": SCHEMA_VERSION,
+                    "manager": type(manager).__name__}
+    netconfs: dict = {}
+    for attr in ("nf_cache", "cache"):
+        cache = getattr(manager, attr, None)
+        if cache is not None:
+            netconfs[attr] = _dump_state_dir(cache.cache_dir)
+    bundle["netconfs"] = netconfs
+    allocator = getattr(manager, "allocator", None)
+    if allocator is not None:
+        bundle["chip_allocations"] = _dump_state_dir(allocator.alloc_dir)
+    devices: dict = {}
+    for attr in ("device_plugin", "ici_device_plugin"):
+        plugin = getattr(manager, attr, None)
+        if plugin is not None:
+            devices[plugin.resource] = plugin.snapshot_devices()
+    bundle["device_plugins"] = devices
+    export = getattr(manager, "export_wire_table", None)
+    if callable(export):
+        bundle["chains"] = export()
+    bundle["breakers"] = {b.site: b.state for b in resilience.breakers()}
+    bundle["pending_cni"] = [_pod_req_to_dict(r) for r in pending_cni]
+    return bundle
+
+
+# -- adoption -----------------------------------------------------------------
+
+#: per-process handoff attempt ids: stamped on EVERY handoff-kind
+#: flight entry (Adopted/Fallback on the incoming side, Served/Aborted
+#: on the outgoing side) AND every adoption-discrepancy entry an
+#: attempt produced, so `tpuctl handoff status` can scope
+#: discrepancies to the LAST handoff instead of sweeping up every
+#: adoption entry still in the ring — a Served/Aborted/Fallback entry
+#: without the stamp would otherwise inherit an EARLIER adoption's
+#: discrepancies (e.g. this daemon's own startup)
+_handoff_ids = itertools.count(1)
+
+
+@dataclass
+class AdoptionReport:
+    discrepancies: list = field(default_factory=list)
+    adopted_hops: int = 0
+    adopted_sandboxes: int = 0
+    adopted_devices: dict = field(default_factory=dict)
+    pending_applied: int = 0
+    handoff_id: int = 0
+
+    def discrepancy(self, kind: str, detail: str) -> None:
+        self.discrepancies.append({"kind": kind, "detail": detail})
+        metrics.ADOPTION_DISCREPANCIES.inc(kind=kind)
+        flight.record("adoption", kind,
+                      attributes={"detail": detail,
+                                  "handoff_id": self.handoff_id})
+
+
+def _reconcile_state_dir(directory: str, entries: dict, label: str,
+                         report: AdoptionReport,
+                         writer: Callable[[str, str], None]) -> None:
+    """Bundle entries vs. on-disk reality for one state dir: an entry
+    the disk lost is restored from the bundle (and recorded); a disk
+    file the outgoing daemon did not know is an orphan (recorded; the
+    defensive DEL path owns its cleanup)."""
+    on_disk = _dump_state_dir(directory)
+    for name, content in entries.items():
+        if name not in on_disk:
+            report.discrepancy(
+                f"{label}-missing-on-disk",
+                f"{name}: restored from the handoff bundle")
+            try:
+                os.makedirs(directory, exist_ok=True)
+                writer(os.path.join(directory, name), content)
+            except OSError:
+                log.exception("restoring %s/%s from bundle failed",
+                              directory, name)
+        elif on_disk[name] != content:
+            report.discrepancy(
+                f"{label}-content-drift",
+                f"{name}: disk content differs from the bundle "
+                "(disk wins; bundle was serialized under freeze)")
+    for name in on_disk:
+        if name not in entries:
+            report.discrepancy(
+                f"{label}-orphan",
+                f"{name}: on disk but unknown to the outgoing daemon")
+
+
+def adopt_bundle(manager, bundle: dict,
+                 handoff_id: int = 0) -> AdoptionReport:
+    """Adopt a received bundle into a freshly-constructed side manager
+    (its servers must not be listening yet), reconciling every layer
+    against on-disk/dataplane reality."""
+    report = AdoptionReport(handoff_id=handoff_id)
+    # device-plugin allocation snapshots: kubelet re-registers against
+    # the same view — ListAndWatch must emit zero spurious deletions
+    for attr in ("device_plugin", "ici_device_plugin"):
+        plugin = getattr(manager, attr, None)
+        if plugin is None:
+            continue
+        snap = (bundle.get("device_plugins") or {}).get(plugin.resource)
+        if snap:
+            plugin.adopt_snapshot(snap)
+            report.adopted_devices[plugin.resource] = len(snap)
+    # netconf caches (on-disk, shared across the two processes): the
+    # bundle is the outgoing daemon's authoritative view under freeze
+    netconfs = bundle.get("netconfs") or {}
+    for attr in ("nf_cache", "cache"):
+        cache = getattr(manager, attr, None)
+        if cache is not None and attr in netconfs:
+            _reconcile_state_dir(
+                cache.cache_dir, netconfs[attr], "netconf", report,
+                lambda path, content: atomic_write(path, content))
+    allocator = getattr(manager, "allocator", None)
+    if allocator is not None and "chip_allocations" in bundle:
+        _reconcile_state_dir(
+            allocator.alloc_dir, bundle["chip_allocations"],
+            "chip-allocation", report,
+            lambda path, content: atomic_claim(path, content))
+    # SFC wire table: adopted in place of journal recovery — hops stay
+    # wired, nothing is re-steered
+    adopt_wire = getattr(manager, "adopt_wire_table", None)
+    if callable(adopt_wire) and bundle.get("chains") is not None:
+        restored, dropped = adopt_wire(bundle["chains"])
+        report.adopted_hops = restored
+        with_attach = getattr(manager, "_attach_store", None)
+        if with_attach is not None:
+            report.adopted_sandboxes = len(with_attach)
+        for detail in dropped:
+            report.discrepancy("hop-not-in-dataplane", detail)
+    # breaker states: a VSP the outgoing daemon already proved dead
+    # must not be hammered afresh by the incoming one
+    for site, state in (bundle.get("breakers") or {}).items():
+        if state != resilience.CircuitBreaker.OPEN:
+            continue
+        for breaker in resilience.breakers():
+            if breaker.site == site:
+                breaker.inherit_open(
+                    reason="adopted from handoff bundle")
+    if report.discrepancies:
+        events.emit(
+            "AdoptionDiscrepancy",
+            f"handoff adoption found {len(report.discrepancies)} "
+            "discrepancy(ies) between the bundle and reality: "
+            + "; ".join(f"{d['kind']}: {d['detail']}"
+                        for d in report.discrepancies[:5]),
+            type_="Warning", series="adoption")
+        # repair pass: re-steer anything the dataplane disagreed about
+        repair = getattr(manager, "repair_chains", None)
+        if callable(repair):
+            try:
+                repair()
+            except Exception:  # noqa: BLE001 — repair is best-effort
+                log.exception("post-adoption repair pass failed")
+    return report
+
+
+def _apply_pending_cni(manager, pending: list) -> dict:
+    """Apply CNI mutations queued during the outgoing daemon's freeze
+    window — exactly once, here, on the adopted state. The results ride
+    the ACK frame back so the outgoing daemon can answer the blocked
+    kubelet requests without re-applying them."""
+    results: dict = {}
+    server = getattr(manager, "cni_server", None)
+    for entry in pending:
+        req = _pod_req_from_dict(entry)
+        key = handoff_key(req)
+        if server is None:
+            results[key] = {"error": f"no handler for {req.command}"}
+            continue
+        try:
+            # the full dispatch machinery, not a raw handler call: a
+            # queued DEL whose state the outgoing daemon already tore
+            # down must be idempotent-success, and a queued ADD gets
+            # its bounded transient retries — same semantics the
+            # request would have had without the freeze window
+            resp = server.dispatch_direct(req)
+            if resp.error:
+                results[key] = {"error": resp.error}
+            else:
+                results[key] = {"result": resp.result
+                                or {"cniVersion": req.netconf.cni_version}}
+        except Exception as e:  # noqa: BLE001 — outcome rides the ACK
+            log.exception("adopted pending CNI %s for sandbox %s failed",
+                          req.command, req.sandbox_id)
+            results[key] = {"error": str(e)}
+    return results
+
+
+# -- outgoing side ------------------------------------------------------------
+
+def serve_handoff(manager, socket_path: str, timeout: float = 30.0,
+                  on_complete: Optional[Callable[[], None]] = None) -> str:
+    """Freeze *manager* and serve its state bundle on *socket_path*
+    until an incoming daemon adopts (ACK) or *timeout* expires.
+
+    Returns ``"served"`` (adopted: queued CNI requests were answered
+    with the incoming daemon's results; *on_complete* — typically the
+    daemon's stop request — was invoked) or ``"aborted"`` (no taker or
+    an explicit reject: the freeze was thawed and this daemon keeps
+    serving — degraded never means wedged)."""
+    started = time.monotonic()
+    hid = next(_handoff_ids)
+    # None (fakes/legacy managers without a drain verdict) counts as
+    # drained; only an explicit False forces the pre-serialize re-check
+    drained = manager.freeze_for_handoff() is not False
+    STATUS.note("serving")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        os.makedirs(os.path.dirname(socket_path), mode=0o700,
+                    exist_ok=True)
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        listener.bind(socket_path)
+        os.chmod(socket_path, 0o600)
+        listener.listen(1)
+        listener.settimeout(timeout)
+        conn, _ = listener.accept()
+    except (OSError, socket.timeout) as e:
+        _cleanup_listener(listener, socket_path)
+        return _abort_handoff(manager, socket_path, started, hid,
+                              f"no incoming daemon: {e}")
+    sent = False
+    try:
+        conn.settimeout(timeout)
+        if not drained:
+            # the accept wait already bought the in-flight dispatch
+            # time to finish; one bounded re-check (kept inside the
+            # peer's recv window) before serializing — a bundle cut
+            # mid-mutation would steer a hop neither generation
+            # tracks, the one outcome this path must never produce
+            drain = getattr(manager, "drain_for_handoff", None)
+            if drain is None or not drain(timeout=2.0):
+                return _abort_handoff(
+                    manager, socket_path, started, hid,
+                    "in-flight mutation outlived the freeze drain; "
+                    "refusing to serialize a bundle mid-mutation")
+        # the bundle is serialized AT CONNECT TIME so it includes every
+        # CNI request queued since the freeze began
+        pending = manager.cni_server.frozen_requests()
+        bundle = collect_bundle(manager, pending_cni=tuple(pending))
+        size = send_frame(conn, bundle)
+        sent = True
+        ack, _ = recv_frame(conn)
+        if not ack.get("adopted"):
+            # an explicit reject: the peer did NOT adopt, so local
+            # dispatch of the queued requests is unambiguous
+            return _abort_handoff(
+                manager, socket_path, started, hid,
+                f"incoming daemon rejected the bundle: "
+                f"{ack.get('reason', 'unspecified')}")
+        completed = manager.cni_server.complete_frozen(
+            ack.get("results") or {})
+        duration = time.monotonic() - started
+        metrics.HANDOFFS.inc(role="outgoing", result="served")
+        flight.record("handoff", "HandoffServed", duration_s=duration,
+                      attributes={"bundle_bytes": size,
+                                  "handoff_id": hid,
+                                  "pending_cni": len(pending),
+                                  "completed": completed})
+        STATUS.note("served")
+        log.info("handoff served: %d-byte bundle adopted in %.3fs "
+                 "(%d queued CNI request(s) answered by the incoming "
+                 "daemon)", size, duration, completed)
+        if on_complete is not None:
+            on_complete()
+        return "served"
+    except HandoffError as e:
+        return _abort_handoff(manager, socket_path, started, hid,
+                              f"handoff protocol failure: {e}",
+                              dispatch_queued=not sent)
+    except OSError as e:
+        return _abort_handoff(manager, socket_path, started, hid,
+                              f"handoff socket failure: {e}",
+                              dispatch_queued=not sent)
+    except Exception as e:  # noqa: BLE001 — an unexpected error must
+        # still thaw: leaving the freeze in place would park every CNI
+        # request until the daemon is killed (the wedge this module's
+        # contract forbids)
+        log.exception("unexpected handoff failure")
+        return _abort_handoff(manager, socket_path, started, hid,
+                              f"unexpected handoff failure: {e!r}",
+                              dispatch_queued=not sent)
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+        _cleanup_listener(listener, socket_path)
+
+
+def _cleanup_listener(listener: socket.socket, socket_path: str) -> None:
+    try:
+        listener.close()
+    except OSError:
+        pass
+    try:
+        os.unlink(socket_path)
+    except OSError:
+        pass
+
+
+def _abort_handoff(manager, socket_path: str, started: float,
+                   hid: int, reason: str,
+                   dispatch_queued: bool = True) -> str:
+    duration = time.monotonic() - started
+    log.warning("handoff aborted after %.3fs: %s — thawing and "
+                "continuing to serve%s", duration, reason,
+                "" if dispatch_queued else
+                " (bundle already sent: queued CNI requests failed "
+                "back to kubelet instead of re-applied — the peer may "
+                "have applied them)")
+    manager.thaw_after_handoff(dispatch_queued=dispatch_queued)
+    metrics.HANDOFFS.inc(role="outgoing", result="aborted")
+    flight.record("handoff", "HandoffAborted", duration_s=duration,
+                  attributes={"reason": reason, "handoff_id": hid})
+    STATUS.note("aborted")
+    return "aborted"
+
+
+# -- incoming side ------------------------------------------------------------
+
+def adopt_into(manager, socket_path: str, timeout: float = 5.0) -> bool:
+    """Dial an outgoing daemon's handoff socket and adopt its bundle.
+
+    Returns True on successful adoption (the caller must SKIP cold-start
+    journal recovery — the wire table is already live). Returns False
+    when no handoff is on offer (no socket file: a plain first boot) or
+    when the transfer failed — missing listener (outgoing killed -9),
+    truncated frame, schema mismatch — in which case the fallback is
+    recorded (``HandoffFallback`` flight entry, degraded until the
+    caller's recovery completes) and the caller must run the cold-start
+    path."""
+    try:
+        stale = os.stat(socket_path)
+    except OSError:
+        return False  # nothing to adopt; silent cold start
+    started = time.monotonic()
+    hid = next(_handoff_ids)
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    try:
+        try:
+            sock.connect(socket_path)
+        except OSError as e:
+            _fallback(hid, f"handoff socket present but not serving "
+                      f"(outgoing daemon killed mid-upgrade?): {e}")
+            # remove the corpse so the NEXT plain restart cold-starts
+            # silently instead of recording this same fallback forever;
+            # inode-guarded — a new outgoing daemon may have rebound
+            # the path between the failed connect and here, and ITS
+            # listener must survive
+            try:
+                cur = os.stat(socket_path)
+                if (cur.st_ino, cur.st_dev) == (stale.st_ino,
+                                                stale.st_dev):
+                    os.unlink(socket_path)
+            except OSError:
+                pass
+            return False
+        try:
+            bundle, size = recv_frame(sock)
+        except SchemaMismatch as e:
+            try:
+                # the reject must be framed in the PEER's dialect — a
+                # reply in OUR version would be unparseable to the very
+                # daemon whose version mismatched, turning the explicit
+                # reject (thaw + dispatch queued requests locally) into
+                # an ambiguous ACK loss over there
+                send_frame(sock, {"adopted": False, "reason": str(e)},
+                           version=getattr(e, "version", SCHEMA_VERSION))
+            except OSError:
+                pass
+            _fallback(hid, f"incompatible bundle: {e}")
+            return False
+        except (FrameError, OSError) as e:
+            _fallback(hid, f"bundle transfer failed: {e}")
+            return False
+        try:
+            report = adopt_bundle(manager, bundle, handoff_id=hid)
+            results = _apply_pending_cni(manager,
+                                         bundle.get("pending_cni") or [])
+        except Exception as e:  # noqa: BLE001 — a frame-valid but
+            # content-malformed bundle must fall back to cold-start
+            # recovery, not crashloop the incoming daemon's startup
+            log.exception("bundle adoption failed")
+            try:
+                send_frame(sock, {"adopted": False,
+                                  "reason": f"adoption failed: {e!r}"})
+            except OSError:
+                pass
+            _fallback(hid, f"bundle adoption failed: {e!r}")
+            return False
+        report.pending_applied = len(results)
+        try:
+            send_frame(sock, {"adopted": True, "results": results})
+        except OSError as e:
+            # adoption is already committed locally; the outgoing
+            # daemon will time out, thaw, and let kubelet retry its
+            # queued requests — safe (DEL idempotent, ADD re-driven)
+            log.warning("handoff ACK could not be delivered: %s", e)
+        duration = time.monotonic() - started
+        metrics.HANDOFFS.inc(role="incoming", result="adopted")
+        flight.record("handoff", "HandoffAdopted", duration_s=duration,
+                      attributes={
+                          "bundle_bytes": size,
+                          "handoff_id": hid,
+                          "adopted_hops": report.adopted_hops,
+                          "adopted_sandboxes": report.adopted_sandboxes,
+                          "pending_applied": report.pending_applied,
+                          "discrepancies": len(report.discrepancies)})
+        STATUS.note("adopted")
+        log.info("handoff adopted: %d-byte bundle, %d hop(s), %d "
+                 "sandbox(es), %d pending CNI op(s), %d discrepancy"
+                 "(ies) in %.3fs", size, report.adopted_hops,
+                 report.adopted_sandboxes, report.pending_applied,
+                 len(report.discrepancies), duration)
+        return True
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _fallback(hid: int, reason: str) -> None:
+    log.warning("handoff adoption failed (%s); falling back to "
+                "cold-start journal recovery", reason)
+    metrics.HANDOFFS.inc(role="incoming", result="fallback")
+    # the handoff_id scopes any adoption-discrepancy entries a
+    # partially-run adopt_bundle recorded before the failure to THIS
+    # attempt in `tpuctl handoff status`
+    flight.record("handoff", "HandoffFallback",
+                  attributes={"reason": reason, "handoff_id": hid})
+    STATUS.begin_fallback(reason)

@@ -15,7 +15,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 import grpc
 
@@ -29,7 +29,9 @@ from ..utils import metrics, tracing
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
 from ..vsp.rpc import VspChannel
+from . import handoff as handoff_mod
 from .device_handler import TpuDeviceHandler
+from .handoff import HandoffStarter
 from .sfc_reconciler import SfcReconciler
 
 log = logging.getLogger(__name__)
@@ -67,6 +69,11 @@ class HostSideManager:
         self.ipam_dir = path_manager.cni_cache_dir() + "/ipam"
         self._tpu_daemon_addr: Optional[tuple] = None
         self._manager: Optional[Manager] = None
+        # live handoff: one serve at a time (daemon/handoff.py)
+        self._handoff_starter = HandoffStarter()
+        #: set by the owning Daemon: runs after a served handoff so the
+        #: outgoing process stops regardless of the trigger
+        self.handoff_on_complete: Optional[Callable[[], None]] = None
 
     # -- SideManager lifecycle (daemon.go:23-28) ------------------------------
     def start_vsp(self):
@@ -78,6 +85,15 @@ class HostSideManager:
         self.device_handler.setup_devices()
 
     def listen(self):
+        # adopt a live handoff from an outgoing daemon before any
+        # server binds: the device-plugin allocation snapshot, NetConf
+        # cache and chip-allocation locks carry over so no pod observes
+        # the upgrade; without one, the on-disk cache IS the cold-start
+        # recovery (daemon/handoff.py)
+        from . import handoff
+        if not handoff.adopt_into(self,
+                                  self.path_manager.handoff_socket()):
+            handoff.STATUS.mark_recovered()
         self.device_plugin.start()
         self.cni_server.start()
 
@@ -95,10 +111,40 @@ class HostSideManager:
 
     def degraded_sites(self) -> list:
         """Open circuit breakers on the VSP seam (utils/resilience.py)
-        — surfaced as a Degraded condition on SFC CRs this side
-        reconciles. Mock VSPs without breakers report healthy."""
+        plus a handoff fallback still recovering — surfaced as a
+        Degraded condition on SFC CRs this side reconciles. Mock VSPs
+        without breakers report healthy."""
+        from . import handoff
         provider = getattr(self.vsp, "degraded_sites", None)
-        return list(provider()) if callable(provider) else []
+        sites = list(provider()) if callable(provider) else []
+        return sites + handoff.STATUS.degraded_components()
+
+    # -- live handoff (daemon/handoff.py) -------------------------------------
+    def freeze_for_handoff(self):
+        """Stop mutating (CNI ADD/DEL queue, reconciler pauses, both
+        drained — nothing is mid-mutation when the bundle serializes;
+        False on drain timeout, re-checked by the serve path) while
+        the state bundle is in flight; reads keep flowing."""
+        return handoff_mod.freeze_mutations(self.cni_server, self._manager)
+
+    def drain_for_handoff(self, timeout: float = 5.0) -> bool:
+        """Re-check the freeze drain (serve path, pre-serialization)."""
+        return handoff_mod.drain_mutations(self.cni_server, self._manager,
+                                           timeout=timeout)
+
+    def thaw_after_handoff(self, dispatch_queued: bool = True):
+        handoff_mod.thaw_mutations(self.cni_server, self._manager,
+                                   dispatch_queued=dispatch_queued)
+
+    def begin_handoff(self, timeout: float = 30.0,
+                      on_complete=None) -> bool:
+        """Serve a live state handoff in the background (SIGUSR2 /
+        AdminService.BeginHandoff); without an explicit *on_complete*
+        the daemon-set ``handoff_on_complete`` hook stops the process
+        after adoption."""
+        return self._handoff_starter.begin(
+            self, self.path_manager.handoff_socket(), timeout=timeout,
+            on_complete=on_complete or self.handoff_on_complete)
 
     def stop(self):
         if self._manager:

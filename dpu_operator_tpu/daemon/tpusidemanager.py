@@ -15,12 +15,12 @@ import logging
 import os
 import re
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 from ..cni import CniServer
 from ..cni.announce import announce_result
 from ..cni.ipam import ipam_add, ipam_del
-from ..utils import metrics, tracing
+from ..utils import atomicfile, metrics, tracing
 from ..cni.types import PodRequest
 from ..deviceplugin import DevicePlugin
 from ..k8s import events
@@ -28,7 +28,9 @@ from ..k8s.manager import Manager
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
 from ..vsp.rpc import VspServer
+from . import handoff as handoff_mod
 from .device_handler import IciPortDeviceHandler, TpuDeviceHandler
+from .handoff import HandoffStarter
 from .sfc_reconciler import SfcReconciler
 
 log = logging.getLogger(__name__)
@@ -85,6 +87,18 @@ class _SliceServiceForwarder:
         if self.manager is None:
             raise RuntimeError("admin plane not wired")
         return self.manager.get_chains()
+
+    def begin_handoff(self, req: dict) -> dict:
+        """Start a live state handoff (tpuctl handoff begin): freeze
+        mutations and serve the state bundle on the local handoff
+        socket until an incoming daemon adopts or the window times
+        out (then thaw). LOCAL-NODE-ONLY like resize: the handoff
+        socket only exists on this host anyway."""
+        if self.manager is None:
+            raise RuntimeError("admin plane not wired")
+        timeout = float(req.get("timeout", 30.0) or 30.0)
+        started = self.manager.begin_handoff(timeout=timeout)
+        return {"started": started}
 
     def create_slice_attachment(self, req: dict) -> dict:
         return self.vsp.create_slice_attachment(req)
@@ -174,7 +188,13 @@ class TpuSideManager:
         self._repair_thread: Optional[threading.Thread] = None
         self._repair_client = None
         self._repair_pass_lock = threading.Lock()
+        self._repair_frozen = threading.Event()
         self._manager: Optional[Manager] = None
+        self._handoff_starter = HandoffStarter()
+        #: set by the owning Daemon: runs after a served handoff so the
+        #: outgoing process stops regardless of the trigger (SIGUSR2 or
+        #: AdminService.BeginHandoff via tpuctl)
+        self.handoff_on_complete: Optional[Callable[[], None]] = None
 
     # -- SideManager lifecycle ------------------------------------------------
     def start_vsp(self):
@@ -185,14 +205,21 @@ class TpuSideManager:
         self.device_handler.setup_devices()
 
     def listen(self):
-        # journal recovery strictly BEFORE any server goes live: a
+        # state recovery strictly BEFORE any server goes live: a
         # retried CNI DEL landing pre-recovery would find an empty
         # attach store, release only IPAM, then be clobbered by recovery
         # (resurrecting the deleted sandbox and leaking its NF wire);
         # and a peer's GetChainEntry answered from the still-empty chain
         # store reads as 'NF gone' and tears down a LIVE cross-host hop.
         # Recovery only needs the VSP, which start_vsp() already dialed.
-        self._recover_chains()
+        # Preferred source: a LIVE handoff from an outgoing daemon
+        # (zero re-steers); fallback: the cold-start journal/.last-good
+        # path — degraded (HandoffFallback), never wedged.
+        from . import handoff
+        if not handoff.adopt_into(self,
+                                  self.path_manager.handoff_socket()):
+            self._recover_chains()
+            handoff.STATUS.mark_recovered()
         # cross-boundary server on the VSP-returned addr (:141-165)
         ip, port = self._addr
         self._slice_server = VspServer(
@@ -1140,6 +1167,13 @@ class TpuSideManager:
         # otherwise race — the loser's stray-wire cleanup could unwire
         # the winner's freshly installed hop
         with self._repair_pass_lock:
+            if self._repair_frozen.is_set():
+                # handoff freeze window: a re-steer AFTER the bundle's
+                # wire table serialized would be invisible to the
+                # adopting daemon — its reconcile-against-dataplane
+                # would drop the hop and the live wire would leak,
+                # untracked by either generation
+                return []
             repaired = self._repair_chains_locked()
         self._flush_chains()
         return repaired
@@ -1301,14 +1335,12 @@ class TpuSideManager:
                 self.__dict__["_chains_dirty"] = False
             try:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(data, f)
                 # keep the outgoing snapshot reachable as last-good via
-                # a hardlink (O(1), no data copy): os.replace is atomic
-                # against OUR writes, but a crash/power-cut can still
-                # leave the primary truncated at the filesystem level —
-                # recovery falls back to this file (_load_journal)
+                # a hardlink (O(1), no data copy) BEFORE the new write
+                # lands: atomic_write's rename is atomic against OUR
+                # writes, but a crash/power-cut can still leave the
+                # primary truncated at the filesystem level — recovery
+                # falls back to this file (_load_journal)
                 bak = path + ".last-good"
                 if os.path.exists(path):
                     try:
@@ -1319,7 +1351,9 @@ class TpuSideManager:
                         os.link(path, bak)
                     except OSError:
                         pass  # exotic fs without hardlinks: no fallback
-                os.replace(tmp, path)  # atomic: no torn reads
+                # crash-safe temp+fsync+rename (utils/atomicfile.py —
+                # the handoff-state-discipline invariant)
+                atomicfile.atomic_write(path, json.dumps(data))
                 metrics.JOURNAL_FLUSHES.inc()
             except OSError:
                 log.exception("chain journal write failed (%s)", path)
@@ -1373,6 +1407,20 @@ class TpuSideManager:
         metrics.JOURNAL_RECOVERIES.inc(result="empty")
         return None
 
+    def _dataplane_ground(self):
+        """Persisted wire pairs from the dataplane, or None when the
+        VSP cannot enumerate them (None = UNKNOWN, not empty)."""
+        lister = getattr(self.vsp, "list_network_functions", None)
+        if lister is None:
+            return None
+        try:
+            wires = lister()
+            return {tuple(w) for w in wires} if wires is not None else None
+        except Exception:  # noqa: BLE001 — degrade to trust-journal
+            log.warning("dataplane wire list unavailable; trusting the "
+                        "journaled/adopted wire table as-is")
+            return None
+
     def _recover_chains(self):
         """Rebuild the wire table after a daemon restart: load the
         journal, then reconcile it against the dataplane's persisted wire
@@ -1390,17 +1438,75 @@ class TpuSideManager:
         data = self._load_journal(path)
         if data is None:
             return
-        ground = None
-        lister = getattr(self.vsp, "list_network_functions", None)
-        if lister is not None:
-            try:
-                wires = lister()
-                if wires is not None:
-                    ground = {tuple(w) for w in wires}
-            except Exception:  # noqa: BLE001 — degrade to trust-journal
-                log.warning("dataplane wire list unavailable; trusting "
-                            "chain journal as-is")
-        restored = dropped = 0
+        restored, dropped = self._apply_wire_table(
+            data, self._dataplane_ground())
+        if restored or dropped:
+            log.info("recovered %d steered hop(s) from the chain journal "
+                     "(%d dropped as not wired)", restored, len(dropped))
+
+    # -- live handoff (daemon/handoff.py) -------------------------------------
+    def export_wire_table(self) -> dict:
+        """Wire-table snapshot for the handoff bundle — the chain
+        journal position, taken live under the lock."""
+        with self._attach_lock:
+            return self._snapshot_chains_locked()
+
+    def adopt_wire_table(self, data: dict) -> tuple:
+        """Adopt a handed-off wire table in place of journal recovery:
+        hops stay wired, nothing is re-steered. Entries the dataplane
+        disproves are dropped and reported as (restored, dropped
+        details) for the adoption discrepancy accounting."""
+        return self._apply_wire_table(data, self._dataplane_ground())
+
+    def freeze_for_handoff(self):
+        """Stop mutating: CNI ADD/DEL queue, the reconciler pauses,
+        the chain-repair loop parks, then everything DRAINS — a
+        dispatch, reconcile or repair pass already past its gate
+        finishes before the bundle serializes. Returns False when the
+        drain timed out (the serve path re-checks before serializing
+        and aborts rather than cut a bundle mid-mutation). Reads
+        (CHECK, admin GetChains, device plugin, metrics) keep being
+        served until the incoming daemon ACKs."""
+        # park chain repair first: the flag stops NEW passes (both the
+        # periodic loop and AdminService.RepairChains funnel through
+        # repair_chains), and acquiring the pass lock drains one
+        # already in flight — after this no repair can re-steer a hop
+        # behind the serialized bundle's back
+        self._repair_frozen.set()
+        with self._repair_pass_lock:
+            pass
+        return handoff_mod.freeze_mutations(self.cni_server, self._manager)
+
+    def drain_for_handoff(self, timeout: float = 5.0) -> bool:
+        """Re-check the freeze drain (serve path, pre-serialization)."""
+        return handoff_mod.drain_mutations(self.cni_server, self._manager,
+                                           timeout=timeout)
+
+    def thaw_after_handoff(self, dispatch_queued: bool = True):
+        """Abort path: resume normal service (queued CNI requests are
+        dispatched locally when unambiguous — this daemon still owns
+        the dataplane; see handoff.thaw_mutations)."""
+        handoff_mod.thaw_mutations(self.cni_server, self._manager,
+                                   dispatch_queued=dispatch_queued)
+        # repair resumes only on the abort path — after a SERVED
+        # handoff the flag stays set so this (exiting) daemon can never
+        # re-steer a dataplane its successor now owns
+        self._repair_frozen.clear()
+
+    def begin_handoff(self, timeout: float = 30.0,
+                      on_complete=None) -> bool:
+        """Serve a live state handoff in the background (SIGUSR2 /
+        AdminService.BeginHandoff). Returns False when one is already
+        in flight. Without an explicit *on_complete*, the daemon-set
+        ``handoff_on_complete`` hook runs after adoption (the process
+        must stop no matter which entry point started the handoff)."""
+        return self._handoff_starter.begin(
+            self, self.path_manager.handoff_socket(), timeout=timeout,
+            on_complete=on_complete or self.handoff_on_complete)
+
+    def _apply_wire_table(self, data: dict, ground) -> tuple:
+        restored = 0
+        dropped: list = []
         with self._attach_lock:
             for c in data.get("chains", []):
                 key = (c.get("namespace", "default"), c.get("name", ""))
@@ -1411,6 +1517,9 @@ class TpuSideManager:
                 if len(pair) != 2:
                     continue
                 if ground is not None and pair not in ground:
+                    dropped.append(
+                        f"sandbox {sbx} NF wire {pair} absent from the "
+                        "dataplane")
                     log.warning("journaled sandbox %s NF wire absent from "
                                 "the dataplane; dropped", sbx)
                     continue
@@ -1425,7 +1534,9 @@ class TpuSideManager:
                 if len(ids) != 2:
                     continue
                 if ground is not None and ids not in ground:
-                    dropped += 1
+                    dropped.append(
+                        f"hop {key} ({ids[0]} -> {ids[1]}) absent from "
+                        "the dataplane")
                     log.warning("journaled hop %s (%s -> %s) absent from "
                                 "the dataplane; dropped", key, *ids)
                     continue
@@ -1447,17 +1558,18 @@ class TpuSideManager:
                                                   old_ids)
             self._save_chains_locked()
         self._flush_chains()
-        if restored or dropped:
-            log.info("recovered %d steered hop(s) from the chain journal "
-                     "(%d dropped as not wired)", restored, dropped)
+        return restored, dropped
 
     def degraded_sites(self) -> list:
         """Dependency sites currently walled off by an open circuit
-        breaker (utils/resilience.py) — the daemon's Degraded signal,
-        surfaced on SFC CR conditions and the health endpoint. Mock VSPs
-        without breakers report healthy."""
+        breaker (utils/resilience.py), plus a handoff fallback still
+        recovering — the daemon's Degraded signal, surfaced on SFC CR
+        conditions and the health endpoint. Mock VSPs without breakers
+        report healthy."""
+        from . import handoff
         provider = getattr(self.vsp, "degraded_sites", None)
-        return list(provider()) if callable(provider) else []
+        sites = list(provider()) if callable(provider) else []
+        return sites + handoff.STATUS.degraded_components()
 
     # -- chain observability --------------------------------------------------
     def chain_status(self, namespace: str, name: str) -> list:

@@ -213,6 +213,16 @@ class DevicePlugin:
         self._poke = threading.Event()
         self._devices: dict[str, dict] = {}
         self._devices_lock = threading.Lock()
+        #: (st_ino, st_dev) of the socket file _start_locked bound —
+        #: stop() only removes the file while it still matches, so an
+        #: outgoing daemon's shutdown can never delete the fresh socket
+        #: an incoming (handoff) daemon just bound at the same path
+        self._bound_socket_id: Optional[tuple] = None
+        #: handoff-adopted device snapshot: served while the live
+        #: handler cannot answer yet (VSP still dialing) so kubelet's
+        #: ListAndWatch never observes a spurious shrink across an
+        #: upgrade; cleared on the first non-empty live snapshot
+        self._adopted: Optional[dict] = None
         # refresh barrier state: _refresh_gen bumps per refresh request;
         # the stream loop records the gen its latest yielded (or
         # unchanged) snapshot covered in _served_gen
@@ -251,6 +261,11 @@ class DevicePlugin:
         self._server.add_generic_rpc_handlers((_PluginHandler(self),))
         self._server.add_insecure_port(f"unix://{self.socket_path}")
         self._server.start()
+        try:
+            st = os.stat(self.socket_path)
+            self._bound_socket_id = (st.st_ino, st.st_dev)
+        except OSError:
+            self._bound_socket_id = None
         log.info("device plugin %s serving on %s", self.resource,
                  self.socket_path)
 
@@ -290,12 +305,45 @@ class DevicePlugin:
             # acquiring the lock — without this the revived server and
             # watch loop would outlive shutdown
             self._stop.set()
-            if self._server:
-                self._server.stop(0.5).wait()
-                self._server = None
+            self._unbind_server_locked()
         if self._kubelet_watch_thread is not None:
             self._kubelet_watch_thread.join(timeout=3)
             self._kubelet_watch_thread = None
+
+    def _unbind_server_locked(self):
+        """Stop the gRPC server WITHOUT deleting a successor's socket.
+
+        grpc-core unlinks the bound *path* when the server stops — even
+        when an incoming (handoff) daemon has already wiped our stale
+        file and bound a fresh socket at the same path. Deleting that
+        fresh file would sever kubelet from the new daemon mid-upgrade.
+        So: if the file at socket_path is no longer the inode
+        _start_locked bound, park it aside for the duration of the stop
+        and restore it after (the listener holds the inode; the rename
+        round-trip preserves it)."""
+        if self._server is None:
+            return
+        parked = None
+        try:
+            st = os.stat(self.socket_path)
+            if (self._bound_socket_id is not None
+                    and (st.st_ino, st.st_dev) != self._bound_socket_id):
+                parked = self.socket_path + ".handoff-keep"
+                os.rename(self.socket_path, parked)
+                log.info("device plugin %s: socket %s re-bound by a "
+                         "successor; preserving it across our shutdown",
+                         self.resource, self.socket_path)
+        except OSError:
+            parked = None  # no file to protect
+        self._server.stop(0.5).wait()
+        self._server = None
+        self._bound_socket_id = None
+        if parked is not None:
+            try:
+                os.rename(parked, self.socket_path)
+            except OSError:
+                log.exception("restoring successor socket %s failed",
+                              self.socket_path)
 
     # -- kubelet-restart resilience -------------------------------------------
     def enable_kubelet_watch(self, interval: float = 1.0):
@@ -368,9 +416,7 @@ class DevicePlugin:
         with self._lifecycle_lock:
             if self._stop.is_set():
                 return  # shutdown won the race: stay down
-            if self._server is not None:
-                self._server.stop(0.5).wait()
-                self._server = None
+            self._unbind_server_locked()
             self._start_locked()
 
     # -- registration (deviceplugin.go:229-262) -------------------------------
@@ -394,9 +440,53 @@ class DevicePlugin:
         finally:
             channel.close()
 
+    # -- handoff adoption (daemon/handoff.py) ---------------------------------
+    def snapshot_devices(self) -> dict:
+        """Copy of the currently advertised device set (handoff bundle
+        export: the allocation snapshot kubelet last saw)."""
+        with self._devices_lock:
+            return {k: dict(v) for k, v in self._devices.items()}
+
+    def adopt_snapshot(self, devices: dict) -> None:
+        """Pre-seed the advertised set from a handoff bundle. Until the
+        live device handler produces a non-empty answer of its own,
+        ListAndWatch serves this snapshot — kubelet re-registers against
+        the SAME allocation view and never observes a spurious device
+        deletion across the upgrade."""
+        adopted = {k: dict(v) for k, v in (devices or {}).items()}
+        if not adopted:
+            return
+        with self._devices_lock:
+            self._devices = {k: dict(v) for k, v in adopted.items()}
+            self._adopted = adopted
+        metrics.DEVICES_ADVERTISED.set(
+            sum(1 for d in adopted.values() if d.get("healthy")),
+            resource=self.resource)
+
     # -- DevicePlugin service -------------------------------------------------
     def _snapshot(self) -> dict[str, dict]:
-        devs = self.device_handler.get_devices()
+        try:
+            devs = self.device_handler.get_devices()
+        except Exception:  # noqa: BLE001 — classified below
+            with self._devices_lock:
+                adopted = self._adopted
+            if adopted is None:
+                raise
+            # live handler not answering yet (incoming daemon's VSP
+            # still coming up): keep serving the adopted snapshot so
+            # kubelet never sees the set blink out mid-upgrade
+            log.warning("device handler for %s unavailable; serving the "
+                        "handoff-adopted snapshot", self.resource,
+                        exc_info=True)
+            devs = {k: dict(v) for k, v in adopted.items()}
+        else:
+            with self._devices_lock:
+                if not devs and self._adopted:
+                    # an empty early answer (topology not learned yet)
+                    # must not retract the adopted set either
+                    devs = {k: dict(v) for k, v in self._adopted.items()}
+                elif devs:
+                    self._adopted = None  # live handler owns the set now
         with self._devices_lock:
             self._devices = dict(devs)
         metrics.DEVICES_ADVERTISED.set(

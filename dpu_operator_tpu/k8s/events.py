@@ -23,7 +23,9 @@ every constructor: unconfigured, :func:`emit` is a no-op.
 Event catalog (reasons): ``BreakerOpen`` / ``BreakerClosed``,
 ``JournalRecovered``, ``ChainRepaired``, ``WatchdogStall`` /
 ``WatchdogRecovered``, ``SloAlertFiring`` / ``SloAlertCleared``,
-``OperatorDegraded`` / ``OperatorHealthy`` (doc/observability.md).
+``OperatorDegraded`` / ``OperatorHealthy``, ``UpgradeStarted`` /
+``UpgradeHeld`` / ``UpgradeCompleted``, ``AdoptionDiscrepancy``
+(doc/observability.md).
 """
 
 from __future__ import annotations

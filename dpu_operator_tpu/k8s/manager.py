@@ -50,6 +50,15 @@ class Manager:
         self._lock = threading.Lock()
         self._cancels = []
         self._stop = threading.Event()
+        #: handoff freeze gate: while cleared, the worker parks BEFORE
+        #: processing the next item (outside the watchdog task scope, so
+        #: a paused manager reads as idle, not stalled)
+        self._resume_gate = threading.Event()
+        self._resume_gate.set()
+        #: set whenever no reconcile body is executing — pause() +
+        #: drain() together give the handoff a mutation-free window
+        self._quiesced = threading.Event()
+        self._quiesced.set()
         self._thread: Optional[threading.Thread] = None
         self._idle = threading.Event()
         self._idle.set()
@@ -94,8 +103,23 @@ class Manager:
                                         name="manager-worker")
         self._thread.start()
 
+    def pause(self) -> None:
+        """Park the worker before its next reconcile (handoff freeze:
+        the outgoing daemon must stop mutating cluster state while its
+        bundle is in flight). Watch events still enqueue; nothing is
+        lost — resume() drains the backlog."""
+        self._resume_gate.clear()
+
+    def resume(self) -> None:
+        self._resume_gate.set()
+
+    @property
+    def paused(self) -> bool:
+        return not self._resume_gate.is_set()
+
     def stop(self) -> None:
         self._stop.set()
+        self._resume_gate.set()  # wake a paused worker so it can exit
         for c in self._cancels:
             c()
         self._queue.put(None)
@@ -108,6 +132,14 @@ class Manager:
     def wait_idle(self, timeout: float = 10.0) -> bool:
         """Test helper: block until the workqueue drains."""
         return self._idle.wait(timeout)
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until no reconcile body is mid-flight. Meaningful
+        after :meth:`pause`: the worker parks before its NEXT item, so
+        once the CURRENT reconcile (if any) finishes, nothing mutates
+        until resume() — the quiescence a handoff bundle needs. False
+        on timeout (a stalled reconcile belongs to the watchdog)."""
+        return self._quiesced.wait(timeout)
 
     #: error-retry backoff bounds (controller-runtime uses 5ms..16m;
     #: scaled down since our base reconciles are cheap)
@@ -169,29 +201,46 @@ class Manager:
             item = self._queue.get()
             if item is None:
                 break
+            while True:
+                self._resume_gate.wait()
+                # claim-then-recheck: if pause() landed between the
+                # gate wait and the claim, release and park again so
+                # drain() never returns while this item is about to run
+                self._quiesced.clear()
+                if self._resume_gate.is_set():
+                    break
+                self._quiesced.set()
+            if self._stop.is_set():
+                self._quiesced.set()
+                break  # stop() raced a paused worker: never reconcile
+                # past the handoff freeze with state already handed off
             rec, req = item
             fkey = (id(rec), req)
             controller = type(rec).__name__
             with self._lock:
                 self._pending.discard(fkey)
             try:
-                metrics.RECONCILE_TOTAL.inc(controller=controller)
-                with watchdog.task(self._heartbeat), \
-                        metrics.RECONCILE_SECONDS.time(), \
-                        tracing.span("reconcile", controller=controller,
-                                     request=req.name or ""):
-                    result = (rec.reconcile(self.client, req)
-                              or ReconcileResult())
-                failures.pop(fkey, None)
-            except Exception:
-                metrics.RECONCILE_ERRORS.inc(controller=controller)
-                n = failures.get(fkey, 0)
-                failures[fkey] = n + 1
-                delay = min(self.RETRY_BASE * (2 ** n), self.RETRY_MAX)
-                log.exception("reconcile failed for %s (retry in %.1fs)",
-                              req, delay)
-                self._schedule_retry(delay, rec, req, timers)
-                result = ReconcileResult()
+                try:
+                    metrics.RECONCILE_TOTAL.inc(controller=controller)
+                    with watchdog.task(self._heartbeat), \
+                            metrics.RECONCILE_SECONDS.time(), \
+                            tracing.span("reconcile",
+                                         controller=controller,
+                                         request=req.name or ""):
+                        result = (rec.reconcile(self.client, req)
+                                  or ReconcileResult())
+                    failures.pop(fkey, None)
+                except Exception:
+                    metrics.RECONCILE_ERRORS.inc(controller=controller)
+                    n = failures.get(fkey, 0)
+                    failures[fkey] = n + 1
+                    delay = min(self.RETRY_BASE * (2 ** n), self.RETRY_MAX)
+                    log.exception("reconcile failed for %s (retry in "
+                                  "%.1fs)", req, delay)
+                    self._schedule_retry(delay, rec, req, timers)
+                    result = ReconcileResult()
+            finally:
+                self._quiesced.set()
             if result.requeue_after:
                 self._schedule_retry(result.requeue_after, rec, req, timers,
                                      counts_as_pending=False)

@@ -94,6 +94,69 @@ def _vsp_cmds(sub):
              "TpuOperatorConfig CR's Healthy/Degraded conditions fold")
     p.add_argument("--token", default="",
                    help="bearer token when /debug/health is auth-filtered")
+    p = sub.add_parser(
+        "handoff",
+        help="zero-downtime upgrade: 'begin' asks the daemon (over "
+             "--daemon-addr) to freeze mutations and serve its live "
+             "state bundle on the local handoff socket; 'status' "
+             "renders the last handoff's flight-recorder entries "
+             "(duration, bundle size, adoption discrepancies, fallback "
+             "reason) from --metrics-addr")
+    p.add_argument("action", choices=["begin", "status"])
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="how long the outgoing daemon waits for an "
+                        "incoming daemon before thawing (begin)")
+    p.add_argument("--token", default="",
+                   help="bearer token when /debug/flight is "
+                        "auth-filtered (status)")
+
+
+def handoff_status(snap: dict) -> dict:
+    """Render the last handoff from a /debug/flight snapshot: the final
+    handoff-kind entry (HandoffServed/Adopted/Aborted/Fallback) plus
+    every adoption discrepancy recorded with it — the post-upgrade
+    answer to "did the handoff actually carry everything over?"."""
+    events = snap.get("events", [])
+    handoffs = [e for e in events if e.get("kind") == "handoff"]
+    adoptions = [e for e in events if e.get("kind") == "adoption"]
+    if not handoffs:
+        return {"lastHandoff": None, "adoptionDiscrepancies": [],
+                "history": []}
+    last = handoffs[-1]
+    attrs = last.get("attributes") or {}
+    # scope discrepancies to the LAST handoff via its handoff_id —
+    # adoption entries from an earlier handoff still sitting in the
+    # flight ring are not this handoff's problem. Every handoff entry
+    # carries the stamp; one without it (a pre-stamp ring, or a Served
+    # entry meaning this daemon was the OUTGOING side and never
+    # adopted) attributes NO discrepancies rather than inheriting an
+    # earlier adoption's
+    hid = attrs.get("handoff_id")
+    adoptions = [e for e in adoptions
+                 if hid is not None
+                 and (e.get("attributes") or {}).get("handoff_id")
+                 == hid]
+    out = {
+        "lastHandoff": {
+            "result": last.get("name", ""),
+            "at": last.get("ts"),
+            "durationSeconds": last.get("duration_s"),
+            "bundleBytes": attrs.get("bundle_bytes"),
+            "adoptedHops": attrs.get("adopted_hops"),
+            "adoptedSandboxes": attrs.get("adopted_sandboxes"),
+            "pendingCniApplied": attrs.get("pending_applied"),
+            "fallbackReason": (attrs.get("reason", "")
+                               if last.get("name") in ("HandoffFallback",
+                                                       "HandoffAborted")
+                               else ""),
+        },
+        "adoptionDiscrepancies": [
+            {"kind": e.get("name", ""),
+             "detail": (e.get("attributes") or {}).get("detail", "")}
+            for e in adoptions],
+        "history": [e.get("name", "") for e in handoffs],
+    }
+    return out
 
 
 def main(argv=None):
@@ -155,6 +218,11 @@ def run(args) -> dict:
         return fetch(args.metrics_addr, token=args.token,
                      path="/debug/health")
 
+    if args.cmd == "handoff" and args.action == "status":
+        from .utils.flight import fetch
+        snap = fetch(args.metrics_addr, token=args.token)
+        return handoff_status(snap)
+
     if args.cmd == "flight":
         from .utils.flight import fetch
         snap = fetch(args.metrics_addr, token=args.token)
@@ -168,6 +236,17 @@ def run(args) -> dict:
                 "recorded": snap.get("recorded"), "events": events}
 
     from .vsp.rpc import VspChannel, unix_target
+
+    if args.cmd == "handoff":  # action == "begin" (status returned above)
+        if not args.daemon_addr:
+            raise SystemExit("handoff begin needs --daemon-addr")
+        channel = VspChannel(args.daemon_addr)
+        try:
+            return channel.call("AdminService", "BeginHandoff",
+                                {"timeout": args.timeout},
+                                timeout=args.timeout + 10.0)
+        finally:
+            channel.close()
 
     if args.cmd == "repair-chains":
         if not args.daemon_addr:

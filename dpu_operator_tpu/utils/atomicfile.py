@@ -1,0 +1,146 @@
+"""Crash-safe writes for daemon state directories.
+
+Every file the daemon persists under its state dirs (NetConf cache,
+chip-allocation locks, chain journal, handoff artifacts) is read back
+by a FUTURE process — a restarted daemon, or the incoming daemon of a
+live handoff. A ``kill -9`` landing mid-``write()`` must therefore
+never be able to leave a truncated file at the final path: a poisoned
+cache entry silently breaks the next DEL, a half-written allocation
+lock reads as "owned by ''" and wedges the chip forever.
+
+The discipline (enforced by the opslint ``handoff-state-discipline``
+rule): state writers never ``open(path, "w")`` the final path. They
+write a temp file **in the same directory** (same filesystem, so the
+rename is atomic), ``fsync`` it, then ``os.rename`` into place —
+readers observe either the complete old content or the complete new
+content, nothing in between.
+"""
+
+from __future__ import annotations
+
+import errno
+import itertools
+import os
+import threading
+from typing import Union
+
+#: temp names must be unique per WRITER, not just per process: the CNI
+#: dispatch pool can run two claims for the same path concurrently, and
+#: a shared temp file lets one writer publish the other's content (or
+#: unlink it mid-link). pid + thread id + a counter covers concurrent
+#: AND re-entrant use.
+_seq = itertools.count()
+
+
+def _tmp_name(path: str, kind: str) -> str:
+    return (f"{path}.{kind}.{os.getpid()}.{threading.get_ident()}"
+            f".{next(_seq)}")
+
+
+def _fsync_dir(path: str) -> None:
+    """Persist a just-performed rename/link by fsyncing its directory
+    (best-effort: some filesystems reject O_RDONLY dir fsync)."""
+    try:
+        dfd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+def atomic_write(path: str, data: Union[str, bytes],
+                 fsync: bool = True, mode: int = 0o600) -> None:
+    """Write *data* to *path* crash-safely: temp file in the same
+    directory, fsync, atomic ``os.rename``. Raises OSError on failure
+    with the temp file cleaned up and the old *path* untouched."""
+    payload = data.encode() if isinstance(data, str) else data
+    directory = os.path.dirname(path)
+    tmp = _tmp_name(path, "tmp")
+    fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, mode)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.rename(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        _fsync_dir(directory)
+
+
+def atomic_claim(path: str, data: Union[str, bytes],
+                 fsync: bool = True, mode: int = 0o600) -> bool:
+    """Atomically create *path* with *data* iff it does not already
+    exist — the crash-safe form of ``O_CREAT | O_EXCL`` + ``write``.
+
+    The naive form can be killed between the ``open`` and the
+    ``write``, leaving an empty claim file that poisons every later
+    owner check. Here the content is written and fsynced to a temp
+    file FIRST, then ``os.link``\\ ed into place: the link either fails
+    with ``FileExistsError`` (someone else holds the claim — returns
+    False) or atomically publishes the complete file. On a filesystem
+    without hardlinks (some overlay/FUSE mounts — the chain journal's
+    last-good link tolerates the same class) it degrades to the legacy
+    ``O_CREAT|O_EXCL`` claim: a crash mid-write can leave a truncated
+    claim there, but owner checks already detect and re-claim those
+    (the legacy-poison path) — degraded crash-safety beats failing
+    every claim on the node. Returns True when the claim landed."""
+    directory = os.path.dirname(path)
+    payload = data.encode() if isinstance(data, str) else data
+    tmp = _tmp_name(path, "claim")
+    fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, mode)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        try:
+            os.link(tmp, path)
+        except FileExistsError:
+            return False
+        except OSError as e:
+            if e.errno not in _NO_HARDLINK_ERRNOS:
+                raise
+            return _claim_excl(path, payload, fsync, mode)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    if fsync:
+        _fsync_dir(directory)
+    return True
+
+
+#: link(2) failure modes that mean "this filesystem cannot hardlink",
+#: not "the claim is contested": fall back to O_CREAT|O_EXCL there.
+_NO_HARDLINK_ERRNOS = frozenset({errno.EPERM, errno.EOPNOTSUPP,
+                                 errno.ENOSYS, errno.EMLINK,
+                                 errno.EXDEV})
+
+
+def _claim_excl(path: str, payload: bytes, fsync: bool,
+                mode: int) -> bool:
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, mode)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "wb") as f:
+        f.write(payload)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    if fsync:
+        _fsync_dir(os.path.dirname(path))
+    return True

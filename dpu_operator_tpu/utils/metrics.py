@@ -393,6 +393,16 @@ JOURNAL_RECOVERIES = REGISTRY._add(_FlightRecordedCounter(
     "read clean; last_good = truncated/corrupt journal, fell back to "
     "the previous snapshot; empty = no readable snapshot at all)",
     kind="journal_recovery"))
+# -- zero-downtime upgrade (daemon/handoff.py) -------------------------------
+HANDOFFS = REGISTRY.counter(
+    "tpu_daemon_handoffs_total",
+    "Live state handoffs by role and result (served/adopted = a bundle "
+    "crossed the socket and was acked; aborted = outgoing thawed and "
+    "kept serving; fallback = incoming cold-started from the journal)")
+ADOPTION_DISCREPANCIES = REGISTRY.counter(
+    "tpu_daemon_adoption_discrepancies_total",
+    "Adopted-state entries that disagreed with on-disk/dataplane "
+    "reality during handoff adoption, by kind")
 # -- health engine (utils/watchdog.py + utils/slo.py) ------------------------
 WATCHDOG_STALLS = REGISTRY.counter(
     "tpu_watchdog_stalls_total",

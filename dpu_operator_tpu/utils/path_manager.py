@@ -47,6 +47,13 @@ class PathManager:
         """
         return self._p("var/lib/cni/tpu")
 
+    def handoff_socket(self) -> str:
+        """Unix socket an outgoing daemon serves its live state bundle
+        on during a zero-downtime upgrade (daemon/handoff.py). The
+        incoming daemon dials it before falling back to cold-start
+        journal recovery."""
+        return self._p("var/run/tpu-daemon/handoff.sock")
+
     # --- VSP seam ------------------------------------------------------------
     def vendor_plugin_socket(self) -> str:
         """Unix socket the vendor-specific plugin serves gRPC on.

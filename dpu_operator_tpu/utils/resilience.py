@@ -347,6 +347,20 @@ class CircuitBreaker:
                 raise BreakerOpen(site or self.site)
             self._probes += 1
 
+    def inherit_open(self, reason: str = "") -> None:
+        """Adopt an OPEN verdict from a predecessor process (live
+        handoff): the outgoing daemon already proved this dependency
+        dead — the incoming one starts walled-off instead of re-paying
+        ``failure_threshold`` fresh failures. The reset clock starts
+        now, so a half-open probe still happens on schedule."""
+        with self._lock:
+            if self._state == self.OPEN:
+                return
+            self._opened_at = self.clock()
+            self._transition_locked(self.OPEN)
+        log.warning("circuit breaker %s opened by inheritance%s",
+                    self.site, f" ({reason})" if reason else "")
+
     def record_success(self) -> None:
         with self._lock:
             self._failures = 0

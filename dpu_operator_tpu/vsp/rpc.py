@@ -75,6 +75,7 @@ class VspServer:
         ("AdminService", "ResizeChips"): "resize_chips",
         ("AdminService", "RepairChains"): "repair_chains",
         ("AdminService", "GetChains"): "get_chains",
+        ("AdminService", "BeginHandoff"): "begin_handoff",
     }
 
     def __init__(self, impl, socket_path: Optional[str] = None,

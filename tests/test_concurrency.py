@@ -305,6 +305,7 @@ def chain_manager(short_tmp, kube):
     mgr._chain_hops = {}
     mgr._degraded_hops = set()
     mgr._repair_pass_lock = threading.Lock()
+    mgr._repair_frozen = threading.Event()
     mgr.link_prober = None
     return mgr
 

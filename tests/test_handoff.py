@@ -1,0 +1,1124 @@
+"""Zero-downtime daemon upgrade: live state handoff (make upgrade-check).
+
+The acceptance bar (ISSUE 6): a full daemon->daemon handoff under the
+chaos harness shows ZERO pod sandbox re-setups, ZERO chain re-steers and
+ZERO spurious kubelet device deletions; the kill-9-mid-transfer case
+recovers via `.last-good` with a HandoffFallback flight entry and a
+Degraded-then-Healthy transition; an incompatible bundle schema is
+rejected (outgoing thaws, incoming cold-starts); and a CNI DEL arriving
+during the frozen window is queued and applied exactly once after
+adoption. Everything is seeded/deterministic — no wall-clock sleeps
+beyond bounded waits on explicit events.
+"""
+
+import json
+import os
+import socket
+import threading
+
+import pytest
+
+from dpu_operator_tpu.cni import ChipAllocator, CniServer, NetConfCache
+from dpu_operator_tpu.cni.types import CniRequest
+from dpu_operator_tpu.daemon import TpuSideManager, handoff
+from dpu_operator_tpu.testing.chaos import ChaosVsp, Fail, FaultPlan
+from dpu_operator_tpu.utils import flight
+from dpu_operator_tpu.utils.path_manager import PathManager
+
+from utils import assert_eventually
+
+pytestmark = pytest.mark.upgrade
+
+
+# -- shared-dataplane VSP stub ------------------------------------------------
+# The real VSP is a separate long-lived process: it (and its programmed
+# wires/attachments) outlives the daemon across a handoff. Two stub
+# instances over one _Dataplane model exactly that.
+
+class _Dataplane:
+    def __init__(self):
+        self.wires = []        # programmed NF wire pairs, in order
+        self.attachments = {}
+
+
+class _UpgradeVsp:
+    def __init__(self, dataplane, chips=4):
+        self.dp = dataplane
+        self.chips = chips
+        self.created = []      # create_network_function calls BY THIS daemon
+        self.deleted = []
+        self.attach_calls = []
+        self.detach_calls = []
+
+    def get_devices(self):
+        return {f"chip-{i}": {"id": f"chip-{i}", "healthy": True,
+                              "dev_path": f"/dev/accel{i}",
+                              "coords": [i % 2, i // 2, 0]}
+                for i in range(self.chips)}
+
+    def set_num_chips(self, count):
+        pass
+
+    def create_slice_attachment(self, att):
+        self.attach_calls.append(att["name"])
+        self.dp.attachments[att["name"]] = att
+        return att
+
+    def delete_slice_attachment(self, name):
+        self.detach_calls.append(name)
+        self.dp.attachments.pop(name, None)
+
+    def create_network_function(self, a, b):
+        self.created.append((a, b))
+        if (a, b) not in self.dp.wires:
+            self.dp.wires.append((a, b))
+
+    def delete_network_function(self, a, b):
+        self.deleted.append((a, b))
+        if (a, b) in self.dp.wires:
+            self.dp.wires.remove((a, b))
+
+    def list_network_functions(self):
+        return list(self.dp.wires)
+
+
+class _Req:
+    def __init__(self, sandbox, device, ifname, pod, ns="default"):
+        self.sandbox_id = sandbox
+        self.device_id = device
+        self.ifname = ifname
+        self.pod_name = pod
+        self.pod_namespace = ns
+        self.netns = f"/var/run/netns/{sandbox}"
+
+        class _NC:
+            cni_version = "0.4.0"
+            name = ""
+            ipam = {}
+            ici_ports = []
+        self.netconf = _NC()
+
+
+def _nf_pod(kube, name, sfc, index):
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {"tpu.openshift.io/sfc": sfc,
+                                     "tpu.openshift.io/sfc-index":
+                                         str(index)}},
+        "spec": {"containers": [{"name": "c"}]},
+    })
+
+
+def _manager(root, vsp, client=None):
+    mgr = TpuSideManager(vsp, PathManager(root), client=client)
+    mgr.device_handler.setup_devices()
+    return mgr
+
+
+def _del_request(sandbox):
+    return CniRequest(
+        env={"CNI_COMMAND": "DEL", "CNI_CONTAINERID": sandbox,
+             "CNI_NETNS": f"/var/run/netns/{sandbox}", "CNI_IFNAME": "",
+             "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p"},
+        config={"cniVersion": "0.4.0", "type": "tpu-cni",
+                "mode": "network-function"})
+
+
+@pytest.fixture(autouse=True)
+def _reset_handoff_status():
+    handoff.STATUS.reset()
+    yield
+    handoff.STATUS.reset()
+
+
+# -- frame protocol -----------------------------------------------------------
+
+def _framed_pair():
+    return socket.socketpair()
+
+
+def test_frame_roundtrip():
+    a, b = _framed_pair()
+    payload = {"schema": handoff.SCHEMA_VERSION, "x": [1, 2, 3],
+               "nested": {"y": "z"}}
+    size = handoff.send_frame(a, payload)
+    got, got_size = handoff.recv_frame(b)
+    assert got == payload and got_size == size
+    a.close(); b.close()
+
+
+def test_frame_truncated_mid_body_is_frame_error():
+    a, b = _framed_pair()
+    # send a frame, then chop the stream after the header + checksum:
+    # the reader must see FrameError (kill -9 mid-transfer), never a
+    # partial json or a hang
+    body = json.dumps({"big": "x" * 500}).encode()
+    import hashlib
+    import struct
+    header = struct.pack("!4sHI", b"TPUH", handoff.SCHEMA_VERSION,
+                         len(body))
+    a.sendall(header + hashlib.sha256(body).digest() + body[: len(body) // 2])
+    a.close()
+    with pytest.raises(handoff.FrameError):
+        handoff.recv_frame(b)
+    b.close()
+
+
+def test_frame_checksum_mismatch_is_frame_error():
+    a, b = _framed_pair()
+    body = b'{"k": "v"}'
+    import struct
+    header = struct.pack("!4sHI", b"TPUH", handoff.SCHEMA_VERSION,
+                         len(body))
+    a.sendall(header + b"\x00" * 32 + body)
+    with pytest.raises(handoff.FrameError, match="checksum"):
+        handoff.recv_frame(b)
+    a.close(); b.close()
+
+
+def test_frame_schema_bump_is_schema_mismatch():
+    a, b = _framed_pair()
+    handoff.send_frame(a, {"schema": 99},
+                       version=handoff.SCHEMA_VERSION + 1)
+    with pytest.raises(handoff.SchemaMismatch):
+        handoff.recv_frame(b)
+    a.close(); b.close()
+
+
+# -- THE acceptance test: full live handoff under chaos -----------------------
+
+def test_full_handoff_zero_resteer_zero_resetup(kube, short_tmp):
+    dataplane = _Dataplane()
+    vsp_a = _UpgradeVsp(dataplane)
+    outgoing = _manager(short_tmp, vsp_a, client=kube)
+    # two NF pods of one chain, each wired from two chip attachments —
+    # the dataplane state an upgrade must carry over untouched
+    _nf_pod(kube, "my-sfc-nf-a", "my-sfc", 0)
+    _nf_pod(kube, "my-sfc-nf-b", "my-sfc", 1)
+    outgoing._cni_nf_add(_Req("sandboxAAAA", "chip-0", "net1",
+                              "my-sfc-nf-a"))
+    outgoing._cni_nf_add(_Req("sandboxAAAA", "chip-1", "net2",
+                              "my-sfc-nf-a"))
+    outgoing._cni_nf_add(_Req("sandboxBBBB", "chip-2", "net1",
+                              "my-sfc-nf-b"))
+    outgoing._cni_nf_add(_Req("sandboxBBBB", "chip-3", "net2",
+                              "my-sfc-nf-b"))
+    assert len(outgoing._chain_hops) == 1  # hop NF0 -> NF1 steered
+    wires_before = list(dataplane.wires)
+    assert len(wires_before) == 3  # 2 pod-internal NFs + 1 chain hop
+    snap_before = outgoing.device_plugin._snapshot()
+    assert set(snap_before) == {"chip-0", "chip-1", "chip-2", "chip-3"}
+    deletes_before_freeze = len(vsp_a.deleted)
+
+    # outgoing side serves the handoff in the background (what SIGUSR2
+    # / tpuctl handoff begin trigger)
+    sock_path = outgoing.path_manager.handoff_socket()
+    result = {}
+    serve = threading.Thread(
+        target=lambda: result.setdefault(
+            "serve", handoff.serve_handoff(outgoing, sock_path,
+                                           timeout=10.0)),
+        daemon=True)
+    serve.start()
+    assert_eventually(lambda: outgoing.cni_server.frozen
+                      and os.path.exists(sock_path),
+                      message="freeze window never opened")
+
+    # a CNI DEL lands DURING the frozen window: it must queue, then be
+    # applied exactly once by the incoming daemon after adoption
+    del_response = {}
+    del_thread = threading.Thread(
+        target=lambda: del_response.setdefault(
+            "resp", outgoing.cni_server._handle(
+                _del_request("sandboxBBBB"))),
+        daemon=True)
+    del_thread.start()
+    assert_eventually(lambda: len(outgoing.cni_server.frozen_requests())
+                      == 1, message="DEL was not queued by the freeze")
+
+    # incoming daemon: same state dirs, same (still-running) dataplane,
+    # wrapped in the chaos harness so ANY re-setup/re-steer attempt —
+    # create_slice_attachment or create_network_function — fails loudly
+    vsp_b_inner = _UpgradeVsp(dataplane)
+    plan = FaultPlan(seed=7)
+    plan.script("create_network_function", Fail(times=64))
+    plan.script("create_slice_attachment", Fail(times=64))
+    vsp_b = ChaosVsp(vsp_b_inner, plan=plan)
+    incoming = _manager(short_tmp, vsp_b, client=kube)
+    assert handoff.adopt_into(incoming, sock_path)
+    serve.join(timeout=10)
+    del_thread.join(timeout=10)
+    assert result.get("serve") == "served"
+
+    # ZERO chain re-steers / pod sandbox re-setups: the incoming daemon
+    # made no create calls at all (the chaos scripts would have thrown)
+    assert vsp_b_inner.created == []
+    assert vsp_b_inner.attach_calls == []
+    # the outgoing daemon mutated nothing after the freeze either
+    assert len(vsp_a.deleted) == deletes_before_freeze
+
+    # the queued DEL was applied EXACTLY ONCE, by the incoming daemon:
+    # sandboxB's NF pair + the chain hop unwired there and only there
+    assert del_response["resp"].error == ""
+    assert del_response["resp"].result is not None
+    pair_b = ("nf-sandboxBBBB-chip-2", "nf-sandboxBBBB-chip-3")
+    assert vsp_b_inner.deleted.count(pair_b) == 1
+    assert pair_b not in dataplane.wires
+    assert "sandboxBBBB" not in incoming._attach_store
+    # sandbox A carried over live: still wired, never re-set-up
+    assert incoming._attach_store["sandboxAAAA"]["wired"] is True
+    assert ("nf-sandboxAAAA-chip-0",
+            "nf-sandboxAAAA-chip-1") in dataplane.wires
+
+    # ZERO spurious kubelet device deletions: the adopted snapshot is
+    # what ListAndWatch serves, even while the live handler cannot
+    # answer yet (chaos: VSP not ready on the incoming side)
+    assert incoming.device_plugin.snapshot_devices().keys() \
+        == snap_before.keys()
+    plan.script("get_devices", Fail(times=4))
+    served = incoming.device_plugin._snapshot()
+    assert set(served) == set(snap_before)
+
+    # the freeze is fully released on the outgoing side
+    assert not outgoing.cni_server.frozen
+    # flight recorder: one served + one adopted entry for this handoff
+    names = [e["name"] for e in flight.RECORDER.events(kind="handoff")]
+    assert "HandoffServed" in names and "HandoffAdopted" in names
+    adopted_entry = [e for e in flight.RECORDER.events(kind="handoff")
+                     if e["name"] == "HandoffAdopted"][-1]
+    assert adopted_entry["attributes"]["adopted_hops"] == 1
+    assert adopted_entry["attributes"]["pending_applied"] == 1
+    assert adopted_entry["attributes"]["discrepancies"] == 0
+    # both roles share this process's STATUS here: serving -> adopted
+    # -> served (order of the last two depends on thread scheduling)
+    assert set(handoff.STATUS.history[-2:]) == {"adopted", "served"}
+
+
+# -- kill -9 mid-transfer: .last-good fallback --------------------------------
+
+def test_kill9_mid_transfer_falls_back_to_last_good(kube, short_tmp):
+    from dpu_operator_tpu.testing.chaos import truncate_file
+    dataplane = _Dataplane()
+    vsp_a = _UpgradeVsp(dataplane)
+    first = _manager(short_tmp, vsp_a, client=kube)
+    _nf_pod(kube, "my-sfc-nf-a", "my-sfc", 0)
+    _nf_pod(kube, "my-sfc-nf-b", "my-sfc", 1)
+    first._cni_nf_add(_Req("sandboxAAAA", "chip-0", "net1", "my-sfc-nf-a"))
+    first._cni_nf_add(_Req("sandboxAAAA", "chip-1", "net2", "my-sfc-nf-a"))
+    first._cni_nf_add(_Req("sandboxBBBB", "chip-2", "net1", "my-sfc-nf-b"))
+    first._cni_nf_add(_Req("sandboxBBBB", "chip-3", "net2", "my-sfc-nf-b"))
+    hops_before = dict(first._chain_hops)
+    assert hops_before
+    journal = first._chains_file
+    # one more flush so .last-good (always one snapshot behind) holds
+    # the fully-wired state the crash must be recoverable to
+    with first._attach_lock:
+        first._save_chains_locked()
+    first._flush_chains()
+    assert os.path.exists(journal + ".last-good")
+    # the crash leaves the primary torn mid-write (seeded truncation)
+    truncate_file(journal, seed=3)
+
+    # the outgoing daemon was killed -9 mid-transfer: its handoff
+    # socket exists and accepts, but the stream dies after half a frame
+    sock_path = first.path_manager.handoff_socket()
+    os.makedirs(os.path.dirname(sock_path), exist_ok=True)
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(sock_path)
+    listener.listen(1)
+
+    def _die_mid_frame():
+        conn, _ = listener.accept()
+        import hashlib
+        import struct
+        body = json.dumps({"schema": handoff.SCHEMA_VERSION,
+                           "chains": {}}).encode()
+        header = struct.pack("!4sHI", b"TPUH", handoff.SCHEMA_VERSION,
+                             len(body))
+        conn.sendall(header + hashlib.sha256(body).digest()
+                     + body[: len(body) // 2])
+        conn.close()  # kill -9: the rest never arrives
+
+    killer = threading.Thread(target=_die_mid_frame, daemon=True)
+    killer.start()
+
+    incoming = _manager(short_tmp, _UpgradeVsp(dataplane), client=kube)
+    fallback_baseline = len(flight.RECORDER.events(kind="handoff"))
+    adopted = handoff.adopt_into(incoming, sock_path)
+    killer.join(timeout=5)
+    listener.close()
+    assert not adopted
+
+    # HandoffFallback flight entry with the truncation reason
+    entries = flight.RECORDER.events(kind="handoff")[fallback_baseline:]
+    assert [e["name"] for e in entries] == ["HandoffFallback"]
+    assert "truncated" in entries[0]["attributes"]["reason"]
+
+    # DEGRADED until the cold-start recovery completes...
+    assert incoming.degraded_sites() == [
+        f"handoff: {entries[0]['attributes']['reason']}"]
+    # ...the .last-good journal recovery rebuilds the wire table...
+    incoming._recover_chains()
+    assert incoming._chain_hops == hops_before
+    assert incoming._attach_store["sandboxAAAA"]["wired"] is True
+    # ...then HEALTHY again: the Degraded-then-Healthy transition
+    handoff.STATUS.mark_recovered()
+    assert incoming.degraded_sites() == []
+    assert handoff.STATUS.history == ["fallback", "recovered"]
+
+
+# -- schema rejection ---------------------------------------------------------
+
+def test_incoming_rejects_bumped_schema_and_cold_starts(kube, short_tmp):
+    sock_path = os.path.join(short_tmp, "handoff.sock")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(sock_path)
+    listener.listen(1)
+    reject = {}
+
+    def _future_daemon():
+        conn, _ = listener.accept()
+        handoff.send_frame(conn, {"schema": handoff.SCHEMA_VERSION + 1},
+                           version=handoff.SCHEMA_VERSION + 1)
+        try:
+            # the reject must arrive framed in THIS daemon's (v2)
+            # dialect — a v1-framed reply would be unparseable to the
+            # very peer whose version mismatched
+            reject["frame"], _ = handoff.recv_frame(
+                conn, expect_version=handoff.SCHEMA_VERSION + 1)
+        finally:
+            conn.close()
+
+    server = threading.Thread(target=_future_daemon, daemon=True)
+    server.start()
+    incoming = _manager(short_tmp, _UpgradeVsp(_Dataplane()), client=kube)
+    assert not handoff.adopt_into(incoming, sock_path)
+    server.join(timeout=5)
+    listener.close()
+    # the incoming daemon told the outgoing one WHY (so it can thaw
+    # immediately instead of waiting out its timeout)
+    assert reject["frame"]["adopted"] is False
+    assert "schema" in reject["frame"]["reason"]
+    assert handoff.STATUS.degraded_components()
+    assert handoff.STATUS.history == ["fallback"]
+
+
+def test_outgoing_thaws_on_reject_and_dispatches_queued_del(kube,
+                                                            short_tmp):
+    dataplane = _Dataplane()
+    vsp = _UpgradeVsp(dataplane)
+    outgoing = _manager(short_tmp, vsp, client=kube)
+    outgoing._cni_nf_add(_Req("sandboxCCCC", "chip-0", "net1", "p"))
+    outgoing._cni_nf_add(_Req("sandboxCCCC", "chip-1", "net2", "p"))
+    sock_path = outgoing.path_manager.handoff_socket()
+    result = {}
+    serve = threading.Thread(
+        target=lambda: result.setdefault(
+            "serve", handoff.serve_handoff(outgoing, sock_path,
+                                           timeout=10.0)),
+        daemon=True)
+    serve.start()
+    assert_eventually(lambda: outgoing.cni_server.frozen
+                      and os.path.exists(sock_path),
+                      message="freeze window never opened")
+    del_response = {}
+    del_thread = threading.Thread(
+        target=lambda: del_response.setdefault(
+            "resp", outgoing.cni_server._handle(
+                _del_request("sandboxCCCC"))),
+        daemon=True)
+    del_thread.start()
+    assert_eventually(lambda: len(outgoing.cni_server.frozen_requests())
+                      == 1, message="DEL was not queued")
+
+    # an incoming daemon that cannot adopt (schema from the future)
+    client = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    client.connect(sock_path)
+    bundle, _ = handoff.recv_frame(client)
+    assert bundle["schema"] == handoff.SCHEMA_VERSION
+    assert len(bundle["pending_cni"]) == 1
+    handoff.send_frame(client, {"adopted": False,
+                                "reason": "schema v2 only"})
+    client.close()
+    serve.join(timeout=10)
+    del_thread.join(timeout=10)
+    assert result.get("serve") == "aborted"
+    # degraded, never wedged: the outgoing daemon thawed and applied
+    # the queued DEL itself — exactly once, locally
+    assert not outgoing.cni_server.frozen
+    assert del_response["resp"].error == ""
+    assert "sandboxCCCC" not in outgoing._attach_store
+    assert vsp.deleted.count(("nf-sandboxCCCC-chip-0",
+                              "nf-sandboxCCCC-chip-1")) == 1
+
+
+def test_serve_handoff_times_out_and_thaws(kube, short_tmp):
+    outgoing = _manager(short_tmp, _UpgradeVsp(_Dataplane()), client=kube)
+    sock_path = outgoing.path_manager.handoff_socket()
+    assert handoff.serve_handoff(outgoing, sock_path,
+                                 timeout=0.2) == "aborted"
+    assert not outgoing.cni_server.frozen
+    assert not os.path.exists(sock_path)
+    # the abort entry is stamped so `tpuctl handoff status` can scope
+    # adoption discrepancies to the attempt that produced them
+    aborted = [e for e in flight.RECORDER.events(kind="handoff")
+               if e.get("name") == "HandoffAborted"]
+    assert aborted and aborted[-1]["attributes"].get("handoff_id")
+
+
+def test_serve_aborts_when_drain_never_completes(short_tmp):
+    """A mutation that outlives every drain window must ABORT the
+    handoff (thaw, keep serving) — serializing the bundle mid-mutation
+    would hand over a wire table missing that mutation's effects, a
+    hop neither generation tracks. The serve path re-checks the drain
+    after the accept wait (free extra budget) and refuses to cut the
+    bundle when it still fails."""
+    class _StuckManager:
+        def __init__(self):
+            self.drain_calls = []
+            self.thawed = None
+
+        def freeze_for_handoff(self):
+            return False  # something is mid-mutation at the deadline
+
+        def drain_for_handoff(self, timeout=5.0):
+            self.drain_calls.append(timeout)
+            return False  # ...and it never finishes
+
+        def thaw_after_handoff(self, dispatch_queued=True):
+            self.thawed = dispatch_queued
+
+    mgr = _StuckManager()
+    sock_path = os.path.join(short_tmp, "handoff.sock")
+    results = []
+    server = threading.Thread(
+        target=lambda: results.append(
+            handoff.serve_handoff(mgr, sock_path, timeout=5.0)),
+        daemon=True)
+    server.start()
+    assert_eventually(lambda: os.path.exists(sock_path))
+    peer = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    peer.settimeout(5)
+    peer.connect(sock_path)
+    # no bundle frame may ever arrive: the connection just closes
+    assert peer.recv(4096) == b""
+    peer.close()
+    server.join(timeout=5)
+    assert results == ["aborted"]
+    assert mgr.drain_calls, "serve path skipped the drain re-check"
+    # bundle never sent -> unambiguous abort: queued CNI dispatches
+    # locally (this daemon still owns the dataplane)
+    assert mgr.thawed is True
+    aborted = [e for e in flight.RECORDER.events(kind="handoff")
+               if e.get("name") == "HandoffAborted"]
+    assert "mid-mutation" in aborted[-1]["attributes"]["reason"]
+
+
+def test_stale_handoff_socket_fallback_once_then_silent(short_tmp):
+    """A handoff socket corpse (outgoing daemon killed -9 before any
+    peer connected) records ONE fallback and is then removed — every
+    later plain restart cold-starts silently instead of repeating the
+    spurious HandoffFallback (metric + degraded window) forever."""
+    sock_path = os.path.join(short_tmp, "handoff.sock")
+    corpse = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    corpse.bind(sock_path)
+    corpse.close()  # bound then closed: file exists, connect refused
+    baseline = len(flight.RECORDER.events(kind="handoff"))
+    assert handoff.adopt_into(None, sock_path) is False
+    entries = flight.RECORDER.events(kind="handoff")[baseline:]
+    assert [e["name"] for e in entries] == ["HandoffFallback"]
+    assert "not serving" in entries[0]["attributes"]["reason"]
+    assert not os.path.exists(sock_path), "socket corpse not removed"
+    # the next restart: nothing to adopt, nothing recorded
+    handoff.STATUS.reset()
+    assert handoff.adopt_into(None, sock_path) is False
+    assert len(flight.RECORDER.events(kind="handoff")) == baseline + 1
+    assert handoff.STATUS.degraded_components() == []
+
+
+def test_adopted_pending_cni_rides_dispatch_machinery(short_tmp):
+    """Freeze-window requests applied at adoption get the SAME
+    semantics they would have had without the freeze: a DEL whose
+    state is already gone is idempotent-success (a raw handler call
+    would 500 and kubelet would re-drive it forever), and an ADD
+    hitting a transient blip gets its bounded in-dispatch retries."""
+    from dpu_operator_tpu.cni.types import AlreadyGone, PodRequest
+    from dpu_operator_tpu.utils import resilience
+    from types import SimpleNamespace
+
+    add_attempts = []
+
+    def flaky_add(req):
+        add_attempts.append(1)
+        if len(add_attempts) == 1:
+            raise ConnectionError("VSP restarting under the daemon")
+        return {"cniVersion": "0.4.0", "adopted": True}
+
+    def gone_del(req):
+        raise AlreadyGone("state torn down before the handoff")
+
+    server = CniServer(os.path.join(short_tmp, "cni.sock"),
+                       add_handler=flaky_add, del_handler=gone_del,
+                       retry=resilience.RetryPolicy(
+                           max_attempts=3, base=0.001, cap=0.002))
+    mgr = SimpleNamespace(cni_server=server)
+    del_req = PodRequest.from_cni_request(_del_request("sandboxGONE"))
+    add_req = PodRequest.from_cni_request(CniRequest(
+        env={"CNI_COMMAND": "ADD", "CNI_CONTAINERID": "sandboxADD",
+             "CNI_NETNS": "/var/run/netns/a", "CNI_IFNAME": "net1",
+             "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p"},
+        config={"cniVersion": "0.4.0", "type": "tpu-cni",
+                "mode": "network-function", "deviceID": "chip-0"}))
+    results = handoff._apply_pending_cni(mgr, [
+        handoff._pod_req_to_dict(del_req),
+        handoff._pod_req_to_dict(add_req)])
+    del_out = results[handoff.handoff_key(del_req)]
+    assert not del_out.get("error"), del_out
+    assert del_out["result"]["cniVersion"] == "0.4.0"
+    add_out = results[handoff.handoff_key(add_req)]
+    assert not add_out.get("error"), add_out
+    assert add_out["result"].get("adopted") is True
+    assert len(add_attempts) == 2, "transient ADD was not retried"
+
+
+# -- adoption discrepancy repair ----------------------------------------------
+
+def test_adoption_restores_netconf_lost_from_disk(kube, short_tmp):
+    """Orphan/lost netconf entries are flight-recorded (kind=adoption)
+    and repaired from the bundle — the adoption-or-rebuild contract."""
+    dataplane = _Dataplane()
+    outgoing = _manager(short_tmp, _UpgradeVsp(dataplane), client=kube)
+    outgoing._cni_nf_add(_Req("sandboxDDDD", "chip-0", "net1", "p"))
+    outgoing._cni_nf_add(_Req("sandboxDDDD", "chip-1", "net2", "p"))
+    bundle = handoff.collect_bundle(outgoing)
+    # disk loses one cache entry between serialize and adopt (torn fs)
+    lost = os.path.join(outgoing.nf_cache.cache_dir,
+                        "sandboxDDDD-net1.json")
+    os.unlink(lost)
+    incoming = _manager(short_tmp, _UpgradeVsp(dataplane), client=kube)
+    baseline = len(flight.RECORDER.events(kind="adoption"))
+    report = handoff.adopt_bundle(incoming, bundle)
+    kinds = [d["kind"] for d in report.discrepancies]
+    assert "netconf-missing-on-disk" in kinds
+    assert os.path.exists(lost)  # restored from the bundle
+    assert json.load(open(lost))["device"] == "chip-0"
+    recorded = flight.RECORDER.events(kind="adoption")[baseline:]
+    assert any(e["name"] == "netconf-missing-on-disk" for e in recorded)
+
+
+# -- crash-safe state writes (satellite) --------------------------------------
+
+def test_netconf_cache_save_is_atomic_and_truncation_safe(tmp_path):
+    cache = NetConfCache(str(tmp_path / "nf"))
+    cache.save("sbx", "net1", {"device": "chip-0"})
+    assert cache.load("sbx", "net1") == {"device": "chip-0"}
+    # no temp debris left behind by the atomic write
+    assert [f for f in os.listdir(cache.cache_dir) if ".tmp" in f] == []
+    # a truncated entry (pre-fix crash artifact) must load as None, not
+    # poison the DEL path with a JSONDecodeError
+    path = os.path.join(cache.cache_dir, "torn-net1.json")
+    with open(path, "w") as f:
+        f.write('{"device": "chi')
+    assert cache.load("torn", "net1") is None
+    # and a crash DURING save never tears the visible file: the write
+    # lands in a temp file first, so an exception before rename leaves
+    # the old content intact
+    import dpu_operator_tpu.utils.atomicfile as af
+    real_rename = os.rename
+    try:
+        af.os.rename = lambda *a: (_ for _ in ()).throw(
+            OSError("crash before rename"))
+        with pytest.raises(OSError):
+            cache.save("sbx", "net1", {"device": "NEW"})
+    finally:
+        af.os.rename = real_rename
+    assert cache.load("sbx", "net1") == {"device": "chip-0"}
+
+
+def test_chip_allocator_poison_recovery_single_winner(tmp_path):
+    """Concurrent allocates racing to recover the same empty (poisoned)
+    lock must produce exactly one owner: the recovery unlink may never
+    delete a contender's freshly-landed valid claim (which would grant
+    the chip twice)."""
+    alloc = ChipAllocator(str(tmp_path / "alloc"))
+    os.makedirs(alloc.alloc_dir, exist_ok=True)
+    for round_ in range(20):
+        chip = f"chip-{round_}"
+        open(os.path.join(alloc.alloc_dir, chip), "w").close()  # poison
+        barrier = threading.Barrier(2)
+        results = {}
+
+        def claim(owner, chip=chip, barrier=barrier, results=results):
+            barrier.wait()
+            results[owner] = alloc.allocate(chip, owner)
+
+        threads = [threading.Thread(target=claim, args=(o,))
+                   for o in ("sandboxA", "sandboxB")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        winners = sorted(o for o, ok in results.items() if ok)
+        assert len(winners) == 1, (round_, results)
+        assert alloc.owner(chip) == winners[0], (round_, results)
+
+
+def test_chip_allocator_claim_is_crash_safe(tmp_path):
+    alloc = ChipAllocator(str(tmp_path / "alloc"))
+    assert alloc.allocate("chip-0", "sandboxA")
+    assert alloc.owner("chip-0") == "sandboxA"
+    assert alloc.allocate("chip-0", "sandboxA")      # idempotent
+    assert not alloc.allocate("chip-0", "sandboxB")  # held
+    # a kill -9 before the old code's write() left an EMPTY lock file:
+    # owner() must read it as unowned and allocate() must recover it
+    torn = os.path.join(alloc.alloc_dir, "chip-1")
+    open(torn, "w").close()
+    assert alloc.owner("chip-1") is None
+    assert alloc.allocate("chip-1", "sandboxC")
+    assert alloc.owner("chip-1") == "sandboxC"
+    # no .claim temp debris
+    assert [f for f in os.listdir(alloc.alloc_dir) if ".claim" in f] == []
+
+
+# -- device plugin socket ownership (satellite) -------------------------------
+
+def test_atomic_claim_falls_back_without_hardlinks(tmp_path, monkeypatch):
+    """link(2) is unavailable on some overlay/FUSE mounts (EPERM /
+    EOPNOTSUPP): the claim must degrade to the legacy O_CREAT|O_EXCL
+    path rather than fail every CNI ADD on the node — the narrower
+    crash window it reopens leaves truncated claims the owner checks
+    already detect and re-claim."""
+    import errno
+
+    import dpu_operator_tpu.utils.atomicfile as af
+
+    def no_link(src, dst, **kw):
+        raise OSError(errno.EPERM, "Operation not permitted")
+
+    monkeypatch.setattr(af.os, "link", no_link)
+    path = str(tmp_path / "claims" / "chip-0")
+    os.makedirs(os.path.dirname(path))
+    assert af.atomic_claim(path, "sandboxA") is True
+    with open(path) as f:
+        assert f.read() == "sandboxA"
+    # a contested claim still loses cleanly
+    assert af.atomic_claim(path, "sandboxB") is False
+    with open(path) as f:
+        assert f.read() == "sandboxA"
+    # and no temp debris is left behind on either outcome
+    assert [n for n in os.listdir(os.path.dirname(path))
+            if ".claim" in n] == []
+
+
+def test_outgoing_plugin_stop_preserves_successor_socket(short_tmp):
+    import grpc  # noqa: F401 — skip cleanly if grpc is absent
+    from dpu_operator_tpu.deviceplugin import DevicePlugin
+
+    class _Handler:
+        def get_devices(self):
+            return {}
+
+    pm = PathManager(short_tmp)
+    outgoing = DevicePlugin(_Handler(), path_manager=pm)
+    outgoing.start()
+    sock = outgoing.socket_path
+    old_ino = os.stat(sock).st_ino
+    # the incoming daemon wipes the stale file and binds a fresh socket
+    # at the same path (what _start_locked does)
+    incoming = DevicePlugin(_Handler(), path_manager=pm)
+    incoming.start()
+    new_ino = os.stat(sock).st_ino
+    assert new_ino != old_ino
+    try:
+        # the OUTGOING daemon's shutdown must not delete the successor's
+        # socket (grpc-core unlinks the bound path on stop — the guard
+        # parks the successor's file across it)
+        outgoing.stop()
+        assert os.path.exists(sock)
+        assert os.stat(sock).st_ino == new_ino
+    finally:
+        incoming.stop()
+    # a normal (sole-owner) stop does clean its own socket up
+    assert not os.path.exists(sock)
+
+
+# -- reconciler pause (freeze window) -----------------------------------------
+
+def test_unexpected_serve_error_still_thaws(kube, short_tmp):
+    """An exception that is neither HandoffError nor OSError (a bug in
+    bundle collection, a malformed ACK shape) must still thaw the
+    outgoing daemon — never leave the freeze parked forever."""
+    outgoing = _manager(short_tmp, _UpgradeVsp(_Dataplane()),
+                        client=kube)
+    real_export = outgoing.export_wire_table
+    outgoing.export_wire_table = lambda: (_ for _ in ()).throw(
+        TypeError("bug in bundle collection"))
+    try:
+        sock_path = outgoing.path_manager.handoff_socket()
+        result = {}
+        serve = threading.Thread(
+            target=lambda: result.setdefault(
+                "r", handoff.serve_handoff(outgoing, sock_path,
+                                           timeout=10.0)),
+            daemon=True)
+        serve.start()
+        assert_eventually(lambda: os.path.exists(sock_path),
+                          message="handoff socket never appeared")
+        incoming = _manager(short_tmp, _UpgradeVsp(_Dataplane()),
+                            client=kube)
+        assert not handoff.adopt_into(incoming, sock_path)
+        serve.join(timeout=10)
+        assert result.get("r") == "aborted"
+        assert not outgoing.cni_server.frozen  # thawed, still serving
+    finally:
+        outgoing.export_wire_table = real_export
+
+
+def test_content_malformed_bundle_falls_back_not_crashes(kube,
+                                                         short_tmp):
+    """A bundle that passes the frame checks but carries wrong inner
+    shapes must land on the cold-start fallback (HandoffFallback,
+    degraded), not crash the incoming daemon's startup."""
+    sock_path = os.path.join(short_tmp, "handoff.sock")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(sock_path)
+    listener.listen(1)
+    reject = {}
+
+    def _bad_outgoing():
+        conn, _ = listener.accept()
+        # frame-valid, content-garbage: device snapshot as a list
+        handoff.send_frame(conn, {
+            "schema": handoff.SCHEMA_VERSION,
+            "device_plugins": {"google.com/tpu": ["not", "a", "dict"]},
+            "pending_cni": ["not-a-request"]})
+        try:
+            reject["frame"], _ = handoff.recv_frame(conn)
+        finally:
+            conn.close()
+
+    server = threading.Thread(target=_bad_outgoing, daemon=True)
+    server.start()
+    incoming = _manager(short_tmp, _UpgradeVsp(_Dataplane()),
+                        client=kube)
+    assert not handoff.adopt_into(incoming, sock_path)
+    server.join(timeout=5)
+    listener.close()
+    assert reject["frame"]["adopted"] is False
+    assert "adoption failed" in reject["frame"]["reason"]
+    assert handoff.STATUS.degraded_components()
+    names = [e["name"] for e in flight.RECORDER.events(kind="handoff")]
+    assert names[-1] == "HandoffFallback"
+
+
+def test_tpuctl_style_begin_handoff_runs_stop_hook(kube, short_tmp):
+    """AdminService.BeginHandoff (tpuctl) reaches the side manager
+    directly, without the Daemon wrapper: the daemon-set
+    handoff_on_complete hook must still stop the outgoing process
+    after adoption."""
+    dataplane = _Dataplane()
+    outgoing = _manager(short_tmp, _UpgradeVsp(dataplane), client=kube)
+    stopped = threading.Event()
+    outgoing.handoff_on_complete = stopped.set
+    assert outgoing.begin_handoff(timeout=10.0)  # no explicit hook
+    sock_path = outgoing.path_manager.handoff_socket()
+    assert_eventually(lambda: os.path.exists(sock_path),
+                      message="handoff socket never appeared")
+    incoming = _manager(short_tmp, _UpgradeVsp(dataplane), client=kube)
+    assert handoff.adopt_into(incoming, sock_path)
+    assert stopped.wait(5), "stop hook never ran after adoption"
+
+
+def test_ambiguous_abort_fails_queued_instead_of_reapplying():
+    """unfreeze(dispatch_queued=False) — the bundle reached the peer
+    but the ACK was lost: the peer may have applied the queued
+    mutations, so re-applying locally could double-steer. They must be
+    failed back to kubelet as retryable, untouched locally."""
+    applied = []
+    srv = CniServer("/unused.sock",
+                    add_handler=lambda r: applied.append(r) or {},
+                    del_handler=lambda r: applied.append(r) or {})
+    srv.freeze()
+    resp = {}
+    t = threading.Thread(
+        target=lambda: resp.setdefault(
+            "r", srv._handle(_del_request("sandboxQ"))), daemon=True)
+    t.start()
+    assert_eventually(lambda: len(srv.frozen_requests()) == 1,
+                      message="DEL never queued")
+    srv.unfreeze(dispatch_queued=False)
+    t.join(5)
+    assert applied == []
+    assert "retry" in resp["r"].error
+
+
+def test_mutations_after_served_handoff_fail_fast():
+    """After complete_frozen the outgoing daemon's state lives in its
+    successor: a late ADD/DEL here must error immediately (kubelet
+    retries against the new daemon's socket), never mutate state the
+    bundle no longer covers."""
+    srv = CniServer("/unused.sock", add_handler=lambda r: {},
+                    del_handler=lambda r: {})
+    srv.freeze()
+    srv.complete_frozen({})
+    resp = srv._handle(_del_request("sandboxX"))
+    assert "handed off" in resp.error
+
+
+def test_timed_out_frozen_request_not_applied_on_unfreeze():
+    """A queued request whose kubelet caller already received the
+    freeze-window timeout error must NOT be silently applied by a
+    later unfreeze — kubelet thinks it failed and will re-drive it."""
+    applied = []
+    srv = CniServer("/unused.sock", add_handler=lambda r: {},
+                    del_handler=lambda r: applied.append(r.sandbox_id)
+                    or {}, timeout=0.1)
+    srv.freeze()
+    resp = srv._handle(_del_request("sandboxT"))  # waits 0.1s, errors
+    assert "no adoption" in resp.error
+    srv.unfreeze()
+    assert applied == []
+
+
+def test_freeze_drains_inflight_cni_dispatch(kube, short_tmp):
+    """A CNI ADD already past the freeze check when the freeze begins
+    must FINISH before freeze_for_handoff returns — otherwise the
+    bundle could be serialized while the dispatch is still mutating
+    state it will never capture."""
+    mgr = _manager(short_tmp, _UpgradeVsp(_Dataplane()), client=kube)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow_add(req):
+        entered.set()
+        assert release.wait(5), "dispatch never released"
+        return {"cniVersion": "0.4.0"}
+
+    mgr.cni_server.add_handler = slow_add
+    add_done = threading.Event()
+
+    def post_add():
+        mgr.cni_server._handle(CniRequest(
+            env={"CNI_COMMAND": "ADD", "CNI_CONTAINERID": "sandboxZZ",
+                 "CNI_NETNS": "/var/run/netns/z", "CNI_IFNAME": "net1",
+                 "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p"},
+            config={"cniVersion": "0.4.0", "type": "tpu-cni",
+                    "mode": "network-function", "deviceID": "chip-0"}))
+        add_done.set()
+
+    threading.Thread(target=post_add, daemon=True).start()
+    assert entered.wait(5), "dispatch never started"
+    froze = threading.Event()
+    threading.Thread(
+        target=lambda: (mgr.freeze_for_handoff(), froze.set()),
+        daemon=True).start()
+    # the freeze must NOT complete while the dispatch is in flight
+    assert not froze.wait(0.3)
+    release.set()
+    assert froze.wait(5), "freeze never completed after drain"
+    assert add_done.wait(5)
+    assert mgr.cni_server.frozen
+    mgr.thaw_after_handoff()
+
+
+def test_freeze_parks_chain_repair_pass(kube, short_tmp):
+    """A repair re-steer during the freeze window would land AFTER the
+    bundle's wire table serialized: the adopting daemon's reconcile-
+    against-dataplane would drop the hop and the re-steered wire would
+    leak, tracked by neither generation. Freeze must park repair (the
+    periodic loop and AdminService.RepairChains both funnel through
+    repair_chains); an aborted handoff thaws it."""
+    mgr = _manager(short_tmp, _UpgradeVsp(_Dataplane()), client=kube)
+    passes = []
+    mgr.link_prober = lambda port: None
+    mgr._repair_chains_locked = lambda: passes.append(1) or []
+    assert mgr.repair_chains() == []
+    assert len(passes) == 1
+    mgr.freeze_for_handoff()
+    assert mgr.repair_chains() == []
+    assert len(passes) == 1, "repair pass ran inside the freeze window"
+    mgr.thaw_after_handoff()
+    mgr.repair_chains()
+    assert len(passes) == 2, "repair did not resume after the thaw"
+
+
+def test_freeze_drains_inflight_repair_pass(kube, short_tmp):
+    """A repair pass already past its gate when the freeze begins must
+    FINISH before freeze_for_handoff returns — the bundle is never
+    serialized mid-re-steer."""
+    mgr = _manager(short_tmp, _UpgradeVsp(_Dataplane()), client=kube)
+    mgr.link_prober = lambda port: None
+    entered, release = threading.Event(), threading.Event()
+
+    def slow_pass():
+        entered.set()
+        assert release.wait(5), "repair pass never released"
+        return []
+
+    mgr._repair_chains_locked = slow_pass
+    done = threading.Event()
+    threading.Thread(target=lambda: (mgr.repair_chains(), done.set()),
+                     daemon=True).start()
+    assert entered.wait(5), "repair pass never started"
+    froze = threading.Event()
+    threading.Thread(
+        target=lambda: (mgr.freeze_for_handoff(), froze.set()),
+        daemon=True).start()
+    # the freeze must NOT complete while the pass is mid-re-steer
+    assert not froze.wait(0.3)
+    release.set()
+    assert froze.wait(5), "freeze never completed after repair drain"
+    assert done.wait(5)
+    mgr.thaw_after_handoff()
+
+
+def test_pause_drain_waits_for_inflight_reconcile(kube):
+    """Manager.pause() parks the worker before its NEXT item;
+    drain() must additionally wait out the CURRENT reconcile so the
+    handoff bundle never serializes mid-mutation."""
+    from dpu_operator_tpu.k8s.manager import Manager, ReconcileResult
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class _Slow:
+        watches = ("v1", "ConfigMap")
+
+        def reconcile(self, client, req):
+            entered.set()
+            assert release.wait(5), "reconcile never released"
+            return ReconcileResult()
+
+    mgr = Manager(kube)
+    mgr.add_reconciler(_Slow())
+    mgr.start()
+    try:
+        kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                     "metadata": {"name": "cm1", "namespace": "default"}})
+        assert entered.wait(5), "reconcile never started"
+        mgr.pause()
+        drained = threading.Event()
+        threading.Thread(
+            target=lambda: mgr.drain(timeout=10) and drained.set(),
+            daemon=True).start()
+        assert not drained.wait(0.3)  # reconcile still mid-flight
+        release.set()
+        assert drained.wait(5), "drain never observed quiescence"
+    finally:
+        release.set()
+        mgr.resume()
+        mgr.stop()
+
+
+def test_manager_pause_parks_reconciles_until_resume(kube):
+    from dpu_operator_tpu.k8s.manager import Manager, ReconcileResult
+
+    seen = []
+
+    class _Rec:
+        watches = ("v1", "ConfigMap")
+
+        def reconcile(self, client, req):
+            seen.append(req.name)
+            return ReconcileResult()
+
+    mgr = Manager(kube)
+    mgr.add_reconciler(_Rec())
+    mgr.start()
+    try:
+        mgr.pause()
+        assert mgr.paused
+        kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                     "metadata": {"name": "cm1", "namespace": "default"}})
+        # the event is queued but must NOT be reconciled while paused
+        import time
+        deadline = time.monotonic() + 0.3
+        while time.monotonic() < deadline:
+            assert seen == []
+            time.sleep(0.02)
+        mgr.resume()
+        assert_eventually(lambda: seen == ["cm1"],
+                          message="queued reconcile after resume")
+    finally:
+        mgr.stop()
+
+
+# -- tpuctl -------------------------------------------------------------------
+
+def test_tpuctl_handoff_status_renders_last_handoff():
+    from dpu_operator_tpu.tpuctl import handoff_status
+    snap = {"events": [
+        {"kind": "span", "name": "noise"},
+        {"kind": "handoff", "name": "HandoffFallback", "ts": 1.0,
+         "attributes": {"reason": "bundle transfer failed: truncated"}},
+        # a PREVIOUS handoff's discrepancy still in the ring: must NOT
+        # be attributed to the last handoff
+        {"kind": "adoption", "name": "chip-allocation-orphan",
+         "attributes": {"detail": "stale: belongs to handoff 1",
+                        "handoff_id": 1}},
+        {"kind": "adoption", "name": "netconf-orphan",
+         "attributes": {"detail": "sbx-net1.json: on disk but unknown",
+                        "handoff_id": 2}},
+        {"kind": "handoff", "name": "HandoffAdopted", "ts": 2.0,
+         "duration_s": 0.12,
+         "attributes": {"bundle_bytes": 4096, "handoff_id": 2,
+                        "adopted_hops": 3,
+                        "adopted_sandboxes": 2, "pending_applied": 1,
+                        "discrepancies": 1}},
+    ]}
+    out = handoff_status(snap)
+    last = out["lastHandoff"]
+    assert last["result"] == "HandoffAdopted"
+    assert last["durationSeconds"] == 0.12
+    assert last["bundleBytes"] == 4096
+    assert last["adoptedHops"] == 3
+    assert last["fallbackReason"] == ""
+    assert out["history"] == ["HandoffFallback", "HandoffAdopted"]
+    assert out["adoptionDiscrepancies"] == [
+        {"kind": "netconf-orphan",
+         "detail": "sbx-net1.json: on disk but unknown"}]
+
+
+def test_tpuctl_handoff_status_served_owns_no_adoptions():
+    """A daemon that adopted at startup (its discrepancies still in the
+    ring) and later SERVED a handoff to its successor: the Served entry
+    carries its own handoff_id, so the startup adoption's discrepancies
+    must not be listed under it."""
+    from dpu_operator_tpu.tpuctl import handoff_status
+    snap = {"events": [
+        {"kind": "adoption", "name": "netconf-orphan",
+         "attributes": {"detail": "from this daemon's own startup",
+                        "handoff_id": 1}},
+        {"kind": "handoff", "name": "HandoffAdopted", "ts": 1.0,
+         "attributes": {"handoff_id": 1, "discrepancies": 1}},
+        {"kind": "handoff", "name": "HandoffServed", "ts": 2.0,
+         "attributes": {"bundle_bytes": 512, "handoff_id": 2,
+                        "pending_cni": 0, "completed": 0}},
+    ]}
+    out = handoff_status(snap)
+    assert out["lastHandoff"]["result"] == "HandoffServed"
+    assert out["adoptionDiscrepancies"] == []
+
+
+def test_tpuctl_handoff_status_unstamped_entry_attributes_nothing():
+    """A handoff entry with no handoff_id (a pre-stamp flight ring)
+    must not sweep up adoption entries from an earlier handoff."""
+    from dpu_operator_tpu.tpuctl import handoff_status
+    snap = {"events": [
+        {"kind": "adoption", "name": "netconf-orphan",
+         "attributes": {"detail": "earlier adoption",
+                        "handoff_id": 1}},
+        {"kind": "handoff", "name": "HandoffFallback", "ts": 2.0,
+         "attributes": {"reason": "truncated"}},
+    ]}
+    out = handoff_status(snap)
+    assert out["lastHandoff"]["result"] == "HandoffFallback"
+    assert out["lastHandoff"]["fallbackReason"] == "truncated"
+    assert out["adoptionDiscrepancies"] == []
+
+
+def test_tpuctl_handoff_begin_needs_daemon_addr():
+    from dpu_operator_tpu import tpuctl
+    with pytest.raises(SystemExit):
+        tpuctl.main(["handoff", "begin"])

@@ -14,6 +14,7 @@ from dpu_operator_tpu.analysis import (ALL_CHECKERS,
                                        ChaosDeterminismChecker,
                                        EventsSeamChecker,
                                        ExceptionHygieneChecker,
+                                       HandoffStateDisciplineChecker,
                                        LockDisciplineChecker,
                                        MetricsNamingChecker,
                                        RetryDisciplineChecker,
@@ -358,6 +359,76 @@ def test_lock_discipline_skips_lock_free_classes():
             def bump(self):
                 self.x += 1
     """) == []
+
+
+
+
+# -- handoff-state-discipline -------------------------------------------------
+
+def test_handoff_state_discipline_flags_raw_write_in_state_module():
+    violations = check(HandoffStateDisciplineChecker(), """
+        def save(path, data):
+            with open(path, "w") as f:
+                f.write(data)
+    """, relpath="dpu_operator_tpu/cni/cache.py")
+    assert [v.rule for v in violations] == ["handoff-state-discipline"]
+    assert "atomic_write" in violations[0].message
+
+
+def test_handoff_state_discipline_flags_append_and_mode_keyword():
+    src = """
+        def touch(path):
+            open(path, mode="a").close()
+        def binary(path):
+            open(path, "wb").close()
+    """
+    assert len(check(HandoffStateDisciplineChecker(), src,
+                     relpath="dpu_operator_tpu/daemon/handoff.py")) == 2
+
+
+def test_handoff_state_discipline_allows_reads_and_other_modules():
+    reads = """
+        def load(path):
+            with open(path) as f:
+                return f.read()
+        def load_binary(path):
+            with open(path, "rb") as f:
+                return f.read()
+    """
+    assert check(HandoffStateDisciplineChecker(), reads,
+                 relpath="dpu_operator_tpu/cni/cache.py") == []
+    # non-state modules may open files freely
+    assert check(HandoffStateDisciplineChecker(),
+                 'open("/tmp/scratch", "w")\n') == []
+
+
+def test_handoff_state_discipline_flags_os_open_write_flags():
+    # os.open(path, O_CREAT|O_EXCL|O_WRONLY) + write is the same torn-
+    # write shape as open(path, "w") — the rule must see through it
+    violations = check(HandoffStateDisciplineChecker(), """
+        import os
+        def claim(path, owner):
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                         0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(owner)
+    """, relpath="dpu_operator_tpu/cni/ipam.py")
+    assert [v.rule for v in violations] == ["handoff-state-discipline"]
+    assert "os.open" in violations[0].message
+    # read-only os.open (flock handles, dir-fsync descriptors) is fine
+    assert check(HandoffStateDisciplineChecker(), """
+        import os
+        def handle(path):
+            return os.open(path, os.O_RDONLY)
+    """, relpath="dpu_operator_tpu/cni/ipam.py") == []
+
+
+def test_handoff_state_discipline_ignores_dynamic_modes():
+    # a computed mode cannot be judged statically; no false positive
+    assert check(HandoffStateDisciplineChecker(), """
+        def reopen(path, mode):
+            return open(path, mode)
+    """, relpath="dpu_operator_tpu/cni/cache.py") == []
 
 
 # -- pragma -------------------------------------------------------------------

@@ -71,6 +71,7 @@ def mgr(kube, tmp_path):
     m._chain_hops = {}
     m._degraded_hops = set()
     m._repair_pass_lock = threading.Lock()
+    m._repair_frozen = threading.Event()
     m.ipam_dir = str(tmp_path / "ipam")
     m.nf_cache = NetConfCache(str(tmp_path / "nf"))
     return m

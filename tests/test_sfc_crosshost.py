@@ -389,6 +389,7 @@ def _lean_mgr(kube, tmp_path, vsp, tag="m"):
     m._chain_hops = {}
     m._degraded_hops = set()
     m._repair_pass_lock = threading.Lock()
+    m._repair_frozen = threading.Event()
     m.link_prober = None
     m.ipam_dir = str(tmp_path / "ipam")
     m.nf_cache = NetConfCache(str(tmp_path / "nf"))

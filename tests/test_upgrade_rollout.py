@@ -1,0 +1,402 @@
+"""Blue-green VSP rollout (spec.upgradeStrategy) — make upgrade-check.
+
+The controller stages the target VSP as the inactive color, gates
+promotion on pod readiness + the health engine snapshot (a burn-rate
+alert HOLDS the rollout with an UpgradeHeld Event while the old VSP
+keeps serving), then drains the old color and records
+UpgradeStarted/UpgradeCompleted — the fleet-level half of the
+zero-downtime upgrade story (doc/architecture.md).
+"""
+
+import pytest
+
+from dpu_operator_tpu.api import (
+    TpuOperatorConfig,
+    TpuOperatorConfigSpec,
+    UpgradeStrategy,
+    ValidationError,
+    validate_tpu_operator_config,
+)
+from dpu_operator_tpu.controller import TpuOperatorConfigReconciler
+from dpu_operator_tpu.k8s import Manager
+from dpu_operator_tpu.utils import NAMESPACE
+
+from utils import assert_eventually
+
+pytestmark = pytest.mark.upgrade
+
+
+class _Health:
+    """Controllable health-engine snapshot (the /debug/health fold)."""
+
+    def __init__(self):
+        self.degraded: dict = {}
+
+    def __call__(self):
+        components = {name: {"healthy": False, "reasons": [reason]}
+                      for name, reason in self.degraded.items()}
+        return {"healthy": not components, "components": components}
+
+
+@pytest.fixture
+def health():
+    return _Health()
+
+
+@pytest.fixture
+def manager(kube, node_agent, images, tmp_path, health):
+    from dpu_operator_tpu.utils.filesystem_mode_detector import (
+        FilesystemModeDetector,
+    )
+    from dpu_operator_tpu.utils.path_manager import PathManager
+    node_agent.register_node("tpu-vm-0", labels={"tpu": "true"})
+    mgr = Manager(kube)
+    mgr.add_reconciler(TpuOperatorConfigReconciler(
+        images,
+        path_manager=PathManager(str(tmp_path)),
+        fs_detector=FilesystemModeDetector(str(tmp_path)),
+        health_provider=health))
+    mgr.start()
+    yield mgr
+    mgr.stop()
+
+
+def _cfg(image, type_="blueGreen"):
+    return TpuOperatorConfig(spec=TpuOperatorConfigSpec(
+        mode="tpu",
+        upgrade_strategy=UpgradeStrategy(
+            type=type_, vsp_image=image, check_interval=0.05)))
+
+
+def _status_upgrade(kube):
+    obj = kube.get(*("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                     "tpu-operator-config"))
+    return (obj.get("status") or {}).get("upgrade") or {}
+
+
+def _ds_image(kube, color):
+    ds = kube.get("apps/v1", "DaemonSet", f"tpu-vsp-{color}",
+                  namespace=NAMESPACE)
+    if ds is None:
+        return None
+    return ds["spec"]["template"]["spec"]["containers"][0]["image"]
+
+
+def _events(kube, reason):
+    return [e for e in kube.list("v1", "Event", namespace=NAMESPACE)
+            if e.get("reason") == reason]
+
+
+def _retarget(kube, image, type_="blueGreen"):
+    obj = kube.get("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                   "tpu-operator-config")
+    obj["spec"]["upgradeStrategy"] = UpgradeStrategy(
+        type=type_, vsp_image=image, check_interval=0.05).to_dict()
+    kube.update(obj)
+
+
+def test_first_managed_deploy_no_upgrade_events(kube, manager):
+    kube.create(_cfg("vsp:v1").to_obj())
+    assert manager.wait_idle()
+    assert_eventually(lambda: _ds_image(kube, "blue") == "vsp:v1",
+                      message="initial VSP DaemonSet")
+    up = _status_upgrade(kube)
+    assert up["currentImage"] == "vsp:v1"
+    assert up["phase"] == "Complete"
+    # no rollout happened: nothing to announce
+    assert _events(kube, "UpgradeStarted") == []
+    assert _events(kube, "UpgradeCompleted") == []
+
+
+def test_blue_green_rollout_stages_gates_promotes(kube, manager):
+    kube.create(_cfg("vsp:v1").to_obj())
+    assert manager.wait_idle()
+    assert_eventually(lambda: _ds_image(kube, "blue") == "vsp:v1")
+    _retarget(kube, "vsp:v2")
+    # staged as green, gated on its pods Running + health clean, then
+    # promoted: blue drained, currentImage advanced
+    assert_eventually(
+        lambda: _status_upgrade(kube).get("currentImage") == "vsp:v2",
+        message="rollout completion")
+    assert _ds_image(kube, "green") == "vsp:v2"
+    assert _ds_image(kube, "blue") is None  # old color drained
+    up = _status_upgrade(kube)
+    assert up["color"] == "green" and up["phase"] == "Complete"
+    assert len(_events(kube, "UpgradeStarted")) == 1
+    assert len(_events(kube, "UpgradeCompleted")) == 1
+    assert "vsp:v2" in _events(kube, "UpgradeCompleted")[0]["message"]
+    # a second rollout flips back: green -> blue
+    _retarget(kube, "vsp:v3")
+    assert_eventually(
+        lambda: _status_upgrade(kube).get("currentImage") == "vsp:v3",
+        message="second rollout completion")
+    assert _ds_image(kube, "blue") == "vsp:v3"
+    assert _ds_image(kube, "green") is None
+
+
+def test_burn_rate_alert_holds_rollout_until_clear(kube, manager,
+                                                   health):
+    kube.create(_cfg("vsp:v1").to_obj())
+    assert manager.wait_idle()
+    assert_eventually(lambda: _ds_image(kube, "blue") == "vsp:v1")
+    # an SLO burn-rate page fires mid-rollout: automatic hold
+    health.degraded["kube-client"] = "SloAlert:kube-client:page"
+    _retarget(kube, "vsp:v2")
+    assert_eventually(
+        lambda: _status_upgrade(kube).get("phase") == "Held",
+        message="rollout hold on burn-rate alert")
+    up = _status_upgrade(kube)
+    assert "kube-client" in up["heldReason"]
+    # held, not promoted: the OLD VSP keeps serving, the new one stays
+    # staged, and operators see why
+    assert _ds_image(kube, "blue") == "vsp:v1"
+    assert _ds_image(kube, "green") == "vsp:v2"
+    assert _status_upgrade(kube).get("currentImage") == "vsp:v1"
+    held = _events(kube, "UpgradeHeld")
+    assert len(held) >= 1 and "kube-client" in held[0]["message"]
+    # the alert clears -> the held rollout resumes and completes
+    health.degraded.clear()
+    assert_eventually(
+        lambda: _status_upgrade(kube).get("currentImage") == "vsp:v2",
+        message="held rollout resuming after the alert cleared")
+    assert _ds_image(kube, "blue") is None
+    assert len(_events(kube, "UpgradeCompleted")) == 1
+    # the flapping hold deduplicated into ONE Event (count bumps)
+    assert len(_events(kube, "UpgradeHeld")) == 1
+
+
+def test_reverted_target_cleans_up_abandoned_stage(kube, manager,
+                                                   health):
+    """Reverting spec.upgradeStrategy.vspImage back to the serving
+    image mid-rollout must tear the staged other-color DaemonSet down
+    — not leave it running the abandoned image on every node."""
+    kube.create(_cfg("vsp:v1").to_obj())
+    assert manager.wait_idle()
+    assert_eventually(lambda: _ds_image(kube, "blue") == "vsp:v1")
+    # hold the rollout so the stage stays parked mid-flight
+    health.degraded["kube-client"] = "SloAlert:kube-client:page"
+    _retarget(kube, "vsp:v2")
+    assert_eventually(
+        lambda: _status_upgrade(kube).get("phase") == "Held",
+        message="rollout held before the revert")
+    assert _ds_image(kube, "green") == "vsp:v2"
+    # operator aborts the upgrade: target back to the serving image
+    _retarget(kube, "vsp:v1")
+    assert_eventually(
+        lambda: _ds_image(kube, "green") is None,
+        message="abandoned green stage deleted")
+    up = _status_upgrade(kube)
+    assert up["phase"] == "Complete" and up["currentImage"] == "vsp:v1"
+    assert up["targetImage"] == ""
+    assert _ds_image(kube, "blue") == "vsp:v1"
+
+
+def test_recreate_strategy_replaces_in_place(kube, manager):
+    kube.create(_cfg("vsp:v1", type_="recreate").to_obj())
+    assert manager.wait_idle()
+    assert_eventually(lambda: _ds_image(kube, "blue") == "vsp:v1")
+    _retarget(kube, "vsp:v2", type_="recreate")
+    assert_eventually(
+        lambda: _ds_image(kube, "blue") == "vsp:v2",
+        message="in-place recreate")
+    up = _status_upgrade(kube)
+    assert up["currentImage"] == "vsp:v2" and up["color"] == "blue"
+    assert _ds_image(kube, "green") is None
+    assert len(_events(kube, "UpgradeStarted")) == 1
+    assert len(_events(kube, "UpgradeCompleted")) == 1
+
+
+def test_removed_strategy_mid_rollout_cleans_up_stage(kube, manager,
+                                                      health):
+    """Deleting spec.upgradeStrategy while a rollout is staged must
+    tear the staged other-color DS down (the serving color is left
+    alone — never tear down a live dataplane on a spec removal)."""
+    kube.create(_cfg("vsp:v1").to_obj())
+    assert manager.wait_idle()
+    assert_eventually(lambda: _ds_image(kube, "blue") == "vsp:v1")
+    health.degraded["kube-client"] = "SloAlert:kube-client:page"
+    _retarget(kube, "vsp:v2")
+    assert_eventually(
+        lambda: _status_upgrade(kube).get("phase") == "Held",
+        message="rollout held before the strategy removal")
+    assert _ds_image(kube, "green") == "vsp:v2"
+    obj = kube.get("config.tpu.openshift.io/v1", "TpuOperatorConfig",
+                   "tpu-operator-config")
+    del obj["spec"]["upgradeStrategy"]
+    kube.update(obj)
+    assert_eventually(lambda: _ds_image(kube, "green") is None,
+                      message="abandoned stage deleted on removal")
+    assert _ds_image(kube, "blue") == "vsp:v1"  # serving DS untouched
+    up = _status_upgrade(kube)
+    assert up["targetImage"] == "" and up["phase"] == "Complete"
+
+
+def test_degraded_sfc_condition_holds_rollout(kube, manager):
+    """The node daemons surface dataplane health as Degraded /
+    ChainDegraded conditions on the SFC CRs they reconcile — the
+    cross-process signal the gate consults (the operator-local health
+    snapshot cannot see daemons or the staged VSP on other nodes). A
+    True condition mid-rollout holds promotion until the daemon clears
+    it."""
+    kube.create(_cfg("vsp:v1").to_obj())
+    assert manager.wait_idle()
+    assert_eventually(lambda: _ds_image(kube, "blue") == "vsp:v1")
+    kube.create({
+        "apiVersion": "config.tpu.openshift.io/v1",
+        "kind": "ServiceFunctionChain",
+        "metadata": {"name": "chain-a", "namespace": "default"},
+        "spec": {},
+        "status": {"conditions": [
+            {"type": "Degraded", "status": "True",
+             "reason": "CircuitBreakerOpen"}]},
+    })
+    _retarget(kube, "vsp:v2")
+    assert_eventually(
+        lambda: _status_upgrade(kube).get("phase") == "Held",
+        message="rollout hold on degraded SFC CR")
+    up = _status_upgrade(kube)
+    assert "chain-a" in up["heldReason"]
+    assert "Degraded" in up["heldReason"]
+    # held, not promoted: the old VSP keeps serving
+    assert _ds_image(kube, "blue") == "vsp:v1"
+    assert _ds_image(kube, "green") == "vsp:v2"
+    # the daemon repairs the chain and clears the condition -> resume
+    obj = kube.get("config.tpu.openshift.io/v1", "ServiceFunctionChain",
+                   "chain-a", namespace="default")
+    obj["status"]["conditions"] = []
+    kube.update(obj)
+    assert_eventually(
+        lambda: _status_upgrade(kube).get("currentImage") == "vsp:v2",
+        message="held rollout resuming after the condition cleared")
+    assert _ds_image(kube, "blue") is None
+
+
+def test_gate_holds_on_pods_running_stale_image(kube):
+    """phase=Running is not enough to promote: after a mid-rollout
+    retarget the staged color's pods can still be running the previous
+    image while the DS controller catches up — the gate must hold
+    until every pod is on the TARGET image."""
+    from dpu_operator_tpu.controller.vsp_rollout import VspRollout
+    rollout = VspRollout(health_provider=lambda: {"components": {}})
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "tpu-vsp-green-0", "namespace": NAMESPACE,
+                     "labels": {"tpu.openshift.io/vsp-color": "green"}},
+        "spec": {"containers": [{"name": "vsp", "image": "vsp:v2"}]},
+        "status": {"phase": "Running"},
+    })
+    strategy = UpgradeStrategy(vsp_image="vsp:v3")
+    hold = rollout._gate(kube, strategy, "green", "vsp:v3")
+    assert "not yet on target image" in hold
+    pod = kube.get("v1", "Pod", "tpu-vsp-green-0", namespace=NAMESPACE)
+    pod["spec"]["containers"][0]["image"] = "vsp:v3"
+    kube.update(pod)
+    assert rollout._gate(kube, strategy, "green", "vsp:v3") == ""
+
+
+def test_gate_matches_vsp_container_by_name(kube):
+    """An admission webhook can inject a sidecar at containers[0]: the
+    image check must find the 'vsp' container BY NAME — checking index
+    0 either holds forever (sidecar image != target) or, if the images
+    happened to collide, promotes an unverified VSP."""
+    from dpu_operator_tpu.controller.vsp_rollout import VspRollout
+    rollout = VspRollout(health_provider=lambda: {"components": {}})
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "tpu-vsp-green-0", "namespace": NAMESPACE,
+                     "labels": {"tpu.openshift.io/vsp-color": "green"}},
+        "spec": {"containers": [
+            {"name": "mesh-proxy", "image": "sidecar:v9"},
+            {"name": "vsp", "image": "vsp:v3"}]},
+        "status": {"phase": "Running"},
+    })
+    strategy = UpgradeStrategy(vsp_image="vsp:v3")
+    assert rollout._gate(kube, strategy, "green", "vsp:v3") == ""
+    pod = kube.get("v1", "Pod", "tpu-vsp-green-0", namespace=NAMESPACE)
+    pod["spec"]["containers"][1]["image"] = "vsp:v2"  # vsp stale
+    kube.update(pod)
+    assert "not yet on target image" in rollout._gate(
+        kube, strategy, "green", "vsp:v3")
+
+
+def test_sfc_degraded_holds_even_with_health_gate_disabled(kube):
+    """healthGate=false disables only the operator-local health-engine
+    snapshot (its stated purpose: dev clusters with no engine running);
+    the SFC-CR Degraded signal comes from the node daemons through the
+    apiserver and must hold the rollout regardless — a staged VSP that
+    walled itself off never promotes by draining the last working
+    one."""
+    from dpu_operator_tpu.controller.vsp_rollout import VspRollout
+
+    def forbidden_health():
+        raise AssertionError(
+            "health provider consulted with healthGate=false")
+
+    rollout = VspRollout(health_provider=forbidden_health)
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "tpu-vsp-green-0", "namespace": NAMESPACE,
+                     "labels": {"tpu.openshift.io/vsp-color": "green"}},
+        "spec": {"containers": [{"name": "vsp", "image": "vsp:v3"}]},
+        "status": {"phase": "Running"},
+    })
+    kube.create({
+        "apiVersion": "config.tpu.openshift.io/v1",
+        "kind": "ServiceFunctionChain",
+        "metadata": {"name": "chain-walled", "namespace": "default"},
+        "spec": {},
+        "status": {"conditions": [
+            {"type": "Degraded", "status": "True",
+             "reason": "CircuitBreakerOpen"}]},
+    })
+    strategy = UpgradeStrategy(vsp_image="vsp:v3", health_gate=False)
+    hold = rollout._gate(kube, strategy, "green", "vsp:v3")
+    assert "chain-walled" in hold and "Degraded" in hold
+    # condition cleared -> gate passes, still without touching the
+    # (disabled) health provider
+    obj = kube.get("config.tpu.openshift.io/v1", "ServiceFunctionChain",
+                   "chain-walled", namespace="default")
+    obj["status"]["conditions"] = []
+    kube.update(obj)
+    assert rollout._gate(kube, strategy, "green", "vsp:v3") == ""
+
+
+def test_upgrade_strategy_admission_validation():
+    ok = _cfg("vsp:v1").to_obj()
+    validate_tpu_operator_config(ok)  # well-formed passes
+    bad_type = _cfg("vsp:v1").to_obj()
+    bad_type["spec"]["upgradeStrategy"]["type"] = "yolo"
+    with pytest.raises(ValidationError, match="upgradeStrategy.type"):
+        validate_tpu_operator_config(bad_type)
+    bad_interval = _cfg("vsp:v1").to_obj()
+    bad_interval["spec"]["upgradeStrategy"]["checkIntervalSeconds"] = 0
+    with pytest.raises(ValidationError, match="checkIntervalSeconds"):
+        validate_tpu_operator_config(bad_interval)
+    not_a_map = _cfg("vsp:v1").to_obj()
+    not_a_map["spec"]["upgradeStrategy"] = "blueGreen"
+    with pytest.raises(ValidationError, match="mapping"):
+        validate_tpu_operator_config(not_a_map)
+    # a non-string image would pass admission and then wedge the
+    # rollout at DaemonSet apply time — reject it up front
+    bad_image = _cfg("vsp:v1").to_obj()
+    bad_image["spec"]["upgradeStrategy"]["vspImage"] = 5
+    with pytest.raises(ValidationError, match="vspImage"):
+        validate_tpu_operator_config(bad_image)
+    bad_gate = _cfg("vsp:v1").to_obj()
+    bad_gate["spec"]["upgradeStrategy"]["healthGate"] = "yes"
+    with pytest.raises(ValidationError, match="healthGate"):
+        validate_tpu_operator_config(bad_gate)
+
+
+def test_upgrade_strategy_round_trips_through_spec():
+    spec = TpuOperatorConfigSpec.from_dict(
+        {"mode": "tpu", "upgradeStrategy": {"vspImage": "vsp:v9",
+                                            "healthGate": False}})
+    assert spec.upgrade_strategy.vsp_image == "vsp:v9"
+    assert spec.upgrade_strategy.health_gate is False
+    assert spec.upgrade_strategy.type == "blueGreen"
+    assert (spec.to_dict()["upgradeStrategy"]["vspImage"] == "vsp:v9")
+    # absent strategy stays absent (no controller-managed VSP)
+    bare = TpuOperatorConfigSpec.from_dict({"mode": "host"})
+    assert bare.upgrade_strategy is None
+    assert "upgradeStrategy" not in bare.to_dict()
