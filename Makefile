@@ -4,8 +4,8 @@ REGISTRY ?= localhost:5000
 TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
-        upgrade-check lint-check type-check bench native traffic-flow \
-        images smoke-images deploy undeploy graft-check clean
+        upgrade-check fault-check lint-check type-check bench native \
+        traffic-flow images smoke-images deploy undeploy graft-check clean
 
 test: lint-check native
 	$(PYTHON) -m pytest tests/ -q
@@ -59,6 +59,18 @@ health-check:
 # stage/hold/promote machine. Seeded, no wall-clock sleeps.
 upgrade-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m upgrade \
+	  -p no:randomly -p no:cacheprovider
+
+# hardware fault-domain gate (doc/architecture.md "Hardware fault
+# domains"): seeded link-flap / chip-death / host-loss storms through
+# the fault engine, device plugin and SFC repair pass — every chain
+# must converge to healthy-or-explicitly-Degraded within a bounded
+# round count, a flapping link must be HELD DOWN (not re-admitted per
+# bounce), ListAndWatch must emit zero spurious deletions of healthy
+# devices, and recovery MTTR is recorded to FAULT_r01.json. Fixed
+# seeds, injected clocks, no wall-clock sleeps.
+fault-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m fault \
 	  -p no:randomly -p no:cacheprovider
 
 # opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST

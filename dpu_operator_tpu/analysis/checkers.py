@@ -206,6 +206,8 @@ STATE_WRITER_MODULES = {
         "chain wire-table journal (+ .last-good)",
     "dpu_operator_tpu/daemon/handoff.py":
         "handoff bundle restore writes during adoption",
+    "dpu_operator_tpu/faults/engine.py":
+        "fault-engine state journal (quarantines/hold-downs)",
 }
 
 #: write modes for the builtin open(); "r+"/"a" style appends count too
@@ -459,17 +461,28 @@ _NONDETERMINISTIC = {
 }
 _ALLOWED_RANDOM = {"random.Random"}  # seedable constructor — the idiom
 
+#: markers whose tests promise bit-identical replay from a seed: the
+#: scripted-fault matrix (chaos) and the hardware fault-domain storms
+#: (fault) share the invariant
+_DETERMINISTIC_MARKS = ("pytest.mark.chaos", "pytest.mark.fault")
+
+
+def _is_deterministic_mark(target) -> bool:
+    name = dotted_name(target) or ""
+    return any(name.endswith(mark) for mark in _DETERMINISTIC_MARKS)
+
 
 def _has_chaos_mark(decorators: list) -> bool:
     for dec in decorators:
         target = dec.func if isinstance(dec, ast.Call) else dec
-        if (dotted_name(target) or "").endswith("pytest.mark.chaos"):
+        if _is_deterministic_mark(target):
             return True
     return False
 
 
 def _module_is_chaos(tree: ast.Module) -> bool:
-    """`pytestmark = pytest.mark.chaos` (or a list containing it)."""
+    """`pytestmark = pytest.mark.chaos` / `pytest.mark.fault` (or a
+    list containing one)."""
     for node in tree.body:
         if not isinstance(node, ast.Assign):
             continue
@@ -480,15 +493,16 @@ def _module_is_chaos(tree: ast.Module) -> bool:
                   else [node.value])
         for v in values:
             target = v.func if isinstance(v, ast.Call) else v
-            if (dotted_name(target) or "").endswith("pytest.mark.chaos"):
+            if _is_deterministic_mark(target):
                 return True
     return False
 
 
 class ChaosDeterminismChecker(Checker):
     name = "chaos-determinism"
-    description = ("chaos-marked tests must not call unseeded random or "
-                   "wall-clock time (seeds must replay bit-identically)")
+    description = ("chaos/fault-marked tests must not call unseeded "
+                   "random or wall-clock time (seeds must replay "
+                   "bit-identically)")
 
     def check(self, module: Module) -> Iterator[Violation]:
         if not module.is_test:
@@ -515,7 +529,8 @@ class ChaosDeterminismChecker(Checker):
                 if bad:
                     yield self.violation(
                         module, call,
-                        f"chaos-marked test calls {name}() — {bad}")
+                        f"chaos/fault-marked test calls {name}() — "
+                        f"{bad}")
 
     @staticmethod
     def _classify(name: str) -> Optional[str]:

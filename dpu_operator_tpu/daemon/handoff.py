@@ -61,7 +61,11 @@ log = logging.getLogger(__name__)
 #: bundle schema version. Bump on ANY incompatible change to the bundle
 #: layout; an incoming daemon speaking a different version rejects the
 #: bundle and cold-starts (never adopts state it cannot interpret).
-SCHEMA_VERSION = 1
+#: v2: added the ``faults`` section (ICI fault-domain engine state —
+#: quarantines and hold-downs must survive the upgrade, so a withdrawn
+#: chip cannot briefly re-enter kubelet's allocatable set under the
+#: incoming daemon).
+SCHEMA_VERSION = 2
 
 MAGIC = b"TPUH"
 _HEADER = struct.Struct("!4sHI")  # magic, schema version, payload length
@@ -326,6 +330,11 @@ def collect_bundle(manager, pending_cni: tuple = ()) -> dict:
     if callable(export):
         bundle["chains"] = export()
     bundle["breakers"] = {b.site: b.state for b in resilience.breakers()}
+    export_faults = getattr(manager, "export_fault_state", None)
+    if callable(export_faults):
+        faults = export_faults()
+        if faults is not None:
+            bundle["faults"] = faults
     bundle["pending_cni"] = [_pod_req_to_dict(r) for r in pending_cni]
     return bundle
 
@@ -433,6 +442,16 @@ def adopt_bundle(manager, bundle: dict,
             report.adopted_sandboxes = len(with_attach)
         for detail in dropped:
             report.discrepancy("hop-not-in-dataplane", detail)
+    # fault-domain verdicts: a quarantined chip/link stays withdrawn
+    # through the upgrade (its hold-down timer rides as remaining
+    # seconds); fresh probes then reconcile the adopted verdicts —
+    # recovery still walks recovering->healthy on live signals. Adopt
+    # BEFORE any server binds so the very first ListAndWatch snapshot
+    # already carries the withdrawals.
+    adopt_faults = getattr(manager, "adopt_fault_state", None)
+    if callable(adopt_faults) and bundle.get("faults") is not None:
+        for detail in adopt_faults(bundle["faults"]):
+            report.discrepancy("fault-state", detail)
     # breaker states: a VSP the outgoing daemon already proved dead
     # must not be hammered afresh by the incoming one
     for site, state in (bundle.get("breakers") or {}).items():

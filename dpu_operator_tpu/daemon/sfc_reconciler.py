@@ -43,6 +43,7 @@ class SfcReconciler:
     def __init__(self, workload_image: str = "",
                  chain_status_provider=None, boundary_sync=None,
                  cross_host_sync=None, degraded_provider=None,
+                 slice_degraded_provider=None,
                  retry: resilience.RetryPolicy = None):
         """*chain_status_provider*: callable (namespace, name) -> list of
         hop dicts ({index, input, output, degraded}) from the live wire
@@ -56,12 +57,18 @@ class SfcReconciler:
         *degraded_provider*: callable () -> list of degraded dependency
         sites (open circuit breakers, utils/resilience.py) — surfaced as
         a ``Degraded`` condition on the CR so operators SEE a walled-off
-        VSP instead of discovering it from missing wires."""
+        VSP instead of discovering it from missing wires.
+        *slice_degraded_provider*: callable () -> None |
+        {"operational", "total", "chips"} from the fault engine —
+        surfaced as a ``SliceDegraded`` condition when hardware faults
+        shrank the mesh to a sub-slice (the chain keeps running on the
+        largest still-connected component instead of failing whole)."""
         self.workload_image = workload_image
         self.chain_status_provider = chain_status_provider
         self.boundary_sync = boundary_sync
         self.cross_host_sync = cross_host_sync
         self.degraded_provider = degraded_provider
+        self.slice_degraded_provider = slice_degraded_provider
         # transient apiserver blips during NF pod creation retry in
         # place; a still-failing create raises after rollback (below)
         # and rides the manager's exponential-backoff requeue
@@ -268,6 +275,22 @@ class SfcReconciler:
                 "Degraded", True, "CircuitBreakerOpen",
                 f"dependency breaker(s) open: {', '.join(sites)} — "
                 "calls short-circuit until a half-open probe succeeds"))
+        # hardware fault domains shrank the mesh: surface the operating
+        # sub-slice instead of failing the chain whole — added only
+        # while degraded, so healthy chains keep their stable shape
+        shrunk = None
+        if self.slice_degraded_provider is not None:
+            try:
+                shrunk = self.slice_degraded_provider()
+            except Exception:  # noqa: BLE001 — status is best-effort
+                log.exception("slice-degraded provider failed")
+        if shrunk:
+            status["conditions"].append(_condition(
+                "SliceDegraded", True, "IciFaultDomain",
+                f"operational sub-slice is {shrunk['operational']}/"
+                f"{shrunk['total']} chips (quarantined or disconnected "
+                "chips withdrawn; chains steer within the surviving "
+                "mesh)"))
         if obj.get("status") != status:
             updated = dict(obj, status=status)
             try:

@@ -13,8 +13,10 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import re
 import threading
+import zlib
 from typing import Callable, Optional
 
 from ..cni import CniServer
@@ -23,6 +25,8 @@ from ..cni.ipam import ipam_add, ipam_del
 from ..utils import atomicfile, metrics, tracing
 from ..cni.types import PodRequest
 from ..deviceplugin import DevicePlugin
+from ..faults import LINK as FAULT_LINK
+from ..faults import FaultEngine, FaultGatedHandler
 from ..k8s import events
 from ..k8s.manager import Manager
 from ..utils import vars as v
@@ -88,6 +92,14 @@ class _SliceServiceForwarder:
             raise RuntimeError("admin plane not wired")
         return self.manager.get_chains()
 
+    def get_faults(self, req: dict) -> dict:
+        """Fault-domain observability (tpuctl faults): the engine's
+        judged per-chip/per-link state table, hold-downs and the
+        degraded-slice verdict."""
+        if self.manager is None:
+            raise RuntimeError("admin plane not wired")
+        return self.manager.fault_status()
+
     def begin_handoff(self, req: dict) -> dict:
         """Start a live state handoff (tpuctl handoff begin): freeze
         mutations and serve the state bundle on the local handoff
@@ -147,11 +159,21 @@ class TpuSideManager:
         # finally-uncordon would reopen the node mid-drain
         self._resize_lock = threading.Lock()
         self.device_handler = TpuDeviceHandler(self.vsp, tpu_mode=True)
+        # judged hardware health (faults/): raw VSP health bits and link
+        # probes feed the engine; kubelet and the repair pass consume
+        # its verdicts. Journaled next to the chain journal so
+        # quarantines/hold-downs survive a cold restart.
+        self.fault_engine = FaultEngine(
+            topology_provider=self._slice_topology,
+            journal_path=path_manager.cni_cache_dir() + "/faults.json")
+        self.fault_engine.load()
+        self.fault_engine.add_listener(self._on_fault_transition)
         # newest-first chip ids from recent chip Allocates: the ici-port
         # plugin's GetPreferredAllocation aligns port picks with them
         self._recent_chip_allocs: list[str] = []
         self.device_plugin = DevicePlugin(
-            self.device_handler, resource=v.TPU_RESOURCE_NAME,
+            FaultGatedHandler(self.device_handler, self.fault_engine),
+            resource=v.TPU_RESOURCE_NAME,
             path_manager=path_manager,
             allocation_listener=self._note_chip_allocation)
         self.ici_device_plugin: Optional[DevicePlugin] = None
@@ -185,6 +207,10 @@ class TpuSideManager:
         # wired in serve() when the native agent socket is reachable
         self.link_prober = None
         self._repair_stop = threading.Event()
+        # event-driven repair: a fault-engine transition sets this so
+        # steering reacts NOW instead of on the next poll (and the
+        # idle backoff resets)
+        self._repair_nudge = threading.Event()
         self._repair_thread: Optional[threading.Thread] = None
         self._repair_client = None
         self._repair_pass_lock = threading.Lock()
@@ -237,7 +263,7 @@ class TpuSideManager:
         if topology and self.ici_device_plugin is None:
             from ..ici import SliceTopology
             topo = SliceTopology.cached(topology)
-            worker = int(os.environ.get("TPU_WORKER_ID", "0"))
+            worker = v.tpu_worker_id()
             # bootstrap contract: Allocate exports the facts the OPERATOR
             # owns — this host's index in the slice and the slice shape.
             # Job-level facts (process count, coordinator address) belong
@@ -268,7 +294,9 @@ class TpuSideManager:
                               chain_status_provider=self.chain_status,
                               boundary_sync=self.sync_chain_boundaries,
                               cross_host_sync=self.sync_cross_host_hops,
-                              degraded_provider=self.degraded_sites))
+                              degraded_provider=self.degraded_sites,
+                              slice_degraded_provider=
+                              self.slice_degraded_status))
             self._manager.start()
         # self-healing chain repair: probe ICI link state through the
         # native agent (VSP spawns it next to the vendor-plugin socket —
@@ -285,34 +313,178 @@ class TpuSideManager:
                 log.warning("chain repair disabled: agent socket %s not "
                             "connectable", agent_sock)
 
-    def enable_chain_repair(self, prober, interval: float = 5.0):
+    def enable_chain_repair(self, prober, interval: float = 5.0,
+                            max_interval: float = 0.0, jitter_seed=None):
         """Start the periodic hop-repair loop (reference has no analog:
         its chain flow rules stay broken until pod churn; the bar is
-        beat, not match)."""
+        beat, not match).
+
+        Idle passes back off exponentially — bounded by *max_interval*
+        (default 8× *interval*) — with seeded jitter, so a fleet of
+        daemons falls out of lockstep instead of all probing the agent
+        on the same 5 s beat. A pass that found work, or a fault-engine
+        nudge (:meth:`_on_fault_transition`), resets the cadence to
+        *interval*. *jitter_seed* defaults to a stable per-node value
+        (crc32 of the node name) so a failing run replays."""
         self.link_prober = prober
         if self._repair_thread is None:
+            if jitter_seed is None:
+                jitter_seed = zlib.crc32(
+                    (getattr(self, "node_name", "")
+                     or os.environ.get("NODE_NAME", "")
+                     or "tpu-daemon").encode())
+            max_interval = max_interval or interval * 8
             self._repair_thread = threading.Thread(
-                target=self._repair_loop, args=(interval,), daemon=True,
-                name="chain-repair")
+                target=self._repair_loop,
+                args=(interval, max_interval, random.Random(jitter_seed)),
+                daemon=True, name="chain-repair")
             self._repair_thread.start()
 
-    def _repair_loop(self, interval: float):
+    @staticmethod
+    def _next_repair_delay(delay: float, interval: float,
+                           max_interval: float, busy: bool,
+                           nudged: bool) -> float:
+        """Backoff policy for the repair loop: reset to the base
+        cadence when the pass found work or a fault nudge woke us;
+        otherwise double, bounded by *max_interval*."""
+        if busy or nudged:
+            return interval
+        return min(delay * 2, max_interval)
+
+    def _repair_loop(self, interval: float, max_interval: float, rng):
         from ..utils import watchdog
         heartbeat = watchdog.register(
-            "tpuside.chain-repair", deadline=max(30.0, interval * 6))
+            "tpuside.chain-repair", deadline=max(30.0, max_interval * 6))
+        delay = interval
         try:
-            while not self._repair_stop.wait(interval):
+            while not self._repair_stop.is_set():
+                # jitter in [0.5, 1.0]× keeps the wait bounded by the
+                # backed-off delay while de-phasing the fleet
+                nudged = self._repair_nudge.wait(
+                    delay * (0.5 + 0.5 * rng.random()))
+                if self._repair_stop.is_set():
+                    break
+                if nudged:
+                    self._repair_nudge.clear()
                 heartbeat.beat()
-                try:
-                    # each pass is its own root trace: repairs triggered
-                    # by the loop (vs. AdminService) are distinguishable
-                    # in the flight recorder by this span
-                    with tracing.span("tpuside.repair_pass"):
-                        self.repair_chains()
-                except Exception:  # noqa: BLE001 — keep the loop alive
-                    log.exception("chain repair pass failed")
+                busy = self._repair_tick(heartbeat)
+                delay = self._next_repair_delay(
+                    delay, interval, max_interval, busy, nudged)
         finally:
             heartbeat.close()
+
+    def _repair_tick(self, heartbeat) -> bool:
+        """One guarded probe+repair pass; True when it found work (the
+        backoff resets). A raising prober (or any bug in the pass) must
+        not silently end the pass: the swallow is COUNTED
+        (tpu_daemon_swallowed_errors_total — flight-recorded by the
+        counter itself) and the watchdog heartbeat is fed, so the loop
+        reads alive-but-degraded rather than stalled."""
+        try:
+            # each pass is its own root trace: repairs triggered by the
+            # loop (vs. AdminService) are distinguishable in the flight
+            # recorder by this span
+            with tracing.span("tpuside.repair_pass"):
+                probed, probe_cache = self._fault_probe_pass()
+                # the probe pass just asked the agent about every local
+                # chip — hand its answers to repair so the steering scan
+                # does not re-issue the same RPCs this pass
+                repaired = self.repair_chains(probe_cache=probe_cache)
+            return bool(probed or repaired)
+        except Exception:  # noqa: BLE001 — keep the loop alive
+            metrics.SWALLOWED_ERRORS.inc(site="tpuside.repair_loop")
+            heartbeat.beat()
+            log.exception("chain repair pass failed")
+            return False
+
+    def _fault_probe_pass(self) -> tuple:
+        """Feed this host's link-state probes into the fault engine
+        (one pass over the local chips). Per-chip prober failures are
+        telemetry, not control: counted and skipped — absence of data
+        must never quarantine a link. Returns (committed transitions,
+        per-chip probe cache) — the cache is handed to repair_chains so
+        the steering scan reuses this pass's agent answers instead of
+        re-probing the same chips."""
+        engine = getattr(self, "fault_engine", None)
+        prober = self.link_prober
+        if engine is None or prober is None:
+            return [], {}
+        topo = self._slice_topology()
+        if topo is None:
+            return [], {}
+        host = v.tpu_worker_id()
+        chips = topo.chips_on_host(host)
+        if not chips:
+            # TPU_WORKER_ID does not name a topology host (stale after
+            # a reshape, or misconfigured): probing the WHOLE slice
+            # through the local agent would ingest link verdicts this
+            # prober has no authority over — skip rather than fight
+            # the owning hosts' probes
+            log.debug("fault probe pass skipped: worker %d not in "
+                      "topology %s", host, topo.topology)
+            return [], {}
+        transitions = []
+        probe_cache: dict = {}
+        for chip in chips:
+            try:
+                ports = prober(chip.index)
+            except Exception:  # noqa: BLE001 — telemetry, not control
+                metrics.SWALLOWED_ERRORS.inc(site="tpuside.link_probe")
+                log.debug("fault probe for chip %d failed; skipped "
+                          "this pass", chip.index, exc_info=True)
+                continue
+            probe_cache[chip.index] = {p.get("port", ""): p
+                                       for p in ports}
+            transitions.extend(
+                engine.ingest_link_probe(chip.index, ports))
+        return transitions, probe_cache
+
+    def _slice_topology(self):
+        """SliceTopology of this slice, or None before the VSP reported
+        one (the fault engine degrades to per-unit verdicts until
+        then)."""
+        topology = getattr(self.vsp, "topology", "")
+        if not topology:
+            return None
+        from ..ici import SliceTopology
+        try:
+            return SliceTopology.cached(topology)
+        except ValueError:
+            return None
+
+    def _on_fault_transition(self, transition) -> None:
+        """Fault-engine listener: withdraw/restore must not wait for
+        the next 5 s poll. Wake both ListAndWatch streams so kubelet
+        sees the verdict now, and nudge the repair loop so steering
+        around a freshly-dark link is event-driven (the nudge also
+        resets the idle backoff).
+
+        ONLY transitions that change the advertised/dark sets react —
+        entering quarantine, or completing recovering→healthy. A
+        suspect (or quarantined→recovering) transition changes neither
+        set, and poking on it would make the gated ListAndWatch
+        re-ingest the same raw bit milliseconds later, collapsing the
+        poll-cadence hysteresis ('consecutive bad probes' would no
+        longer mean consecutive 5 s polls)."""
+        from ..faults import HEALTHY as _H
+        from ..faults import QUARANTINED as _Q
+        from ..faults import RECOVERING as _R
+        if not (transition.new == _Q
+                or (transition.new == _H and transition.old == _R)):
+            return
+        nudge = getattr(self, "_repair_nudge", None)
+        if nudge is not None and threading.current_thread() \
+                is not getattr(self, "_repair_thread", None):
+            # transitions committed by the repair loop's OWN probe pass
+            # must not re-nudge it — the pass that ingested them runs
+            # repair_chains right after, so a self-nudge would only buy
+            # an immediate redundant back-to-back pass (and defeat the
+            # seeded-jitter de-phasing)
+            nudge.set()
+        for dp in (getattr(self, "device_plugin", None),
+                   getattr(self, "ici_device_plugin", None)):
+            if dp is not None:
+                dp.poke()
 
     def stop(self):
         self._flush_chains()
@@ -327,6 +499,7 @@ class TpuSideManager:
                 log.debug("peer channel close failed during stop",
                           exc_info=True)
         self._repair_stop.set()
+        self._repair_nudge.set()  # wake a loop parked in its backoff
         if self._repair_client is not None:
             try:
                 self._repair_client.close()
@@ -1032,11 +1205,113 @@ class TpuSideManager:
             return addr, None, True
         return addr, resp, True
 
+    #: consecutive failed resync ROUNDS against one peer daemon before
+    #: the fault engine is told its whole fault domain is gone (5 s
+    #: resync cadence => ~15 s to declare a host lost; one blip must
+    #: not quarantine eight chips)
+    PEER_LOST_AFTER = 3
+    #: failures against one peer within this window count as ONE round:
+    #: a peer serving several remote hops fails once per hop inside the
+    #: same resync pass, and that must not fast-forward the threshold
+    PEER_FAIL_DEDUP_S = 2.0
+
+    def _note_peer_unreachable(self, addr: str, hop_ids) -> None:
+        """Track consecutive peer-daemon failure rounds; at (and past)
+        the threshold, feed the fault engine the authoritative
+        host-lost signal (the 'peer daemon gone' case observe_host_lost
+        exists for). Firing keeps retrying every round past the
+        threshold — observe_host_lost is idempotent — so a host whose
+        index could not be resolved at the exact threshold pass (hop
+        not wired yet, topology not learned) is still declared lost
+        once it can be. The peer's host index is recovered from the
+        hop's remote ingress endpoint — nf<worker>-<chip> carries the
+        worker directly, ici-<chip>-<port> resolves through the slice
+        topology."""
+        engine = getattr(self, "fault_engine", None)
+        if engine is None or not addr:
+            return
+        now = engine.clock()
+        failures = self.__dict__.setdefault("_peer_failure_counts", {})
+        count, last = failures.get(addr, (0, None))
+        if last is not None and now - last < self.PEER_FAIL_DEDUP_S:
+            return  # same resync round: another hop on the same peer
+        count += 1
+        failures[addr] = (count, now)
+        if count < self.PEER_LOST_AFTER:
+            return
+        host = self._peer_host_of(hop_ids)
+        if host is not None:
+            if count == self.PEER_LOST_AFTER:
+                log.warning("peer daemon %s unreachable %d rounds; "
+                            "declaring host %d lost to the fault "
+                            "engine", addr, count, host)
+            engine.observe_host_lost(host)
+
+    def _note_peer_reachable(self, addr: str, hop_ids=None) -> None:
+        """Reset the failure count AND feed the engine good chip probes
+        for the peer's host while any of its chips are not healthy: a
+        host-lost quarantine has no other probe source (only local
+        chips are polled), so without this a 15 s partition would leave
+        the peer's chips quarantined — and the slice degraded —
+        forever. Recovery still walks the normal hold-down +
+        recovering→healthy hysteresis, one (batched) good probe per
+        resync. Good probes dedupe per round exactly like failures
+        (PEER_FAIL_DEDUP_S): a peer serving several remote hops
+        answers once per hop in the same pass, and recover_after must
+        mean consecutive ROUNDS of confirmation — not one pass
+        re-admitting eight chips because it carried three hops."""
+        self.__dict__.setdefault("_peer_failure_counts", {}).pop(
+            addr, None)
+        engine = getattr(self, "fault_engine", None)
+        if engine is None:
+            return
+        now = engine.clock()
+        last = self.__dict__.setdefault("_peer_recovery_last", {})
+        prev = last.get(addr)
+        if prev is not None and now - prev < self.PEER_FAIL_DEDUP_S:
+            return  # same resync round: another hop on the same peer
+        host = self._peer_host_of(hop_ids)
+        if host is None:
+            return
+        topo = self._slice_topology()
+        if topo is None:
+            return
+        from ..faults import HEALTHY as FAULT_HEALTHY
+        probes = {chip.id: True for chip in topo.chips_on_host(host)
+                  if engine.state(chip.id) != FAULT_HEALTHY}
+        if probes:
+            last[addr] = now
+            engine.ingest_chip_probes(probes)
+
+    _NF_ATTACH_RE = re.compile(r"^nf(\d+)-(\d+)$")
+
+    def _peer_host_of(self, hop_ids) -> Optional[int]:
+        if not hop_ids:
+            return None
+        in_id = hop_ids[1]
+        m = self._NF_ATTACH_RE.match(in_id)
+        if m:
+            return int(m.group(1))
+        m = self._ICI_ID_RE.match(in_id)
+        if m:
+            topo = self._slice_topology()
+            chip = int(m.group(1))
+            if topo is not None and 0 <= chip < topo.num_chips:
+                return topo.chips[chip].host
+        return None
+
     def _converge_remote_hop(self, key: tuple, i: int, up_entry: dict,
                              nf_spec: dict):
         hop_key = key + (i,)
         addr, entry, reachable = self._remote_chain_entry(
             key[0], key[1], nf_spec, i + 1)
+        if addr:
+            with self._attach_lock:
+                known = self._chain_hops.get(hop_key)
+            if reachable:
+                self._note_peer_reachable(addr, known)
+            else:
+                self._note_peer_unreachable(addr, known)
         with self._attach_lock:
             existing = self._chain_hops.get(hop_key)
             existing_remote = self._remote_hops.get(hop_key, "")
@@ -1124,17 +1399,25 @@ class TpuSideManager:
         m = TpuSideManager._CHIP_ID_RE.match(device_id or "")
         if not m:
             return None
-        worker = int(os.environ.get("TPU_WORKER_ID", "0"))
+        worker = v.tpu_worker_id()
         return f"nf{worker}-{m.group(1)}", int(m.group(1))
 
-    def _endpoint_link_down(self, endpoint: str,
-                            probe_cache: dict) -> bool:
+    def _endpoint_link_down(self, endpoint: str, probe_cache: dict,
+                            dark=frozenset()) -> bool:
         """True when *endpoint* is a port-addressed id whose physical
-        link is down. Attachment-id endpoints carry no port-level state
-        (never 'down'); prober failures read as healthy — repair must
-        never churn wiring on flaky telemetry."""
+        link is down — or whose link the fault engine has JUDGED dark
+        (*dark*: quarantined/held-down links plus links darkened by a
+        withdrawn chip's fault domain), so repair steers around a
+        flapping link proactively instead of only after the wire reads
+        down. Attachment-id endpoints carry no port-level state (never
+        'down'); prober failures read as healthy — repair must never
+        churn wiring on flaky telemetry."""
         m = self._ICI_ID_RE.match(endpoint)
         if not m:
+            return False
+        if endpoint in dark:
+            return True
+        if self.link_prober is None:
             return False
         chip, port = int(m.group(1)), m.group(2)
         if chip not in probe_cache:
@@ -1154,13 +1437,18 @@ class TpuSideManager:
         return (state is not None and state.get("wired", False)
                 and not state.get("up", True))
 
-    def repair_chains(self) -> list:
+    def repair_chains(self, probe_cache: Optional[dict] = None) -> list:
         """Self-healing steering: re-wire chain hops whose allocated ICI
         port's link went down, degrading that side to the NF's
         attachment-id endpoint (topology-level steering) make-before-
-        break. Returns [(hop_key, old_ids, new_ids)]. The reference's
-        chain flow rules have no repair path — broken until pod churn."""
-        if self.link_prober is None:
+        break. Returns [(hop_key, old_ids, new_ids)]. *probe_cache*
+        (chip index -> {port: state}) seeds the per-pass probe results
+        — the repair loop passes its probe pass's answers so each tick
+        asks the agent about every chip once, not twice. The
+        reference's chain flow rules have no repair path — broken until
+        pod churn."""
+        if self.link_prober is None \
+                and getattr(self, "fault_engine", None) is None:
             return []
         # one repair pass at a time: the periodic loop and the manual
         # AdminService trigger computing the same plan concurrently would
@@ -1174,12 +1462,19 @@ class TpuSideManager:
                 # would drop the hop and the live wire would leak,
                 # untracked by either generation
                 return []
-            repaired = self._repair_chains_locked()
+            repaired = self._repair_chains_locked(probe_cache)
         self._flush_chains()
         return repaired
 
-    def _repair_chains_locked(self) -> list:
-        probe_cache: dict = {}
+    def _repair_chains_locked(self,
+                              probe_cache: Optional[dict] = None) -> list:
+        probe_cache = dict(probe_cache) if probe_cache else {}
+        engine = getattr(self, "fault_engine", None)
+        # the engine's judged dark set, computed once per pass:
+        # quarantined/held-down links + links darkened by a withdrawn
+        # chip's fault domain
+        dark = engine.dark_link_ids() if engine is not None \
+            else frozenset()
         with self._attach_lock:
             snapshot = [(hop_key, ids,
                          self._chain_store.get(hop_key[:2], {}))
@@ -1200,10 +1495,10 @@ class TpuSideManager:
             out_id, in_id = ids
             new_out, new_in = out_id, in_id
             if up_entry is not None and self._endpoint_link_down(
-                    out_id, probe_cache):
+                    out_id, probe_cache, dark):
                 new_out = up_entry["out"]
             if down_entry is not None and self._endpoint_link_down(
-                    in_id, probe_cache):
+                    in_id, probe_cache, dark):
                 new_in = down_entry["in"]
             if (new_out, new_in) != ids:
                 plans.append((hop_key, ids, (new_out, new_in)))
@@ -1569,7 +1864,45 @@ class TpuSideManager:
         from . import handoff
         provider = getattr(self.vsp, "degraded_sites", None)
         sites = list(provider()) if callable(provider) else []
+        engine = getattr(self, "fault_engine", None)
+        if engine is not None and engine.slice_degraded() is not None:
+            # hardware fault domains darkened part of the mesh: the
+            # node serves the largest still-connected sub-slice
+            sites.append("faults:slice-degraded")
         return sites + handoff.STATUS.degraded_components()
+
+    # -- fault-domain engine (faults/engine.py) -------------------------------
+    def fault_status(self) -> dict:
+        """Engine state table for AdminService.GetFaults / `tpuctl
+        faults`."""
+        engine = getattr(self, "fault_engine", None)
+        if engine is None:
+            return {"enabled": False, "units": [], "sliceDegraded": None}
+        return {"enabled": True, "units": engine.state_table(),
+                "sliceDegraded": engine.slice_degraded()}
+
+    def slice_degraded_status(self):
+        """Degraded-slice verdict for the SFC reconciler's
+        ``SliceDegraded`` CR condition (None while fully operational)."""
+        engine = getattr(self, "fault_engine", None)
+        return engine.slice_degraded() if engine is not None else None
+
+    def export_fault_state(self):
+        """Fault-engine state for the handoff bundle (schema v2
+        section)."""
+        engine = getattr(self, "fault_engine", None)
+        return engine.export_state() if engine is not None else None
+
+    def adopt_fault_state(self, data) -> list:
+        """Adopt the handed-off fault section: quarantines and
+        hold-downs survive the upgrade (a withdrawn chip must NOT
+        briefly re-enter kubelet's allocatable set under a new daemon).
+        Returns discrepancy details; fresh probes then reconcile the
+        adopted verdicts against live hardware."""
+        engine = getattr(self, "fault_engine", None)
+        if engine is None:
+            return []
+        return engine.adopt_state(data)
 
     # -- chain observability --------------------------------------------------
     def chain_status(self, namespace: str, name: str) -> list:
@@ -1767,9 +2100,11 @@ class TpuSideManager:
         prober appears when chain repair connects the agent client), and
         preferred allocation aligns ports with recent chip Allocates."""
         self.ici_device_plugin = DevicePlugin(
-            IciPortDeviceHandler(topology_provider,
-                                 link_prober_provider=lambda:
-                                 self.link_prober),
+            FaultGatedHandler(
+                IciPortDeviceHandler(topology_provider,
+                                     link_prober_provider=lambda:
+                                     self.link_prober),
+                getattr(self, "fault_engine", None), kind=FAULT_LINK),
             resource=v.ICI_RESOURCE_NAME,
             path_manager=self.path_manager,
             preferred_fn=self._preferred_ports)

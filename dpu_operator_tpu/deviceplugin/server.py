@@ -291,6 +291,13 @@ class DevicePlugin:
                 lambda: self._served_gen >= want or self._stop.is_set(),
                 timeout=wait) and self._served_gen >= want
 
+    def poke(self) -> None:
+        """Wake ListAndWatch for an immediate re-snapshot, without the
+        refresh() barrier wait — the fault engine's withdraw/restore
+        path rides this so a quarantine reaches kubelet now, not on
+        the next 5 s poll."""
+        self._poke.set()
+
     def stop(self):
         self._stop.set()
         self._poke.set()

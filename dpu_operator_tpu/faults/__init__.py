@@ -1,0 +1,11 @@
+"""Per-node ICI fault-domain engine (doc/architecture.md "Hardware
+fault domains")."""
+
+from .engine import (CHIP, HEALTHY, LINK, QUARANTINED, RECOVERING,
+                     SUSPECT, FaultEngine, FaultPolicy, Transition)
+from .gate import FaultGatedHandler
+
+__all__ = [
+    "CHIP", "LINK", "HEALTHY", "SUSPECT", "QUARANTINED", "RECOVERING",
+    "FaultEngine", "FaultPolicy", "FaultGatedHandler", "Transition",
+]

@@ -1,0 +1,641 @@
+"""ICI fault-domain engine: judged hardware health for chips and links.
+
+The operator's core promise is that pods consume accelerator resources
+without caring about the hardware faults underneath — and for TPUs the
+hardware that fails is the ICI mesh itself: links flap, chips die, hosts
+drop whole fault domains at once. The reactive pieces already existed
+(per-device ``Unhealthy`` gating in the device plugin, the chain-repair
+loop, breaker Degraded conditions) but nothing *modeled* hardware
+health: a link that bounced ten times a minute was re-admitted on every
+bounce, and a dead chip's links kept reading "probe failed, assume
+healthy".
+
+This engine turns raw probe signals into judged state via a per-unit
+state machine with hysteresis and flap damping:
+
+``healthy → suspect → quarantined → recovering → healthy``
+
+- **healthy → suspect**: one bad probe. The unit stays advertised — a
+  single flap must not churn kubelet's allocatable set.
+- **suspect → quarantined**: ``quarantine_after`` consecutive bad
+  probes. The unit is withdrawn and a hold-down timer starts.
+- **quarantined → recovering**: good probes are IGNORED until the
+  hold-down expires (CrashLoopBackOff-style); the first good probe
+  after expiry starts recovery.
+- **recovering → healthy**: ``recover_after`` consecutive good probes.
+  Only here does the unit return to service (MTTR is recorded from the
+  first quarantine entry).
+- **recovering → quarantined**: any bad probe. Each re-quarantine
+  within ``flap_window`` doubles the hold-down (bounded by
+  ``hold_down_max``), so a link that bounces N times in a window stays
+  quarantined with exponential hold-down instead of being re-admitted
+  per bounce.
+
+Fault domains propagate: a quarantined chip darkens every ICI link
+touching it (``SliceTopology`` adjacency indexes), a lost host
+quarantines all its chips at once, and the engine computes the largest
+still-connected sub-slice over the surviving mesh — chips that are
+individually healthy but cut off from the main component are withdrawn
+too (a chip without ICI connectivity cannot join collectives), and the
+shrinkage is published as degraded-slice state instead of failing the
+whole slice.
+
+Verdicts are consumed by the device plugin (withdraw/restore in
+ListAndWatch, Allocate refusal), the SFC repair pass (proactive
+steering around dark links, event-driven nudge), the CR status
+(``SliceDegraded``) and ``/healthz``. State survives cold restart (an
+``atomicfile`` journal with relative timers — monotonic clocks do not
+compare across processes) and live handoff (a dedicated bundle
+section, adopted then reconciled against fresh probes).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+from ..k8s import events
+from ..utils import flight, metrics
+from ..utils.atomicfile import atomic_write
+
+log = logging.getLogger(__name__)
+
+#: unit health states (the machine above)
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+RECOVERING = "recovering"
+
+#: unit kinds
+CHIP = "chip"
+LINK = "link"
+
+#: journal/bundle schema for the engine's own persisted state
+STATE_SCHEMA = 1
+
+
+@dataclass(frozen=True)
+class FaultPolicy:
+    """Hysteresis thresholds and hold-down parameters (documented in
+    doc/architecture.md "Hardware fault domains")."""
+
+    #: consecutive bad probes before a suspect unit is quarantined
+    quarantine_after: int = 2
+    #: consecutive good probes before a recovering unit is healthy
+    recover_after: int = 3
+    #: first-quarantine hold-down, seconds; doubles per re-quarantine
+    hold_down_base: float = 10.0
+    #: hold-down ceiling, seconds
+    hold_down_max: float = 300.0
+    #: window for counting quarantine episodes (flap damping)
+    flap_window: float = 120.0
+
+
+@dataclass(frozen=True)
+class Transition:
+    """One committed state change, delivered to listeners."""
+
+    unit: str
+    kind: str
+    old: str
+    new: str
+    reason: str
+
+
+class _Unit:
+    __slots__ = ("unit", "kind", "state", "bad", "good", "hold_until",
+                 "episodes", "quarantined_at", "reason")
+
+    def __init__(self, unit: str, kind: str):
+        self.unit = unit
+        self.kind = kind
+        self.state = HEALTHY
+        self.bad = 0
+        self.good = 0
+        #: monotonic time before which good probes are ignored
+        self.hold_until = 0.0
+        #: quarantine-entry times within the flap window (damping input)
+        self.episodes: collections.deque = collections.deque(maxlen=64)
+        #: first quarantine entry of the current outage (MTTR epoch)
+        self.quarantined_at: Optional[float] = None
+        self.reason = ""
+
+
+class FaultEngine:
+    """Per-node fault-domain engine. Thread-safe: probe feeders (device
+    plugin ListAndWatch, the repair loop), the handoff path and admin
+    reads all call in concurrently. Listeners run OUTSIDE the lock."""
+
+    def __init__(self, topology_provider: Optional[Callable] = None,
+                 policy: Optional[FaultPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 journal_path: str = ""):
+        """*topology_provider*: callable -> SliceTopology | None (may be
+        None early — propagation degrades to per-unit verdicts until the
+        slice shape is known). *clock* is injectable so fault tests
+        advance time instead of sleeping."""
+        self.topology_provider = topology_provider
+        self.policy = policy or FaultPolicy()
+        self.clock = clock
+        self.journal_path = journal_path
+        self._units: dict[str, _Unit] = {}
+        self._lock = threading.Lock()
+        self._listeners: list[Callable[[Transition], None]] = []
+        #: derived-view cache (withdrawn chips, dark links, sub-slice),
+        #: invalidated on every committed transition. The generation
+        #: counter closes the stale-store race: a transition landing
+        #: while a reader computes the view off-lock must win — the
+        #: reader only publishes its result if no invalidation happened
+        #: in between
+        self._derived: Optional[tuple] = None
+        self._derived_gen = 0
+        #: last published operational sub-slice size (event dedup)
+        self._last_operational: Optional[int] = None
+        #: (unit, seconds) recoveries — the MTTR series FAULT_r01.json
+        #: summarizes; bounded like the episode deques (a daemon built
+        #: to never restart must not grow an unbounded list off a link
+        #: that flaps for months)
+        self.recoveries: collections.deque = collections.deque(maxlen=1024)
+
+    # -- probe ingestion ------------------------------------------------------
+    def observe_chip(self, chip_id: str, healthy: bool) -> list:
+        """Feed one raw chip-health probe; returns committed
+        transitions (empty for the common no-change case)."""
+        return self._observe(chip_id, CHIP, healthy)
+
+    def observe_link(self, link_id: str, up: bool) -> list:
+        """Feed one raw link-state probe (wired port trained/untrained,
+        or the agent's fault flag folded in by the caller)."""
+        return self._observe(link_id, LINK, up)
+
+    def observe_host_lost(self, host: int) -> list:
+        """Fault-domain signal: a whole host dropped (peer daemon gone,
+        VM preempted). Every chip on it is quarantined at once — no
+        hysteresis; the signal is authoritative, not a flaky probe."""
+        topo = self._topology()
+        if topo is None:
+            return []
+        now = self.clock()
+        transitions = []
+        with self._lock:
+            for chip in topo.chips_on_host(host):
+                unit = self._unit_locked(chip.id, CHIP)
+                if unit.state == QUARANTINED:
+                    continue
+                transitions.append(self._enter_quarantine_locked(
+                    unit, now, f"host {host} lost"))
+        return self._commit(transitions)
+
+    def _observe(self, unit_id: str, kind: str, ok: bool) -> list:
+        now = self.clock()
+        with self._lock:
+            unit = self._unit_locked(unit_id, kind)
+            tr = self._observe_locked(unit, bool(ok), now)
+        return self._commit([tr] if tr is not None else [])
+
+    def _unit_locked(self, unit_id: str, kind: str) -> _Unit:
+        unit = self._units.get(unit_id)
+        if unit is None:
+            unit = self._units[unit_id] = _Unit(unit_id, kind)
+        return unit
+
+    def _observe_locked(self, u: _Unit, ok: bool,
+                        now: float) -> Optional[Transition]:
+        if ok:
+            u.bad = 0
+            if u.state == SUSPECT:
+                return self._set_locked(u, HEALTHY, "probe recovered")
+            if u.state == QUARANTINED and now >= u.hold_until:
+                u.good = 1
+                return self._set_locked(u, RECOVERING,
+                                        "hold-down expired, probing good")
+            if u.state == RECOVERING:
+                u.good += 1
+                if u.good >= self.policy.recover_after:
+                    return self._set_locked(u, HEALTHY,
+                                            f"{u.good} consecutive good "
+                                            "probes")
+            return None
+        u.good = 0
+        if u.state == HEALTHY:
+            u.bad = 1
+            return self._set_locked(u, SUSPECT, "bad probe")
+        if u.state == SUSPECT:
+            u.bad += 1
+            if u.bad >= self.policy.quarantine_after:
+                return self._enter_quarantine_locked(
+                    u, now, f"{u.bad} consecutive bad probes")
+        elif u.state == RECOVERING:
+            return self._enter_quarantine_locked(
+                u, now, "bounced during recovery")
+        return None
+
+    def _enter_quarantine_locked(self, u: _Unit, now: float,
+                                 reason: str) -> Transition:
+        while u.episodes and u.episodes[0] < now - self.policy.flap_window:
+            u.episodes.popleft()
+        u.episodes.append(now)
+        # episode 1 -> base hold; each re-quarantine in the window
+        # doubles it (exponential hold-down; a flapping unit is held
+        # out longer every bounce instead of re-admitted per bounce)
+        level = len(u.episodes) - 1
+        hold = min(self.policy.hold_down_base * (2 ** level),
+                   self.policy.hold_down_max)
+        u.hold_until = now + hold
+        if u.quarantined_at is None:
+            u.quarantined_at = now
+        if level:
+            metrics.FAULT_FLAP_HOLDDOWNS.inc(kind=u.kind)
+        return self._set_locked(
+            u, QUARANTINED, f"{reason}; hold-down {hold:g}s"
+            + (f" (flap level {level})" if level else ""))
+
+    def _set_locked(self, u: _Unit, new: str, reason: str) -> Transition:
+        tr = Transition(unit=u.unit, kind=u.kind, old=u.state, new=new,
+                        reason=reason)
+        u.state = new
+        u.reason = reason
+        self._derived = None
+        self._derived_gen += 1
+        if new == HEALTHY:
+            u.bad = u.good = 0
+            if u.quarantined_at is not None:
+                mttr = self.clock() - u.quarantined_at
+                self.recoveries.append((u.unit, mttr))
+                metrics.FAULT_RECOVERY_SECONDS.observe(mttr)
+                u.quarantined_at = None
+        return tr
+
+    # -- transition side effects (outside the lock) ---------------------------
+    def _commit(self, transitions: list) -> list:
+        if not transitions:
+            return transitions
+        for tr in transitions:
+            metrics.FAULT_TRANSITIONS.inc(kind=tr.kind, to=tr.new)
+            flight.record("fault", f"{tr.unit}: {tr.old}->{tr.new}",
+                          attributes={"unit": tr.unit, "kind": tr.kind,
+                                      "to": tr.new, "reason": tr.reason})
+            if tr.new == QUARANTINED:
+                events.emit(
+                    "ChipQuarantined" if tr.kind == CHIP
+                    else "LinkQuarantined",
+                    f"{tr.unit} quarantined: {tr.reason}",
+                    type_="Warning", series=tr.unit)
+            elif tr.new == HEALTHY and tr.old == RECOVERING:
+                events.emit(
+                    "FaultRecovered",
+                    f"{tr.unit} recovered: {tr.reason}",
+                    series=tr.unit)
+        self._republish()
+        for tr in transitions:
+            for listener in list(self._listeners):
+                try:
+                    listener(tr)
+                except Exception:  # noqa: BLE001 — listener bug must not
+                    metrics.SWALLOWED_ERRORS.inc(  # poison the engine
+                        site="faults.listener")
+                    log.exception("fault-transition listener failed")
+        return transitions
+
+    def _republish(self) -> None:
+        """Refresh every published surface from the current unit table:
+        the quarantine gauges, the sub-slice gauge/Event, and the
+        journal. Runs after each transition batch AND after adoption —
+        a restart that adopts two quarantined chips must not read 0 on
+        tpu_fault_quarantined until some unrelated unit transitions."""
+        with self._lock:
+            counts: dict[str, int] = {CHIP: 0, LINK: 0}
+            for u in self._units.values():
+                if u.state in (QUARANTINED, RECOVERING):
+                    counts[u.kind] = counts.get(u.kind, 0) + 1
+        for kind, n in counts.items():
+            metrics.FAULT_QUARANTINED.set(n, kind=kind)
+        self._publish_subslice()
+        self.save()
+
+    def _publish_subslice(self) -> None:
+        degraded = self.slice_degraded()
+        if degraded is None:
+            topo = self._topology()
+            if topo is not None:
+                metrics.FAULT_SUBSLICE.set(topo.num_chips)
+            if self._last_operational is not None:
+                self._last_operational = None
+            return
+        operational = degraded["operational"]
+        metrics.FAULT_SUBSLICE.set(operational)
+        if operational != self._last_operational:
+            self._last_operational = operational
+            events.emit(
+                "SliceDegraded",
+                f"operational sub-slice is {operational}/"
+                f"{degraded['total']} chips (largest still-connected "
+                "component; disconnected or quarantined chips are "
+                "withdrawn from kubelet)",
+                type_="Warning", series="subslice")
+
+    def add_listener(self, fn: Callable[[Transition], None]) -> None:
+        """*fn* runs on every committed transition, outside the engine
+        lock (the repair-loop nudge and device-plugin pokes ride this)."""
+        self._listeners.append(fn)
+
+    # -- derived views --------------------------------------------------------
+    def _topology(self):
+        if self.topology_provider is None:
+            return None
+        try:
+            return self.topology_provider()
+        except Exception:  # noqa: BLE001 — topology is an enhancement
+            metrics.SWALLOWED_ERRORS.inc(site="faults.topology")
+            log.debug("fault-engine topology provider failed",
+                      exc_info=True)
+            return None
+
+    def _derived_views(self) -> tuple:
+        """(withdrawn chip ids, dark link ids, operational chip ids or
+        None, total chips or None) — cached until the next transition."""
+        with self._lock:
+            if self._derived is not None:
+                return self._derived
+            gen = self._derived_gen
+            withdrawn = {u.unit for u in self._units.values()
+                         if u.state in (QUARANTINED, RECOVERING)}
+        topo = self._topology()
+        dead_chips = {u for u in withdrawn if u.startswith("chip-")}
+        dark = {u for u in withdrawn if u.startswith("ici-")}
+        component: Optional[set] = None
+        total: Optional[int] = None
+        if topo is not None:
+            total = topo.num_chips
+            dead_idx = set()
+            for cid in dead_chips:
+                chip = topo.chip_by_id(cid)
+                if chip is not None:
+                    dead_idx.add(chip.index)
+            # a dead chip darkens every link touching it (both
+            # directions exist as distinct IciLink objects)
+            for link in topo.links:
+                if link.src in dead_idx or link.dst in dead_idx:
+                    dark.add(link.id)
+            component = self._largest_component(topo, dead_idx, dark)
+            # individually-healthy chips cut off from the main
+            # component cannot join collectives: withdrawn too
+            for chip in topo.chips:
+                if chip.index not in dead_idx \
+                        and chip.id not in component:
+                    withdrawn = withdrawn | {chip.id}
+        result = (frozenset(withdrawn), frozenset(dark),
+                  frozenset(component) if component is not None else None,
+                  total)
+        with self._lock:
+            # a transition committed while we computed off-lock must
+            # win: publish only if no invalidation raced this view
+            # (callers still get a verdict consistent with the state
+            # they snapshotted; the next read recomputes fresh)
+            if self._derived_gen == gen:
+                self._derived = result
+        return result
+
+    @staticmethod
+    def _largest_component(topo, dead_idx: set, dark: set) -> set:
+        """Chip ids of the largest connected component over live chips
+        and non-dark links (BFS over the adjacency index)."""
+        alive = [c for c in topo.chips if c.index not in dead_idx]
+        seen: set = set()
+        best: set = set()
+        for start in alive:
+            if start.index in seen:
+                continue
+            frontier = [start.index]
+            seen.add(start.index)
+            component = {start.index}
+            while frontier:
+                idx = frontier.pop()
+                for link in topo.links_from(idx):
+                    if link.id in dark or link.dst in dead_idx \
+                            or link.dst in component:
+                        continue
+                    component.add(link.dst)
+                    seen.add(link.dst)
+                    frontier.append(link.dst)
+            if len(component) > len(best):
+                best = component
+        return {topo.chips[i].id for i in best}
+
+    def withdrawn_chips(self) -> frozenset:
+        """Chip ids the device plugin must advertise Unhealthy:
+        quarantined/recovering chips plus healthy-but-disconnected ones
+        (outside the largest connected sub-slice)."""
+        withdrawn, _, _, _ = self._derived_views()
+        return frozenset(u for u in withdrawn if u.startswith("chip-"))
+
+    def dark_link_ids(self) -> frozenset:
+        """Link ids the repair pass must steer around: quarantined or
+        recovering links, plus every link touching a withdrawn chip."""
+        _, dark, _, _ = self._derived_views()
+        return dark
+
+    def slice_degraded(self) -> Optional[dict]:
+        """None while the full slice is operational; otherwise
+        ``{"operational", "total", "chips"}`` for the largest
+        still-connected sub-slice (CR ``SliceDegraded`` condition,
+        /healthz component, `tpuctl faults`)."""
+        _, _, component, total = self._derived_views()
+        if component is None or total is None or len(component) >= total:
+            return None
+        return {"operational": len(component), "total": total,
+                "chips": sorted(component)}
+
+    def state(self, unit_id: str) -> str:
+        with self._lock:
+            unit = self._units.get(unit_id)
+            return unit.state if unit is not None else HEALTHY
+
+    def state_table(self) -> list:
+        """Rows for `tpuctl faults` / AdminService.GetFaults: every
+        tracked unit's judged state, hold-down remaining and flap
+        pressure."""
+        now = self.clock()
+        with self._lock:
+            rows = [{
+                "unit": u.unit, "kind": u.kind, "state": u.state,
+                "reason": u.reason,
+                "holdRemainingSeconds": round(
+                    max(0.0, u.hold_until - now), 3)
+                if u.state == QUARANTINED else 0.0,
+                "flapEpisodes": len([t for t in u.episodes
+                                     if t >= now
+                                     - self.policy.flap_window]),
+                "outageSeconds": round(now - u.quarantined_at, 3)
+                if u.quarantined_at is not None else 0.0,
+            } for u in self._units.values()]
+        return sorted(rows, key=lambda r: (r["kind"], r["unit"]))
+
+    # -- persistence (cold restart) and handoff (live upgrade) ----------------
+    def export_state(self) -> dict:
+        """Serialized engine state with RELATIVE timers: monotonic
+        clocks do not compare across processes, so hold-downs and
+        outage epochs ride as remaining/elapsed seconds."""
+        now = self.clock()
+        with self._lock:
+            units = [{
+                "unit": u.unit, "kind": u.kind, "state": u.state,
+                "bad": u.bad, "good": u.good, "reason": u.reason,
+                "hold_remaining": max(0.0, u.hold_until - now),
+                "episode_ages": [max(0.0, now - t) for t in u.episodes],
+                "outage_elapsed": (now - u.quarantined_at
+                                   if u.quarantined_at is not None
+                                   else None),
+            } for u in self._units.values()]
+        return {"schema": STATE_SCHEMA, "units": units}
+
+    def adopt_state(self, data: Optional[dict]) -> list:
+        """Install exported state (handoff bundle section or journal).
+        Returns discrepancy strings for entries that were dropped —
+        unknown schema, malformed rows, or units the current topology
+        does not know. Adopted verdicts are then reconciled against
+        fresh probes: a quarantined unit whose hardware is actually
+        fine walks recovering→healthy on live signals."""
+        if not isinstance(data, dict):
+            return ["fault state missing or malformed; starting clean"]
+        if data.get("schema") != STATE_SCHEMA:
+            return [f"fault state schema {data.get('schema')!r} != "
+                    f"{STATE_SCHEMA}; starting clean"]
+        topo = self._topology()
+        now = self.clock()
+        dropped: list = []
+        with self._lock:
+            for row in data.get("units") or []:
+                unit_id = row.get("unit", "")
+                kind = row.get("kind", "")
+                state = row.get("state", "")
+                if (not unit_id or kind not in (CHIP, LINK)
+                        or state not in (HEALTHY, SUSPECT, QUARANTINED,
+                                         RECOVERING)):
+                    dropped.append(f"malformed fault row {row!r}")
+                    continue
+                if topo is not None and self._unknown_unit(topo, unit_id,
+                                                           kind):
+                    dropped.append(
+                        f"{unit_id}: not in topology "
+                        f"{topo.topology}; dropped")
+                    continue
+                try:
+                    # coerce BEFORE installing anything: a wrong-typed
+                    # field in a corrupt journal/bundle drops the row,
+                    # it must not raise out of load()'s 'never raises'
+                    # contract or leave a half-installed unit
+                    bad = int(row.get("bad") or 0)
+                    good = int(row.get("good") or 0)
+                    hold_until = now + float(row.get("hold_remaining")
+                                             or 0.0)
+                    episodes = [now - float(age)
+                                for age in row.get("episode_ages") or []]
+                    elapsed = row.get("outage_elapsed")
+                    quarantined_at = (now - float(elapsed)
+                                      if elapsed is not None else None)
+                except (TypeError, ValueError):
+                    dropped.append(f"malformed fault row {row!r}")
+                    continue
+                u = self._unit_locked(unit_id, kind)
+                u.state = state
+                u.bad = bad
+                u.good = good
+                u.reason = str(row.get("reason") or "adopted")
+                u.hold_until = hold_until
+                u.episodes.clear()
+                u.episodes.extend(episodes)
+                u.quarantined_at = quarantined_at
+            self._derived = None
+            self._derived_gen += 1
+        # adopted verdicts are live state: gauges, the sub-slice view
+        # and the journal must reflect them NOW, not after the next
+        # organic transition
+        self._republish()
+        return dropped
+
+    @staticmethod
+    def _unknown_unit(topo, unit_id: str, kind: str) -> bool:
+        if kind == CHIP:
+            return topo.chip_by_id(unit_id) is None
+        return topo.link_by_id(unit_id) is None
+
+    def save(self, path: str = "") -> None:
+        """Journal the engine state (atomic temp+fsync+rename); no-op
+        without a journal path. Failures are observable, never fatal —
+        losing the journal degrades restart behavior, not service."""
+        path = path or self.journal_path
+        if not path:
+            return
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            atomic_write(path, json.dumps(self.export_state(),
+                                          sort_keys=True))
+        except OSError:
+            metrics.SWALLOWED_ERRORS.inc(site="faults.journal")
+            log.exception("fault journal write failed (%s)", path)
+
+    def load(self, path: str = "") -> list:
+        """Recover journaled state on cold start. Never raises: a
+        missing/corrupt journal starts the engine clean (probes rebuild
+        the picture within a few passes)."""
+        path = path or self.journal_path
+        if not path:
+            return []
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return []
+        except (OSError, ValueError) as e:
+            log.warning("fault journal %s unreadable (%s); starting "
+                        "clean", path, e)
+            return [f"journal unreadable: {e}"]
+        dropped = self.adopt_state(data)
+        for detail in dropped:
+            log.warning("fault journal entry dropped: %s", detail)
+        return dropped
+
+    def ingest_chip_probes(self, probes: dict) -> list:
+        """Batch chip-health observations — the device plugin's poll
+        feeds one whole snapshot (global chip units -> raw healthy
+        bit), committing ONE transition batch: one journal write and
+        one sub-slice recomputation per poll, not one per flipped chip
+        in a host-loss storm."""
+        now = self.clock()
+        transitions = []
+        with self._lock:
+            for unit_id, ok in probes.items():
+                unit = self._unit_locked(unit_id, CHIP)
+                tr = self._observe_locked(unit, bool(ok), now)
+                if tr is not None:
+                    transitions.append(tr)
+        return self._commit(transitions)
+
+    def ingest_link_probe(self, chip_index: int,
+                          ports: Iterable[dict]) -> list:
+        """Convenience for the repair loop's probe pass: fold one
+        chip's prober answer ([{"port","up","wired","fault"}]) into
+        link observations. A wired-but-untrained port and a faulted
+        port are both bad; an unwired port idles at up=False by design
+        and reads healthy (chip_links_ok has the same rule). The whole
+        answer commits as ONE batch — one journal write per chip probe
+        instead of one per flipped port."""
+        now = self.clock()
+        transitions = []
+        with self._lock:
+            for p in ports:
+                bad = bool(p.get("fault")) or (bool(p.get("wired"))
+                                               and not p.get("up", True))
+                unit = self._unit_locked(
+                    f"ici-{chip_index}-{p.get('port', '')}", LINK)
+                tr = self._observe_locked(unit, not bad, now)
+                if tr is not None:
+                    transitions.append(tr)
+        return self._commit(transitions)

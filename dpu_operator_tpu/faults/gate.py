@@ -1,0 +1,135 @@
+"""Fault-engine gating for device handlers.
+
+The device plugin's ListAndWatch polls its handler every 5 s and
+advertises the raw ``healthy`` bit straight to kubelet — so before the
+fault engine, one flaky VSP health answer withdrew a chip and the next
+restored it, churning the allocatable set. The gate sits between the
+plugin and the handler: every poll FEEDS the raw bit into the engine as
+a probe observation, and what kubelet sees is the engine's JUDGED
+verdict — hysteresis on the way down (one bad poll → suspect, still
+advertised), hold-down on the way up (a quarantined chip returns only
+after recovering→healthy). Devices are never deleted from the set —
+withdraw/restore rides the Healthy/Unhealthy flag, so kubelet observes
+zero spurious deletions across a fault storm.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ..utils import vars as _vars
+from . import engine as _engine
+
+_LOCAL_CHIP_RE = re.compile(r"^chip-(\d+)$")
+
+
+class FaultGatedHandler:
+    """Wrap a device handler's ``get_devices()`` with fault-engine
+    judgment.
+
+    *kind* ``"chip"``: raw health feeds :meth:`FaultEngine.observe_chip`
+    and the advertised bit is REPLACED by the verdict (withdrawn =
+    quarantined/recovering or outside the operational sub-slice).
+    Device ids are LOCAL (the VSP enumerates this worker's accel
+    chardevs as ``chip-<local>``) while the engine's units are GLOBAL
+    topology chips (``Chip.id``), so observations and verdicts are
+    translated through ``chips_on_host(TPU_WORKER_ID)`` — on worker 1
+    of a two-host slice, local ``chip-3`` is global ``chip-11``, and a
+    peer host's loss must never withdraw THIS host's devices.
+
+    *kind* ``"link"``: the raw bit (the agent's fault flag) is kept
+    AND-ed with the verdict — an actively-faulted port stays Unhealthy
+    immediately (the pre-engine contract), and the engine adds hold-down
+    on top so a flapping port is not re-admitted per bounce. Link
+    observations come from the repair loop's probe pass
+    (:meth:`FaultEngine.ingest_link_probe`), the single source of truth
+    for link up/wired state — feeding the fault flag here too would
+    make the two signals fight (good/bad alternation that never
+    quarantines).
+    """
+
+    #: minimum engine-clock seconds between chip-probe feeds. A fault
+    #: transition pokes ListAndWatch for an immediate re-snapshot;
+    #: without this floor that re-snapshot would re-ingest every raw
+    #: bit milliseconds after the scheduled poll, so "quarantine_after
+    #: consecutive bad probes" would stop meaning consecutive 5 s polls
+    #: (a sub-second VSP glitch could ride one poke straight into
+    #: quarantine). The judged verdict is still re-applied on every
+    #: call — only the FEEDING is rate-limited.
+    PROBE_MIN_INTERVAL_S = 1.0
+
+    def __init__(self, inner, engine: Optional["_engine.FaultEngine"],
+                 kind: str = _engine.CHIP,
+                 min_probe_interval: Optional[float] = None):
+        self.inner = inner
+        self.engine = engine
+        self.kind = kind
+        self.min_probe_interval = (self.PROBE_MIN_INTERVAL_S
+                                   if min_probe_interval is None
+                                   else min_probe_interval)
+        self._last_feed: Optional[float] = None
+
+    def __getattr__(self, name: str):
+        # setup_devices, topology providers, test hooks: pass through
+        return getattr(self.inner, name)
+
+    def _chip_units(self, dev_ids) -> Optional[dict]:
+        """dev id -> global chip unit, or None while observations
+        cannot be attributed: on a worker > 0 the local/global spaces
+        differ, and feeding identity-mapped probes before the topology
+        is known would pin bad bits on HOST 0's units (which this
+        worker's polls could never correct). Worker 0's locals coincide
+        with globals, so it maps identity even pre-topology."""
+        engine = self.engine
+        topo = engine._topology() if engine is not None else None
+        host = _vars.tpu_worker_id()
+        units = {dev_id: dev_id for dev_id in dev_ids}
+        if topo is None:
+            return units if host == 0 else None
+        by_local = {chip.local_index: chip.id
+                    for chip in topo.chips_on_host(host)}
+        if not by_local:
+            # topology known but TPU_WORKER_ID names no host in it
+            # (stale after a reshape): identity would misattribute this
+            # worker's bits to host 0's units — same skip as the
+            # manager's probe pass
+            return units if host == 0 else None
+        for dev_id in units:
+            m = _LOCAL_CHIP_RE.match(dev_id)
+            if m and int(m.group(1)) in by_local:
+                units[dev_id] = by_local[int(m.group(1))]
+        return units
+
+    def get_devices(self) -> dict:
+        devs = self.inner.get_devices()
+        engine = self.engine
+        if engine is None:
+            return devs
+        if self.kind == _engine.CHIP:
+            units = self._chip_units(devs)
+            if units is None:
+                # worker > 0 before the topology is known: raw bits
+                # pass through unjudged for now — the first poll after
+                # the VSP reports the slice shape starts feeding
+                return devs
+            # one batched commit per poll (one journal write/sub-slice
+            # recomputation), not one per flipped chip in a storm —
+            # and at most one feed per min_probe_interval, so a
+            # poke-triggered re-snapshot cannot double-count a probe
+            now = engine.clock()
+            if self._last_feed is None or \
+                    now - self._last_feed >= self.min_probe_interval:
+                self._last_feed = now
+                engine.ingest_chip_probes(
+                    {units[dev_id]: bool(info.get("healthy", True))
+                     for dev_id, info in devs.items()})
+            withdrawn = engine.withdrawn_chips()
+            return {dev_id: dict(info,
+                                 healthy=units[dev_id] not in withdrawn)
+                    for dev_id, info in devs.items()}
+        dark = engine.dark_link_ids()
+        return {dev_id: dict(info,
+                             healthy=bool(info.get("healthy", True))
+                             and dev_id not in dark)
+                for dev_id, info in devs.items()}

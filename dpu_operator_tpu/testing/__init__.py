@@ -4,10 +4,14 @@ from .chaos import (  # noqa: F401
     ChaosChannel,
     ChaosKube,
     ChaosVsp,
+    ChipDead,
     Fail,
     FailAfter,
     FaultPlan,
+    HardwareStorm,
+    HostLost,
     Latency,
+    LinkFlap,
     Ok,
     truncate_file,
 )

@@ -265,6 +265,163 @@ class ChaosVsp:
         return attr
 
 
+# -- hardware fault scripts (faults/engine.py chaos gate) ---------------------
+#
+# The wrappers above fault the WIRE (calls fail); these fault the
+# HARDWARE model: links flap, chips die, hosts drop whole fault domains.
+# A HardwareStorm plays scripted faults out in discrete rounds over a
+# SliceTopology and exposes the two probe surfaces the daemon consumes —
+# a chip-health answer (for the VSP/device-handler seam) and a
+# link-state prober (drop-in for AgentClient.link_state) — so `make
+# fault-check` replays a storm bit-identically from its seed with zero
+# wall-clock sleeps.
+
+class HwFault:
+    """One scripted hardware fault, evaluated per round."""
+
+    def chip_dead(self, topology, chip_index: int, rnd: int) -> bool:
+        return False
+
+    def link_down(self, topology, link_id: str, rnd: int) -> bool:
+        return False
+
+
+class LinkFlap(HwFault):
+    """A link that BOUNCES: down on rounds ``start, start+period, ...``
+    (*bounces* times), up in between — the flap pattern the engine's
+    hold-down must damp instead of re-admitting per bounce."""
+
+    def __init__(self, link_id: str, bounces: int = 3, start: int = 0,
+                 period: int = 2):
+        self.link_id = link_id
+        self.downs = {start + i * period for i in range(bounces)}
+
+    def link_down(self, topology, link_id: str, rnd: int) -> bool:
+        return link_id == self.link_id and rnd in self.downs
+
+
+class ChipDead(HwFault):
+    """A chip dead from round *at* (until *until*, exclusive, when
+    given). Its links read down too — the prober on a dead chip sees
+    untrained ports."""
+
+    def __init__(self, chip_id: str, at: int = 0,
+                 until: Optional[int] = None):
+        self.chip_id = chip_id
+        self.at = at
+        self.until = until
+
+    def _active(self, rnd: int) -> bool:
+        return rnd >= self.at and (self.until is None or rnd < self.until)
+
+    def chip_dead(self, topology, chip_index: int, rnd: int) -> bool:
+        return (f"chip-{chip_index}" == self.chip_id
+                and self._active(rnd))
+
+    def link_down(self, topology, link_id: str, rnd: int) -> bool:
+        if not self._active(rnd):
+            return False
+        link = topology.link_by_id(link_id)
+        return link is not None and (f"chip-{link.src}" == self.chip_id
+                                     or f"chip-{link.dst}" == self.chip_id)
+
+
+class HostLost(HwFault):
+    """A whole host VM drops from round *at* for *duration* rounds
+    (forever when None): every chip on it dead at once — the
+    fault-domain case."""
+
+    def __init__(self, host: int, at: int = 0,
+                 duration: Optional[int] = None):
+        self.host = host
+        self.at = at
+        self.duration = duration
+
+    def _active(self, rnd: int) -> bool:
+        if rnd < self.at:
+            return False
+        return self.duration is None or rnd < self.at + self.duration
+
+    def chip_dead(self, topology, chip_index: int, rnd: int) -> bool:
+        return (self._active(rnd)
+                and topology.chips[chip_index].host == self.host)
+
+    def link_down(self, topology, link_id: str, rnd: int) -> bool:
+        if not self._active(rnd):
+            return False
+        link = topology.link_by_id(link_id)
+        if link is None:
+            return False
+        return (topology.chips[link.src].host == self.host
+                or topology.chips[link.dst].host == self.host)
+
+
+class HardwareStorm:
+    """Deterministic hardware-fault storm over a SliceTopology.
+
+    ``storm.prober`` is a drop-in ``link_prober`` (chip ->
+    [{"port","up","wired","fault"}]) and ``chip_healthy`` backs a fake
+    VSP's device answer; ``advance()`` steps one round. ``random_flaps``
+    scripts extra flaps chosen by the storm's seeded RNG, so a failing
+    run replays bit-identically from (topology, seed)."""
+
+    def __init__(self, topology, seed: int = 0):
+        self.topology = topology
+        self.rng = random.Random(seed)
+        self.round = 0
+        self.faults: list[HwFault] = []
+
+    def add(self, *faults: HwFault) -> "HardwareStorm":
+        self.faults.extend(faults)
+        return self
+
+    def random_flaps(self, n: int, bounces: int = 2, horizon: int = 16
+                     ) -> "HardwareStorm":
+        """Script *n* seeded LinkFlaps over the first *horizon* rounds."""
+        links = self.topology.links
+        for _ in range(n):
+            link = links[self.rng.randrange(len(links))]
+            start = self.rng.randrange(max(1, horizon - bounces * 2))
+            self.add(LinkFlap(link.id, bounces=bounces, start=start))
+        return self
+
+    def advance(self) -> int:
+        self.round += 1
+        return self.round
+
+    def chip_healthy(self, chip_index: int) -> bool:
+        return not any(f.chip_dead(self.topology, chip_index, self.round)
+                       for f in self.faults)
+
+    def link_up(self, link_id: str) -> bool:
+        return not any(f.link_down(self.topology, link_id, self.round)
+                       for f in self.faults)
+
+    def prober(self, chip_index: int) -> list:
+        """AgentClient.link_state drop-in: every topology port of the
+        chip, wired, with the storm's up/down verdict."""
+        return [{"port": link.port, "up": self.link_up(link.id),
+                 "wired": True, "fault": False}
+                for link in self.topology.links_from(chip_index)]
+
+    def quiet(self) -> bool:
+        """True when no fault can fire this round or later (the storm
+        has fully passed). Permanent faults (ChipDead without *until*,
+        HostLost without *duration*) never go quiet — callers assert
+        explicit Degraded for those, not recovery."""
+        for f in self.faults:
+            if isinstance(f, LinkFlap):
+                if any(r >= self.round for r in f.downs):
+                    return False
+            elif isinstance(f, ChipDead):
+                if f.until is None or f.until > self.round:
+                    return False
+            elif isinstance(f, HostLost):
+                if f.duration is None or f.at + f.duration > self.round:
+                    return False
+        return True
+
+
 def truncate_file(path: str, seed: int = 0,
                   keep_fraction: Optional[float] = None) -> int:
     """Model a crash mid-write: truncate *path* to a seed-determined

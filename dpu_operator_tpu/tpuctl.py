@@ -95,6 +95,16 @@ def _vsp_cmds(sub):
     p.add_argument("--token", default="",
                    help="bearer token when /debug/health is auth-filtered")
     p = sub.add_parser(
+        "faults",
+        help="hardware fault-domain engine state (AdminService."
+             "GetFaults over --daemon-addr): judged per-chip/per-link "
+             "verdicts with hold-down timers and flap pressure, the "
+             "degraded-slice verdict, and the last fault transitions "
+             "from the flight recorder (--metrics-addr)")
+    p.add_argument("--token", default="",
+                   help="bearer token when /debug/flight is "
+                        "auth-filtered")
+    p = sub.add_parser(
         "handoff",
         help="zero-downtime upgrade: 'begin' asks the daemon (over "
              "--daemon-addr) to freeze mutations and serve its live "
@@ -157,6 +167,24 @@ def handoff_status(snap: dict) -> dict:
         "history": [e.get("name", "") for e in handoffs],
     }
     return out
+
+
+def render_faults(status: dict, flight_events: list) -> dict:
+    """Fold the daemon's GetFaults answer with the flight recorder's
+    fault-kind entries into the `tpuctl faults` view: the judged state
+    table now, plus how each unit got there."""
+    transitions = [
+        {"at": e.get("ts"), "unit": (e.get("attributes") or {})
+         .get("unit", ""), "to": (e.get("attributes") or {})
+         .get("to", ""), "reason": (e.get("attributes") or {})
+         .get("reason", "")}
+        for e in flight_events if e.get("kind") == "fault"]
+    return {
+        "enabled": status.get("enabled", False),
+        "units": status.get("units", []),
+        "sliceDegraded": status.get("sliceDegraded"),
+        "lastTransitions": transitions[-20:],
+    }
 
 
 def main(argv=None):
@@ -247,6 +275,24 @@ def run(args) -> dict:
                                 timeout=args.timeout + 10.0)
         finally:
             channel.close()
+
+    if args.cmd == "faults":
+        if not args.daemon_addr:
+            raise SystemExit("faults needs --daemon-addr")
+        from .utils.flight import fetch
+        channel = VspChannel(args.daemon_addr)
+        try:
+            status = channel.call("AdminService", "GetFaults", {})
+        finally:
+            channel.close()
+        try:
+            snap = fetch(args.metrics_addr, token=args.token)
+        except Exception as e:  # noqa: BLE001 — transitions are a
+            # bonus: the state table renders with no metrics endpoint
+            print(f"tpuctl: flight recorder unavailable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+            snap = {"events": []}
+        return render_faults(status, snap.get("events", []))
 
     if args.cmd == "repair-chains":
         if not args.daemon_addr:

@@ -418,6 +418,27 @@ SLO_ALERT_ACTIVE = REGISTRY.gauge(
     "tpu_slo_alert_active",
     "1 while a multi-window burn-rate alert is firing, by SLO and "
     "severity")
+# -- ICI fault-domain engine (dpu_operator_tpu/faults/) ----------------------
+FAULT_TRANSITIONS = REGISTRY.counter(
+    "tpu_fault_transitions_total",
+    "Fault-engine state transitions by unit kind (chip/link) and "
+    "target state (healthy/suspect/quarantined/recovering)")
+FAULT_QUARANTINED = REGISTRY.gauge(
+    "tpu_fault_quarantined",
+    "Units currently withdrawn by the fault engine (quarantined or "
+    "recovering), by kind")
+FAULT_FLAP_HOLDDOWNS = REGISTRY.counter(
+    "tpu_fault_flap_holddowns_total",
+    "Re-quarantines within the flap window, by kind — each one doubles "
+    "the unit's hold-down (CrashLoopBackOff-style damping)")
+FAULT_SUBSLICE = REGISTRY.gauge(
+    "tpu_fault_subslice_chips",
+    "Chips in the largest still-connected sub-slice (equals the slice "
+    "size while no fault domain is dark)")
+FAULT_RECOVERY_SECONDS = REGISTRY.histogram(
+    "tpu_fault_recovery_seconds",
+    "Recovery MTTR: first quarantine entry to the recovering->healthy "
+    "transition, per unit outage")
 # -- static-analysis gate (opslint exception-hygiene rule) -------------------
 SWALLOWED_ERRORS = REGISTRY._add(_FlightRecordedCounter(
     "tpu_daemon_swallowed_errors_total",

@@ -6,6 +6,19 @@ config name, default NAD name) and the hardcoded resource name
 and internal/daemon/device-plugin/deviceplugin.go:25.
 """
 
+import os
+
+
+def tpu_worker_id() -> int:
+    """This VM's worker index within the slice (the ``TPU_WORKER_ID``
+    env var; Allocate exports it as part of the bootstrap contract).
+    The single parse point for every consumer — a malformed value
+    falls back to worker 0 rather than crashing the daemon."""
+    try:
+        return int(os.environ.get("TPU_WORKER_ID", "0"))
+    except ValueError:
+        return 0
+
 # Namespace every operator-owned object lives in.
 NAMESPACE = "tpu-operator-system"
 

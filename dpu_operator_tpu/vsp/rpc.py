@@ -75,6 +75,7 @@ class VspServer:
         ("AdminService", "ResizeChips"): "resize_chips",
         ("AdminService", "RepairChains"): "repair_chains",
         ("AdminService", "GetChains"): "get_chains",
+        ("AdminService", "GetFaults"): "get_faults",
         ("AdminService", "BeginHandoff"): "begin_handoff",
     }
 

@@ -932,7 +932,8 @@ def test_freeze_parks_chain_repair_pass(kube, short_tmp):
     mgr = _manager(short_tmp, _UpgradeVsp(_Dataplane()), client=kube)
     passes = []
     mgr.link_prober = lambda port: None
-    mgr._repair_chains_locked = lambda: passes.append(1) or []
+    mgr._repair_chains_locked = \
+        lambda probe_cache=None: passes.append(1) or []
     assert mgr.repair_chains() == []
     assert len(passes) == 1
     mgr.freeze_for_handoff()
@@ -951,7 +952,7 @@ def test_freeze_drains_inflight_repair_pass(kube, short_tmp):
     mgr.link_prober = lambda port: None
     entered, release = threading.Event(), threading.Event()
 
-    def slow_pass():
+    def slow_pass(probe_cache=None):
         entered.set()
         assert release.wait(5), "repair pass never released"
         return []

@@ -307,6 +307,31 @@ def test_chaos_determinism_ignores_unmarked_tests():
     """, relpath="tests/test_plain.py") == []
 
 
+def test_chaos_determinism_covers_fault_marked_tests():
+    """The hardware-fault storms (`make fault-check`) carry the same
+    bit-identical-replay invariant as the chaos matrix."""
+    violations = check(ChaosDeterminismChecker(), """
+        import pytest, time
+        @pytest.mark.fault
+        def test_storm():
+            start = time.time()
+    """, relpath="tests/test_fault_x.py")
+    assert [v.rule for v in violations] == ["chaos-determinism"]
+
+
+def test_chaos_determinism_fault_module_mark_seeded_rng_ok():
+    src = """
+        import pytest, random
+        pytestmark = pytest.mark.fault
+        SEED = 20260803
+        def test_storm():
+            rng = random.Random(SEED)
+            assert rng.random() < 1.0
+    """
+    assert check(ChaosDeterminismChecker(), src,
+                 relpath="tests/test_fault_y.py") == []
+
+
 # -- lock-discipline ----------------------------------------------------------
 
 def test_lock_discipline_flags_off_lock_write_of_guarded_attr():
