@@ -4,8 +4,9 @@ REGISTRY ?= localhost:5000
 TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
-        upgrade-check fault-check lint-check type-check bench native \
-        traffic-flow images smoke-images deploy undeploy graft-check clean
+        upgrade-check fault-check scale-check lint-check type-check bench \
+        native traffic-flow images smoke-images deploy undeploy graft-check \
+        clean
 
 test: lint-check native
 	$(PYTHON) -m pytest tests/ -q
@@ -71,6 +72,19 @@ upgrade-check:
 # seeds, injected clocks, no wall-clock sleeps.
 fault-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m fault \
+	  -p no:randomly -p no:cacheprovider
+
+# informer watch-core fleet gate (doc/architecture.md "Watch core and
+# caching"): 1000 simulated Nodes + 120 SFC CRs converge through the
+# REAL Manager on the informer path (one LIST + one watch stream per
+# kind, reconcilers reading from the shared cache), with update-storm
+# dedup (K updates to one key -> far fewer than K reconciles),
+# forced-relist staleness (watch outage + 410 Gone -> relist diff, cache
+# equals apiserver object-by-object afterwards), per-key error backoff
+# isolation, and zero LockTracer lock-order cycles. Seeded; convergence
+# waits are event-driven — no wall-clock sleep drives an assertion.
+scale-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m scale \
 	  -p no:randomly -p no:cacheprovider
 
 # opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST

@@ -486,6 +486,75 @@ class ComputeBench:
             lambda d: d["hbm_frac"] / 1.15, name)
 
 
+def bench_fleet() -> dict:
+    """Informer-vs-poll fleet comparison (BENCH_r06): 1000 simulated
+    Nodes + 120 SFC CRs converge through the real Manager twice —
+
+    - **informer** path: streaming watch + shared cache (the refactor),
+      with reconciler reads riding the lister seam;
+    - **poll** baseline: the pre-informer architecture reproduced
+      through the reflector's degraded mode (client proxy hides
+      streaming support → relist every ``poll`` seconds) with reads
+      going live (no cache) — what `RealKube.watch` + per-reconcile
+      LISTs cost before this refactor.
+
+    Both runs include the same reconciler-level periodic resync
+    (SfcReconciler's requeue_after analog) and the same steady-state
+    window after convergence, because the poll architecture's cost is
+    dominated by steady state: relist ticks and per-resync live reads
+    continue forever while the informer path sits on its cache.
+    Reports reconciles/s (full-fleet storm drain rate), watch-fanout
+    p95 (event → handler delivery across the fanout), and the
+    apiserver-request counts whose ratio the acceptance gate bounds."""
+    from dpu_operator_tpu.testing.fleet import FleetHarness
+
+    n_nodes = int(os.environ.get("TPU_BENCH_FLEET_NODES", "1000"))
+    n_crs = int(os.environ.get("TPU_BENCH_FLEET_CRS", "120"))
+    steady_s = _float_env("TPU_BENCH_FLEET_STEADY_S", 6.0)
+    out: dict = {"nodes": n_nodes, "crs": n_crs,
+                 "steady_window_s": steady_s}
+    for mode, streaming, cache in (("informer", True, True),
+                                   ("poll", False, False)):
+        h = FleetHarness(n_nodes=n_nodes, n_crs=n_crs,
+                         streaming=streaming, use_cache=cache,
+                         resync_after=0.5, poll=0.25,
+                         node_read_every=16, workers=8)
+        h.populate()
+        t0 = time.perf_counter()
+        h.start()
+        converged = h.wait_converged(timeout=120)
+        convergence_s = time.perf_counter() - t0
+        stats = {"converged": converged,
+                 "convergence_s": round(convergence_s, 3)}
+        if mode == "informer":
+            # full-fleet storm: one spec bump per CR, drain through the
+            # workqueue — the end-to-end reconcile throughput number
+            before = h.reconciler.reconciles
+            t1 = time.perf_counter()
+            for i in range(n_crs):
+                h.storm(cr_index=i, updates=1)
+            h.wait_converged(timeout=60)
+            drain_s = max(time.perf_counter() - t1, 1e-9)
+            stats["reconciles_per_s"] = round(
+                (h.reconciler.reconciles - before) / drain_s, 1)
+            h.node_churn(500)  # fanout traffic for the p95
+            h.wait_converged(timeout=30)
+            stats["watch_fanout_p95"] = round(h.fanout_p95(), 6)
+        # steady-state window: where the poll architecture keeps paying
+        # (relist ticks + live per-resync reads) and the informer does
+        # not — identical wall-clock window for both modes
+        time.sleep(steady_s)
+        stats["requests"] = h.client.total_requests()
+        stats["verbs"] = h.client.snapshot()
+        stats["reconciles"] = h.reconciler.reconciles
+        stats["relists"] = h.relists()
+        h.stop()
+        out[mode] = stats
+    out["request_ratio"] = round(
+        out["poll"]["requests"] / max(1, out["informer"]["requests"]), 1)
+    return out
+
+
 def run_sections(sections):
     """Run (name, thunk) pairs; collect results and errors independently.
 
@@ -599,6 +668,23 @@ def build_payload(results, errors):
             statistics.median(results["pods"]), 4)
         payload["pod_schedule_to_ready_p95"] = round(
             _p95(results["pods"]), 4)
+    # fleet watch-core comparison (BENCH_r06): reconcile throughput +
+    # fanout p95 on the informer path, apiserver-request totals for the
+    # informer-vs-poll convergence (the >=10x acceptance ratio)
+    if results.get("fleet"):
+        fl = results["fleet"]
+        informer = fl.get("informer") or {}
+        baseline = fl.get("poll") or {}
+        if informer.get("reconciles_per_s") is not None:
+            payload["reconciles_per_s"] = informer["reconciles_per_s"]
+        if informer.get("watch_fanout_p95") is not None:
+            payload["watch_fanout_p95"] = informer["watch_fanout_p95"]
+        if informer.get("requests") is not None:
+            payload["fleet_requests_informer"] = informer["requests"]
+        if baseline.get("requests") is not None:
+            payload["fleet_requests_poll"] = baseline["requests"]
+        if fl.get("request_ratio") is not None:
+            payload["fleet_request_ratio"] = fl["request_ratio"]
     if train is None:
         # promote a fallback headline so "value" is numeric when another
         # compute metric landed. ONLY fraction-of-roofline metrics are
@@ -628,6 +714,7 @@ def main():
     sections = [
         ("pods", lambda: bench_pod_ready(n_pods)),
         ("pods_wire", lambda: bench_pod_ready(n_pods, wire=True)),
+        ("fleet", bench_fleet),
     ]
     results, errors = run_sections(sections)
 

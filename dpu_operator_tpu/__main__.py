@@ -86,10 +86,16 @@ def main(argv=None):
     if args.leader_elect:
         # the lease lives in the operator's own namespace so the
         # namespaced leader-election Role covers it
-        # (config/rbac/leader_election_role.yaml)
+        # (config/rbac/leader_election_role.yaml). `stop=done` makes the
+        # contention loop cancellable: a SIGTERM while another replica
+        # holds the lease exits instead of contending forever.
         from .utils import NAMESPACE
         client.acquire_leader_lease("tpu-operator-leader",
-                                    namespace=NAMESPACE)
+                                    namespace=NAMESPACE, stop=done)
+        if done.is_set():
+            webhook.stop()
+            metrics_server.stop()
+            return
 
     mgr.start()
     started.set()

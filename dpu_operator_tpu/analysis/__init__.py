@@ -9,6 +9,7 @@ workflow are documented in doc/static-analysis.md.
 from .checkers import (ChaosDeterminismChecker, EventsSeamChecker,
                        ExceptionHygieneChecker,
                        HandoffStateDisciplineChecker,
+                       ListDisciplineChecker,
                        MetricsNamingChecker, RetryDisciplineChecker,
                        TraceContextChecker, WireSeamChecker)
 from .core import Baseline, Checker, Module, Violation, run_checkers
@@ -19,6 +20,7 @@ ALL_CHECKERS = (
     TraceContextChecker,
     EventsSeamChecker,
     HandoffStateDisciplineChecker,
+    ListDisciplineChecker,
     RetryDisciplineChecker,
     ExceptionHygieneChecker,
     MetricsNamingChecker,
@@ -30,7 +32,7 @@ __all__ = [
     "ALL_CHECKERS", "Baseline", "Checker", "Module", "Violation",
     "run_checkers", "WireSeamChecker", "TraceContextChecker",
     "EventsSeamChecker", "HandoffStateDisciplineChecker",
-    "RetryDisciplineChecker", "ExceptionHygieneChecker",
-    "MetricsNamingChecker", "ChaosDeterminismChecker",
-    "LockDisciplineChecker",
+    "ListDisciplineChecker", "RetryDisciplineChecker",
+    "ExceptionHygieneChecker", "MetricsNamingChecker",
+    "ChaosDeterminismChecker", "LockDisciplineChecker",
 ]

@@ -284,6 +284,72 @@ class HandoffStateDisciplineChecker(Checker):
         return None  # default mode "r": reads are fine
 
 
+# -- list-discipline ----------------------------------------------------------
+
+#: controller/reconciler module prefixes: code that runs inside the
+#: manager's reconcile loop, where a raw ``client.list(`` re-pays an
+#: apiserver LIST the informer cache already serves — the exact cost
+#: the watch core (k8s/informer.py) exists to remove. Reads go through
+#: ``k8s.informer.cached_list`` (the lister seam) instead.
+_RECONCILER_PREFIXES = (
+    "dpu_operator_tpu/controller/",
+)
+_RECONCILER_MODULES = {
+    "dpu_operator_tpu/daemon/sfc_reconciler.py",
+}
+
+#: justified raw LISTs inside reconciler modules, path -> why. Kept
+#: EMPTY on purpose: after the informer refactor every reconciler read
+#: rides the lister seam; additions here need the same justification
+#: discipline as WIRE_SEAM_ALLOW.
+LIST_SEAM_ALLOW: dict = {}
+
+#: receiver names that denote the apiserver client in reconciler code
+_CLIENT_NAMES = {"client", "kube"}
+
+
+class ListDisciplineChecker(Checker):
+    name = "list-discipline"
+    description = ("controller/reconciler modules must read collections "
+                   "through the informer lister seam "
+                   "(k8s.informer.cached_list), not raw client.list() — "
+                   "a reconcile-loop LIST re-pays the apiserver cost the "
+                   "shared cache already absorbed")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if module.is_test:
+            return
+        if module.relpath in LIST_SEAM_ALLOW:
+            return
+        if not (module.relpath.startswith(_RECONCILER_PREFIXES)
+                or module.relpath in _RECONCILER_MODULES):
+            return
+        for call in calls_in(module.tree):
+            receiver = self._client_list_receiver(call)
+            if receiver is None:
+                continue
+            yield self.violation(
+                module, call,
+                f"raw {receiver}.list() in a reconciler module: read "
+                "through k8s.informer.cached_list(client, ...) so the "
+                "shared informer cache serves it (one watch stream "
+                "instead of a LIST per reconcile)")
+
+    @staticmethod
+    def _client_list_receiver(call: ast.Call) -> Optional[str]:
+        """'client' / 'self.client' / 'kube'… when the call is
+        ``<receiver>.list(...)`` on an apiserver-client name."""
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "list"):
+            return None
+        name = dotted_name(func.value)
+        if name is None:
+            return None
+        if name.split(".")[-1] in _CLIENT_NAMES:
+            return name
+        return None
+
+
 # -- retry-discipline ---------------------------------------------------------
 
 _RETRY_EXEMPT = {

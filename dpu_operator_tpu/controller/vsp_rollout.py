@@ -121,8 +121,9 @@ class VspRollout:
               image: str) -> str:
         """Empty string when the staged VSP may be promoted; otherwise
         the hold reason (surfaced in status + the UpgradeHeld Event)."""
-        pods = client.list(
-            "v1", "Pod", namespace=self.namespace,
+        from ..k8s.informer import cached_list
+        pods = cached_list(
+            client, "v1", "Pod", namespace=self.namespace,
             label_selector={"tpu.openshift.io/vsp-color": color})
         if not pods:
             return "staged VSP has no pods scheduled yet"
@@ -182,8 +183,10 @@ class VspRollout:
         """SFC CRs carrying a True Degraded/ChainDegraded condition —
         the daemons' own health verdicts, readable from any process."""
         from ..api.types import API_VERSION
+        from ..k8s.informer import cached_list
         try:
-            sfcs = client.list(API_VERSION, "ServiceFunctionChain") or []
+            sfcs = cached_list(client, API_VERSION,
+                               "ServiceFunctionChain") or []
         except Exception:  # noqa: BLE001 — an unlistable dataplane
             log.exception("SFC list failed during rollout gate")
             return ["<SFC CRs unlistable>"]  # holds, never passes

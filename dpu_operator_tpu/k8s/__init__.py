@@ -1,15 +1,26 @@
-from .client import KubeClient, gvk_key, set_owner_reference, owned_by
+from .client import (KubeClient, StaleResourceVersion, gvk_key,
+                     set_owner_reference, owned_by)
 from .fake import FakeKube, FakeNodeAgent
+from .informer import (CachedClient, InformerFactory, SharedInformer,
+                       Store, cached_list)
 from .manager import Manager, Reconciler, ReconcileResult
+from .workqueue import RateLimitingQueue
 
 __all__ = [
     "KubeClient",
+    "StaleResourceVersion",
     "gvk_key",
     "set_owner_reference",
     "owned_by",
     "FakeKube",
     "FakeNodeAgent",
+    "CachedClient",
+    "InformerFactory",
+    "SharedInformer",
+    "Store",
+    "cached_list",
     "Manager",
     "Reconciler",
     "ReconcileResult",
+    "RateLimitingQueue",
 ]

@@ -27,6 +27,15 @@ class AlreadyExists(Exception):
     test fake."""
 
 
+class StaleResourceVersion(Exception):
+    """The resourceVersion a watch tried to resume from has been
+    compacted away (HTTP 410 Gone / in-stream ERROR event with code
+    410). The informer's reflector answers with a full re-LIST; both
+    client flavors raise it from :meth:`KubeClient.watch_from` so the
+    relist path is exercised against the fake exactly as against a
+    real apiserver."""
+
+
 def is_already_exists(e: BaseException) -> bool:
     """409/AlreadyExists across both client flavors: FakeKube raises
     the typed :class:`AlreadyExists`; RealKube surfaces the apiserver's
@@ -77,6 +86,16 @@ class KubeClient(Protocol):
               callback: Callable[[str, dict], None]) -> Callable[[], None]:
         """Register *callback(event_type, obj)*; returns a cancel function."""
         ...
+
+    # Incremental watch (optional capability): clients that implement
+    # ``watch_from(api_version, kind, on_event, resource_version, stop)``
+    # — a BLOCKING call streaming ("ADDED"|"MODIFIED"|"DELETED"|
+    # "BOOKMARK", obj) events strictly after *resource_version* until
+    # *stop* is set, raising StaleResourceVersion when the version has
+    # been compacted — get the informer fast path (one LIST, then
+    # incremental events). Clients without it are served by the
+    # reflector's degraded poll-relist mode. Not part of the Protocol
+    # proper: hasattr-probed so third-party fakes stay valid KubeClients.
 
 
 def set_owner_reference(owner: dict, obj: dict, controller: bool = True) -> None:

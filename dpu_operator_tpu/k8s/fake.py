@@ -21,13 +21,25 @@ from typing import Callable, Optional
 from .client import (
     AlreadyExists,
     Conflict,
+    StaleResourceVersion,
     deep_merge,
     gvk_key,
     match_labels,
     pod_resource_requests,
 )
 
-__all__ = ["AlreadyExists", "Conflict", "FakeKube", "FakeNodeAgent"]
+__all__ = ["AlreadyExists", "Conflict", "FakeKube", "FakeNodeAgent",
+           "StaleResourceVersion"]
+
+#: sentinel pushed into a stream watcher's queue to simulate the server
+#: dropping the watch connection (chaos/fleet harness; the consumer's
+#: watch_from raises WatchDisconnected and the reflector re-dials)
+_KICK = object()
+
+
+class WatchDisconnected(Exception):
+    """The fake apiserver dropped this watch stream (test-injected):
+    transport-level failure, the reflector's re-watch/relist path."""
 
 
 class FakeKube:
@@ -37,12 +49,29 @@ class FakeKube:
     #: with their tests)
     instances: "weakref.WeakSet[FakeKube]" = None  # set below
 
+    #: watch-event history retained per GVK for resourceVersion resume;
+    #: a resume older than the retained window raises
+    #: StaleResourceVersion (410 Gone), forcing the informer relist —
+    #: shrink it in tests to force the path deterministically
+    watch_history_limit = 2048
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._store: dict[tuple, dict] = {}
         self._watchers: dict[str, list[Callable]] = {}
-        self._rv = itertools.count(1)
+        self._rv_counter = 0
         self._uid = itertools.count(1)
+        #: per-GVK ordered event history [(rv:int, event, obj)] and the
+        #: highest rv ever dropped from it (the 410 floor)
+        self._history: dict[str, "list[tuple[int, str, dict]]"] = {}
+        self._history_floor: dict[str, int] = {}
+        #: per-GVK live stream subscriber queues (watch_from consumers);
+        #: fed UNDER the lock so stream order always matches history
+        self._streams: dict[str, list] = {}
+        #: streams currently delivering a popped event (watch_inflight)
+        self._stream_busy = 0
+        #: GVKs refusing new watch connections (test-injected outage)
+        self._stream_blocked: set[str] = set()
         FakeKube.instances.add(self)
 
     # -- internal -------------------------------------------------------------
@@ -50,17 +79,51 @@ class FakeKube:
              name: str) -> tuple:
         return (gvk_key(api_version, kind), namespace or "", name)
 
-    def _notify(self, event: str, obj: dict) -> None:
-        for cb in list(self._watchers.get(
-                gvk_key(obj.get("apiVersion", ""), obj.get("kind", "")), [])):
+    def _commit_event_locked(self, event: str, obj: dict) -> None:
+        """Append to watch history and fan out to stream subscribers.
+        MUST run inside the mutation's own critical section (the rv was
+        just minted under the same RLock hold): committing history in a
+        SEPARATE lock acquisition would let two concurrent writers
+        publish rv=6 before rv=5, and a stream consumer's rv-monotonic
+        dedup would then drop the lower-rv event forever."""
+        g = gvk_key(obj.get("apiVersion", ""), obj.get("kind", ""))
+        try:
+            rv = int(obj.get("metadata", {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            rv = 0
+        hist = self._history.setdefault(g, [])
+        hist.append((rv, event, copy.deepcopy(obj)))
+        while len(hist) > self.watch_history_limit:
+            dropped_rv, _, _ = hist.pop(0)
+            self._history_floor[g] = max(
+                self._history_floor.get(g, 0), dropped_rv)
+        for q in self._streams.get(g, []):
+            q.put((event, copy.deepcopy(obj)))
+
+    def _dispatch_legacy(self, event: str, obj: dict) -> None:
+        """Legacy synchronous watch callbacks — outside the store lock,
+        as always (callbacks re-enter kube methods freely and carry no
+        rv-ordering contract)."""
+        g = gvk_key(obj.get("apiVersion", ""), obj.get("kind", ""))
+        for cb in list(self._watchers.get(g, [])):
             cb(event, copy.deepcopy(obj))
 
     def _stamp(self, obj: dict, new: bool) -> None:
         md = obj.setdefault("metadata", {})
-        md["resourceVersion"] = str(next(self._rv))
+        md["resourceVersion"] = str(self._next_rv())
         if new:
             md.setdefault("uid", f"uid-{next(self._uid)}")
             md.setdefault("creationTimestamp", time.time())
+
+    def _next_rv(self) -> int:
+        with self._lock:
+            self._rv_counter += 1
+            return self._rv_counter
+
+    def current_rv(self) -> str:
+        """The collection resourceVersion a fresh LIST would carry."""
+        with self._lock:
+            return str(self._rv_counter)
 
     # -- KubeClient interface -------------------------------------------------
     def get(self, api_version: str, kind: str, name: str,
@@ -97,8 +160,17 @@ class FakeKube:
             self._stamp(obj, new=True)
             self._store[key] = obj
             stored = copy.deepcopy(obj)
-        self._notify("ADDED", stored)
+            self._commit_event_locked("ADDED", stored)
+        self._dispatch_legacy("ADDED", stored)
         self._fan_out(stored)
+        if self._owners_all_absent(stored):
+            # real-apiserver GC parity: an object created with owner
+            # references whose uids no longer exist (e.g. a cache-fed
+            # reconciler re-applying children after its CR was deleted)
+            # is garbage-collected — the real GC controller does exactly
+            # this, and without it such orphans would live forever here
+            self.delete(obj.get("apiVersion"), obj.get("kind"),
+                        md.get("name"), namespace=md.get("namespace"))
         return stored
 
     def update(self, obj: dict) -> dict:
@@ -118,7 +190,8 @@ class FakeKube:
             self._stamp(obj, new=False)
             self._store[key] = obj
             stored = copy.deepcopy(obj)
-        self._notify("MODIFIED", stored)
+            self._commit_event_locked("MODIFIED", stored)
+        self._dispatch_legacy("MODIFIED", stored)
         self._fan_out(stored)
         return stored
 
@@ -151,9 +224,14 @@ class FakeKube:
         key = self._key(api_version, kind, namespace, name)
         with self._lock:
             obj = self._store.pop(key, None)
+            if obj is not None:
+                # deletion mints a resourceVersion (apiserver parity):
+                # watch resume needs DELETED events ordered in rv space
+                obj["metadata"]["resourceVersion"] = str(self._next_rv())
+                self._commit_event_locked("DELETED", obj)
         if obj is None:
             return
-        self._notify("DELETED", obj)
+        self._dispatch_legacy("DELETED", obj)
         self._gc(obj)
 
     def update_status(self, obj: dict) -> dict:
@@ -167,9 +245,10 @@ class FakeKube:
             if cur.get("status", {}) == obj.get("status", {}):
                 return copy.deepcopy(cur)  # no-op: don't re-trigger watchers
             cur["status"] = copy.deepcopy(obj.get("status", {}))
-            cur["metadata"]["resourceVersion"] = str(next(self._rv))
+            cur["metadata"]["resourceVersion"] = str(self._next_rv())
             stored = copy.deepcopy(cur)
-        self._notify("MODIFIED", stored)
+            self._commit_event_locked("MODIFIED", stored)
+        self._dispatch_legacy("MODIFIED", stored)
         return stored
 
     def watch(self, api_version: str, kind: str,
@@ -190,7 +269,168 @@ class FakeKube:
                     pass
         return cancel
 
+    # -- incremental watch (informer fast path) -------------------------------
+    def list_collection(self, api_version: str, kind: str,
+                        namespace: Optional[str] = None,
+                        label_selector: Optional[dict] = None
+                        ) -> "tuple[list, str]":
+        """LIST plus the collection resourceVersion a watch may resume
+        from — taken atomically, so no event between the two can be
+        missed (the reflector's list-then-watch contract)."""
+        with self._lock:
+            return (self.list(api_version, kind, namespace=namespace,
+                              label_selector=label_selector),
+                    self.current_rv())
+
+    def watch_from(self, api_version: str, kind: str,
+                   on_event: Callable,
+                   resource_version: "Optional[str]" = None,
+                   stop: "Optional[threading.Event]" = None,
+                   timeout: Optional[float] = None) -> None:
+        """Blocking incremental watch: replay retained history strictly
+        after *resource_version*, emit a BOOKMARK, then stream live
+        events until *stop* is set (or *timeout* elapses — the fixture's
+        ``timeoutSeconds``). Raises :class:`StaleResourceVersion` when
+        the resume point has been compacted out of the history window
+        (410 Gone) and :class:`WatchDisconnected` when a test kicked the
+        stream (transport failure)."""
+        import queue as _queue
+        g = gvk_key(api_version, kind)
+        try:
+            rv = int(resource_version) if resource_version else 0
+        except (TypeError, ValueError):
+            rv = 0
+        q: "_queue.Queue" = _queue.Queue()
+        with self._lock:
+            if g in self._stream_blocked:
+                raise WatchDisconnected(f"{g}: watch outage injected")
+            floor = self._history_floor.get(g, 0)
+            if rv and rv < floor:
+                raise StaleResourceVersion(
+                    f"resourceVersion {rv} compacted (floor {floor})")
+            backlog = [(ev, copy.deepcopy(obj))
+                       for hrv, ev, obj in self._history.get(g, [])
+                       if hrv > rv]
+            self._streams.setdefault(g, []).append(q)
+            # bookmark rv captured UNDER the registration lock: a value
+            # read later could cover events still queued behind it, and
+            # a client resuming from the bookmark would skip them
+            bookmark_rv = self.current_rv()
+        last = rv
+        deadline = (time.monotonic() + timeout) if timeout else None
+        try:
+            for ev, obj in backlog:
+                last = self._deliver_stream_event(on_event, ev, obj, last)
+            on_event("BOOKMARK",
+                     {"metadata": {"resourceVersion": bookmark_rv}})
+            while stop is None or not stop.is_set():
+                if deadline is not None and time.monotonic() >= deadline:
+                    return
+                try:
+                    item = q.get(timeout=0.05)
+                except _queue.Empty:
+                    continue
+                if item is _KICK:
+                    raise WatchDisconnected(g)
+                ev, obj = item
+                last = self._deliver_stream_event(on_event, ev, obj, last)
+        finally:
+            with self._lock:
+                try:
+                    self._streams.get(g, []).remove(q)
+                except ValueError:
+                    pass
+
+    def _deliver_stream_event(self, on_event: Callable, ev: str,
+                              obj: dict, last: int) -> int:
+        """Skip events at or before *last* (an event can land in both
+        the history backlog and the live queue during registration);
+        track delivery for :meth:`watch_inflight`."""
+        try:
+            rv = int(obj.get("metadata", {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            rv = 0
+        if rv and rv <= last:
+            return last
+        with self._lock:
+            self._stream_busy += 1
+        try:
+            on_event(ev, obj)
+        finally:
+            with self._lock:
+                self._stream_busy -= 1
+        return rv or last
+
+    def disconnect_watches(self, api_version: Optional[str] = None,
+                           kind: Optional[str] = None) -> int:
+        """Kick live watch streams (all, or one GVK): each consumer's
+        ``watch_from`` raises :class:`WatchDisconnected`, exercising the
+        reflector's re-watch/relist path. Returns streams kicked."""
+        g = (gvk_key(api_version, kind)
+             if api_version is not None and kind is not None else None)
+        kicked = 0
+        with self._lock:
+            for key, queues in self._streams.items():
+                if g is not None and key != g:
+                    continue
+                for q in queues:
+                    q.put(_KICK)
+                    kicked += 1
+        return kicked
+
+    def block_watches(self, api_version: str, kind: str) -> int:
+        """Refuse new watch connections for a GVK AND kick the live
+        ones — a watch outage: events keep committing, consumers cannot
+        see them until :meth:`unblock_watches`."""
+        with self._lock:
+            self._stream_blocked.add(gvk_key(api_version, kind))
+        return self.disconnect_watches(api_version, kind)
+
+    def unblock_watches(self, api_version: str, kind: str) -> None:
+        with self._lock:
+            self._stream_blocked.discard(gvk_key(api_version, kind))
+
+    def compact_history(self, api_version: Optional[str] = None,
+                        kind: Optional[str] = None) -> None:
+        """Drop retained watch history (all, or one GVK) so the next
+        resume raises StaleResourceVersion — the deterministic 410
+        injection the forced-relist tests use."""
+        g = (gvk_key(api_version, kind)
+             if api_version is not None and kind is not None else None)
+        with self._lock:
+            for key in list(self._history):
+                if g is not None and key != g:
+                    continue
+                self._history[key] = []
+                self._history_floor[key] = self._rv_counter
+
+    def watch_inflight(self) -> bool:
+        """True while any committed event has not yet been handed to
+        every stream consumer — the visibility Manager.wait_idle needs
+        to close the commit→deliver window FakeKube's async streams
+        opened (the legacy synchronous watch had no such window)."""
+        with self._lock:
+            if self._stream_busy:
+                return True
+            return any(not q.empty()
+                       for queues in self._streams.values()
+                       for q in queues)
+
     # -- controller-manager-ish behaviors ------------------------------------
+    def _owners_all_absent(self, obj: dict) -> bool:
+        """True when the object carries uid-bearing ownerReferences and
+        NONE of those uids exist in the store (refs without a uid are
+        unresolvable and ignored, matching the real GC's behavior of
+        only acting on resolvable references)."""
+        refs = [r for r in (obj.get("metadata", {})
+                            .get("ownerReferences") or []) if r.get("uid")]
+        if not refs:
+            return False
+        with self._lock:
+            live = {o.get("metadata", {}).get("uid")
+                    for o in self._store.values()}
+        return not any(r["uid"] in live for r in refs)
+
     def _gc(self, owner: dict) -> None:
         """ownerReference cascade delete."""
         uid = owner.get("metadata", {}).get("uid")

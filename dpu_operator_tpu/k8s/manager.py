@@ -1,21 +1,32 @@
-"""Tiny controller-runtime analog: Manager + Reconciler + workqueue.
+"""Controller-runtime analog: Manager + Reconciler on the informer core.
 
 Reference: cmd/main.go:45-133 builds a ctrl.Manager, registers reconcilers via
-SetupWithManager, then mgr.Start blocks. Here a Manager owns watch
-registrations and a single worker thread draining a deduplicating workqueue —
-the same level-triggered reconcile semantics controller-runtime provides.
+SetupWithManager, then mgr.Start blocks. Here a Manager owns one
+SharedInformer per watched kind (one apiserver stream regardless of how
+many reconcilers or handlers consume it), a keyed rate-limited workqueue
+(per-key dedup/coalescing while queued or in-flight, per-key exponential
+backoff, shared token bucket) and N worker threads — the same
+level-triggered reconcile semantics controller-runtime provides, at the
+same cost profile: watch events instead of poll re-LISTs, cache reads
+instead of per-reconcile LISTs (reconcilers receive a
+:class:`~dpu_operator_tpu.k8s.informer.CachedClient`).
+
+The pre-informer poll architecture survives as the reflector's degraded
+mode for clients without streaming watch support — and as the measured
+BENCH_r06 baseline.
 """
 
 from __future__ import annotations
 
 import logging
-import queue
 import threading
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
 from ..utils import metrics, tracing, watchdog
 from .client import KubeClient
+from .informer import CachedClient, InformerFactory
+from .workqueue import ExponentialBackoff, RateLimitingQueue
 
 log = logging.getLogger(__name__)
 
@@ -42,16 +53,39 @@ class Reconciler(Protocol):
 
 
 class Manager:
-    def __init__(self, client: "KubeClient") -> None:
+    #: error-retry backoff bounds (controller-runtime uses 5ms..16m;
+    #: scaled down since our base reconciles are cheap)
+    RETRY_BASE = 0.5
+    RETRY_MAX = 60.0
+
+    #: a single reconcile past this is a stalled worker (the queue
+    #: behind it is frozen): watchdog dumps stacks + flips degraded
+    STALL_DEADLINE = 60.0
+
+    #: reconcile worker threads. Per-KEY serialization is guaranteed by
+    #: the workqueue regardless (a key is never handed to two workers),
+    #: so concurrency is across objects only — the controller-runtime
+    #: MaxConcurrentReconciles contract.
+    DEFAULT_WORKERS = 2
+
+    def __init__(self, client: "KubeClient",
+                 workers: Optional[int] = None) -> None:
         self.client = client
+        self.workers = workers or self.DEFAULT_WORKERS
         self._reconcilers: list[Reconciler] = []
-        self._queue: "queue.Queue[tuple[Reconciler, Request]]" = queue.Queue()
-        self._pending: set[tuple[int, Request]] = set()
+        self.informers = InformerFactory(client)
+        #: reconcilers read through this: cache hits for watched kinds,
+        #: live client for everything else, writes always live
+        self.cached_client = CachedClient(client, self.informers)
+        self._queue = RateLimitingQueue(
+            name="manager",
+            backoff=ExponentialBackoff(base=self.RETRY_BASE,
+                                       cap=self.RETRY_MAX))
         self._lock = threading.Lock()
-        self._cancels = []
+        self._cancels: list = []
         self._stop = threading.Event()
-        #: handoff freeze gate: while cleared, the worker parks BEFORE
-        #: processing the next item (outside the watchdog task scope, so
+        #: handoff freeze gate: while cleared, every worker parks BEFORE
+        #: processing its next item (outside the watchdog task scope, so
         #: a paused manager reads as idle, not stalled)
         self._resume_gate = threading.Event()
         self._resume_gate.set()
@@ -59,52 +93,55 @@ class Manager:
         #: drain() together give the handoff a mutation-free window
         self._quiesced = threading.Event()
         self._quiesced.set()
-        self._thread: Optional[threading.Thread] = None
-        self._idle = threading.Event()
-        self._idle.set()
-        self._inflight_timers = 0
-        #: watchdog heartbeat for the worker thread: task-scoped (idle
+        self._active = 0  # reconcile bodies currently executing
+        self._threads: list[threading.Thread] = []
+        #: watchdog heartbeat shared by the workers: task-scoped (idle
         #: between queue items is healthy; a reconcile stuck past
-        #: STALL_DEADLINE is not), registered in start()
+        #: STALL_DEADLINE is not — concurrent tasks tracked
+        #: individually, the oldest governs), registered in start()
         self._heartbeat: Optional[watchdog.Heartbeat] = None
-        #: (id(rec), req) keys with a periodic-resync timer pending —
-        #: dedups requeue_after so watch-event storms (including the
-        #: MODIFIED events a reconciler's own status writes emit) cannot
-        #: stack N parallel resync loops for the same object
+        #: keys with a periodic-resync timer pending — dedups
+        #: requeue_after so watch-event storms (including the MODIFIED
+        #: events a reconciler's own status writes emit) cannot stack N
+        #: parallel resync loops for the same object. Invisible to
+        #: wait_idle: a steady-state resync loop must not hold it
+        #: hostage.
         self._resync_pending: set = set()
+        self._resync_timers: dict = {}
 
     def add_reconciler(self, rec: Reconciler) -> None:
         self._reconcilers.append(rec)
 
-    def _enqueue(self, rec: Reconciler, req: Request) -> None:
-        key = (id(rec), req)
-        with self._lock:
-            if key in self._pending:
-                return
-            self._pending.add(key)
-        self._idle.clear()
-        self._queue.put((rec, req))
+    def cache(self, api_version: str, kind: str) -> None:
+        """Pre-warm an informer for a kind no reconciler watches but
+        reconcilers read (e.g. Pods for the SFC reconciler) — otherwise
+        the first cached_list starts it lazily."""
+        self.informers.informer_for(api_version, kind)
 
     def start(self) -> None:
-        for rec in self._reconcilers:
+        for index, rec in enumerate(self._reconcilers):
             api_version, kind = rec.watches
+            informer = self.informers.informer_for(api_version, kind)
 
-            def cb(event: str, obj: dict, rec: Reconciler = rec,
+            def cb(event: str, obj: dict, index: int = index,
                    api_version: str = api_version,
                    kind: str = kind) -> None:
                 md = obj.get("metadata", {})
-                self._enqueue(rec, Request(api_version, kind, md.get("name"),
-                                           md.get("namespace") or None))
-            self._cancels.append(self.client.watch(api_version, kind, cb))
+                self._queue.add((index, Request(
+                    api_version, kind, md.get("name"),
+                    md.get("namespace") or None)))
+            self._cancels.append(informer.add_handler(cb))
         self._heartbeat = watchdog.register(
             "manager.worker", deadline=self.STALL_DEADLINE,
             periodic=False)
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="manager-worker")
-        self._thread.start()
+        for i in range(self.workers):
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name=f"manager-worker-{i}")
+            t.start()
+            self._threads.append(t)
 
     def pause(self) -> None:
-        """Park the worker before its next reconcile (handoff freeze:
+        """Park every worker before its next reconcile (handoff freeze:
         the outgoing daemon must stop mutating cluster state while its
         bundle is in flight). Watch events still enqueue; nothing is
         lost — resume() drains the backlog."""
@@ -119,134 +156,148 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
-        self._resume_gate.set()  # wake a paused worker so it can exit
+        self._resume_gate.set()  # wake paused workers so they can exit
         for c in self._cancels:
             c()
-        self._queue.put(None)
-        if self._thread:
-            self._thread.join(timeout=5)
+        self._cancels = []
+        self._queue.shutdown()
+        with self._lock:
+            timers = list(self._resync_timers.values())
+            self._resync_timers.clear()
+        for t in timers:
+            t.cancel()
+        self.informers.stop_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
         if self._heartbeat is not None:
             self._heartbeat.close()
             self._heartbeat = None
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
-        """Test helper: block until the workqueue drains."""
-        return self._idle.wait(timeout)
+        """Test helper: block until every event already committed to the
+        apiserver has been delivered, enqueued and reconciled. The
+        pipeline is watch stream → informer fanout → workqueue →
+        worker; each stage exposes a pending probe, and idle means a
+        stable pass over all three (an event mid-hand-off between
+        stages makes any single check racy)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if not self._pipeline_busy():
+                # settle window: an event can be BETWEEN stages (popped
+                # from one queue, not yet pushed to the next) — require
+                # the pipeline to read idle twice with a scheduling gap
+                _time.sleep(0.002)
+                if not self._pipeline_busy():
+                    return True
+                continue
+            _time.sleep(0.002)
+        return not self._pipeline_busy()
+
+    def _pipeline_busy(self) -> bool:
+        inflight = getattr(self.client, "watch_inflight", None)
+        if inflight is not None and inflight():
+            return True
+        if self.informers.pending():
+            return True
+        return not self._queue.empty()
 
     def drain(self, timeout: float = 5.0) -> bool:
         """Block until no reconcile body is mid-flight. Meaningful
-        after :meth:`pause`: the worker parks before its NEXT item, so
-        once the CURRENT reconcile (if any) finishes, nothing mutates
+        after :meth:`pause`: workers park before their NEXT item, so
+        once the CURRENT reconciles (if any) finish, nothing mutates
         until resume() — the quiescence a handoff bundle needs. False
         on timeout (a stalled reconcile belongs to the watchdog)."""
         return self._quiesced.wait(timeout)
 
-    #: error-retry backoff bounds (controller-runtime uses 5ms..16m;
-    #: scaled down since our base reconciles are cheap)
-    RETRY_BASE = 0.5
-    RETRY_MAX = 60.0
-
-    #: a single reconcile past this is a stalled worker (the queue
-    #: behind it is frozen): watchdog dumps stacks + flips degraded
-    STALL_DEADLINE = 60.0
-
-    def _schedule_retry(self, delay: float, rec: Reconciler, req: Request,
-                        timers: dict, counts_as_pending: bool = True) -> None:
-        """*counts_as_pending*=False for periodic resyncs
-        (ReconcileResult.requeue_after): a steady-state resync loop must
-        not hold wait_idle hostage — idle means the queue is drained, not
-        that no reconciler ever wants to look again. Error retries DO
-        count: work that failed is still pending."""
-        fkey = (id(rec), req)
+    # -- periodic resync (ReconcileResult.requeue_after) ----------------------
+    def _schedule_resync(self, key: tuple, delay: float) -> None:
+        """One pending resync per key: every reconcile pass reschedules,
+        so a second timer would fork a permanent parallel loop. The
+        timer enqueues through the workqueue's normal add (dedup
+        applies); the marker is dropped BEFORE enqueueing so the pass
+        the new item triggers can reschedule."""
         with self._lock:
-            if not counts_as_pending:
-                # one pending resync per (reconciler, request): every
-                # reconcile pass reschedules, so a second timer would
-                # fork a permanent parallel loop
-                if fkey in self._resync_pending:
-                    return
-                self._resync_pending.add(fkey)
-            else:
-                self._inflight_timers += 1
+            if key in self._resync_pending:
+                return
+            self._resync_pending.add(key)
 
-        key = object()
+        handle_key = object()
 
         def fire() -> None:
-            if not counts_as_pending:
-                # drop the resync marker BEFORE enqueueing: if the worker
-                # drains the new item and reschedules before we dropped
-                # it, the next timer would be suppressed and the resync
-                # loop would die (the marker is invisible to wait_idle,
-                # so this order costs nothing there)
-                with self._lock:
-                    self._resync_pending.discard(fkey)
-            # for error retries: enqueue BEFORE decrementing, else
-            # wait_idle can observe a nothing-pending window while the
-            # retry work is still about to be queued
-            self._enqueue(rec, req)
-            if counts_as_pending:
-                with self._lock:
-                    self._inflight_timers -= 1
-            timers.pop(key, None)
+            with self._lock:
+                self._resync_pending.discard(key)
+                self._resync_timers.pop(handle_key, None)
+            if not self._stop.is_set():
+                self._queue.add(key)
 
         t = threading.Timer(delay, fire)
         t.daemon = True
+        with self._lock:
+            self._resync_timers[handle_key] = t
         t.start()
-        timers[key] = t
+
+    # -- workers --------------------------------------------------------------
+    def _claim(self) -> bool:
+        """Gate + quiescence claim for one reconcile; False = stopping."""
+        while True:
+            self._resume_gate.wait()
+            if self._stop.is_set():
+                return False
+            # claim-then-recheck: if pause() landed between the gate
+            # wait and the claim, release and park again so drain()
+            # never returns while this item is about to run
+            with self._lock:
+                self._active += 1
+                self._quiesced.clear()
+            if self._resume_gate.is_set():
+                return True
+            self._release()
+
+    def _release(self) -> None:
+        with self._lock:
+            self._active -= 1
+            if self._active == 0:
+                self._quiesced.set()
 
     def _run(self) -> None:
-        timers: dict = {}
-        failures: dict[tuple, int] = {}
         while not self._stop.is_set():
-            item = self._queue.get()
-            if item is None:
-                break
-            while True:
-                self._resume_gate.wait()
-                # claim-then-recheck: if pause() landed between the
-                # gate wait and the claim, release and park again so
-                # drain() never returns while this item is about to run
-                self._quiesced.clear()
-                if self._resume_gate.is_set():
-                    break
-                self._quiesced.set()
-            if self._stop.is_set():
-                self._quiesced.set()
-                break  # stop() raced a paused worker: never reconcile
-                # past the handoff freeze with state already handed off
-            rec, req = item
-            fkey = (id(rec), req)
-            controller = type(rec).__name__
-            with self._lock:
-                self._pending.discard(fkey)
+            key = self._queue.get(timeout=0.5)
+            if key is None:
+                continue
+            if not self._claim():
+                # stop() raced a paused worker: never reconcile past the
+                # handoff freeze with state already handed off
+                self._queue.done(key)
+                return
             try:
-                try:
-                    metrics.RECONCILE_TOTAL.inc(controller=controller)
-                    with watchdog.task(self._heartbeat), \
-                            metrics.RECONCILE_SECONDS.time(), \
-                            tracing.span("reconcile",
-                                         controller=controller,
-                                         request=req.name or ""):
-                        result = (rec.reconcile(self.client, req)
-                                  or ReconcileResult())
-                    failures.pop(fkey, None)
-                except Exception:
-                    metrics.RECONCILE_ERRORS.inc(controller=controller)
-                    n = failures.get(fkey, 0)
-                    failures[fkey] = n + 1
-                    delay = min(self.RETRY_BASE * (2 ** n), self.RETRY_MAX)
-                    log.exception("reconcile failed for %s (retry in "
-                                  "%.1fs)", req, delay)
-                    self._schedule_retry(delay, rec, req, timers)
-                    result = ReconcileResult()
+                self._process(key)
             finally:
-                self._quiesced.set()
-            if result.requeue_after:
-                self._schedule_retry(result.requeue_after, rec, req, timers,
-                                     counts_as_pending=False)
-            with self._lock:
-                if (not self._pending and self._queue.empty()
-                        and self._inflight_timers == 0):
-                    self._idle.set()
-        for t in list(timers.values()):
-            t.cancel()
+                self._release()
+                self._queue.done(key)
+
+    def _process(self, key: tuple) -> None:
+        index, req = key
+        rec = self._reconcilers[index]
+        controller = type(rec).__name__
+        try:
+            metrics.RECONCILE_TOTAL.inc(controller=controller)
+            with watchdog.task(self._heartbeat), \
+                    metrics.RECONCILE_SECONDS.time(), \
+                    tracing.span("reconcile",
+                                 controller=controller,
+                                 request=req.name or ""):
+                result = (rec.reconcile(self.cached_client, req)
+                          or ReconcileResult())
+            self._queue.forget(key)
+        except Exception:
+            metrics.RECONCILE_ERRORS.inc(controller=controller)
+            delay = self.RETRY_BASE * (
+                2 ** self._queue.num_retries(key))
+            log.exception("reconcile failed for %s (retry in ~%.1fs)",
+                          req, min(delay, self.RETRY_MAX))
+            self._queue.add_rate_limited(key)
+            result = ReconcileResult()
+        if result.requeue_after:
+            self._schedule_resync(key, result.requeue_after)

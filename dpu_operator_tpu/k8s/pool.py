@@ -90,6 +90,34 @@ class PooledResponse:
                 response=self)
 
 
+class StreamingResponse:
+    """A live chunked HTTP response (watch stream): iterate JSON lines,
+    then close. ``http.client`` decodes the chunked framing
+    transparently in ``readline``."""
+
+    def __init__(self, conn: http.client.HTTPSConnection,
+                 resp: http.client.HTTPResponse) -> None:
+        self._conn = conn
+        self._resp = resp
+        self.status_code = resp.status
+
+    def iter_lines(self):
+        """Yield non-empty lines until the server closes the stream.
+        Read errors propagate — the reflector classifies and re-dials."""
+        while True:
+            line = self._resp.readline()
+            if not line:
+                return
+            line = line.strip()
+            if line:
+                yield line
+
+    def close(self) -> None:
+        # a watch connection is never reusable (mid-stream close leaves
+        # undrained framing); always discard
+        self._conn.close()
+
+
 class HttpsConnectionPool:
     """Keep-alive pool of ``http.client.HTTPSConnection`` to one host."""
 
@@ -240,6 +268,47 @@ class HttpsConnectionPool:
                 resp.status, resp_headers,
                 _decode_body(resp_headers, data),
                 f"https://{self.host}:{self.port}{path}")
+
+    # -- streaming (watch) ----------------------------------------------------
+    def stream(self, method: str, path: str, params: Optional[dict] = None,
+               headers: Optional[dict] = None,
+               timeout: Optional[float] = None) -> "StreamingResponse":
+        """Open a watch-style streaming request on a DEDICATED
+        connection (client-go does the same: watch sockets never share
+        with request/response traffic — a stream parked mid-body would
+        poison the idle pool). The caller owns the returned
+        :class:`StreamingResponse` and must ``close()`` it; gzip is NOT
+        advertised (events must flush per line, not per gzip block)."""
+        path = self.path_prefix + path
+        if params:
+            path = path + "?" + urlencode(params)
+        headers = dict(headers or {})
+        tp = tracing.inject_traceparent()
+        if tp:
+            headers.setdefault("Traceparent", tp)
+        conn = self._dial(timeout)
+        try:
+            conn.request(method, path, headers=headers)
+            resp = conn.getresponse()
+        except Exception:
+            conn.close()
+            raise
+        if resp.status >= 400:
+            # error responses are small: drain into a normal response
+            # so the caller's raise_for_status sees the Status body
+            try:
+                data = resp.read()
+            finally:
+                conn.close()
+            resp_headers = dict(resp.getheaders())
+            err = PooledResponse(
+                resp.status, resp_headers, _decode_body(resp_headers, data),
+                f"https://{self.host}:{self.port}{path}")
+            err.raise_for_status()
+            return StreamingResponse(conn, resp)  # pragma: no cover
+        with self._lock:
+            self.requests_served += 1
+        return StreamingResponse(conn, resp)
 
     # -- observability --------------------------------------------------------
     def stats(self) -> dict:

@@ -140,7 +140,6 @@ class RealKube:
                 f"unsupported kubeconfig auth for user {ctx['user']!r}: "
                 "need token or client certificate (exec plugins / "
                 "auth-providers are not supported)")
-        self._watch_threads: list[threading.Thread] = []
         #: per-request HTTP timeout (connect+read); callers with stricter
         #: deadlines (leader lease) pass their own
         self.request_timeout = 30.0
@@ -291,6 +290,37 @@ class RealKube:
         r.raise_for_status()
         return r.json().get("items", [])
 
+    def list_collection(self, api_version: str, kind: str,
+                        namespace: Optional[str] = None,
+                        label_selector: Optional[dict] = None
+                        ) -> "tuple[list, Optional[str]]":
+        """LIST plus the collection resourceVersion (the list
+        metadata's, falling back to the max item rv for apiservers that
+        omit it) — the resume point for the reflector's list-then-watch
+        contract."""
+        params = {}
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items())
+        r = self._request("list", "GET",
+                          self._url(api_version, kind, namespace),
+                          params=params)
+        r.raise_for_status()
+        body = r.json()
+        rv = (body.get("metadata") or {}).get("resourceVersion")
+        items = body.get("items", [])
+        if not rv:
+            best = None
+            for obj in items:
+                try:
+                    n = int(obj.get("metadata", {})
+                            .get("resourceVersion", ""))
+                except ValueError:
+                    continue
+                best = n if best is None else max(best, n)
+            rv = str(best) if best is not None else None
+        return items, rv
+
     def create(self, obj: dict, timeout: Optional[float] = None) -> dict:
         md = obj["metadata"]
         r = self._request(
@@ -343,45 +373,103 @@ class RealKube:
         if self.pool is not None:
             self.pool.close()
 
+    #: per-stream server-side timeout (timeoutSeconds): the apiserver
+    #: closes the watch cleanly at this bound and the reflector resumes
+    #: from its last resourceVersion — client-go uses 5-10 min; shorter
+    #: here so a silently-dead stream is bounded by minutes, not hours
+    WATCH_TIMEOUT_S = 240
+
+    def watch_from(self, api_version: str, kind: str,
+                   on_event: Callable,
+                   resource_version: Optional[str] = None,
+                   stop: Optional[threading.Event] = None,
+                   timeout: Optional[float] = None) -> None:
+        """Blocking incremental watch over the real wire protocol: one
+        streaming GET with ``watch=1`` + ``allowWatchBookmarks``,
+        newline-delimited JSON events handed to *on_event(type, obj)*.
+        Returns on clean server close (timeoutSeconds); raises
+        :class:`~dpu_operator_tpu.k8s.client.StaleResourceVersion` on an
+        in-stream 410 ERROR (the reflector relists) and lets transport
+        errors propagate (the reflector classifies and re-dials). Watch
+        failures are counted (``tpu_kube_watch_errors_total``) by the
+        informer layer, not here — this is one stream attempt."""
+        from .client import StaleResourceVersion
+        watch_seconds = int(timeout or self.WATCH_TIMEOUT_S)
+        params = {"watch": "1", "allowWatchBookmarks": "true",
+                  "timeoutSeconds": str(watch_seconds)}
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        url_path = self._url(api_version, kind, None)[len(self.base):]
+        # the read timeout must outlive the server-side close so an idle
+        # stream (no events) is ended by the SERVER's bookmark/close, and
+        # only a genuinely dead peer trips the socket timeout
+        read_timeout = watch_seconds + 30.0
+        if self.pool is not None:
+            hdrs = {k: v for k, v in self.session.headers.items()
+                    if k.lower() not in ("accept-encoding",)}
+            resp = self.pool.stream("GET", url_path, params=params,
+                                    headers=hdrs, timeout=read_timeout)
+            lines = resp.iter_lines()
+        else:
+            resp = self.session.get(
+                self.base + url_path, params=params, stream=True,
+                timeout=read_timeout)
+            resp.raise_for_status()
+            lines = resp.iter_lines()
+        try:
+            for line in lines:
+                if stop is not None and stop.is_set():
+                    return
+                evt = json.loads(line)
+                etype = evt.get("type", "")
+                obj = evt.get("object") or {}
+                if etype == "ERROR":
+                    code = obj.get("code")
+                    if code == 410:
+                        raise StaleResourceVersion(
+                            obj.get("message", "410 Gone"))
+                    raise RuntimeError(
+                        f"watch ERROR event for {kind}: {obj}")
+                on_event(etype, obj)
+        finally:
+            resp.close()
+
     def watch(self, api_version: str, kind: str, callback: Callable,
               poll: float = 5.0) -> Callable[[], None]:
-        stop = threading.Event()
+        """Level-triggered watch with the legacy callback contract
+        (ADDED for existing objects, then incremental events). Since the
+        informer refactor this rides a private SharedInformer — one
+        LIST, then a streaming WATCH with resourceVersion resume —
+        instead of re-LISTing the collection every *poll* seconds; the
+        poll interval survives only as the degraded relist cadence when
+        the server cannot stream."""
+        from .informer import SharedInformer
+        informer = SharedInformer(self, api_version, kind, poll=poll)
+        cancel = informer.add_handler(callback, initial_sync=True)
+        informer.start()
 
-        def run() -> None:
-            seen: dict[str, tuple[str, dict]] = {}
-            while not stop.is_set():
-                try:
-                    current: dict[str, tuple[str, dict]] = {}
-                    for obj in self.list(api_version, kind):
-                        uid = obj["metadata"]["uid"]
-                        rv = obj["metadata"]["resourceVersion"]
-                        if uid not in seen:
-                            callback("ADDED", obj)
-                        elif seen[uid][0] != rv:
-                            callback("MODIFIED", obj)
-                        current[uid] = (rv, obj)
-                    for uid, (_, old) in seen.items():
-                        if uid not in current:
-                            callback("DELETED", old)
-                    seen = current
-                except Exception as e:  # noqa: BLE001 — keep polling
-                    log.warning("watch poll for %s/%s failed: %s",
-                                api_version, kind, e)
-                stop.wait(poll)
-
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
-        self._watch_threads.append(t)
-        return stop.set
+        def stop() -> None:
+            cancel()
+            informer.stop()
+        return stop
 
     # -- leader election (cmd/main.go leader-elect analog) --------------------
     def acquire_leader_lease(self, name: str, namespace: str = "kube-system",
                              lease_seconds: int = 15,
                              identity: str = "",
                              poll: float = 2.0,
-                             on_lost: Optional[Callable] = None) -> Callable:
+                             on_lost: Optional[Callable] = None,
+                             stop: Optional[threading.Event] = None
+                             ) -> Callable:
         """Block until this process holds the coordination.k8s.io Lease,
         then renew in the background. Returns a cancel function.
+
+        *stop* makes the acquisition phase cancellable: a replica told
+        to shut down while still CONTENDING a held lease (previously an
+        uncancellable ``while not try_take(): sleep(poll)``) returns a
+        no-op cancel as soon as the event is set instead of hanging
+        forever. The returned cancel sets it too, so callers can cancel
+        without knowing which phase the acquisition is in.
 
         If renewal fails past the renew deadline (2/3 of the lease
         duration, mirroring controller-runtime's renewDeadline <
@@ -451,12 +539,18 @@ class RealKube:
                           namespace, name, exc_info=True)
                 return False
 
+        stop = stop or threading.Event()
         while not try_take():
-            time.sleep(poll)
+            if stop.wait(poll):
+                log.info("leader lease acquisition for %s/%s cancelled "
+                         "while contending", namespace, name)
+                return stop.set  # pre-acquisition cancel: nothing to stop
+        if stop.is_set():
+            # cancelled the instant we won: release by simply not
+            # renewing (the lease expires); do not start the renew loop
+            return stop.set
         log.info("acquired leader lease %s/%s as %s", namespace, name,
                  identity)
-
-        stop = threading.Event()
 
         def lost() -> None:
             log.critical("leader lease %s/%s lost by %s — stopping",

@@ -170,8 +170,12 @@ class ChaosKube:
 
     Wraps FakeKube (or any client with the same surface); the verb names
     used as fault keys are the method names: get/list/create/update/
-    apply/delete/update_status. (watch is NOT scriptable — it passes
-    through to the inner client; fault its underlying list/get instead.)
+    apply/delete/update_status. ``list_collection`` (the informer
+    reflector's LIST) is scripted under the "list" verb — faulting
+    "list" breaks the informer's initial sync / relist exactly as it
+    broke the poll loop before the informer refactor. Watch STREAMS are
+    not scripted here: inject stream faults with the inner FakeKube's
+    ``disconnect_watches``/``block_watches``/``compact_history``.
     """
 
     _VERBS = ("get", "list", "create", "update", "apply", "delete",
@@ -198,6 +202,13 @@ class ChaosKube:
 
     def list(self, *a, **kw):
         return self._verb("list", *a, **kw)
+
+    def list_collection(self, *a, **kw):
+        # the reflector's LIST+resourceVersion read: same wire cost,
+        # same fault key as a plain LIST
+        return self.plan.run("list",
+                             getattr(self.inner, "list_collection"),
+                             *a, **kw)
 
     def create(self, *a, **kw):
         kw.pop("timeout", None)

@@ -365,6 +365,49 @@ KUBE_CONNECTIONS = REGISTRY.counter(
 KUBE_STALE_RECONNECTS = REGISTRY.counter(
     "tpu_kube_client_stale_reconnects_total",
     "Pooled connections found dead on reuse and replaced mid-request")
+# -- informer watch core (k8s/informer.py + k8s/workqueue.py) ----------------
+KUBE_WATCH_ERRORS = REGISTRY._add(_FlightRecordedCounter(
+    "tpu_kube_watch_errors_total",
+    "Watch-stream failures by kind and reason (transport = the stream "
+    "died mid-read; gone = resourceVersion expired, relist forced) — "
+    "churn here is apiserver/stream instability the health engine "
+    "should see",
+    kind="watch"))
+KUBE_WATCH_RELISTS = REGISTRY._add(_FlightRecordedCounter(
+    "tpu_kube_watch_relists_total",
+    "Full re-LISTs performed by reflectors, by kind and reason "
+    "(initial = first sync; gone = 410 resourceVersion expired; "
+    "error = stream failures past the retry budget; poll = degraded "
+    "poll-mode tick on a client without streaming watch support)",
+    kind="watch"))
+KUBE_WATCH_EVENTS = REGISTRY.counter(
+    "tpu_kube_watch_events_total",
+    "Watch events applied to informer stores, by kind and event type")
+INFORMER_FANOUT_SECONDS = REGISTRY.histogram(
+    "tpu_informer_fanout_seconds",
+    "Delivery latency from watch event arrival to handler execution "
+    "across every SharedInformer handler queue (the watch-fanout p95 "
+    "the fleet bench reports)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 5.0))
+WORKQUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_workqueue_depth",
+    "Keys currently queued (not yet picked by a worker), by queue")
+WORKQUEUE_ADDS = REGISTRY.counter(
+    "tpu_workqueue_adds_total",
+    "Keys accepted by the workqueue, by queue")
+WORKQUEUE_COALESCED = REGISTRY.counter(
+    "tpu_workqueue_coalesced_total",
+    "Adds absorbed into an already-queued or in-flight key, by queue "
+    "(update-storm dedup: K adds to one key -> far fewer reconciles)")
+WORKQUEUE_RETRIES = REGISTRY.counter(
+    "tpu_workqueue_retries_total",
+    "Rate-limited requeues (per-key exponential backoff), by queue")
+WORKQUEUE_LATENCY_SECONDS = REGISTRY.histogram(
+    "tpu_workqueue_latency_seconds",
+    "Time a key spends queued before a worker picks it up",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
 JOURNAL_MUTATIONS = REGISTRY.counter(
     "tpu_daemon_journal_mutations_total",
     "Chain wire-table mutations marked for journaling")

@@ -586,14 +586,62 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(200, obj)
             return
+        if query.get("watch") in ("1", "true"):
+            self._serve_watch(api_version, kind, query)
+            return
         selector = None
         if query.get("labelSelector"):
             selector = dict(kv.split("=", 1)
                             for kv in query["labelSelector"].split(","))
-        items = self.kube.list(api_version, kind, namespace=namespace,
-                               label_selector=selector)
+        items, rv = self.kube.list_collection(api_version, kind,
+                                              namespace=namespace,
+                                              label_selector=selector)
         self._send(200, {"kind": f"{kind}List", "apiVersion": api_version,
+                         "metadata": {"resourceVersion": rv},
                          "items": items})
+
+    # -- streaming watch (the real wire protocol's chunked event feed) -------
+    def _serve_watch(self, api_version: str, kind: str, query: dict):
+        """Serve ``?watch=1``: chunked transfer encoding, one JSON watch
+        event per line, resourceVersion resume through FakeKube's event
+        history, BOOKMARK events, and the in-stream 410 ERROR event the
+        real apiserver answers a compacted resourceVersion with."""
+        from dpu_operator_tpu.k8s.fake import (StaleResourceVersion,
+                                               WatchDisconnected)
+
+        timeout = float(query.get("timeoutSeconds", 240))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(event: str, obj: dict) -> None:
+            data = json.dumps({"type": event, "object": obj}).encode() \
+                + b"\n"
+            self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            try:
+                self.kube.watch_from(
+                    api_version, kind, emit,
+                    resource_version=query.get("resourceVersion"),
+                    timeout=timeout)
+            except StaleResourceVersion as e:
+                emit("ERROR", dict(
+                    _status(410, "Expired", str(e)),
+                    reason="Expired"))
+            # clean end-of-stream (timeoutSeconds reached): final chunk
+            self.wfile.write(b"0\r\n\r\n")
+        except WatchDisconnected:
+            # test-injected outage (block/disconnect_watches): abrupt
+            # close with no terminal chunk, as a crashed apiserver
+            # would — the client sees a transport error and re-dials
+            self.close_connection = True
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client went away mid-stream; watch_from unregistered its
+            # queue in its finally — nothing to clean up here
+            self.close_connection = True
 
     def do_POST(self):  # noqa: N802
         # drain the body first: an error response with the body unread

@@ -79,3 +79,43 @@ def test_pod_scheduling_respects_allocatable(kube):
     agent.sync()
     assert kube.get("v1", "Pod", "p2", namespace="default")["status"]["phase"] == "Running"
     agent.stop()
+
+
+def test_dangling_owner_reference_is_garbage_collected(kube):
+    """Real-apiserver GC parity: an object created whose ownerReference
+    uids no longer resolve is collected — the window a cache-fed
+    reconciler can hit by re-applying children just after its CR was
+    deleted (the real GC controller deletes such orphans too)."""
+    owner = kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                         "metadata": {"name": "owner",
+                                      "namespace": "default"}})
+    uid = owner["metadata"]["uid"]
+    kube.delete("v1", "ConfigMap", "owner", namespace="default")
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "orphan", "namespace": "default",
+                              "ownerReferences": [{
+                                  "apiVersion": "v1", "kind": "ConfigMap",
+                                  "name": "owner", "uid": uid,
+                                  "controller": True}]}})
+    assert kube.get("v1", "ConfigMap", "orphan",
+                    namespace="default") is None
+
+    # a LIVE owner keeps its child; refs without a uid are ignored
+    live = kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": "live",
+                                     "namespace": "default"}})
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "child", "namespace": "default",
+                              "ownerReferences": [{
+                                  "apiVersion": "v1", "kind": "ConfigMap",
+                                  "name": "live",
+                                  "uid": live["metadata"]["uid"]}]}})
+    assert kube.get("v1", "ConfigMap", "child",
+                    namespace="default") is not None
+    kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "no-uid-ref", "namespace": "default",
+                              "ownerReferences": [{
+                                  "apiVersion": "v1", "kind": "ConfigMap",
+                                  "name": "whatever"}]}})
+    assert kube.get("v1", "ConfigMap", "no-uid-ref",
+                    namespace="default") is not None

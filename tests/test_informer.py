@@ -1,0 +1,287 @@
+"""Informer core units (k8s/informer.py): Store semantics + indexes,
+SharedInformer fanout (per-handler queues, overflow degradation,
+initial sync), relist diffing, resync, and the CachedClient facade."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dpu_operator_tpu.k8s import FakeKube
+from dpu_operator_tpu.k8s.informer import (
+    SYNC,
+    CachedClient,
+    InformerFactory,
+    SharedInformer,
+    Store,
+    cached_list,
+)
+
+from utils import assert_eventually
+
+
+def obj_(name, ns=None, rv="1", labels=None, **extra):
+    o = {"apiVersion": "v1", "kind": "ConfigMap",
+         "metadata": {"name": name, "namespace": ns,
+                      "resourceVersion": rv}}
+    if labels is not None:
+        o["metadata"]["labels"] = labels
+    o.update(extra)
+    return o
+
+
+def cm(kube, name, data=None, ns="default"):
+    return kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": name, "namespace": ns},
+                        "data": data or {}})
+
+
+# -- Store --------------------------------------------------------------------
+
+def test_store_replace_diffs_added_modified_deleted():
+    s = Store()
+    s.apply_event("ADDED", obj_("keep", rv="1"))
+    s.apply_event("ADDED", obj_("change", rv="1"))
+    s.apply_event("ADDED", obj_("drop", rv="1"))
+    added, modified, deleted = s.replace([
+        obj_("keep", rv="1"), obj_("change", rv="9"), obj_("new", rv="2")])
+    assert [o["metadata"]["name"] for o in added] == ["new"]
+    assert [o["metadata"]["name"] for o in modified] == ["change"]
+    assert [o["metadata"]["name"] for o in deleted] == ["drop"]
+    assert s.get("drop") is None
+    assert s.get("new") is not None
+
+
+def test_store_reads_are_copies():
+    s = Store()
+    s.apply_event("ADDED", obj_("a", rv="1"))
+    got = s.get("a")
+    got["metadata"]["name"] = "mutated"
+    assert s.get("a")["metadata"]["name"] == "a"
+
+
+def test_store_secondary_index():
+    s = Store(indexers={"app": lambda o: [
+        (o.get("metadata", {}).get("labels") or {}).get("app", "")]})
+    s.apply_event("ADDED", obj_("a", labels={"app": "x"}))
+    s.apply_event("ADDED", obj_("b", labels={"app": "x"}))
+    s.apply_event("ADDED", obj_("c", labels={"app": "y"}))
+    assert {o["metadata"]["name"] for o in s.by_index("app", "x")} \
+        == {"a", "b"}
+    # index follows mutation and delete
+    s.apply_event("MODIFIED", obj_("a", rv="2", labels={"app": "y"}))
+    assert {o["metadata"]["name"] for o in s.by_index("app", "y")} \
+        == {"a", "c"}
+    s.apply_event("DELETED", obj_("c"))
+    assert {o["metadata"]["name"] for o in s.by_index("app", "y")} == {"a"}
+
+
+def test_store_label_selector_list():
+    s = Store()
+    s.apply_event("ADDED", obj_("a", labels={"t": "1"}))
+    s.apply_event("ADDED", obj_("b", labels={"t": "2"}))
+    assert [o["metadata"]["name"]
+            for o in s.list(label_selector={"t": "1"})] == ["a"]
+
+
+# -- SharedInformer -----------------------------------------------------------
+
+def test_informer_initial_sync_and_live_events(kube):
+    cm(kube, "pre")
+    inf = SharedInformer(kube, "v1", "ConfigMap").start()
+    try:
+        assert inf.wait_synced(5)
+        events = []
+        inf.add_handler(lambda e, o: events.append(
+            (e, o["metadata"]["name"])))
+        assert_eventually(lambda: ("ADDED", "pre") in events)
+        cm(kube, "live")
+        assert_eventually(lambda: ("ADDED", "live") in events)
+        kube.delete("v1", "ConfigMap", "live", namespace="default")
+        assert_eventually(lambda: ("DELETED", "live") in events)
+        assert inf.store.get("live", namespace="default") is None
+    finally:
+        inf.stop()
+
+
+def test_one_stream_fans_out_to_n_handlers(kube):
+    """One upstream watch serves every handler — no per-consumer
+    apiserver stream."""
+    inf = SharedInformer(kube, "v1", "ConfigMap").start()
+    try:
+        assert inf.wait_synced(5)
+        sinks = [[] for _ in range(5)]
+        for sink in sinks:
+            inf.add_handler(
+                lambda e, o, s=sink: s.append((e, o["metadata"]["name"])))
+        cm(kube, "x")
+        for sink in sinks:
+            assert_eventually(lambda s=sink: ("ADDED", "x") in s)
+        with kube._lock:
+            n_streams = sum(len(qs) for qs in kube._streams.values())
+        assert n_streams == 1, "each handler opened its own stream"
+    finally:
+        inf.stop()
+
+
+def test_slow_handler_does_not_block_siblings(kube):
+    inf = SharedInformer(kube, "v1", "ConfigMap").start()
+    try:
+        assert inf.wait_synced(5)
+        release = threading.Event()
+        fast: list = []
+        inf.add_handler(lambda e, o: release.wait(10))
+        inf.add_handler(lambda e, o: fast.append(o["metadata"]["name"]))
+        cm(kube, "a")
+        cm(kube, "b")
+        # the fast handler sees both while the slow one is parked
+        assert_eventually(lambda: {"a", "b"} <= set(fast))
+        release.set()
+    finally:
+        release.set()
+        inf.stop()
+
+
+def test_handler_overflow_degrades_to_sync_replay(kube):
+    """A handler too slow for the storm gets per-key SYNC replay from
+    the store once it catches up — level-triggered, nothing lost."""
+    inf = SharedInformer(kube, "v1", "ConfigMap").start()
+    try:
+        assert inf.wait_synced(5)
+        release = threading.Event()
+        seen: list = []
+        started = threading.Event()
+
+        def slow(e, o):
+            started.set()
+            release.wait(10)
+            seen.append((e, o["metadata"]["name"]))
+        inf.add_handler(slow, queue_size=2)
+        cm(kube, "first")  # occupies the handler
+        assert started.wait(5)
+        for i in range(10):  # overflows the size-2 queue
+            cm(kube, f"burst-{i}")
+        release.set()
+        assert_eventually(
+            lambda: {f"burst-{i}" for i in range(10)}
+            <= {name for _, name in seen},
+            message="overflowed keys never replayed")
+        # replayed entries arrive as SYNC (or queued ADDED for the ones
+        # that fit) — correctness is the KEY set, not the event types
+    finally:
+        release.set()
+        inf.stop()
+
+
+def test_forced_relist_emits_missed_events(kube):
+    """Watch outage + 410: events missed while disconnected surface as
+    relist diff — no staleness."""
+    cm(kube, "stays")
+    cm(kube, "dies")
+    inf = SharedInformer(kube, "v1", "ConfigMap")
+    inf.MAX_STREAM_FAILURES = 10_000  # only the 410 path may relist
+    inf.STREAM_RETRY_S = 0.02
+    inf.start()
+    try:
+        assert inf.wait_synced(5)
+        events = []
+        inf.add_handler(lambda e, o: events.append(
+            (e, o["metadata"]["name"])), initial_sync=False)
+        kube.block_watches("v1", "ConfigMap")
+        kube.delete("v1", "ConfigMap", "dies", namespace="default")
+        cm(kube, "born")
+        obj = kube.get("v1", "ConfigMap", "stays", namespace="default")
+        obj["data"] = {"k": "v"}
+        kube.update(obj)
+        kube.compact_history("v1", "ConfigMap")
+        kube.unblock_watches("v1", "ConfigMap")
+        assert_eventually(lambda: ("DELETED", "dies") in events
+                          and ("ADDED", "born") in events
+                          and ("MODIFIED", "stays") in events,
+                          message="relist diff incomplete")
+        assert inf.store.get("dies", namespace="default") is None
+        assert inf.store.get("born", namespace="default") is not None
+        assert inf.store.get("stays",
+                             namespace="default")["data"] == {"k": "v"}
+        assert inf.relists >= 2  # initial + gone
+    finally:
+        inf.stop()
+
+
+def test_resync_emits_sync_events(kube):
+    cm(kube, "obj")
+    inf = SharedInformer(kube, "v1", "ConfigMap", resync=0.05).start()
+    try:
+        assert inf.wait_synced(5)
+        events = []
+        inf.add_handler(lambda e, o: events.append(e), initial_sync=False)
+        assert_eventually(lambda: SYNC in events,
+                          message="resync never fired")
+    finally:
+        inf.stop()
+
+
+def test_informer_factory_shares_per_gvk(kube):
+    factory = InformerFactory(kube)
+    a = factory.informer_for("v1", "ConfigMap")
+    b = factory.informer_for("v1", "ConfigMap")
+    c = factory.informer_for("v1", "Secret")
+    try:
+        assert a is b
+        assert c is not a
+    finally:
+        factory.stop_all()
+
+
+# -- CachedClient -------------------------------------------------------------
+
+def test_cached_client_serves_reads_and_delegates_writes(kube):
+    factory = InformerFactory(kube)
+    client = CachedClient(kube, factory)
+    try:
+        cm(kube, "a", data={"x": "1"})
+        # uncached kind: read-through
+        assert client.get("v1", "ConfigMap", "a",
+                          namespace="default")["data"] == {"x": "1"}
+        # cached: served from the store once synced
+        got = client.cached_list("v1", "ConfigMap", namespace="default")
+        assert [o["metadata"]["name"] for o in got] == ["a"]
+        inf = factory.peek("v1", "ConfigMap")
+        assert inf is not None and inf.has_synced()
+        # a write delegates and the cache converges
+        obj = client.get("v1", "ConfigMap", "a", namespace="default")
+        obj["data"] = {"x": "2"}
+        client.update(obj)
+        assert_eventually(
+            lambda: (inf.store.get("a", namespace="default")
+                     or {}).get("data") == {"x": "2"})
+        # cache MISS falls through live (created after the snapshot but
+        # not yet watched back — must not read as NotFound)
+        fresh = cm(kube, "fresh")
+        assert client.get("v1", "ConfigMap", "fresh",
+                          namespace="default") is not None
+        assert fresh
+    finally:
+        factory.stop_all()
+
+
+def test_cached_list_helper_against_bare_client(kube):
+    """Reconcilers driven directly against FakeKube (no manager) get a
+    plain LIST — the fallback the lister seam promises."""
+    cm(kube, "a")
+    out = cached_list(kube, "v1", "ConfigMap", namespace="default")
+    assert [o["metadata"]["name"] for o in out] == ["a"]
+
+
+def test_stopped_informer_releases_stream(kube):
+    inf = SharedInformer(kube, "v1", "ConfigMap").start()
+    assert inf.wait_synced(5)
+    inf.stop()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with kube._lock:
+            if not any(kube._streams.values()):
+                break
+        time.sleep(0.02)
+    with kube._lock:
+        assert not any(kube._streams.values()), "stream leaked past stop"

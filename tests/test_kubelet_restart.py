@@ -158,10 +158,12 @@ def test_kubelet_restart_and_apiserver_flap_during_sfc_reconcile(pm):
 
     kube = FakeKube()
     chaos = ChaosKube(kube, seed=7)
-    # the flap: reconcile's first GET dies send-phase, the first two NF
-    # pod creates die send-phase (retried in place), one status write
-    # dies too (next resync repairs it)
-    chaos.plan.script("get", Fail(times=1))
+    # the flap: the informer's initial LIST dies send-phase (since the
+    # watch-core refactor, reconcile READS ride the cache — the wire
+    # reads that can flap are the reflector's LIST and the writes), the
+    # first two NF pod creates die send-phase (retried in place), one
+    # status write dies too (next resync repairs it)
+    chaos.plan.script("list", Fail(times=1))
     chaos.plan.script("create", Fail(times=2))
     chaos.plan.script("update_status", Fail(times=1))
 

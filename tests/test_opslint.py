@@ -561,3 +561,62 @@ def test_repo_gate_is_green():
     assert opslint_main(["--repo-root", repo]) == 0
     baseline = Baseline(os.path.join(repo, "opslint-baseline.json"))
     assert baseline.loaded and baseline.entries == set()
+
+
+# -- list-discipline ----------------------------------------------------------
+
+def test_list_discipline_flags_client_list_in_controller():
+    from dpu_operator_tpu.analysis import ListDisciplineChecker
+    violations = check(ListDisciplineChecker(), """
+        def reconcile(self, client, req):
+            pods = client.list("v1", "Pod", namespace="x")
+    """, relpath="dpu_operator_tpu/controller/some_controller.py")
+    assert [v.rule for v in violations] == ["list-discipline"]
+    assert "cached_list" in violations[0].message
+
+
+def test_list_discipline_flags_self_client_and_kube_receivers():
+    from dpu_operator_tpu.analysis import ListDisciplineChecker
+    src = """
+        def a(self):
+            self.client.list("v1", "Node")
+        def b(kube):
+            kube.list("v1", "Pod")
+    """
+    assert len(check(ListDisciplineChecker(), src,
+                     relpath="dpu_operator_tpu/daemon/sfc_reconciler.py")) \
+        == 2
+
+
+def test_list_discipline_allows_lister_seam_and_other_receivers():
+    from dpu_operator_tpu.analysis import ListDisciplineChecker
+    src = """
+        from ..k8s.informer import cached_list
+        def reconcile(self, client, req):
+            pods = cached_list(client, "v1", "Pod")
+            hops = self.wire_table.list()   # not an apiserver client
+            keys = list(pods)               # builtin, no receiver
+    """
+    assert check(ListDisciplineChecker(), src,
+                 relpath="dpu_operator_tpu/controller/c.py") == []
+
+
+def test_list_discipline_scopes_to_reconciler_modules_only():
+    from dpu_operator_tpu.analysis import ListDisciplineChecker
+    src = 'def f(client):\n    return client.list("v1", "Node")\n'
+    # utils/testing/k8s internals may list raw — the informer itself must
+    assert check(ListDisciplineChecker(), src,
+                 relpath="dpu_operator_tpu/k8s/informer.py") == []
+    assert check(ListDisciplineChecker(), src,
+                 relpath="dpu_operator_tpu/utils/drain.py") == []
+    assert check(ListDisciplineChecker(), src,
+                 relpath="tests/test_x.py") == []
+
+
+def test_list_discipline_pragma_suppresses():
+    from dpu_operator_tpu.analysis import ListDisciplineChecker
+    src = ('def f(client):\n'
+           '    return client.list("v1", "Node")'
+           '  # opslint: disable=list-discipline\n')
+    assert check(ListDisciplineChecker(), src,
+                 relpath="dpu_operator_tpu/controller/c.py") == []

@@ -1,0 +1,148 @@
+"""Keyed rate-limited workqueue (k8s/workqueue.py): the client-go
+workqueue contract — dedup while queued AND while in-flight, dirty
+re-queue, per-key exponential backoff with forget, token-bucket
+admission and deterministic stepped-clock timers."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from dpu_operator_tpu.k8s.workqueue import (
+    ExponentialBackoff,
+    RateLimitingQueue,
+    SteppedTimerFactory,
+    TokenBucket,
+)
+
+
+def make_queue(**kw):
+    timers = SteppedTimerFactory()
+    q = RateLimitingQueue(name="test", clock=timers.now,
+                          timer_factory=timers, **kw)
+    return q, timers
+
+
+def test_add_get_done_roundtrip():
+    q, _ = make_queue()
+    q.add("a")
+    q.add("b")
+    assert q.get(timeout=1) == "a"
+    assert q.get(timeout=1) == "b"
+    q.done("a")
+    q.done("b")
+    assert q.empty()
+
+
+def test_queued_dedup_coalesces():
+    q, _ = make_queue()
+    for _ in range(100):
+        q.add("a")
+    assert q.get(timeout=1) == "a"
+    q.done("a")
+    assert q.get(timeout=0.05) is None  # one queued instance, not 100
+    assert q.coalesced == 99
+
+
+def test_inflight_add_marks_dirty_and_requeues_once():
+    q, _ = make_queue()
+    q.add("a")
+    assert q.get(timeout=1) == "a"
+    # adds DURING processing: coalesced to one re-queue after done
+    for _ in range(50):
+        q.add("a")
+    assert q.depth() == 0  # nothing queued while in-flight
+    q.done("a")
+    assert q.get(timeout=1) == "a"
+    q.done("a")
+    assert q.get(timeout=0.05) is None
+
+
+def test_done_without_dirty_does_not_requeue():
+    q, _ = make_queue()
+    q.add("a")
+    assert q.get(timeout=1) == "a"
+    q.done("a")
+    assert q.empty()
+
+
+def test_rate_limited_backoff_is_exponential_and_forgettable():
+    b = ExponentialBackoff(base=0.1, cap=5.0)
+    assert b.delay("k") == pytest.approx(0.1)
+    assert b.delay("k") == pytest.approx(0.2)
+    assert b.delay("k") == pytest.approx(0.4)
+    assert b.delay("other") == pytest.approx(0.1)  # per-key isolation
+    b.forget("k")
+    assert b.delay("k") == pytest.approx(0.1)
+    for _ in range(20):
+        b.delay("capped")
+    assert b.delay("capped") == pytest.approx(5.0)
+
+
+def test_add_rate_limited_fires_after_stepped_delay():
+    q, timers = make_queue(backoff=ExponentialBackoff(base=1.0, cap=60.0),
+                           bucket=TokenBucket(rate=1e9, capacity=1e9))
+    q.add_rate_limited("a")
+    assert q.get(timeout=0.05) is None  # delayed, not queued
+    timers.advance(0.5)
+    assert q.get(timeout=0.05) is None
+    timers.advance(0.6)  # past the 1.0s backoff
+    assert q.get(timeout=1) == "a"
+    q.done("a")
+
+
+def test_delayed_add_coalesces_with_direct_add():
+    q, timers = make_queue(backoff=ExponentialBackoff(base=1.0, cap=60.0),
+                           bucket=TokenBucket(rate=1e9, capacity=1e9))
+    q.add_rate_limited("a")
+    q.add("a")  # lands immediately; the delayed timer must coalesce
+    assert q.get(timeout=1) == "a"
+    q.done("a")
+    timers.advance(2.0)
+    assert q.get(timeout=0.05) is None
+
+
+def test_token_bucket_spreads_a_storm():
+    clock = [0.0]
+    bucket = TokenBucket(rate=10.0, capacity=2.0, clock=lambda: clock[0])
+    assert bucket.reserve() == pytest.approx(0.0)
+    assert bucket.reserve() == pytest.approx(0.0)
+    # bucket exhausted: each further reservation queues deeper debt
+    d1 = bucket.reserve()
+    d2 = bucket.reserve()
+    assert d1 > 0 and d2 > d1
+    clock[0] += 10.0  # refill
+    assert bucket.reserve() == pytest.approx(0.0)
+
+
+def test_shutdown_wakes_getters_and_cancels_delayed():
+    q, timers = make_queue()
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.get(timeout=5)))
+    t.start()
+    q.add_rate_limited("pending")
+    q.shutdown()
+    t.join(timeout=5)
+    assert got == [None]
+    timers.advance(120.0)  # cancelled timer must not resurrect the key
+    assert q.get(timeout=0.05) is None
+    q.add("late")  # post-shutdown adds are dropped
+    assert q.empty()
+
+
+def test_wait_empty_tracks_inflight_and_delayed():
+    q, timers = make_queue(backoff=ExponentialBackoff(base=0.5, cap=60.0),
+                           bucket=TokenBucket(rate=1e9, capacity=1e9))
+    assert q.wait_empty(timeout=0.1)
+    q.add("a")
+    assert not q.wait_empty(timeout=0.1)
+    assert q.get(timeout=1) == "a"
+    assert not q.wait_empty(timeout=0.1)  # in-flight counts
+    q.add_rate_limited("a")  # delayed counts too
+    q.done("a")
+    assert not q.wait_empty(timeout=0.1)
+    timers.advance(1.0)
+    assert q.get(timeout=1) == "a"
+    q.done("a")
+    assert q.wait_empty(timeout=1)
