@@ -4,9 +4,9 @@ REGISTRY ?= localhost:5000
 TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
-        upgrade-check fault-check scale-check lint-check type-check bench \
-        native traffic-flow images smoke-images deploy undeploy graft-check \
-        clean
+        upgrade-check fault-check scale-check serve-check lint-check \
+        type-check bench native traffic-flow images smoke-images deploy \
+        undeploy graft-check clean
 
 test: lint-check native
 	$(PYTHON) -m pytest tests/ -q
@@ -85,6 +85,20 @@ fault-check:
 # waits are event-driven — no wall-clock sleep drives an assertion.
 scale-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m scale \
+	  -p no:randomly -p no:cacheprovider
+
+# continuous-batching serve gate (doc/architecture.md "Serving layer"):
+# the seeded scheduler harness — two consecutive runs must produce
+# bit-identical scheduler traces; continuous batching must beat static
+# batching >=1.5x aggregate tokens/s at the same offered load; an
+# interactive request admitted under full batch-class load must meet
+# its TTFT bound via preemption; 500 seeded request lifecycles must
+# leak zero KV-pool blocks (occupancy returns to zero); plus the
+# shared zero-spurious-ListAndWatch-deletion churn regression for both
+# capacity producers (fault gate + serve slots). Seeded RNG, virtual
+# clocks, no wall-clock sleeps.
+serve-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m serve \
 	  -p no:randomly -p no:cacheprovider
 
 # opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST

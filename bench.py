@@ -116,6 +116,31 @@ def reset_backend() -> None:
             continue
 
 
+def forced_platform(env=None) -> "str | None":
+    """The platform JAX_PLATFORMS explicitly pins (first entry, lower-
+    cased), or None when unset/empty — the probe-skip decision input.
+    BENCH_r05 burned ~12 minutes on three consecutive 240 s probe
+    timeouts while the platform was already pinned to cpu: with an
+    explicit pin there is no tunnel-vs-cpu question for the probe to
+    answer, so the dials were pure waste."""
+    raw = (env if env is not None else os.environ).get(
+        "JAX_PLATFORMS", "")
+    first = raw.split(",")[0].strip().lower()
+    return first or None
+
+
+def should_probe_backend(env=None) -> bool:
+    """True when the subprocess backend probe is worth running — i.e.
+    whenever the platform is NOT explicitly pinned to cpu. A cpu pin
+    makes the probe pure waste (nothing to dial, nothing to fall back
+    from). An ACCELERATOR pin (e.g. tpu) still needs the bounded
+    subprocess dial: its failure verdict is what triggers the cpu
+    fallback BEFORE in-process backend init can block ~25 min per
+    attempt on a dead tunnel — skipping it there would reintroduce the
+    exact hang the probe exists to prevent."""
+    return forced_platform(env) != "cpu"
+
+
 def probe_backend(timeout_s=240.0, attempts=3):
     """Check from a SUBPROCESS that jax can initialize its default backend
     (the axon TPU plugin when the tunnel is up). Returns the device kind
@@ -555,6 +580,37 @@ def bench_fleet() -> dict:
     return out
 
 
+def bench_serve() -> dict:
+    """Open-loop serving bench (workloads/serve.py): seeded Poisson
+    arrivals through the continuous-batching scheduler at three offered
+    loads, plus the continuous-vs-static throughput comparison. The
+    cost model replayed by the (deterministic, virtual-time) scheduler
+    is calibrated from the real prefill/decode_step pair on the local
+    backend; calibration failure falls back to the documented defaults
+    rather than losing the section. Runs AFTER the backend probe: the
+    calibration is this section's first in-process jax contact."""
+    from dpu_operator_tpu.workloads import serve as serve_mod
+
+    cm = None
+    try:
+        cm = serve_mod.calibrate_cost_model()
+    except Exception as e:  # noqa: BLE001 — calibration is best-effort
+        print(f"serve cost-model calibration failed (defaults used): "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    out = serve_mod.bench_serving(seed=0, loads=(0.5, 0.8, 1.1),
+                                  cost_model=cm)
+    out["cost_model_calibrated"] = cm is not None
+    if cm is not None:
+        # the continuous-vs-static ratio depends on the decode/prefill
+        # cost balance, and a CPU calibration is prefill-heavy in a way
+        # no accelerator is — record the reference-model ratio (the one
+        # `make serve-check` gates >=1.5x) alongside the calibrated one
+        ref = serve_mod.bench_serving(seed=0, loads=())
+        out["continuous_speedup_reference"] = \
+            ref["continuous_vs_static"]["speedup"]
+    return out
+
+
 def run_sections(sections):
     """Run (name, thunk) pairs; collect results and errors independently.
 
@@ -577,13 +633,11 @@ def run_sections(sections):
 
 
 def _p95(samples) -> float:
-    """p95 over a small sample set (nearest-rank; no numpy dependency).
-    ceil(0.95*n)-1, NOT int(0.95*n): the latter lands on the max whenever
-    0.95*n is integral (n=20, the default pod count), silently reporting
-    p100."""
-    import math
-    ordered = sorted(samples)
-    return ordered[max(0, math.ceil(0.95 * len(ordered)) - 1)]
+    """p95 over a small sample set — the shared nearest-rank helper
+    (utils/stats.py), so the bench, the serve harness, and `tpuctl
+    serve` can never disagree on the rank convention."""
+    from dpu_operator_tpu.utils.stats import nearest_rank
+    return nearest_rank(samples, 0.95)
 
 
 def build_payload(results, errors):
@@ -685,6 +739,40 @@ def build_payload(results, errors):
             payload["fleet_requests_poll"] = baseline["requests"]
         if fl.get("request_ratio") is not None:
             payload["fleet_request_ratio"] = fl["request_ratio"]
+    # open-loop serving record (BENCH_r07+): per-load rows keep the
+    # keys the acceptance gate reads (p99 TTFT at >=2 load points) and
+    # the batching speedup; the cost model rides along so a reader can
+    # tell calibrated runs from default-model runs
+    srv = results.get("serve")
+    if srv:
+        loads = {}
+        for key, row in (srv.get("loads") or {}).items():
+            loads[key] = {k: row[k] for k in (
+                "offered_rps", "completed", "rejected", "preemptions",
+                "tokens_per_s", "ttft_p50_s", "ttft_p99_s", "itl_p99_s",
+                "kv_occupancy_mean", "kv_occupancy_max",
+                "kv_blocks_leaked") if k in row}
+        cvs = srv.get("continuous_vs_static") or {}
+        payload["serve"] = {
+            "seed": srv.get("seed"),
+            "slots": srv.get("slots"),
+            "kv_blocks": srv.get("kv_blocks"),
+            "kv_block_size": srv.get("kv_block_size"),
+            "cost_model": srv.get("cost_model"),
+            "cost_model_calibrated": srv.get("cost_model_calibrated"),
+            "peak_tokens_per_s_modeled": srv.get(
+                "peak_tokens_per_s_modeled"),
+            "loads": loads,
+            "continuous_speedup": cvs.get("speedup"),
+        }
+        if srv.get("continuous_speedup_reference") is not None:
+            payload["serve"]["continuous_speedup_reference"] = \
+                srv["continuous_speedup_reference"]
+        if loads:
+            payload["serve_tokens_per_s_peak"] = max(
+                row.get("tokens_per_s", 0.0) for row in loads.values())
+        if cvs.get("speedup") is not None:
+            payload["serve_continuous_speedup"] = cvs["speedup"]
     if train is None:
         # promote a fallback headline so "value" is numeric when another
         # compute metric landed. ONLY fraction-of-roofline metrics are
@@ -725,7 +813,18 @@ def main():
     # on terminal failure the CPU fallback is pinned so every section
     # still lands (degraded, flagged in "errors") and the line prints.
     probe_timeout = _float_env("TPU_BENCH_PROBE_TIMEOUT_S", 240.0)
-    kind = probe_backend(timeout_s=probe_timeout)
+    forced = forced_platform()
+    if not should_probe_backend():
+        # cpu is explicitly pinned: there is no tunnel to dial and no
+        # fallback to choose, so the (up to attempts x timeout_s)
+        # probe dials can only waste the driver's window (BENCH_r05
+        # lost ~12 min to exactly this). An accelerator pin still
+        # probes: its bounded failure verdict drives the cpu fallback.
+        print(f"JAX_PLATFORMS={forced} is pinned; skipping the backend "
+              "probe", file=sys.stderr)
+        kind = forced
+    else:
+        kind = probe_backend(timeout_s=probe_timeout)
     if kind is not None:
         # record chip provenance now: if the tunnel drops before
         # ComputeBench lands, the degraded record still says what the
@@ -753,8 +852,10 @@ def main():
 
     # device init (the first jax contact through the tunnel) gets the
     # same transient-retry treatment as the measurements: one hiccup at
-    # first dial must not lose all four compute sections
-    compute_sections = []
+    # first dial must not lose all four compute sections. The serve
+    # section survives even a failed device init: its scheduler is
+    # virtual-time and its calibration self-degrades to defaults
+    compute_sections = [("serve", bench_serve)]
     for attempt in range(3):
         if attempt and past_deadline():
             errors.setdefault(
@@ -775,6 +876,7 @@ def main():
         results["device"] = getattr(bench.dev, "device_kind",
                                     str(bench.dev))
         compute_sections = [
+            ("serve", bench_serve),
             ("train", bench.train),
             ("flash", bench.flash),
             ("decode", bench.decode),

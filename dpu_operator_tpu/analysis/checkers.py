@@ -528,9 +528,12 @@ _NONDETERMINISTIC = {
 _ALLOWED_RANDOM = {"random.Random"}  # seedable constructor — the idiom
 
 #: markers whose tests promise bit-identical replay from a seed: the
-#: scripted-fault matrix (chaos) and the hardware fault-domain storms
-#: (fault) share the invariant
-_DETERMINISTIC_MARKS = ("pytest.mark.chaos", "pytest.mark.fault")
+#: scripted-fault matrix (chaos), the hardware fault-domain storms
+#: (fault) and the serve scheduler harness (serve — its open-loop
+#: arrival process must never silently use unseeded entropy) share the
+#: invariant
+_DETERMINISTIC_MARKS = ("pytest.mark.chaos", "pytest.mark.fault",
+                        "pytest.mark.serve")
 
 
 def _is_deterministic_mark(target) -> bool:
@@ -566,9 +569,9 @@ def _module_is_chaos(tree: ast.Module) -> bool:
 
 class ChaosDeterminismChecker(Checker):
     name = "chaos-determinism"
-    description = ("chaos/fault-marked tests must not call unseeded "
-                   "random or wall-clock time (seeds must replay "
-                   "bit-identically)")
+    description = ("chaos/fault/serve-marked tests must not call "
+                   "unseeded random or wall-clock time (seeds must "
+                   "replay bit-identically)")
 
     def check(self, module: Module) -> Iterator[Violation]:
         if not module.is_test:
@@ -595,8 +598,8 @@ class ChaosDeterminismChecker(Checker):
                 if bad:
                     yield self.violation(
                         module, call,
-                        f"chaos/fault-marked test calls {name}() — "
-                        f"{bad}")
+                        f"chaos/fault/serve-marked test calls {name}() "
+                        f"— {bad}")
 
     @staticmethod
     def _classify(name: str) -> Optional[str]:

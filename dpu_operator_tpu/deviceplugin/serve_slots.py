@@ -1,0 +1,58 @@
+"""Device handler advertising decode-service capacity to kubelet.
+
+The serve scheduler (workloads/serve.py) knows how many more requests
+it could admit right now — free batch slots, derated by free KV blocks.
+This handler turns that number into the ``google.com/tpu-serve-slots``
+extended resource so the *scheduler plane* can route request-serving
+pods (or sidecar routers) to nodes with headroom, exactly the way chips
+are routed today.
+
+ListAndWatch contract (shared with the fault gate, faults/gate.py): the
+advertised ID SET NEVER SHRINKS. The handler enumerates ``max_slots``
+slot ids once and forever; capacity changes flip ids between Healthy
+and Unhealthy. A deletion would make kubelet evict pods holding the
+resource — but a serve slot "vanishing" just means the service is
+momentarily full, which is a health condition, not a topology change.
+tests/test_serve.py runs the zero-spurious-deletion churn regression
+against BOTH producers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class ServeSlotsHandler:
+    """``get_devices()`` for the serve-slots resource.
+
+    *capacity_fn* returns the current advertisable slot count — wire it
+    to ``Scheduler.capacity()["advertisableSlots"]`` (or any judged
+    capacity source). *max_slots* fixes the id universe; a capacity
+    reading above it is clamped (ids must never appear out of nowhere
+    any more than they may vanish). Readings below 0 clamp to 0; an
+    erroring capacity source marks every slot Unhealthy rather than
+    raising — a crashed service has zero admittable slots, but its ids
+    still exist.
+    """
+
+    def __init__(self, capacity_fn: Callable[[], int],
+                 max_slots: int) -> None:
+        if max_slots <= 0:
+            raise ValueError("max_slots must be positive")
+        self.capacity_fn = capacity_fn
+        self.max_slots = max_slots
+
+    def get_devices(self) -> dict:
+        try:
+            capacity = int(self.capacity_fn())
+        except Exception:  # noqa: BLE001 — an unreachable service has
+            # zero capacity; the id set must survive the outage
+            from ..utils import metrics
+            metrics.SWALLOWED_ERRORS.inc(site="serve_slots.capacity")
+            capacity = 0
+        capacity = max(0, min(capacity, self.max_slots))
+        return {
+            f"serve-slot-{i}": {"id": f"serve-slot-{i}",
+                                "healthy": i < capacity}
+            for i in range(self.max_slots)
+        }

@@ -105,6 +105,21 @@ def _vsp_cmds(sub):
                    help="bearer token when /debug/flight is "
                         "auth-filtered")
     p = sub.add_parser(
+        "serve",
+        help="continuous-batching decode service: 'status' renders the "
+             "scheduler snapshot from /debug/serve on --metrics-addr "
+             "(active/queued per SLO class, KV-pool occupancy, "
+             "capacity) plus last-60s TTFT percentiles from the flight "
+             "recorder's serve-kind entries; graceful when the "
+             "endpoint is unreachable (the service may simply not be "
+             "running on this node)")
+    p.add_argument("action", choices=["status"])
+    p.add_argument("--window", type=float, default=60.0,
+                   help="TTFT percentile look-back window in seconds")
+    p.add_argument("--token", default="",
+                   help="bearer token when the debug endpoints are "
+                        "auth-filtered")
+    p = sub.add_parser(
         "handoff",
         help="zero-downtime upgrade: 'begin' asks the daemon (over "
              "--daemon-addr) to freeze mutations and serve its live "
@@ -166,6 +181,37 @@ def handoff_status(snap: dict) -> dict:
             for e in adoptions],
         "history": [e.get("name", "") for e in handoffs],
     }
+    return out
+
+
+def render_serve(snapshot: dict, flight_events: list,
+                 now: float, window_s: float = 60.0) -> dict:
+    """Fold the scheduler's /debug/serve snapshot with the flight
+    recorder's serve-kind FirstToken entries into the `tpuctl serve
+    status` view: the live scheduler state plus TTFT percentiles over
+    the last *window_s* seconds — the at-a-glance answer to "is the
+    service keeping its interactive promise right now"."""
+    ttfts = []
+    for e in flight_events:
+        if e.get("kind") != "serve" or e.get("name") != "FirstToken":
+            continue
+        if e.get("ts", 0.0) < now - window_s:
+            continue
+        try:
+            ttfts.append(float((e.get("attributes") or {})
+                               .get("ttft_s", "")))
+        except ValueError:
+            continue
+    out = {
+        "reachable": True,
+        "scheduler": snapshot,
+        "ttftWindowSeconds": window_s,
+        "ttftSamples": len(ttfts),
+    }
+    if ttfts:
+        from .utils.stats import nearest_rank
+        out["ttftP50Seconds"] = round(nearest_rank(ttfts, 0.50), 4)
+        out["ttftP99Seconds"] = round(nearest_rank(ttfts, 0.99), 4)
     return out
 
 
@@ -245,6 +291,30 @@ def run(args) -> dict:
         from .utils.flight import fetch
         return fetch(args.metrics_addr, token=args.token,
                      path="/debug/health")
+
+    if args.cmd == "serve":  # action == "status" (the only one)
+        import time as _time
+
+        from .utils.flight import fetch
+        try:
+            snap = fetch(args.metrics_addr, token=args.token,
+                         path="/debug/serve")
+        except Exception as e:  # noqa: BLE001 — graceful: the decode
+            # service simply may not run on this node; report, don't
+            # traceback (same convention as faults' missing recorder)
+            print(f"tpuctl: serve endpoint unreachable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+            return {"reachable": False, "error": str(e)}
+        try:
+            events = fetch(args.metrics_addr,
+                           token=args.token).get("events", [])
+        except Exception as e:  # noqa: BLE001 — percentiles are a
+            # bonus: the scheduler snapshot renders without them
+            print(f"tpuctl: flight recorder unavailable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+            events = []
+        return render_serve(snap, events, now=_time.time(),
+                            window_s=args.window)
 
     if args.cmd == "handoff" and args.action == "status":
         from .utils.flight import fetch

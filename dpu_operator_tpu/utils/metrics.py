@@ -482,6 +482,53 @@ FAULT_RECOVERY_SECONDS = REGISTRY.histogram(
     "tpu_fault_recovery_seconds",
     "Recovery MTTR: first quarantine entry to the recovering->healthy "
     "transition, per unit outage")
+# -- continuous-batching decode service (workloads/serve.py) -----------------
+SERVE_REQUESTS = REGISTRY.counter(
+    "tpu_serve_requests_total",
+    "Serve requests by SLO class and outcome (completed / rejected = "
+    "admission queue full / failed)")
+SERVE_TOKENS = REGISTRY.counter(
+    "tpu_serve_tokens_total",
+    "Tokens produced by the decode service, by phase (prefill = first "
+    "tokens, decode = continuation tokens)")
+SERVE_TTFT_SECONDS = REGISTRY.histogram(
+    "tpu_serve_ttft_seconds",
+    "Time-to-first-token per request: arrival to first emitted token "
+    "(queueing + admission + prefill) — the serve-ttft SLO source",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0,
+             30.0, 60.0))
+SERVE_ITL_SECONDS = REGISTRY.histogram(
+    "tpu_serve_itl_seconds",
+    "Inter-token latency per decode iteration (includes prefill "
+    "interference from interleaved admissions) — the serve-tokens SLO "
+    "source",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1.0, 2.5, 5.0))
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_serve_queue_depth",
+    "Requests waiting for admission, by SLO class")
+SERVE_ACTIVE = REGISTRY.gauge(
+    "tpu_serve_active_requests",
+    "Requests currently holding a batch slot, by SLO class")
+SERVE_SLOTS = REGISTRY.gauge(
+    "tpu_serve_batch_slots",
+    "Batch slots by state (free / active) — free slots are half of the "
+    "capacity the device plugin advertises as tpu-serve-slots")
+SERVE_KV_BLOCKS = REGISTRY.gauge(
+    "tpu_serve_kv_blocks",
+    "Paged KV cache blocks by state (free / used); used must return "
+    "to zero when the service drains (the leak gate)")
+SERVE_KV_FRAGMENTATION = REGISTRY.gauge(
+    "tpu_serve_kv_internal_fragmentation",
+    "Fraction of allocated KV token slots not yet written (internal "
+    "fragmentation; external is zero by paging construction)")
+SERVE_PREEMPTIONS = REGISTRY.counter(
+    "tpu_serve_preemptions_total",
+    "Batch-class requests evicted (KV blocks freed, recompute on "
+    "re-admission) to admit an interactive request, by reason")
+SERVE_ADMISSION_REJECTED = REGISTRY.counter(
+    "tpu_serve_admission_rejections_total",
+    "Requests rejected at admission, by SLO class and reason (a rising "
+    "rate is the health engine's first saturation signal)")
 # -- static-analysis gate (opslint exception-hygiene rule) -------------------
 SWALLOWED_ERRORS = REGISTRY._add(_FlightRecordedCounter(
     "tpu_daemon_swallowed_errors_total",
@@ -571,7 +618,9 @@ class MetricsServer:
                  ready_check: Optional[Callable[[], bool]] = None,
                  auth: Optional[Callable[[str], bool]] = None,
                  degraded_check: Optional[Callable[[], list]] = None,
-                 health_check: Optional[Callable[[], dict]] = None) -> None:
+                 health_check: Optional[Callable[[], dict]] = None,
+                 debug_handlers: Optional[
+                     dict[str, Callable[[], dict]]] = None) -> None:
         """*degraded_check* returns the components currently degraded
         (open circuit breakers + watchdog-stalled loops) — surfaced as
         a structured JSON breakdown in the /healthz body. Degraded is
@@ -579,7 +628,10 @@ class MetricsServer:
         it out of rotation would turn one failing dependency into a
         total outage. *health_check* returns the full health-engine
         snapshot (utils/slo.py health_snapshot) served at
-        /debug/health."""
+        /debug/health. *debug_handlers* maps extra ``/debug/...``
+        paths to JSON-snapshot callables (the serve scheduler registers
+        ``/debug/serve`` here); they sit behind the same token filter
+        as /metrics."""
         self.host = host
         self.port = port
         self.registry = registry
@@ -587,6 +639,7 @@ class MetricsServer:
         self.auth = auth
         self.degraded_check = degraded_check
         self.health_check = health_check
+        self.debug_handlers = dict(debug_handlers or {})
         self._server: Optional[ThreadingHTTPServer] = None
 
     def start(self) -> None:
@@ -651,6 +704,23 @@ class MetricsServer:
                         import json
                         body = json.dumps(outer.health_check()).encode()
                         ctype, code = "application/json", 200
+                elif self.path in outer.debug_handlers:
+                    denied = self._auth_denial()
+                    if denied is not None:
+                        code, body, ctype = denied
+                    else:
+                        import json
+                        try:
+                            body = json.dumps(
+                                outer.debug_handlers[self.path]()).encode()
+                            ctype, code = "application/json", 200
+                        except Exception:  # noqa: BLE001 — a broken
+                            # snapshot source must not 500 the whole
+                            # metrics mux; report and keep serving
+                            logging.getLogger(__name__).exception(
+                                "debug handler %s failed", self.path)
+                            body = b"debug snapshot failed"
+                            ctype, code = "text/plain", 500
                 elif self.path == "/healthz":
                     degraded = (outer.degraded_check()
                                 if outer.degraded_check else [])

@@ -282,12 +282,20 @@ CNI_SLOW_SECONDS = 1.0
 #: an apiserver round-trip slower than this burns the kube-client
 #: budget (reconcile loops and CNI ADDs sit behind these calls)
 KUBE_SLOW_SECONDS = 0.5
+#: a first token slower than this burns the serve-ttft budget (the
+#: interactive-class admission contract the scheduler preempts for)
+SERVE_TTFT_SLOW_SECONDS = 2.0
+#: a decode iteration slower than this burns the serve-tokens budget
+#: (inter-token stalls — prefill interference, KV thrash — read as a
+#: frozen stream to the user long before the request "fails")
+SERVE_ITL_SLOW_SECONDS = 0.2
 
 
 def default_slos(rules: Optional[tuple[AlertRule, ...]] = None) -> list[Slo]:
     """The standing SLOs over the live registry series (the table in
     doc/observability.md): CNI handler latency, apiserver client
-    error+latency, and breaker rejections across all wire seams."""
+    error+latency, breaker rejections across all wire seams, and the
+    decode service's serve-ttft / serve-tokens objectives."""
 
     def kube_bad() -> float:
         slow = metrics.KUBE_REQUEST_SECONDS.count_above(KUBE_SLOW_SECONDS)
@@ -321,6 +329,38 @@ def default_slos(rules: Optional[tuple[AlertRule, ...]] = None) -> list[Slo]:
             bad_fn=metrics.BREAKER_REJECTIONS.total, rules=rules,
             description="99.9% of wire-seam calls not short-circuited "
                         "by an open breaker"),
+    ] + serve_slos(rules=rules)
+
+
+def serve_slos(rules: Optional[tuple[AlertRule, ...]] = None) -> list[Slo]:
+    """Standing objectives over the decode service's latency series
+    (workloads/serve.py): first-token latency and inter-token stalls,
+    with admission rejections burning the TTFT budget too — a rejected
+    request is an infinitely-late first token."""
+
+    def ttft_bad() -> float:
+        return (metrics.SERVE_TTFT_SECONDS.count_above(
+            SERVE_TTFT_SLOW_SECONDS)
+            + metrics.SERVE_ADMISSION_REJECTED.total())
+
+    def ttft_total() -> float:
+        return (float(metrics.SERVE_TTFT_SECONDS.count)
+                + metrics.SERVE_ADMISSION_REJECTED.total())
+
+    return [
+        Slo("serve-ttft", component="serve", objective=0.99,
+            total_fn=ttft_total, bad_fn=ttft_bad, rules=rules,
+            description=f"99% of serve requests get a first token "
+                        f"under {SERVE_TTFT_SLOW_SECONDS:g}s (and are "
+                        "not rejected at admission)"),
+        Slo("serve-tokens", component="serve", objective=0.99,
+            total_fn=lambda: float(metrics.SERVE_ITL_SECONDS.count),
+            bad_fn=lambda: metrics.SERVE_ITL_SECONDS.count_above(
+                SERVE_ITL_SLOW_SECONDS),
+            rules=rules,
+            description=f"99% of decode iterations under "
+                        f"{SERVE_ITL_SLOW_SECONDS:g}s inter-token "
+                        "latency"),
     ]
 
 

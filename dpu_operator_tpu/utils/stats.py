@@ -1,0 +1,20 @@
+"""Tiny shared statistics helpers (no third-party deps)."""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+
+def nearest_rank(samples: Sequence[float], frac: float) -> float:
+    """Nearest-rank percentile over a small sample set: index
+    ``ceil(frac * n) - 1``, NOT ``int(frac * n)`` — the latter lands on
+    the max whenever ``frac * n`` is integral, silently reporting p100
+    (bench.py caught exactly that with n=20). Returns 0.0 for an empty
+    set. The single implementation the bench, the serve harness, and
+    ``tpuctl serve`` all share, so their percentiles can never diverge.
+    """
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    return ordered[max(0, math.ceil(frac * len(ordered)) - 1)]

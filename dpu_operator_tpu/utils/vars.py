@@ -36,6 +36,13 @@ DEFAULT_NAD_NAME = "tpunfcni-conf"
 TPU_RESOURCE_NAME = "google.com/tpu"
 ICI_RESOURCE_NAME = "google.com/ici-port"
 
+#: Serving capacity of the continuous-batching decode service
+#: (workloads/serve.py): one unit = one admittable batch slot backed by
+#: enough free KV-pool blocks for a typical request. Advertised by the
+#: device plugin with the same shrink-never-delete ListAndWatch
+#: contract as the fault gate (slots flip Unhealthy, ids never vanish).
+SERVE_RESOURCE_NAME = "google.com/tpu-serve-slots"
+
 # Node label selecting nodes that get a daemon pod
 # (reference: internal/controller/bindata/daemon/99.daemonset.yaml:20-21 "dpu=true").
 NODE_LABEL_KEY = "tpu"

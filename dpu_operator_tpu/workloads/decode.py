@@ -148,14 +148,36 @@ def _scale_bhqk(s):
     return s[..., 0].transpose(0, 2, 1)[:, :, None, :]
 
 
+def _cache_write(cache_t: jax.Array, new_t: jax.Array, pos) -> jax.Array:
+    """Write one step's K/V rows (B, 1, H, ...) into the cache at *pos*
+    — a shared scalar position (the fused generate scan, every row in
+    lockstep) or a per-row (B,) vector (the continuous-batching serve
+    path, where every slot sits at its own sequence position). Scalar
+    keeps the original dynamic_update_slice; vector scatters per row.
+    Both write the same values, so the two paths stay token-identical."""
+    if jnp.ndim(pos) == 1:
+        return cache_t.at[jnp.arange(new_t.shape[0]), pos].set(new_t[:, 0])
+    return jax.lax.dynamic_update_slice(
+        cache_t, new_t, (0, pos) + (0,) * (cache_t.ndim - 2))
+
+
 def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
                 tokens: jax.Array, pos: jax.Array):
     """One decode step: *tokens* (B,) at position *pos* -> (logits (B, V),
-    updated cache)."""
+    updated cache). *pos* is a scalar (all rows at the same position —
+    the generate scan) or a (B,) vector (per-slot positions — the serve
+    scheduler's interleaved batch)."""
     B = tokens.shape[0]
-    x = (_embed_rows(params["embed"], tokens)
-         + jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
-                                        keepdims=False))
+    per_row = jnp.ndim(pos) == 1
+    if per_row:
+        pos_emb = params["pos"][pos]                       # (B, D)
+        # (B,1,1,1) against positions (1,1,1,S) -> per-row causal mask
+        pos_q = pos[:, None, None, None]
+    else:
+        pos_emb = jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
+                                               keepdims=False)
+        pos_q = pos
+    x = _embed_rows(params["embed"], tokens) + pos_emb
     x = x.astype(cfg.dtype)[:, None, :]          # (B, 1, D)
     positions = jnp.arange(cfg.max_seq)
     new_cache = []
@@ -171,14 +193,10 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
         if "k_q" in layer_cache:  # KV8: int8 cache, fused dequant
             kq, ks = _kv_quant(k)
             vq, vs = _kv_quant(v)
-            ck = jax.lax.dynamic_update_slice(
-                layer_cache["k_q"], kq, (0, pos, 0, 0))
-            cks = jax.lax.dynamic_update_slice(
-                layer_cache["k_s"], ks, (0, pos, 0, 0))
-            cv = jax.lax.dynamic_update_slice(
-                layer_cache["v_q"], vq, (0, pos, 0, 0))
-            cvs = jax.lax.dynamic_update_slice(
-                layer_cache["v_s"], vs, (0, pos, 0, 0))
+            ck = _cache_write(layer_cache["k_q"], kq, pos)
+            cks = _cache_write(layer_cache["k_s"], ks, pos)
+            cv = _cache_write(layer_cache["v_q"], vq, pos)
+            cvs = _cache_write(layer_cache["v_s"], vs, pos)
             new_cache.append({"k_q": ck, "k_s": cks,
                               "v_q": cv, "v_s": cvs})
             # q . k_q on the MXU (convert fused into the cache read);
@@ -186,7 +204,7 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
             att = jnp.einsum("bqhd,bkhd->bhqk", q, ck.astype(cfg.dtype))
             att = (att.astype(jnp.float32) * _scale_bhqk(cks)
                    / np.sqrt(cfg.d_head))
-            att = jnp.where(positions[None, None, None, :] <= pos,
+            att = jnp.where(positions[None, None, None, :] <= pos_q,
                             att, -1e9)
             att = jax.nn.softmax(att, -1)
             # fold the v scales into the attention weights, then one
@@ -196,15 +214,13 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
                            cv.astype(cfg.dtype)).reshape(
                 B, 1, cfg.d_model)
         else:
-            ck = jax.lax.dynamic_update_slice(
-                layer_cache["k"], k, (0, pos, 0, 0))
-            cv = jax.lax.dynamic_update_slice(
-                layer_cache["v"], v, (0, pos, 0, 0))
+            ck = _cache_write(layer_cache["k"], k, pos)
+            cv = _cache_write(layer_cache["v"], v, pos)
             new_cache.append({"k": ck, "v": cv})
 
             att = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / np.sqrt(
                 cfg.d_head)
-            att = jnp.where(positions[None, None, None, :] <= pos,
+            att = jnp.where(positions[None, None, None, :] <= pos_q,
                             att, -1e9)
             att = jax.nn.softmax(att.astype(jnp.float32),
                                  -1).astype(cfg.dtype)
@@ -221,6 +237,20 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
     x = _rmsnorm(x, params["out_norm"])
     logits = _logits(x[:, 0, :], params["embed"])
     return logits, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decode_step(params: dict, cfg: TransformerConfig, cache: list,
+                tokens: jax.Array, pos: jax.Array):
+    """One compiled decode iteration — the reusable half of the
+    prefill/decode pair the serve scheduler drives. *tokens* (B,) at
+    *pos* (scalar, or a (B,) vector of per-slot positions) -> (logits
+    (B, V), updated cache). Compiled ONCE per (cfg, cache shape): the
+    continuous-batching loop calls this every iteration with varying
+    token/position VALUES and never re-traces. The fused generate()
+    scan runs the same `_decode_one` body, so the two paths cannot
+    drift (asserted token-identical in tests/test_decode.py)."""
+    return _decode_one(params, cfg, cache, tokens, pos)
 
 
 def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,

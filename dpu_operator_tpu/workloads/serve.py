@@ -1,0 +1,870 @@
+"""Continuous-batching decode service: the request path behind the operator.
+
+Eight PRs of control plane and a benched decode kernel, but nothing in
+the repo ever *served a request*. This module closes that gap with the
+Orca/vLLM design: **iteration-level scheduling** over a **block-paged KV
+cache** (:mod:`.kv_pool`):
+
+- the scheduler's unit of progress is one :meth:`Scheduler.step` —
+  ingest due arrivals, admit into free batch slots (prefill), run ONE
+  decode iteration for every active request — so a finishing request
+  frees its slot for the next queued one *this* iteration instead of
+  waiting for the whole batch to drain (static batching's tail loss);
+- requests carry an SLO class: ``interactive`` requests outrank
+  ``batch`` at admission and, under slot/KV pressure, PREEMPT them via
+  recomputable eviction (the victim's blocks are freed, its generated
+  tokens kept; re-admission re-prefills prompt+tokens — paged blocks
+  make eviction cheap, recompute makes it lossless);
+- time is virtual: every iteration advances the scheduler clock by the
+  cost model's modeled duration, so a seeded run is bit-identical
+  (``make serve-check`` asserts two consecutive traces are equal) and
+  an *open-loop* Poisson arrival process — arrivals keep coming whether
+  or not the service keeps up, the millions-of-users traffic shape — is
+  replayable. A real clock is injectable for the production wrapper.
+
+Operator seams (the reason this lives behind the operator at all):
+
+- **capacity**: :meth:`Scheduler.capacity` reports free slots/blocks;
+  :class:`~dpu_operator_tpu.deviceplugin.serve_slots.ServeSlotsHandler`
+  turns it into the ``google.com/tpu-serve-slots`` extended resource
+  (shrink-never-delete, the fault gate's ListAndWatch contract);
+- **health**: TTFT/ITL land in ``tpu_serve_ttft_seconds`` /
+  ``tpu_serve_itl_seconds``, judged by the standing ``serve-ttft`` /
+  ``serve-tokens`` SLOs (utils/slo.py); rejections and preemptions
+  emit ``ServeAdmissionRejected`` / ``ServePreempted`` Events; each
+  step runs inside a task-scoped watchdog heartbeat;
+- **introspection**: :meth:`Scheduler.snapshot` is served at
+  ``/debug/serve`` (MetricsServer debug handler) and rendered by
+  ``tpuctl serve status``; first tokens are flight-recorded
+  (kind=``serve``) so the CLI can compute last-60s TTFT percentiles.
+
+Token generation is pluggable: :class:`SimExecutor` emits synthetic
+tokens (scheduling tests and the serving bench), :class:`JaxSlotExecutor`
+drives the real model through the refactored
+:func:`~dpu_operator_tpu.workloads.decode.prefill` /
+:func:`~dpu_operator_tpu.workloads.decode.decode_step` pair with
+per-slot positions — compiled once, never re-traced, token-identical
+with the fused ``generate()`` scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import logging
+import threading
+from typing import Callable, Optional
+
+from ..utils import flight, metrics, watchdog
+from ..utils.stats import nearest_rank
+from .kv_pool import KvBlockPool
+
+log = logging.getLogger(__name__)
+
+INTERACTIVE = "interactive"
+BATCH = "batch"
+
+# request lifecycle
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+REJECTED = "rejected"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. *output_len* is the number of tokens to
+    generate; *prompt* (actual ids) is only needed by the JAX executor —
+    the scheduler itself reasons in lengths."""
+
+    rid: str
+    prompt_len: int
+    output_len: int
+    slo_class: str = BATCH
+    arrival_s: float = 0.0
+    prompt: Optional[tuple] = None
+    # runtime state (owned by the scheduler)
+    state: str = QUEUED
+    slot: Optional[int] = None
+    tokens: list = dataclasses.field(default_factory=list)
+    admitted_s: Optional[float] = None
+    first_token_s: Optional[float] = None
+    finish_s: Optional[float] = None
+    preemptions: int = 0
+    reject_reason: str = ""
+
+    def fresh_copy(self) -> "Request":
+        """Spec-only copy (id, lengths, class, arrival): re-running the
+        same arrivals through a second scheduler must not inherit the
+        first run's tokens/state — dataclasses.replace would share the
+        mutable runtime fields."""
+        return Request(rid=self.rid, prompt_len=self.prompt_len,
+                       output_len=self.output_len,
+                       slo_class=self.slo_class,
+                       arrival_s=self.arrival_s, prompt=self.prompt)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_s is None:
+            return None
+        return self.first_token_s - self.arrival_s
+
+    def total_tokens(self) -> int:
+        """KV rows the full sequence needs (reservation unit)."""
+        return self.prompt_len + self.output_len
+
+
+@dataclasses.dataclass(frozen=True)
+class CostModel:
+    """Modeled iteration costs (virtual seconds). Decode is memory-bound
+    (BASELINE.md): one iteration streams weights once for the whole
+    batch plus each sequence's KV, so cost is a base sweep plus a small
+    per-sequence term — which is exactly why continuous batching wins
+    (tokens/iteration grows much faster than cost/iteration). Prefill
+    is compute-bound and linear in prompt tokens. Calibratable from a
+    real backend (:func:`calibrate_cost_model`)."""
+
+    decode_base_s: float = 0.025
+    decode_per_seq_s: float = 0.0005
+    prefill_per_token_s: float = 0.0002
+
+    def decode_s(self, batch: int) -> float:
+        return self.decode_base_s + self.decode_per_seq_s * batch if batch \
+            else 0.0
+
+    def prefill_s(self, tokens: int) -> float:
+        return self.prefill_per_token_s * tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Scheduler shape. ``kv_blocks * kv_block_size`` is the token
+    budget the whole batch shares; ``queue_limit`` bounds each SLO
+    class's admission queue (beyond it requests are REJECTED — open
+    loop means the world does not stop sending because we are full).
+    ``static`` reproduces the pre-continuous baseline: admission only
+    when the previous batch fully drained."""
+
+    slots: int = 8
+    kv_blocks: int = 256
+    kv_block_size: int = 16
+    queue_limit: int = 64
+    ttft_bound_s: float = 1.0
+    #: tokens a "typical" request needs — sizes the advertisable-slot
+    #: derate so the device plugin never advertises a slot the KV pool
+    #: could not actually feed
+    typical_tokens: int = 128
+    static: bool = False
+    preemption: bool = True
+
+
+class SimExecutor:
+    """Deterministic synthetic tokens — the scheduling harness executor.
+    Token values are a pure function of (rid, position) so traces are
+    comparable across runs without any model in the loop."""
+
+    def begin(self, req: Request, slot: int) -> int:
+        # the CONTINUATION token: after a preemption the request
+        # re-prefills prompt+tokens, so the next token follows the
+        # stream it already has (mirrors JaxSlotExecutor exactly)
+        return self._token(req, len(req.tokens))
+
+    def step(self, active: list) -> dict:
+        return {slot: self._token(req, len(req.tokens))
+                for slot, req in active}
+
+    @staticmethod
+    def _token(req: Request, n: int) -> int:
+        acc = 0
+        for ch in req.rid:
+            acc = (acc * 131 + ord(ch)) % 50_021
+        return (acc + 7919 * n) % 50_021
+
+
+class JaxSlotExecutor:
+    """Real tokens over a slotted dense KV cache, driven one iteration
+    at a time through the refactored prefill/decode_step pair.
+
+    Slot *i* owns row *i* of the (slots, max_seq, H, Dh) cache; each
+    slot sits at its own position (the ``pos`` vector), which is the
+    capability :func:`decode.decode_step` grew for this module. Greedy
+    decoding; admission prefills the request's prompt (plus any tokens
+    it generated before a preemption — recomputable eviction) into the
+    slot's cache row. decode_step is compiled once per cache shape:
+    the continuous loop never re-traces.
+    """
+
+    def __init__(self, params: dict, cfg, slots: int) -> None:
+        import numpy as np
+
+        from .decode import init_kv_cache
+
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.cache = init_kv_cache(cfg, slots)
+        self.pos = np.zeros(slots, dtype=np.int32)
+        self.last = np.zeros(slots, dtype=np.int32)
+
+    def begin(self, req: Request, slot: int) -> int:
+        import jax.numpy as jnp
+
+        from .decode import prefill
+
+        if req.prompt is None:
+            raise ValueError(f"request {req.rid} has no prompt ids "
+                             "(JaxSlotExecutor needs real tokens)")
+        ids = list(req.prompt) + list(req.tokens)
+        if len(ids) + req.output_len - len(req.tokens) > self.cfg.max_seq:
+            raise ValueError(f"request {req.rid} exceeds max_seq "
+                             f"{self.cfg.max_seq}")
+        cache1, logits = prefill(self.params, self.cfg,
+                                 jnp.asarray([ids], jnp.int32))
+        for layer, one in zip(self.cache, cache1):
+            for key in layer:
+                layer[key] = layer[key].at[slot].set(one[key][0])
+        tok = int(jnp.argmax(logits[0]))
+        self.pos[slot] = len(ids)
+        self.last[slot] = tok
+        return tok
+
+    def step(self, active: list) -> dict:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .decode import decode_step
+
+        # inactive slots decode harmlessly at position 0: their cache
+        # row is dead until the next begin() overwrites it in full
+        tokens = jnp.asarray(self.last)
+        pos = jnp.asarray(np.clip(self.pos, 0, self.cfg.max_seq - 1))
+        logits, self.cache = decode_step(self.params, self.cfg,
+                                         self.cache, tokens, pos)
+        picked = np.asarray(jnp.argmax(logits, axis=-1))
+        out = {}
+        for slot, req in active:
+            tok = int(picked[slot])
+            self.last[slot] = tok
+            self.pos[slot] += 1
+            out[slot] = tok
+        return out
+
+
+class Scheduler:
+    """Iteration-level continuous-batching scheduler (the tentpole).
+
+    Drive it with :meth:`step` (one iteration) or :meth:`run` (until
+    drained). All admission/preemption/completion decisions are
+    appended to :attr:`trace` as primitive tuples — the determinism
+    artifact ``make serve-check`` compares across runs.
+    """
+
+    def __init__(self, config: ServeConfig,
+                 executor=None,
+                 cost_model: Optional[CostModel] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 heartbeat: Optional[watchdog.Heartbeat] = None) -> None:
+        self.config = config
+        self.executor = executor if executor is not None else SimExecutor()
+        self.cost = cost_model if cost_model is not None else CostModel()
+        self._clock = clock
+        self.heartbeat = heartbeat
+        self.pool = KvBlockPool(config.kv_blocks, config.kv_block_size)
+        self.now = 0.0 if clock is None else clock()
+        #: guards _pending (submit() may race the step loop)
+        self._lock = threading.Lock()
+        #: guards the scheduler's mutable state as a whole against
+        #: cross-thread READERS: the DecodeService thread steps while
+        #: the MetricsServer HTTP thread serves /debug/serve and the
+        #: device plugin's ListAndWatch reads capacity() — an unlocked
+        #: dict comprehension over _active would die mid-mutation.
+        #: Reentrant (snapshot -> capacity); ordered before _lock.
+        self._state_lock = threading.RLock()
+        #: future arrivals as a (arrival_s, seq, Request) min-heap —
+        #: O(log n) submit/ingest, ties broken by submission order
+        self._pending: list[tuple] = []
+        self._submit_seq = 0
+        self._queues: dict[str, list[Request]] = {INTERACTIVE: [],
+                                                  BATCH: []}
+        self._active: dict[int, Request] = {}
+        self._free_slots: list[int] = list(range(config.slots))
+        self.completed: list[Request] = []
+        self.rejected: list[Request] = []
+        self.completed_total = 0
+        self.rejected_total = 0
+        self.iterations = 0
+        self.preemptions = 0
+        #: when set, trace/completed/rejected are trimmed to the last N
+        #: entries after each step — a long-lived DecodeService must not
+        #: grow without bound; the test harness leaves it None and reads
+        #: the full history
+        self.history_limit: Optional[int] = None
+        #: primitive-tuple event log — the bit-identical determinism
+        #: artifact (never includes wall-clock values)
+        self.trace: list[tuple] = []
+        self._recent_ttft: list[float] = []
+        self._update_gauges()
+
+    # -- intake ---------------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        """Enqueue a future arrival (arrival_s is on the scheduler's
+        clock). Requests may be submitted in any order; ingestion is by
+        arrival time, ties broken by submission order."""
+        with self._lock:
+            self._submit_seq += 1
+            heapq.heappush(self._pending,
+                           (req.arrival_s, self._submit_seq, req))
+
+    def submit_all(self, reqs: list) -> None:
+        for r in reqs:
+            self.submit(r)
+
+    # -- one iteration --------------------------------------------------------
+    def step(self) -> bool:
+        """One scheduler iteration. Returns False when there is nothing
+        left to do (no active, queued, or pending work)."""
+        with watchdog.task(self.heartbeat), self._state_lock:
+            return self._step_inner()
+
+    def _step_inner(self) -> bool:
+        if self._clock is not None:
+            self.now = self._clock()
+        self._ingest()
+        if not self._active and not self._queued_count():
+            nxt = self._next_arrival()
+            if nxt is None:
+                self._update_gauges()
+                return False
+            if self._clock is None:
+                # idle fast-forward: virtual time jumps to the next
+                # arrival instead of spinning empty iterations
+                self.now = max(self.now, nxt)
+                self._ingest()
+            else:
+                # real clock: nothing due yet — report idle so the
+                # service loop waits instead of busy-spinning
+                self._update_gauges()
+                return False
+        self.iterations += 1
+        it = self.iterations
+        admitted = self._admit(it)
+        for req in admitted:
+            self._advance(self.cost.prefill_s(
+                req.prompt_len + len(req.tokens)))
+            first = len(req.tokens) == 0
+            tok = self.executor.begin(req, req.slot)
+            self._tick()  # real clock: stamp TTFT after the prefill ran
+            req.tokens.append(tok)
+            self.pool.set_used_tokens(req.rid,
+                                      req.prompt_len + len(req.tokens))
+            metrics.SERVE_TOKENS.inc(phase="prefill")
+            if first:
+                req.first_token_s = self.now
+                self._record_first_token(req)
+        active = sorted((slot, req) for slot, req in self._active.items()
+                        if len(req.tokens) < req.output_len)
+        if active:
+            iter_start = self.now
+            self._advance(self.cost.decode_s(len(active)))
+            toks = self.executor.step(active)
+            self._tick()
+            # real clock: the MEASURED iteration time (the serve-tokens
+            # SLO must see a 3 s stall as 3 s, not as the modeled cost);
+            # virtual clock: the modeled cost just advanced
+            metrics.SERVE_ITL_SECONDS.observe(self.now - iter_start)
+            for slot, req in active:
+                req.tokens.append(toks[slot])
+                self.pool.set_used_tokens(
+                    req.rid, req.prompt_len + len(req.tokens))
+                metrics.SERVE_TOKENS.inc(phase="decode")
+            self.trace.append(("decode", it, len(active)))
+        for slot in sorted(self._active):
+            req = self._active[slot]
+            if len(req.tokens) >= req.output_len:
+                self._complete(it, slot, req)
+        if self.history_limit is not None:
+            del self.trace[:-self.history_limit]
+            del self.completed[:-self.history_limit]
+            del self.rejected[:-self.history_limit]
+        self._update_gauges()
+        return True
+
+    def run(self, max_steps: int = 1_000_000) -> int:
+        """Step until drained (or *max_steps*); returns steps taken."""
+        steps = 0
+        while steps < max_steps and self.step():
+            steps += 1
+        return steps
+
+    # -- internals ------------------------------------------------------------
+    def _advance(self, cost_s: float) -> None:
+        if self._clock is None:
+            self.now += cost_s
+
+    def _tick(self) -> None:
+        """Under a real clock, re-read it so latency stamps (TTFT, ITL)
+        measure what actually elapsed around the executor, not the
+        modeled cost; virtual time is advanced by _advance instead."""
+        if self._clock is not None:
+            self.now = self._clock()
+
+    def _next_arrival(self) -> Optional[float]:
+        with self._lock:
+            return self._pending[0][0] if self._pending else None
+
+    def _queued_count(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _ingest(self) -> None:
+        """Move due arrivals into their class queue; reject past the
+        queue bound (the open-loop contract: the world keeps sending)
+        and reject requests whose KV reservation could NEVER fit the
+        pool — left queued, such a request would wedge the priority
+        head forever (admission can't satisfy it, ingest would never
+        revisit it, and everything behind it starves)."""
+        while True:
+            with self._lock:
+                if not self._pending \
+                        or self._pending[0][0] > self.now:
+                    return
+                _, _, req = heapq.heappop(self._pending)
+            if self.pool.blocks_for_tokens(req.total_tokens()) \
+                    > self.pool.num_blocks:
+                self._reject(req, "kv_too_large",
+                             f"request {req.rid} needs "
+                             f"{req.total_tokens()} KV token slots; the "
+                             f"whole pool holds "
+                             f"{self.pool.num_blocks * self.pool.block_size}")
+                continue
+            queue = self._queues[req.slo_class]
+            if len(queue) >= self.config.queue_limit:
+                self._reject(req, "queue_full",
+                             f"serve admission queue for class "
+                             f"{req.slo_class} is full "
+                             f"({self.config.queue_limit}); rejecting "
+                             "new requests (service saturated)")
+            else:
+                queue.append(req)
+
+    def _reject(self, req: Request, reason: str, message: str) -> None:
+        req.state = REJECTED
+        req.reject_reason = reason
+        self.rejected.append(req)
+        self.rejected_total += 1
+        self.trace.append(("reject", self.iterations + 1,
+                           req.rid, req.slo_class, reason))
+        metrics.SERVE_ADMISSION_REJECTED.inc(
+            slo_class=req.slo_class, reason=reason)
+        metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
+                                   outcome="rejected")
+        flight.record("serve", "AdmissionRejected", attributes={
+            "rid": req.rid, "class": req.slo_class, "reason": reason})
+        watchdog.emit_health_event(
+            "ServeAdmissionRejected", message, "Warning",
+            series=f"serve-admission/{req.slo_class}")
+
+    def _admit(self, it: int) -> list:
+        """Admission pass: interactive strictly before batch; under the
+        static baseline, only into an empty batch. Returns the requests
+        admitted (prefill pending)."""
+        if self.config.static and self._active:
+            return []
+        admitted: list[Request] = []
+        while self._free_slots or self._can_preempt_for_head():
+            req = self._head()
+            if req is None:
+                break
+            blocks = self.pool.blocks_for_tokens(req.total_tokens())
+            if not self._free_slots or not self.pool.can_alloc(blocks):
+                if not (req.slo_class == INTERACTIVE
+                        and self.config.preemption
+                        and self._preempt_for(it, req, blocks)):
+                    break
+            if self.pool.alloc(req.rid, blocks) is None:
+                break  # defensive: preemption freed less than judged
+            self._queues[req.slo_class].pop(0)
+            slot = self._free_slots.pop(0)
+            req.slot = slot
+            req.state = RUNNING
+            req.admitted_s = self.now
+            self._active[slot] = req
+            admitted.append(req)
+            self.trace.append(("admit", it, req.rid, req.slo_class,
+                               slot, blocks))
+        return admitted
+
+    def _head(self) -> Optional[Request]:
+        for cls in (INTERACTIVE, BATCH):
+            if self._queues[cls]:
+                return self._queues[cls][0]
+        return None
+
+    def _can_preempt_for_head(self) -> bool:
+        req = self._head()
+        return (req is not None and req.slo_class == INTERACTIVE
+                and self.config.preemption
+                and any(r.slo_class == BATCH
+                        for r in self._active.values()))
+
+    def _preempt_for(self, it: int, req: Request, blocks: int) -> bool:
+        """Evict batch-class victims (latest-admitted first — least
+        progress, cheapest recompute) until *req* fits. Victims keep
+        their generated tokens and requeue at the FRONT of the batch
+        queue; their KV is recomputed on re-admission."""
+        victims = sorted(
+            (r for r in self._active.values() if r.slo_class == BATCH),
+            key=lambda r: (-(r.admitted_s or 0.0), r.rid))
+        progressed = False
+        for victim in victims:
+            if self._free_slots and self.pool.can_alloc(blocks):
+                break
+            slot = victim.slot
+            self.pool.free(victim.rid)
+            del self._active[slot]
+            self._free_slots.append(slot)
+            self._free_slots.sort()
+            victim.slot = None
+            victim.state = QUEUED
+            victim.preemptions += 1
+            self.preemptions += 1
+            self._queues[BATCH].insert(0, victim)
+            progressed = True
+            self.trace.append(("preempt", it, victim.rid, req.rid))
+            metrics.SERVE_PREEMPTIONS.inc(reason="kv_pressure")
+            flight.record("serve", "Preempted", attributes={
+                "rid": victim.rid, "for": req.rid,
+                "tokens_done": str(len(victim.tokens))})
+            watchdog.emit_health_event(
+                "ServePreempted",
+                f"batch-class request {victim.rid} evicted "
+                f"(recomputable) to admit interactive {req.rid} under "
+                "KV/slot pressure", "Normal", series="serve-preempt")
+        return progressed and bool(self._free_slots) \
+            and self.pool.can_alloc(blocks)
+
+    def _complete(self, it: int, slot: int, req: Request) -> None:
+        self.pool.free(req.rid)
+        del self._active[slot]
+        self._free_slots.append(slot)
+        self._free_slots.sort()
+        req.slot = None
+        req.state = DONE
+        req.finish_s = self.now
+        self.completed.append(req)
+        self.completed_total += 1
+        self.trace.append(("complete", it, req.rid, len(req.tokens)))
+        metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
+                                   outcome="completed")
+        flight.record("serve", "Completed", attributes={
+            "rid": req.rid, "class": req.slo_class,
+            "tokens": str(len(req.tokens)),
+            "preemptions": str(req.preemptions)})
+
+    def _record_first_token(self, req: Request) -> None:
+        ttft = req.ttft_s or 0.0
+        metrics.SERVE_TTFT_SECONDS.observe(ttft)
+        self._recent_ttft.append(ttft)
+        del self._recent_ttft[:-64]
+        flight.record("serve", "FirstToken", attributes={
+            "rid": req.rid, "class": req.slo_class,
+            "ttft_s": f"{ttft:.6f}"})
+
+    def _update_gauges(self) -> None:
+        for cls in (INTERACTIVE, BATCH):
+            metrics.SERVE_QUEUE_DEPTH.set(float(len(self._queues[cls])),
+                                          slo_class=cls)
+            metrics.SERVE_ACTIVE.set(
+                float(sum(1 for r in self._active.values()
+                          if r.slo_class == cls)), slo_class=cls)
+        metrics.SERVE_SLOTS.set(float(len(self._free_slots)),
+                                state="free")
+        metrics.SERVE_SLOTS.set(float(len(self._active)), state="active")
+
+    # -- operator seams -------------------------------------------------------
+    def capacity(self) -> dict:
+        """What the device plugin advertises: slots that could take a
+        request NOW — free batch slots, derated so every advertised
+        slot is backed by enough free KV blocks for a typical request
+        (an unfeedable slot would admit-then-starve)."""
+        typical = self.pool.blocks_for_tokens(self.config.typical_tokens)
+        with self._state_lock:
+            free_slots = len(self._free_slots)
+        free_blocks = self.pool.free_blocks()
+        feedable = free_blocks // max(typical, 1)
+        return {
+            "slots": self.config.slots,
+            "freeSlots": free_slots,
+            "freeKvBlocks": free_blocks,
+            "advertisableSlots": min(free_slots, feedable),
+        }
+
+    def snapshot(self) -> dict:
+        """JSON snapshot for ``/debug/serve`` and ``tpuctl serve``.
+        Taken under the state lock: the HTTP thread must never iterate
+        ``_active`` while the step loop mutates it."""
+        with self._state_lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
+        queued = {cls: [r.rid for r in q]
+                  for cls, q in self._queues.items()}
+        active = {cls: sorted(r.rid for r in self._active.values()
+                              if r.slo_class == cls)
+                  for cls in (INTERACTIVE, BATCH)}
+        return {
+            "now_s": round(self.now, 6),
+            "iterations": self.iterations,
+            "active": active,
+            "queued": queued,
+            "queueDepth": {cls: len(q)
+                           for cls, q in self._queues.items()},
+            "kv": self.pool.snapshot(),
+            "capacity": self.capacity(),
+            "completed": self.completed_total,
+            "rejected": self.rejected_total,
+            "preemptions": self.preemptions,
+            "recentTtftS": [round(t, 6)
+                            for t in self._recent_ttft[-16:]],
+        }
+
+
+class DecodeService:
+    """Production wrapper: a background thread driving the scheduler,
+    heartbeat-registered like every long-lived loop, with the snapshot
+    wired into a MetricsServer as ``/debug/serve``. Tests drive
+    :meth:`Scheduler.step` directly; this shell is for the pod."""
+
+    def __init__(self, scheduler: Scheduler,
+                 idle_interval_s: float = 0.05) -> None:
+        self.scheduler = scheduler
+        self.idle_interval_s = idle_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def debug_handlers(self) -> dict:
+        return {"/debug/serve": self.scheduler.snapshot}
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        if self.scheduler.heartbeat is None:
+            self.scheduler.heartbeat = watchdog.register(
+                "serve.scheduler", deadline=60.0, periodic=False)
+        if self.scheduler.history_limit is None:
+            # a long-lived service must not grow trace/completed/
+            # rejected without bound (snapshot totals stay monotone)
+            self.scheduler.history_limit = 4096
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-scheduler")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self.scheduler.step():
+                # drained: level-triggered wait for the next submit
+                self._stop.wait(self.idle_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
+        if self.scheduler.heartbeat is not None:
+            self.scheduler.heartbeat.close()
+            self.scheduler.heartbeat = None
+
+
+# -- open-loop traffic --------------------------------------------------------
+
+def open_loop_arrivals(seed: int, rate_rps: float, horizon_s: float,
+                       prompt_lens: tuple = (16, 128),
+                       output_lens: tuple = (8, 128),
+                       interactive_frac: float = 0.5,
+                       id_prefix: str = "r") -> list:
+    """Seeded Poisson arrival process with mixed prompt/output lengths
+    — the open-loop traffic shape (arrivals are independent of service
+    progress; a closed loop would hide queueing collapse). Lengths are
+    uniform over the given inclusive ranges; class is Bernoulli."""
+    import random
+    rng = random.Random(seed)
+    out: list[Request] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate_rps)
+        if t > horizon_s:
+            return out
+        out.append(Request(
+            rid=f"{id_prefix}{len(out)}",
+            prompt_len=rng.randint(*prompt_lens),
+            output_len=rng.randint(*output_lens),
+            slo_class=INTERACTIVE if rng.random() < interactive_frac
+            else BATCH,
+            arrival_s=t))
+
+
+def run_open_loop(config: ServeConfig, cost_model: CostModel,
+                  arrivals: list, max_steps: int = 200_000) -> dict:
+    """Run one seeded open-loop experiment to drain; report the serving
+    metrics the BENCH series records. Aggregate tokens/s is total
+    generated tokens over the busy makespan (virtual time)."""
+    sched = Scheduler(config, executor=SimExecutor(),
+                      cost_model=cost_model)
+    sched.submit_all(arrivals)
+    occupancies: list[float] = []
+    steps = 0
+    while steps < max_steps and sched.step():
+        steps += 1
+        occupancies.append(sched.pool.occupancy())
+    done = sched.completed
+    tokens = sum(len(r.tokens) for r in done)
+    ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
+    makespan = max((r.finish_s for r in done), default=0.0)
+    # per-request mean ITL: decode duration spread over generated tokens
+    itls = [(r.finish_s - r.first_token_s) / max(len(r.tokens) - 1, 1)
+            for r in done if r.first_token_s is not None
+            and r.finish_s is not None and len(r.tokens) > 1]
+    return {
+        "requests": len(arrivals),
+        "completed": len(done),
+        "rejected": len(sched.rejected),
+        "preemptions": sched.preemptions,
+        "tokens": tokens,
+        "makespan_s": round(makespan, 4),
+        "tokens_per_s": round(tokens / makespan, 2) if makespan else 0.0,
+        "ttft_p50_s": round(nearest_rank(ttfts, 0.50), 4),
+        "ttft_p99_s": round(nearest_rank(ttfts, 0.99), 4),
+        "itl_p99_s": round(nearest_rank(itls, 0.99), 4),
+        "kv_occupancy_mean": round(
+            sum(occupancies) / len(occupancies), 4) if occupancies
+        else 0.0,
+        "kv_occupancy_max": round(max(occupancies), 4) if occupancies
+        else 0.0,
+        "kv_blocks_leaked": sched.pool.outstanding(),
+        "trace_events": len(sched.trace),
+    }
+
+
+def compare_batching(config: ServeConfig, cost_model: CostModel,
+                     arrivals: list) -> dict:
+    """Continuous vs static batching on the SAME seeded arrivals: the
+    >=1.5x aggregate-tokens/s acceptance gate. Static batching admits a
+    batch and drains it fully — every finished request's slot idles
+    until the batch's straggler completes; continuous refills the slot
+    the same iteration."""
+    # both modes get an unbounded queue: a rejection asymmetry would
+    # change the token totals and make the throughput ratio meaningless
+    cont_cfg = dataclasses.replace(config, queue_limit=1_000_000)
+    cont = run_open_loop(cont_cfg, cost_model,
+                         [r.fresh_copy() for r in arrivals])
+    static_cfg = dataclasses.replace(cont_cfg, static=True,
+                                     preemption=False)
+    stat = run_open_loop(static_cfg, cost_model,
+                         [r.fresh_copy() for r in arrivals])
+    ratio = (cont["tokens_per_s"] / stat["tokens_per_s"]
+             if stat["tokens_per_s"] else float("inf"))
+    return {"continuous": cont, "static": stat,
+            "speedup": round(ratio, 3)}
+
+
+def calibrate_cost_model(cfg=None, slots: int = 8,
+                         prompt_len: int = 32) -> CostModel:
+    """Measure real per-iteration costs of the refactored kernel pair
+    on the local backend (tiny config on CPU CI, the flagship on a
+    chip) and fit the linear model the serving bench replays. Kept
+    OUT of the serve-check gate — measurement is wall-clock; the gate
+    uses fixed constants."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from .decode import decode_step, init_kv_cache, prefill
+    from .model import TransformerConfig, init_params
+
+    if cfg is None:
+        cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_seq=256)
+    params = init_params(jax.random.key(0), cfg)
+
+    def timed(fn, iters: int = 8) -> float:
+        fn()  # compile
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (_time.perf_counter() - t0) / iters
+
+    prompt = jnp.ones((1, prompt_len), jnp.int32)
+    prefill_s = timed(lambda: jax.block_until_ready(
+        prefill(params, cfg, prompt)[1]))
+
+    def one_decode(batch: int) -> float:
+        cache = init_kv_cache(cfg, batch)
+        toks = jnp.zeros((batch,), jnp.int32)
+        pos = jnp.full((batch,), prompt_len, jnp.int32)
+        return timed(lambda: jax.block_until_ready(
+            decode_step(params, cfg, cache, toks, pos)[0]))
+
+    d1, dn = one_decode(1), one_decode(slots)
+    per_seq = max((dn - d1) / max(slots - 1, 1), 1e-6)
+    base = max(d1 - per_seq, 1e-6)
+    return CostModel(decode_base_s=base, decode_per_seq_s=per_seq,
+                     prefill_per_token_s=max(
+                         prefill_s / prompt_len, 1e-7))
+
+
+def bench_serving(seed: int = 0, loads: tuple = (0.5, 0.8, 1.1),
+                  cost_model: Optional[CostModel] = None,
+                  config: Optional[ServeConfig] = None,
+                  horizon_s: float = 60.0) -> dict:
+    """The bench.py ``serve`` section: open-loop Poisson traffic at
+    several offered loads (fractions of the modeled peak token rate),
+    plus the continuous-vs-static comparison at the middle load. All
+    virtual-time over the (measured or default) cost model; seeded, so
+    the record is reproducible."""
+    config = config or ServeConfig()
+    cm = cost_model or CostModel()
+    # modeled capacity per request at full batch: its share of the
+    # prefill time PLUS its share of every decode iteration. Leaving
+    # prefill out would map "0.5 offered load" to a hard overload on
+    # any backend where prefill dominates (CPU calibration does)
+    prompt_mean = (16 + 128) / 2.0
+    output_mean = (8 + 128) / 2.0
+    per_request_s = (cm.prefill_s(prompt_mean)
+                     + output_mean * cm.decode_s(config.slots)
+                     / config.slots)
+    capacity_rps = 1.0 / per_request_s
+    peak_tok_s = capacity_rps * output_mean
+    out: dict = {
+        "seed": seed,
+        "slots": config.slots,
+        "kv_blocks": config.kv_blocks,
+        "kv_block_size": config.kv_block_size,
+        "cost_model": {
+            "decode_base_ms": round(cm.decode_base_s * 1e3, 4),
+            "decode_per_seq_ms": round(cm.decode_per_seq_s * 1e3, 4),
+            "prefill_per_token_ms": round(
+                cm.prefill_per_token_s * 1e3, 5),
+        },
+        "peak_tokens_per_s_modeled": round(peak_tok_s, 1),
+        "loads": {},
+    }
+    for load in loads:
+        rate = load * capacity_rps
+        arrivals = open_loop_arrivals(seed, rate, horizon_s,
+                                      id_prefix=f"L{load}-")
+        out["loads"][str(load)] = dict(
+            offered_load=load,
+            offered_rps=round(rate, 3),
+            **run_open_loop(config, cm, arrivals))
+    # the batching comparison runs AT modeled capacity: below it both
+    # modes keep up and the ratio trivially reads 1.0; at it, static
+    # batching's drained-batch stalls bind and the speedup is visible.
+    # Batch-only traffic: preemption recompute is an SLO-class cost,
+    # not a batching-policy one, and would muddy the ratio
+    out["continuous_vs_static"] = compare_batching(
+        config, cm, open_loop_arrivals(seed + 1, capacity_rps,
+                                       horizon_s,
+                                       interactive_frac=0.0,
+                                       id_prefix="C-"))
+    return out

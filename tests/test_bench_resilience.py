@@ -218,6 +218,24 @@ def _no_real_probe(monkeypatch):
                         lambda *a, **k: "TPU v5 lite")
 
 
+@pytest.fixture(autouse=True)
+def _no_real_serve_bench(monkeypatch):
+    """The serve section runs a (deterministic but multi-second)
+    scheduler simulation plus a jax cost-model calibration; stub it so
+    every main() resilience test stays fast. Its real behavior is
+    covered by tests/test_serve.py."""
+    monkeypatch.setattr(bench, "bench_serve", lambda: {
+        "seed": 0, "slots": 8, "kv_blocks": 256, "kv_block_size": 16,
+        "cost_model": {"decode_base_ms": 25.0}, "loads": {
+            "0.8": {"offered_rps": 3.0, "completed": 10, "rejected": 0,
+                    "preemptions": 1, "tokens_per_s": 200.0,
+                    "ttft_p50_s": 0.05, "ttft_p99_s": 0.4,
+                    "itl_p99_s": 0.03, "kv_occupancy_mean": 0.2,
+                    "kv_occupancy_max": 0.4, "kv_blocks_leaked": 0}},
+        "continuous_vs_static": {"speedup": 1.6},
+        "cost_model_calibrated": False})
+
+
 # the real function, captured before the autouse stub replaces the module
 # attribute — TestProbeBackend exercises the genuine implementation
 _REAL_PROBE = bench.probe_backend
@@ -315,9 +333,115 @@ class TestProbeBackend:
         assert _REAL_PROBE(timeout_s=1, attempts=2) == "TPU v5 lite"
 
 
+class TestProbeDecision:
+    """The probe-skip decision (BENCH_r05 burned ~12 min on probe
+    timeouts with JAX_PLATFORMS=cpu already pinned): a cpu pin makes
+    the probe pure waste, so it is skipped — but ONLY a cpu pin: an
+    accelerator pin still needs the bounded subprocess dial, whose
+    failure verdict drives the cpu fallback before in-process init can
+    hang on a dead tunnel."""
+
+    def test_pinned_cpu_skips_the_probe(self):
+        assert bench.should_probe_backend({"JAX_PLATFORMS": "cpu"}) \
+            is False
+        assert bench.forced_platform({"JAX_PLATFORMS": "cpu"}) == "cpu"
+
+    def test_pinned_accelerator_still_probes(self):
+        assert bench.should_probe_backend({"JAX_PLATFORMS": "tpu"}) \
+            is True
+        assert bench.forced_platform({"JAX_PLATFORMS": "tpu"}) == "tpu"
+
+    def test_unset_or_empty_platform_probes(self):
+        assert bench.should_probe_backend({}) is True
+        assert bench.should_probe_backend({"JAX_PLATFORMS": ""}) is True
+        assert bench.should_probe_backend({"JAX_PLATFORMS": "  "}) is True
+
+    def test_multi_platform_pin_uses_the_first_entry(self):
+        env = {"JAX_PLATFORMS": "CPU,tpu"}
+        assert bench.forced_platform(env) == "cpu"
+        assert bench.should_probe_backend(env) is False
+        assert bench.should_probe_backend({"JAX_PLATFORMS": "tpu,cpu"}) \
+            is True
+
+    def test_main_never_dials_the_probe_under_a_pin(self, monkeypatch):
+        # conftest pins JAX_PLATFORMS=cpu for the whole suite, so
+        # main() must go straight to the pinned backend: a probe dial
+        # here would be the exact BENCH_r05 waste this decision removes
+        def boom(*a, **k):
+            raise AssertionError("probe must not run under a pin")
+
+        monkeypatch.setattr(bench, "probe_backend", boom)
+        monkeypatch.setattr(bench, "bench_pod_ready",
+                            lambda n, wire=False: [0.01] * n)
+        monkeypatch.setattr(bench, "bench_fleet", lambda: {})
+
+        class CpuBench:
+            dev = types.SimpleNamespace(device_kind="cpu")
+
+            def train(self):
+                return _train(0.02)
+
+            def flash(self):
+                return _flash()
+
+            def decode(self, **kw):
+                return {"tokens_per_s": 5.0, "ms_per_token": 200.0,
+                        "hbm_frac": 0.01}
+
+        monkeypatch.setattr(bench, "ComputeBench", CpuBench)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            bench.main()
+        payload = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert "tpu_probe" not in payload.get("errors", {})
+        assert payload["device"] == "cpu"
+        assert payload["serve"]["continuous_speedup"] == 1.6
+
+
+class TestServePayload:
+    def test_serve_section_lands_with_per_load_ttft(self):
+        serve_rec = {
+            "seed": 0, "slots": 8, "kv_blocks": 256,
+            "kv_block_size": 16, "cost_model": {"decode_base_ms": 25.0},
+            "cost_model_calibrated": True,
+            "peak_tokens_per_s_modeled": 275.9,
+            "loads": {
+                "0.5": {"offered_rps": 2.0, "tokens_per_s": 130.0,
+                        "ttft_p50_s": 0.04, "ttft_p99_s": 0.2,
+                        "itl_p99_s": 0.03, "kv_blocks_leaked": 0,
+                        "completed": 50, "rejected": 0,
+                        "preemptions": 2, "kv_occupancy_mean": 0.2,
+                        "kv_occupancy_max": 0.3, "trace_events": 99},
+                "1.1": {"offered_rps": 4.4, "tokens_per_s": 240.0,
+                        "ttft_p50_s": 0.06, "ttft_p99_s": 9.0,
+                        "itl_p99_s": 0.07, "kv_blocks_leaked": 0,
+                        "completed": 100, "rejected": 3,
+                        "preemptions": 40, "kv_occupancy_mean": 0.3,
+                        "kv_occupancy_max": 0.4, "trace_events": 999}},
+            "continuous_vs_static": {"speedup": 1.52},
+        }
+        payload = bench.build_payload({"serve": serve_rec}, {})
+        loads = payload["serve"]["loads"]
+        assert set(loads) == {"0.5", "1.1"}  # >=2 load points
+        assert all("ttft_p99_s" in row for row in loads.values())
+        assert "trace_events" not in loads["0.5"]  # compacted
+        assert payload["serve_continuous_speedup"] == 1.52
+        assert payload["serve_tokens_per_s_peak"] == 240.0
+        json.dumps(payload)
+
+    def test_missing_serve_section_is_fine(self):
+        payload = bench.build_payload({}, {"serve": "boom"})
+        assert "serve" not in payload
+        assert payload["errors"]["serve"] == "boom"
+
+
 class TestMainResilience:
     def test_main_pins_cpu_and_records_error_when_probe_dies(
             self, monkeypatch):
+        # unpin the platform: under conftest's JAX_PLATFORMS=cpu the
+        # probe is (correctly) skipped and this fallback path would
+        # never run
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
         monkeypatch.setattr(bench, "probe_backend", lambda *a, **k: None)
         monkeypatch.setattr(bench, "bench_pod_ready",
                             lambda n, wire=False: [0.01] * n)

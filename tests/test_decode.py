@@ -269,6 +269,84 @@ def test_kv_int8_cache_shapes_and_dtypes():
     assert np.asarray(qcache[0]["k_s"][:, :5]).min() > 0
 
 
+def _step_generate(params, cfg, prompt, steps, kv_int8=False):
+    """Drive the refactored prefill + decode_step pair one iteration at
+    a time with a PER-ROW position vector (the serve scheduler's call
+    shape) — greedy, like generate()'s temperature-0 path."""
+    from dpu_operator_tpu.workloads.decode import decode_step, prefill
+
+    B, P = prompt.shape
+    cache, logits = prefill(params, cfg, prompt, kv_int8=kv_int8)
+    pos = jnp.full((B,), P, jnp.int32)
+    out = []
+    for i in range(steps):
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out.append(np.asarray(tok))
+        logits, cache = decode_step(params, cfg, cache, tok, pos + i)
+    return np.stack(out, axis=1)
+
+
+def test_decode_step_token_identical_to_fused_scan(setup):
+    """The satellite contract: the scan is now a thin wrapper over the
+    same step body, so driving single decode_step iterations (vector
+    positions, the serve path) must reproduce the fused generate()
+    token stream EXACTLY on a seeded config."""
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.key(20), (3, 6), 0, cfg.vocab)
+    want = np.asarray(generate(params, cfg, prompt, steps=12))
+    got = _step_generate(params, cfg, prompt, steps=12)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_decode_step_token_identical_with_kv_int8(setup):
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.key(21), (2, 5), 0, cfg.vocab)
+    want = np.asarray(generate(params, cfg, prompt, steps=10,
+                               kv_int8=True))
+    got = _step_generate(params, cfg, prompt, steps=10, kv_int8=True)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_decode_step_scalar_and_vector_pos_agree(setup):
+    """Same values through dynamic_update_slice (scalar pos) and the
+    per-row scatter (vector pos): the serve path cannot drift from the
+    scan path numerically."""
+    from dpu_operator_tpu.workloads.decode import decode_step, prefill
+
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.key(22), (2, 7), 0, cfg.vocab)
+    cache, logits = prefill(params, cfg, prompt)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scalar_logits, scalar_cache = decode_step(params, cfg, cache, tok, 7)
+    vec_logits, vec_cache = decode_step(params, cfg, cache, tok,
+                                        jnp.full((2,), 7, jnp.int32))
+    np.testing.assert_allclose(np.asarray(scalar_logits),
+                               np.asarray(vec_logits),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(scalar_cache[0]["k"]),
+                               np.asarray(vec_cache[0]["k"]),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_decode_step_does_not_retrace_across_values(setup):
+    """One compiled program per (cfg, shapes): the continuous-batching
+    loop feeds new token/position VALUES every iteration and must never
+    pay a re-trace."""
+    from dpu_operator_tpu.workloads.decode import decode_step, prefill
+
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.key(23), (2, 4), 0, cfg.vocab)
+    cache, logits = prefill(params, cfg, prompt)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    pos = jnp.full((2,), 4, jnp.int32)
+    _, cache = decode_step(params, cfg, cache, tok, pos)
+    before = decode_step._cache_size()
+    for i in range(1, 6):
+        _, cache = decode_step(params, cfg, cache,
+                               (tok + i) % cfg.vocab, pos + i)
+    assert decode_step._cache_size() == before
+
+
 def test_measure_decode_kv_int8_byte_model():
     """The roofline byte model must charge KV8 at ~1 byte/elem (+ scale
     amortization), not bf16's 2."""
