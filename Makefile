@@ -90,11 +90,20 @@ scale-check:
 # continuous-batching serve gate (doc/architecture.md "Serving layer"):
 # the seeded scheduler harness — two consecutive runs must produce
 # bit-identical scheduler traces; continuous batching must beat static
-# batching >=1.5x aggregate tokens/s at the same offered load; an
-# interactive request admitted under full batch-class load must meet
-# its TTFT bound via preemption; 500 seeded request lifecycles must
-# leak zero KV-pool blocks (occupancy returns to zero); plus the
-# shared zero-spurious-ListAndWatch-deletion churn regression for both
+# batching >=1.5x aggregate tokens/s at the same offered load; CHUNKED
+# prefill must bound TTFT p99 at 0.8 offered load (>=5x under the
+# atomic-prefill baseline, <= the 5.19s/5 wire gate) and ITL by
+# construction, token-identical to atomic prefill and to generate()
+# across chunk sizes; prefix sharing must cut peak KV occupancy on the
+# prefix-heavy mix with CoW invariants intact (refcounts never
+# negative, referenced blocks never handed out, divergent writes copy
+# exactly once); an interactive request admitted under full
+# batch-class load must meet its TTFT bound via preemption; 500 seeded
+# request lifecycles (sharing+chunking ON) must leak zero KV-pool
+# blocks (occupancy returns to zero, prefix index drained); the
+# streaming HTTP ingress must flush one token per chunk and adopt the
+# caller's traceparent; plus the shared
+# zero-spurious-ListAndWatch-deletion churn regression for both
 # capacity producers (fault gate + serve slots). Seeded RNG, virtual
 # clocks, no wall-clock sleeps.
 serve-check:

@@ -208,6 +208,20 @@ def render_serve(snapshot: dict, flight_events: list,
         "ttftWindowSeconds": window_s,
         "ttftSamples": len(ttfts),
     }
+    prefill = snapshot.get("prefill") or {}
+    if prefill:
+        # chunked-prefill health at a glance: how much admitted prompt
+        # work is still waiting for budget (TTFT is bounded by this
+        # backlog over the per-iteration budget)
+        out["prefillBacklogTokens"] = prefill.get("backlogTokens", 0)
+        out["prefillChunkTokensPerIteration"] = prefill.get(
+            "chunkTokensPerIteration", 0)
+        out["prefilling"] = len(prefill.get("prefilling") or ())
+    kv = snapshot.get("kv") or {}
+    if kv.get("sharing"):
+        out["kvSharedBlocks"] = kv.get("sharedBlocks", 0)
+        out["kvCowCopies"] = kv.get("cowCopies", 0)
+        out["kvLogicalBlocks"] = kv.get("logicalBlocks", 0)
     if ttfts:
         from .utils.stats import nearest_rank
         out["ttftP50Seconds"] = round(nearest_rank(ttfts, 0.50), 4)

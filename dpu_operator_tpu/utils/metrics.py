@@ -529,6 +529,45 @@ SERVE_ADMISSION_REJECTED = REGISTRY.counter(
     "tpu_serve_admission_rejections_total",
     "Requests rejected at admission, by SLO class and reason (a rising "
     "rate is the health engine's first saturation signal)")
+SERVE_PREFILL_CHUNKS = REGISTRY.counter(
+    "tpu_serve_prefill_chunks_total",
+    "Prefill chunks executed by the iteration-level scheduler (chunked "
+    "prefill splits each prompt into budget-sized pieces interleaved "
+    "with decode iterations)")
+SERVE_PREFILL_CHUNK_TOKENS = REGISTRY.counter(
+    "tpu_serve_prefill_chunk_tokens_total",
+    "Prompt tokens prefilled through the chunk queue, by outcome "
+    "(prefilled = executed toward a first token; discarded = chunk "
+    "progress thrown away by a preemptive eviction — the chunk-aware "
+    "preemption cost)")
+SERVE_PREFILL_BACKLOG = REGISTRY.gauge(
+    "tpu_serve_prefill_chunk_backlog_tokens",
+    "Prompt tokens admitted but not yet prefilled (the chunk queue's "
+    "backlog; TTFT is bounded by this backlog over the per-iteration "
+    "budget)")
+SERVE_WIRE_TTFT_SECONDS = REGISTRY.histogram(
+    "tpu_serve_wire_ttft_seconds",
+    "Time-to-first-token measured AT THE WIRE by the streaming HTTP "
+    "ingress: request read to first chunked-response flush (includes "
+    "scheduler queueing the model-level tpu_serve_ttft_seconds sees, "
+    "plus serialization)",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0,
+             30.0, 60.0))
+KV_SHARED_BLOCKS = REGISTRY.gauge(
+    "tpu_kv_shared_blocks",
+    "Physical KV blocks currently mapped by >= 2 requests (prefix "
+    "sharing; each counts once toward occupancy — the saving is this "
+    "gauge times the extra mappers)")
+KV_COW_COPIES = REGISTRY.counter(
+    "tpu_kv_cow_copies_total",
+    "Copy-on-write block copies: a request wrote into a block it "
+    "shared, got a private copy, and the original kept serving its "
+    "other readers")
+KV_PREFIX_BLOCK_HITS = REGISTRY.counter(
+    "tpu_kv_prefix_block_hits_total",
+    "KV blocks served from the content-addressed prefix index instead "
+    "of fresh allocation (each hit is block_size token slots not "
+    "duplicated)")
 # -- static-analysis gate (opslint exception-hygiene rule) -------------------
 SWALLOWED_ERRORS = REGISTRY._add(_FlightRecordedCounter(
     "tpu_daemon_swallowed_errors_total",

@@ -312,6 +312,111 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     return new_cache, last_logits
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def prefill_chunk(params: dict, cfg: TransformerConfig, cache: list,
+                  slot: jax.Array, tokens: jax.Array, offset: jax.Array,
+                  n_valid: jax.Array):
+    """One CHUNK of a prefill, written into row *slot* of a slotted
+    cache at position *offset* — the schedulable unit that lets the
+    serve loop interleave long prompts with decode iterations instead
+    of stalling a whole iteration per prompt (Sarathi-style chunked
+    prefill).
+
+    *tokens* is a FIXED-size (C,) padded chunk; *n_valid* <= C is how
+    many leading entries are real. Compiled ONCE per (cfg, cache
+    shape, C): slot/offset/n_valid ride as traced values, so varying
+    chunk fills never re-trace (asserted in tests via ``_cache_size``).
+    Returns ``(new_cache, logits)`` where *logits* (V,) belongs to the
+    last VALID row — the final chunk's logits pick the first generated
+    token, exactly as :func:`prefill`'s last-position logits do.
+
+    Token identity: the chunk writes its K/V into the cache FIRST and
+    then attends over the full row under a causal-at-offset mask, so
+    for bf16 caches the computed rows are bit-identical to the
+    whole-prompt :func:`prefill` (same per-row ops, and the extra
+    masked key positions contribute exact zeros to the softmax).
+    Padding rows write garbage K/V past ``offset + n_valid`` — always
+    at positions strictly above every real position, which the next
+    chunk (or the first decode steps) overwrites before any causal
+    mask can admit them; rows past ``max_seq`` are dropped by the
+    scatter. KV8 caches are supported (the chunk attends earlier
+    chunks DEquantized, the same numerics decode_step sees — identity
+    with the bf16-attending whole prefill is approximate there, as it
+    already is for generate's decode phase). MoE layers route per
+    chunk, so token identity additionally needs the capacity factor to
+    cover the chunk (the same caveat training-time forward has)."""
+    C = tokens.shape[0]
+    rows = offset + jnp.arange(C)                       # absolute positions
+    pos_emb = params["pos"][jnp.clip(rows, 0, cfg.max_seq - 1)]
+    x = (_embed_rows(params["embed"], tokens) + pos_emb).astype(
+        cfg.dtype)[None]                                # (1, C, D)
+    positions = jnp.arange(cfg.max_seq)
+    mask = positions[None, :] <= rows[:, None]          # (C, S) causal
+    slot_idx = jnp.full((C,), slot)
+
+    def put(cache_t, new_t):
+        # scatter the chunk's rows at (slot, offset+i); out-of-range
+        # rows (a final chunk's padding past max_seq) are dropped
+        return cache_t.at[slot_idx, rows].set(
+            new_t.astype(cache_t.dtype), mode="drop")
+
+    def kscale(s):  # (S, H, 1) per-position scales -> (H, 1, S)
+        return s[..., 0].T[:, None, :]
+
+    new_cache = []
+    for lp, layer_cache in zip(params["layers"], cache):
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = _mm(h, lp["wqkv"])
+        q, k, v = jnp.split(qkv[0], 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(C, cfg.n_heads, cfg.d_head)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if "k_q" in layer_cache:  # KV8: int8 cache, fused dequant
+            kq, ks = _kv_quant(k)
+            vq, vs = _kv_quant(v)
+            ck, cks = put(layer_cache["k_q"], kq), put(layer_cache["k_s"],
+                                                       ks)
+            cv, cvs = put(layer_cache["v_q"], vq), put(layer_cache["v_s"],
+                                                       vs)
+            new_cache.append({"k_q": ck, "k_s": cks,
+                              "v_q": cv, "v_s": cvs})
+            att = jnp.einsum("qhd,khd->hqk", q,
+                             ck[slot].astype(cfg.dtype))
+            att = (att.astype(jnp.float32) * kscale(cks[slot])
+                   / np.sqrt(cfg.d_head))
+            att = jnp.where(mask[None, :, :], att, -1e9)
+            att = jax.nn.softmax(att, -1)
+            att_v = (att * kscale(cvs[slot])).astype(cfg.dtype)
+            o = jnp.einsum("hqk,khd->qhd", att_v,
+                           cv[slot].astype(cfg.dtype)).reshape(
+                1, C, cfg.d_model)
+        else:
+            ck, cv = put(layer_cache["k"], k), put(layer_cache["v"], v)
+            new_cache.append({"k": ck, "v": cv})
+            att = jnp.einsum("qhd,khd->hqk", q, ck[slot]) / np.sqrt(
+                cfg.d_head)
+            att = jnp.where(mask[None, :, :], att, -1e9)
+            att = jax.nn.softmax(att.astype(jnp.float32),
+                                 -1).astype(cfg.dtype)
+            o = jnp.einsum("hqk,khd->qhd", att, cv[slot]).reshape(
+                1, C, cfg.d_model)
+        x = x + _mm(o, lp["wo"])
+        h2 = _rmsnorm(x, lp["ln2"])
+        if "moe" in lp:
+            from .moe import moe_ffn
+            out, _ = moe_ffn(lp["moe"], h2, cfg.moe_capacity_factor)
+            x = x + out
+        else:
+            x = x + _mm(jax.nn.gelu(_mm(h2, lp["w1"])), lp["w2"])
+    x = _rmsnorm(x, params["out_norm"])
+    last = jax.lax.dynamic_index_in_dim(
+        x[0], jnp.clip(n_valid - 1, 0, C - 1), 0, keepdims=False)
+    logits = _logits(last[None, :], params["embed"])[0]
+    return new_cache, logits
+
+
 @partial(jax.jit, static_argnames=("cfg", "steps", "top_k", "greedy",
                                    "kv_int8"))
 def _generate_compiled(params: dict, cfg: TransformerConfig,
@@ -373,7 +478,9 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
                    prompt_len: int = 16, steps: int = 64,
                    iters: int = 4, best_of: int = 3,
                    quantized: bool = False,
-                   kv_int8: bool = False) -> dict:
+                   kv_int8: bool = False,
+                   warmup_rounds: int = 1,
+                   max_sane_frac: "float | None" = None) -> dict:
     """Serving throughput: steady-state decode tokens/s (marginal over two
     generation lengths so prefill + dispatch costs cancel — the same
     slope methodology as perf.marginal_time; best-of for the tunnel's
@@ -396,7 +503,17 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
             float(out[0, -1])
         return go
 
-    per_step = best_marginal_time(make_chained, n_short=max(4, steps // 4),
+    n_short = max(4, steps // 4)
+    # warm BOTH chain lengths before any timed round: the quantized
+    # paths (W8A8 dot, act-quant) compile lazily, and a first-round
+    # compile landing inside marginal_time's min-of-shorts collapsed
+    # the slope into absurd roofline fractions (BENCH_r07's
+    # "degenerate decode_hbm_frac_int8=9.58e+03; remeasuring" noise) —
+    # warm up front instead of detect-and-remeasure
+    for _ in range(max(0, warmup_rounds)):
+        make_chained(n_short)()
+        make_chained(steps)()
+    per_step = best_marginal_time(make_chained, n_short=n_short,
                                   n_long=steps, repeats=iters,
                                   best_of=best_of)
     # the roofline bounds per-token time from below; a slope measurably
@@ -414,8 +531,21 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
     kv_bytes = (2.0 * cfg.n_layers * cfg.max_seq * cfg.d_model
                 * kv_width * batch)
     min_s = (weight_bytes + kv_bytes) / hbm_bandwidth_gbps() / 1e9
+    hbm_frac = min_s / per_step
+    # sanity bound on a RECORDED value (bench callers set it from their
+    # roofline cap): a fraction far past 1.0 means the slope collapsed,
+    # which the warmup should have made impossible — fail loudly rather
+    # than publish it. Toy/smoke callers leave it None: their chains
+    # are legitimately inside the noise floor and they record nothing.
+    if max_sane_frac is not None and not 0.0 < hbm_frac \
+            <= max_sane_frac:
+        raise ValueError(
+            f"degenerate decode measurement: hbm_frac={hbm_frac:.3g} "
+            f"outside (0, {max_sane_frac}] (per-step {per_step:.3g}s "
+            f"vs roofline {min_s:.3g}s) — slope timing collapsed "
+            "despite warmup")
     return {"batch": batch, "steps": steps,
             "ms_per_token": per_step * 1e3,
             "tokens_per_s": batch / per_step,
             "roofline_ms_per_token": min_s * 1e3,
-            "hbm_frac": min_s / per_step}
+            "hbm_frac": hbm_frac}

@@ -17,6 +17,29 @@ BLOCKS of ``block_size`` token slots:
   which the pool meters (``tpu_serve_kv_internal_fragmentation``)
   together with occupancy (``tpu_serve_kv_blocks{state=...}``).
 
+**Prefix sharing (copy-on-write).** With ``sharing=True`` the pool also
+keeps a content-addressed index over allocated blocks: each block of a
+prompt is keyed by the rolling hash of everything up to and including
+it (:func:`chain_keys`), so two requests with a common prompt prefix
+map the SAME physical blocks (refcounted) instead of duplicating them —
+the vLLM prefix-cache design, and the lever that cuts KV occupancy on
+shared-system-prompt traffic. The rules:
+
+- blocks are published into the index only after their content is
+  real (the owner's prefill covered them — :meth:`register_prefix`);
+- :meth:`map_prefix` hands a later request the longest indexed chain,
+  bumping each block's refcount;
+- a write into a block with refcount > 1 is a DIVERGENCE:
+  :meth:`write_token` copies the block first (fresh block swapped into
+  the writer's map, shared refcount decremented — copy-on-write,
+  exactly once per divergence) so a shared block's content never
+  mutates under its other readers;
+- a write into a *registered* block with refcount == 1 unpublishes it
+  (its content is about to stop matching its key);
+- :meth:`free` decrements refcounts; a block returns to the free list
+  only at refcount zero, so a shared block is never handed out while
+  referenced.
+
 Everything is deterministic: the free list is kept sorted and always
 hands out the lowest block id first, so two runs of a seeded scheduler
 produce bit-identical allocation traces. The pool does not touch JAX —
@@ -27,9 +50,39 @@ of the physical cache.
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Optional, Sequence
 
 from ..utils import metrics
+
+#: 61-bit Mersenne prime — the rolling-hash modulus (no PYTHONHASHSEED
+#: dependence, collision space far beyond any pool size)
+_HASH_MOD = (1 << 61) - 1
+_HASH_MUL = 1_000_003
+
+
+def _fold(h: int, values: Sequence[int]) -> int:
+    for v in values:
+        h = (h * _HASH_MUL + int(v) + 1) % _HASH_MOD
+    return h
+
+
+def chain_keys(tokens: Sequence[int], block_size: int) -> list:
+    """Content keys for the blocks of *tokens*: ``key[i]`` hashes every
+    token through block *i* (a chain, so a block only ever matches when
+    its whole PREFIX matches too). The final partial block's key also
+    folds in its length, so a 4-token tail can only match another
+    4-token tail with identical content — never a full block that
+    happens to start the same way."""
+    keys: list[int] = []
+    h = 0
+    n = len(tokens)
+    for start in range(0, n, block_size):
+        block = tokens[start:start + block_size]
+        h = _fold(h, block)
+        if len(block) < block_size:
+            h = _fold(h, (-1, len(block)))
+        keys.append(h)
+    return keys
 
 
 class KvPoolExhausted(Exception):
@@ -39,18 +92,21 @@ class KvPoolExhausted(Exception):
 
 
 class KvBlockPool:
-    """Fixed-size block allocator with per-owner accounting.
+    """Fixed-size block allocator with per-owner accounting and
+    optional refcounted prefix sharing.
 
     *num_blocks* blocks of *block_size* token slots each. Owners are
     opaque strings (request ids). Thread-safe: the serve loop owns the
     pool, but capacity is read from the device-plugin snapshot thread.
     """
 
-    def __init__(self, num_blocks: int, block_size: int) -> None:
+    def __init__(self, num_blocks: int, block_size: int,
+                 sharing: bool = False) -> None:
         if num_blocks <= 0 or block_size <= 0:
             raise ValueError("num_blocks and block_size must be positive")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.sharing = sharing
         self._lock = threading.Lock()
         #: sorted free list — lowest id first, so allocation order is a
         #: pure function of the alloc/free sequence (determinism gate)
@@ -59,6 +115,15 @@ class KvBlockPool:
         #: tokens actually written per owner (internal-fragmentation
         #: numerator is allocated slots minus this)
         self._used_tokens: dict[str, int] = {}
+        #: block id -> refcount (allocated blocks only; shared >= 2)
+        self._refs: dict[int, int] = {}
+        #: content-addressed prefix index: chain key -> block id, plus
+        #: the reverse map for cleanup on free/divergence
+        self._index: dict[int, int] = {}
+        self._block_key: dict[int, int] = {}
+        #: lifetime counters (snapshot/bench visibility)
+        self.cow_copies = 0
+        self.prefix_block_hits = 0
         self._update_gauges_locked()
 
     # -- sizing ---------------------------------------------------------------
@@ -76,11 +141,46 @@ class KvBlockPool:
             return self.num_blocks - len(self._free)
 
     def occupancy(self) -> float:
-        """Fraction of the pool currently allocated (0.0 when idle —
+        """Fraction of the pool PHYSICALLY allocated (0.0 when idle —
         the leak assertion: after every request completes this must
-        return to exactly 0.0)."""
+        return to exactly 0.0). Shared blocks count once — that is the
+        sharing win; :meth:`logical_blocks` counts them per owner."""
         with self._lock:
             return (self.num_blocks - len(self._free)) / self.num_blocks
+
+    def logical_blocks(self) -> int:
+        """Blocks summed over OWNERS (a block mapped by three requests
+        counts three times) — what occupancy would be with sharing
+        off; the gap to :meth:`outstanding` is the saving."""
+        with self._lock:
+            return sum(len(b) for b in self._owned.values())
+
+    def shared_blocks(self) -> int:
+        """Physical blocks currently referenced by >= 2 owners."""
+        with self._lock:
+            return sum(1 for r in self._refs.values() if r >= 2)
+
+    def _written_slots_locked(self) -> int:
+        """PHYSICAL token slots holding real KV rows: per block, the
+        MAX of its owners' coverage (mappers' content is identical in
+        the shared region, so slots written once count once — a flat
+        per-owner sum would count a shared block per mapper, and
+        subtracting blanket refcount duplicates would undercount while
+        a mapper is still mid-prefill). Keeps the fragmentation gauge
+        truthful exactly when sharing is active."""
+        written: dict[int, int] = {}
+        bs = self.block_size
+        for owner, blocks in self._owned.items():
+            used = self._used_tokens.get(owner, 0)
+            if used <= 0:
+                continue
+            full, rem = divmod(used, bs)
+            for b in blocks[:full]:
+                written[b] = bs
+            if rem and full < len(blocks):
+                b = blocks[full]
+                written[b] = max(written.get(b, 0), rem)
+        return sum(written.values())
 
     def internal_fragmentation(self) -> float:
         """Fraction of ALLOCATED token slots not yet written (0.0 when
@@ -90,8 +190,8 @@ class KvBlockPool:
                          * self.block_size)
             if allocated == 0:
                 return 0.0
-            used = sum(self._used_tokens.values())
-            return (allocated - used) / allocated
+            used = self._written_slots_locked()
+            return max(0.0, (allocated - used) / allocated)
 
     def owners(self) -> list[str]:
         with self._lock:
@@ -118,10 +218,127 @@ class KvBlockPool:
                 return None
             taken = self._free[:n_blocks]
             del self._free[:n_blocks]
+            for b in taken:
+                self._refs[b] = 1
             self._owned.setdefault(owner, []).extend(taken)
             self._used_tokens.setdefault(owner, 0)
             self._update_gauges_locked()
             return taken
+
+    # -- prefix sharing -------------------------------------------------------
+    def probe_prefix(self, keys: Sequence[int]) -> int:
+        """How many leading blocks of *keys* the index could hand out
+        right now (admission sizes its fresh-alloc ask with this)."""
+        if not self.sharing:
+            return 0
+        with self._lock:
+            return self._match_len_locked(keys)
+
+    def _match_len_locked(self, keys: Sequence[int]) -> int:
+        n = 0
+        for key in keys:
+            if key not in self._index:
+                break
+            n += 1
+        return n
+
+    def map_prefix(self, owner: str, keys: Sequence[int]) -> int:
+        """Map the longest indexed chain of *keys* into *owner*'s block
+        list (these become the owner's FIRST blocks — call before
+        :meth:`alloc`). Each mapped block's refcount is bumped; returns
+        the number of blocks mapped."""
+        if not self.sharing or not keys:
+            return 0
+        with self._lock:
+            if self._owned.get(owner):
+                raise ValueError(
+                    f"map_prefix must precede alloc for {owner!r}")
+            n = self._match_len_locked(keys)
+            if n == 0:
+                return 0
+            blocks = [self._index[k] for k in keys[:n]]
+            for b in blocks:
+                self._refs[b] += 1
+            self._owned.setdefault(owner, []).extend(blocks)
+            self._used_tokens.setdefault(owner, 0)
+            self.prefix_block_hits += n
+            metrics.KV_PREFIX_BLOCK_HITS.inc(n)
+            self._update_gauges_locked()
+            return n
+
+    def register_prefix(self, owner: str, keys: Sequence[int],
+                        covered_tokens: int) -> int:
+        """Publish *owner*'s leading blocks under *keys* (block i under
+        key i) so later requests can map them. Call only once the
+        owner's prefill has actually WRITTEN those blocks — an indexed
+        block's content must be real. *covered_tokens* is how many
+        token slots the keys describe (the prompt length): the final
+        key may cover only part of its block, and writes PAST a key's
+        coverage — the owner's generated tokens landing after a
+        just-registered prompt tail — do not invalidate it. Keys
+        already indexed (or blocks already published under another
+        key) are skipped; returns the number newly published."""
+        if not self.sharing or not keys:
+            return 0
+        with self._lock:
+            owned = self._owned.get(owner, ())
+            published = 0
+            for i, key in enumerate(keys):
+                if i >= len(owned):
+                    break
+                block = owned[i]
+                if key in self._index or block in self._block_key:
+                    continue
+                covered = min(self.block_size,
+                              int(covered_tokens) - i * self.block_size)
+                if covered <= 0:
+                    break
+                self._index[key] = block
+                self._block_key[block] = (key, covered)
+                published += 1
+            self._update_gauges_locked()
+            return published
+
+    def write_token(self, owner: str, pos: int) -> Optional[bool]:
+        """Account one token write at sequence position *pos*. If the
+        position's block is SHARED (refcount > 1) this is a divergence:
+        copy-on-write swaps a fresh block into the owner's map (the
+        shared original keeps serving its other readers, its indexed
+        key intact) — returns True, and the copy happens exactly once
+        (the fresh block is exclusive). A write into a
+        registered-but-exclusive block unpublishes it only when it
+        lands INSIDE the key's covered slots (content diverging from
+        the key); writes past the coverage — generated tokens after a
+        registered prompt tail — leave the key valid. Returns False on
+        any non-copying write, None when a copy is needed but the pool
+        is exhausted — the caller preempts or stalls."""
+        with self._lock:
+            owned = self._owned.get(owner)
+            if owned is None:
+                raise KeyError(f"unknown owner {owner!r}")
+            b_idx = int(pos) // self.block_size
+            if b_idx >= len(owned):
+                raise IndexError(
+                    f"{owner!r} writing pos {pos} past its "
+                    f"{len(owned)}-block reservation")
+            block = owned[b_idx]
+            if self._refs[block] > 1:
+                if not self._free:
+                    return None
+                fresh = self._free.pop(0)
+                self._refs[fresh] = 1
+                self._refs[block] -= 1
+                owned[b_idx] = fresh
+                self.cow_copies += 1
+                metrics.KV_COW_COPIES.inc()
+                self._update_gauges_locked()
+                return True
+            entry = self._block_key.get(block)
+            if entry is not None and int(pos) % self.block_size \
+                    < entry[1]:
+                del self._block_key[block]
+                self._index.pop(entry[0], None)
+            return False
 
     def set_used_tokens(self, owner: str, tokens: int) -> None:
         """Record how many of *owner*'s allocated slots hold real KV
@@ -136,33 +353,55 @@ class KvBlockPool:
 
     def free(self, owner: str) -> int:
         """Release every block *owner* holds (completion or preemptive
-        eviction). Returns the number of blocks released; freeing an
-        unknown owner is a no-op returning 0 (idempotent, so a
-        completion racing an eviction can never double-free)."""
+        eviction): each refcount is decremented and a block returns to
+        the free list only at ZERO — a block another request still maps
+        stays allocated (and indexed). Returns the number of blocks
+        physically freed; freeing an unknown owner is a no-op returning
+        0 (idempotent, so a completion racing an eviction can never
+        double-free)."""
         with self._lock:
             blocks = self._owned.pop(owner, None)
             self._used_tokens.pop(owner, None)
             if not blocks:
                 self._update_gauges_locked()
                 return 0
-            self._free.extend(blocks)
-            self._free.sort()
+            released = []
+            for b in blocks:
+                refs = self._refs[b] - 1
+                if refs < 0:  # pragma: no cover — invariant guard
+                    raise AssertionError(
+                        f"block {b} refcount went negative")
+                if refs == 0:
+                    del self._refs[b]
+                    entry = self._block_key.pop(b, None)
+                    if entry is not None:
+                        self._index.pop(entry[0], None)
+                    released.append(b)
+                else:
+                    self._refs[b] = refs
+            if released:
+                self._free.extend(released)
+                self._free.sort()
             self._update_gauges_locked()
-            return len(blocks)
+            return len(released)
 
     def outstanding(self) -> int:
-        """Blocks currently allocated across all owners — the leak
-        detector: must be 0 once every request has completed."""
+        """Blocks currently PHYSICALLY allocated — the leak detector:
+        must be 0 once every request has completed (with sharing, a
+        block mapped N times still counts once; the index holds no
+        reference of its own, so the last free really drains it)."""
         with self._lock:
-            return sum(len(b) for b in self._owned.values())
+            return self.num_blocks - len(self._free)
 
     # -- metering -------------------------------------------------------------
     def _update_gauges_locked(self) -> None:
         used = self.num_blocks - len(self._free)
         metrics.SERVE_KV_BLOCKS.set(float(len(self._free)), state="free")
         metrics.SERVE_KV_BLOCKS.set(float(used), state="used")
+        metrics.KV_SHARED_BLOCKS.set(float(
+            sum(1 for r in self._refs.values() if r >= 2)))
         allocated_slots = used * self.block_size
-        frag = ((allocated_slots - sum(self._used_tokens.values()))
+        frag = (max(0.0, allocated_slots - self._written_slots_locked())
                 / allocated_slots if allocated_slots else 0.0)
         metrics.SERVE_KV_FRAGMENTATION.set(frag)
 
@@ -171,7 +410,8 @@ class KvBlockPool:
         with self._lock:
             used = self.num_blocks - len(self._free)
             allocated_slots = used * self.block_size
-            frag = ((allocated_slots - sum(self._used_tokens.values()))
+            frag = (max(0.0,
+                        allocated_slots - self._written_slots_locked())
                     / allocated_slots if allocated_slots else 0.0)
             return {
                 "numBlocks": self.num_blocks,
@@ -181,4 +421,11 @@ class KvBlockPool:
                 "occupancy": round(used / self.num_blocks, 4),
                 "internalFragmentation": round(frag, 4),
                 "owners": len(self._owned),
+                "sharing": self.sharing,
+                "sharedBlocks": sum(1 for r in self._refs.values()
+                                    if r >= 2),
+                "logicalBlocks": sum(len(b)
+                                     for b in self._owned.values()),
+                "cowCopies": self.cow_copies,
+                "prefixBlockHits": self.prefix_block_hits,
             }

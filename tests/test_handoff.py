@@ -218,7 +218,7 @@ def test_full_handoff_zero_resteer_zero_resetup(kube, short_tmp):
     serve = threading.Thread(
         target=lambda: result.setdefault(
             "serve", handoff.serve_handoff(outgoing, sock_path,
-                                           timeout=10.0)),
+                                           timeout=60.0)),
         daemon=True)
     serve.start()
     assert_eventually(lambda: outgoing.cni_server.frozen
@@ -416,7 +416,7 @@ def test_outgoing_thaws_on_reject_and_dispatches_queued_del(kube,
     serve = threading.Thread(
         target=lambda: result.setdefault(
             "serve", handoff.serve_handoff(outgoing, sock_path,
-                                           timeout=10.0)),
+                                           timeout=60.0)),
         daemon=True)
     serve.start()
     assert_eventually(lambda: outgoing.cni_server.frozen
@@ -760,7 +760,7 @@ def test_unexpected_serve_error_still_thaws(kube, short_tmp):
         serve = threading.Thread(
             target=lambda: result.setdefault(
                 "r", handoff.serve_handoff(outgoing, sock_path,
-                                           timeout=10.0)),
+                                           timeout=60.0)),
             daemon=True)
         serve.start()
         assert_eventually(lambda: os.path.exists(sock_path),
@@ -821,7 +821,7 @@ def test_tpuctl_style_begin_handoff_runs_stop_hook(kube, short_tmp):
     outgoing = _manager(short_tmp, _UpgradeVsp(dataplane), client=kube)
     stopped = threading.Event()
     outgoing.handoff_on_complete = stopped.set
-    assert outgoing.begin_handoff(timeout=10.0)  # no explicit hook
+    assert outgoing.begin_handoff(timeout=60.0)  # no explicit hook
     sock_path = outgoing.path_manager.handoff_socket()
     assert_eventually(lambda: os.path.exists(sock_path),
                       message="handoff socket never appeared")

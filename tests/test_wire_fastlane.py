@@ -72,13 +72,27 @@ def test_pool_retry_bypasses_other_stale_idle_sockets(wire_kube):
     kube.create({"apiVersion": "v1", "kind": "ConfigMap",
                  "metadata": {"name": "cm3", "namespace": "default"},
                  "data": {}})
-    # park a second connection, then kill both while they idle
+    # park a second connection, then kill both while they idle. Two
+    # parallel GETs do NOT guarantee two connections — the first can
+    # return its socket to the pool before the second checks out and
+    # both ride one conn (observed ~1/6 runs) — so gate each round on
+    # a barrier and retry until the pool really holds two.
     import concurrent.futures as cf
-    with cf.ThreadPoolExecutor(2) as ex:
-        list(ex.map(lambda _: kube.get("v1", "ConfigMap", "cm3",
-                                       namespace="default"), range(2)))
+    barrier = threading.Barrier(2)
+
+    def synced_get(_):
+        barrier.wait(timeout=10)
+        return kube.get("v1", "ConfigMap", "cm3", namespace="default")
+
+    for _ in range(20):
+        with cf.ThreadPoolExecutor(2) as ex:
+            list(ex.map(synced_get, range(2)))
+        with kube.pool._lock:
+            if len(kube.pool._idle) >= 2:
+                break
     with kube.pool._lock:
-        assert len(kube.pool._idle) >= 2
+        assert len(kube.pool._idle) >= 2, \
+            "never parked two idle connections"
         for conn in kube.pool._idle:
             conn.sock.close()
     assert kube.get("v1", "ConfigMap", "cm3",
