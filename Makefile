@@ -36,7 +36,14 @@ chaos-check:
 # VSP gRPC -> pooled apiserver client) and the tests assert a single
 # trace_id on every seam, a flight-recorder snapshot that survives a
 # seeded VSP breaker-open storm, and a valid OpenMetrics exemplar on
-# the CNI latency histogram referencing that trace
+# the CNI latency histogram referencing that trace. Plus the
+# serve-trace e2e (tests/test_serve_trace.py): one POST /v1/generate
+# against a chunked scheduler with a forced preemption yields ONE
+# trace_id on the ingress span, every prefill-chunk span, the decode
+# spans and the FirstToken flight entry; the tpuctl phase timeline is
+# bit-identical across two seeded runs, and the serve histograms'
+# OpenMetrics exemplars are grammar-valid with classic scrapes
+# byte-unchanged
 obs-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m obs \
 	  -p no:randomly -p no:cacheprovider
@@ -104,8 +111,12 @@ scale-check:
 # streaming HTTP ingress must flush one token per chunk and adopt the
 # caller's traceparent; plus the shared
 # zero-spurious-ListAndWatch-deletion churn regression for both
-# capacity producers (fault gate + serve slots). Seeded RNG, virtual
-# clocks, no wall-clock sleeps.
+# capacity producers (fault gate + serve slots); plus the cost-ledger
+# reconciliation gate: every step's phase sum (prefill/decode/cow/
+# sched) must reconcile with the observed iteration time — exactly in
+# virtual time, within tolerance under a real (injected) clock with a
+# stalling executor, the stall attributed to the stalled phase.
+# Seeded RNG, virtual clocks, no wall-clock sleeps.
 serve-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m serve \
 	  -p no:randomly -p no:cacheprovider

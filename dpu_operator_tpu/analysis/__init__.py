@@ -9,7 +9,7 @@ workflow are documented in doc/static-analysis.md.
 from .checkers import (ChaosDeterminismChecker, EventsSeamChecker,
                        ExceptionHygieneChecker,
                        HandoffStateDisciplineChecker,
-                       ListDisciplineChecker,
+                       ListDisciplineChecker, MetricDocParityChecker,
                        MetricsNamingChecker, RetryDisciplineChecker,
                        TraceContextChecker, WireSeamChecker)
 from .core import Baseline, Checker, Module, Violation, run_checkers
@@ -24,6 +24,7 @@ ALL_CHECKERS = (
     RetryDisciplineChecker,
     ExceptionHygieneChecker,
     MetricsNamingChecker,
+    MetricDocParityChecker,
     ChaosDeterminismChecker,
     LockDisciplineChecker,
 )
@@ -33,6 +34,7 @@ __all__ = [
     "run_checkers", "WireSeamChecker", "TraceContextChecker",
     "EventsSeamChecker", "HandoffStateDisciplineChecker",
     "ListDisciplineChecker", "RetryDisciplineChecker",
-    "ExceptionHygieneChecker", "MetricsNamingChecker",
+    "ExceptionHygieneChecker", "MetricDocParityChecker",
+    "MetricsNamingChecker",
     "ChaosDeterminismChecker", "LockDisciplineChecker",
 ]

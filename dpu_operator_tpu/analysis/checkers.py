@@ -9,6 +9,8 @@ module cannot take the linter down with it.
 from __future__ import annotations
 
 import ast
+import os
+import re
 from typing import Iterator, Optional
 
 from .core import Checker, Module, Violation, calls_in, dotted_name
@@ -512,6 +514,72 @@ class MetricsNamingChecker(Checker):
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             return arg.value
         return None
+
+
+# -- metric-doc-parity --------------------------------------------------------
+
+#: the one page operators discover metric series through; every
+#: registered `tpu_*` family must have a row there
+_METRIC_DOC_RELPATH = os.path.join("doc", "observability.md")
+
+
+class MetricDocParityChecker(Checker):
+    name = "metric-doc-parity"
+    description = ("every registered `tpu_*` metric family must have a "
+                   "matching row in doc/observability.md — operators "
+                   "discover series through that page, not the source")
+
+    def __init__(self) -> None:
+        #: repo root -> doc text (None = no doc file, rule inert —
+        #: fixture Modules built under synthetic paths must not trip it)
+        self._doc_cache: dict = {}
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if module.is_test \
+                or not module.relpath.startswith("dpu_operator_tpu/"):
+            return
+        doc = self._doc_text(module)
+        if doc is None:
+            return
+        for call in calls_in(module.tree):
+            # same registration shapes the metrics-naming rule matches:
+            # REGISTRY.counter/gauge/... and direct ctor calls with a
+            # literal name + help string
+            kind = MetricsNamingChecker._metric_kind(call)
+            if kind is None:
+                continue
+            metric = MetricsNamingChecker._metric_name(call)
+            if metric is None or not metric.startswith("tpu_"):
+                continue
+            # the doc writes families as `name` or `name{labels}` in
+            # backticks; a bare substring test would let an
+            # undocumented metric ride on a documented one it prefixes
+            # (e.g. a new `tpu_serve_step` passing via
+            # `tpu_serve_step_breakdown_seconds`'s row)
+            if not re.search(rf"`{re.escape(metric)}[`{{]", doc):
+                yield self.violation(
+                    module, call,
+                    f"{kind} {metric!r} has no row in "
+                    "doc/observability.md: document the family (name, "
+                    "type, meaning — backticked, as `"
+                    f"{metric}" "` or with its labels) or the series "
+                    "is undiscoverable to operators")
+
+    def _doc_text(self, module: Module) -> Optional[str]:
+        """doc/observability.md's content for the repo that owns
+        *module* (root derived by stripping the repo-relative path off
+        the absolute one), cached per root."""
+        path = module.path.replace(os.sep, "/")
+        if not path.endswith(module.relpath):
+            return None
+        root = path[:len(path) - len(module.relpath)]
+        if root not in self._doc_cache:
+            try:
+                with open(os.path.join(root, _METRIC_DOC_RELPATH)) as fh:
+                    self._doc_cache[root] = fh.read()
+            except OSError:
+                self._doc_cache[root] = None
+        return self._doc_cache[root]
 
 
 # -- chaos-determinism --------------------------------------------------------

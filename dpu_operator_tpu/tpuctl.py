@@ -110,12 +110,22 @@ def _vsp_cmds(sub):
              "scheduler snapshot from /debug/serve on --metrics-addr "
              "(active/queued per SLO class, KV-pool occupancy, "
              "capacity) plus last-60s TTFT percentiles from the flight "
-             "recorder's serve-kind entries; graceful when the "
+             "recorder's serve-kind entries; 'trace <rid>' renders one "
+             "request's phase timeline (queued / prefill chunks / "
+             "preempted / decode / CoW, with durations and the shared "
+             "trace_id) from the flight ring; 'top' renders the last N "
+             "iterations of the cost ledger (/debug/serve/ledger: "
+             "slots, chunk backlog, per-phase breakdown, preemption/"
+             "CoW rates, reconciliation verdict); graceful when the "
              "endpoint is unreachable (the service may simply not be "
              "running on this node)")
-    p.add_argument("action", choices=["status"])
+    p.add_argument("action", choices=["status", "trace", "top"])
+    p.add_argument("rid", nargs="?", default="",
+                   help="request id (trace action)")
     p.add_argument("--window", type=float, default=60.0,
                    help="TTFT percentile look-back window in seconds")
+    p.add_argument("--last", type=int, default=10,
+                   help="iterations of ledger history to render (top)")
     p.add_argument("--token", default="",
                    help="bearer token when the debug endpoints are "
                         "auth-filtered")
@@ -229,6 +239,113 @@ def render_serve(snapshot: dict, flight_events: list,
     return out
 
 
+def render_serve_trace(flight_events: list, rid: str) -> dict:
+    """One request's phase timeline from the flight ring's serve-kind
+    entries: the lifecycle spans (``serve.queued`` → ``serve.
+    prefill_chunk``... → ``serve.decode``) ordered by their
+    scheduler-clock start, plus the terminal marker (Completed /
+    Cancelled / ExecutorFailed / AdmissionRejected) and the trace id
+    they all share — the `tpuctl serve trace <rid>` answer to "where
+    did this request's time go"."""
+    phases = []
+    trace_ids = set()
+    terminal = None
+    ttft_s = None
+    for e in flight_events:
+        if e.get("kind") != "serve":
+            continue
+        attrs = e.get("attributes") or {}
+        if attrs.get("rid") != rid:
+            continue
+        if e.get("trace_id"):
+            trace_ids.add(e["trace_id"])
+        name = e.get("name", "")
+        if name.startswith("serve."):
+            try:
+                start = float(attrs.get("start_s", ""))
+            except ValueError:
+                start = None
+            phases.append({
+                "phase": name,
+                "startSeconds": start,
+                "durationSeconds": e.get("duration_s"),
+                "spanId": e.get("span_id"),
+                "attributes": {k: v for k, v in attrs.items()
+                               if k not in ("rid", "start_s")},
+            })
+        elif name in ("Completed", "Cancelled", "ExecutorFailed",
+                      "AdmissionRejected"):
+            terminal = name
+        elif name == "FirstToken":
+            try:
+                ttft_s = float(attrs.get("ttft_s", ""))
+            except ValueError:
+                pass
+    phases.sort(key=lambda p: (p["startSeconds"] is None,
+                               p["startSeconds"] or 0.0))
+    return {
+        "rid": rid,
+        "found": bool(phases or terminal is not None),
+        # every span of one request shares the ingress trace; >1 id
+        # here means the ring mixed two generations of the same rid
+        "traceId": (sorted(trace_ids)[0] if len(trace_ids) == 1
+                    else None),
+        "traceIds": sorted(trace_ids),
+        "phases": phases,
+        "terminal": terminal,
+        "ttftSeconds": ttft_s,
+    }
+
+
+def render_serve_top(snapshot: dict, ledger: dict,
+                     last: int = 10) -> dict:
+    """The `tpuctl serve top` view: the last *last* ledger iterations
+    folded into a live cost picture — slots and chunk backlog now,
+    per-phase seconds over the window, preemption/CoW rates per
+    iteration, and the standing ledger-vs-measured reconciliation
+    verdict."""
+    entries = (ledger.get("entries") or [])[-last:]
+    phase_totals: dict = {}
+    total = 0.0
+    for e in entries:
+        for phase, sec in (e.get("phases") or {}).items():
+            phase_totals[phase] = phase_totals.get(phase, 0.0) + sec
+        total += e.get("total_s", 0.0)
+    n = len(entries)
+    preempt_rate = cow_rate = 0.0
+    if n >= 2:
+        span = max(n - 1, 1)
+        preempt_rate = (entries[-1].get("preemptionsTotal", 0)
+                        - entries[0].get("preemptionsTotal", 0)) / span
+        cow_rate = (entries[-1].get("cowCopiesTotal", 0)
+                    - entries[0].get("cowCopiesTotal", 0)) / span
+    out = {
+        "iterations": n,
+        "lastIteration": entries[-1].get("iteration") if entries
+        else None,
+        "activeSlots": entries[-1].get("activeSlots") if entries
+        else None,
+        "queuedRequests": entries[-1].get("queuedRequests") if entries
+        else None,
+        "chunkBacklogTokens": entries[-1].get("chunkBacklogTokens")
+        if entries else None,
+        "phaseSeconds": {k: round(v, 6)
+                         for k, v in sorted(phase_totals.items())},
+        "totalSeconds": round(total, 6),
+        "phaseShare": {k: round(v / total, 4)
+                       for k, v in sorted(phase_totals.items())}
+        if total else {},
+        "preemptionsPerIteration": round(preempt_rate, 4),
+        "cowCopiesPerIteration": round(cow_rate, 4),
+        "reconciliation": ledger.get("reconciliation"),
+        "entries": entries,
+    }
+    capacity = (snapshot.get("capacity") or {}) if snapshot else {}
+    if capacity:
+        out["capacity"] = capacity
+    return out
+
+
 def render_faults(status: dict, flight_events: list) -> dict:
     """Fold the daemon's GetFaults answer with the flight recorder's
     fault-kind entries into the `tpuctl faults` view: the judged state
@@ -306,7 +423,33 @@ def run(args) -> dict:
         return fetch(args.metrics_addr, token=args.token,
                      path="/debug/health")
 
-    if args.cmd == "serve":  # action == "status" (the only one)
+    if args.cmd == "serve" and args.action == "trace":
+        from .utils.flight import fetch
+        if not args.rid:
+            raise SystemExit("serve trace needs a request id: "
+                             "tpuctl serve trace <rid>")
+        try:
+            snap = fetch(args.metrics_addr, token=args.token)
+        except Exception as e:  # noqa: BLE001 — graceful, like status
+            print(f"tpuctl: flight recorder unavailable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+            return {"reachable": False, "error": str(e)}
+        return render_serve_trace(snap.get("events", []), args.rid)
+
+    if args.cmd == "serve" and args.action == "top":
+        from .utils.flight import fetch
+        try:
+            ledger = fetch(args.metrics_addr, token=args.token,
+                           path="/debug/serve/ledger")
+            snap = fetch(args.metrics_addr, token=args.token,
+                         path="/debug/serve")
+        except Exception as e:  # noqa: BLE001 — graceful, like status
+            print(f"tpuctl: serve ledger unreachable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+            return {"reachable": False, "error": str(e)}
+        return render_serve_top(snap, ledger, last=args.last)
+
+    if args.cmd == "serve":  # action == "status"
         import time as _time
 
         from .utils.flight import fetch
@@ -344,8 +487,11 @@ def run(args) -> dict:
                       if e.get("trace_id") == args.trace]
         if args.kind:
             events = [e for e in events if e.get("kind") == args.kind]
+        # dropped: per-kind eviction counts — how much history the
+        # ring lost to overflow (tpu_flight_dropped_total's local view)
         return {"capacity": snap.get("capacity"),
-                "recorded": snap.get("recorded"), "events": events}
+                "recorded": snap.get("recorded"),
+                "dropped": snap.get("dropped", {}), "events": events}
 
     from .vsp.rpc import VspChannel, unix_target
 

@@ -75,6 +75,10 @@ class FlightRecorder:
         self._events: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._seq = 0
+        #: events evicted by ring overflow, per kind — the ring used to
+        #: overwrite silently, so a storm that outran it looked like a
+        #: complete history; mirrored to tpu_flight_dropped_total
+        self._dropped: dict = {}
 
     def record(self, kind: str, name: str,
                trace_id: Optional[str] = None,
@@ -103,19 +107,28 @@ class FlightRecorder:
             event["error"] = error
         if attributes:
             event["attributes"] = attributes
+        dropped_kind: Optional[str] = None
         with self._lock:
             self._seq += 1
             event["seq"] = self._seq
+            if len(self._events) == self.capacity:
+                dropped_kind = str(self._events[0].get("kind", ""))
+                self._dropped[dropped_kind] = \
+                    self._dropped.get(dropped_kind, 0) + 1
             self._events.append(event)
+        if dropped_kind is not None:
+            _count_dropped(dropped_kind)
 
     def snapshot(self) -> dict:
         """JSON-ready dump: events oldest-first plus eviction accounting
-        (``recorded - len(events)`` is how much history the ring lost)."""
+        (``recorded - len(events)`` is how much history the ring lost;
+        ``dropped`` breaks the loss down per kind)."""
         with self._lock:
             events = list(self._events)
             recorded = self._seq
+            dropped = dict(self._dropped)
         return {"capacity": self.capacity, "recorded": recorded,
-                "events": events}
+                "dropped": dropped, "events": events}
 
     def events(self, kind: Optional[str] = None,
                trace_id: Optional[str] = None) -> list:
@@ -132,6 +145,18 @@ class FlightRecorder:
         with self._lock:
             self._events.clear()
             self._seq = 0
+            self._dropped.clear()
+
+
+def _count_dropped(kind: str) -> None:
+    """Bump ``tpu_flight_dropped_total{kind}``. Lazy + guarded import:
+    :mod:`utils.metrics` imports this module at load time, and a span
+    finishing while metrics is still initializing must see a missing
+    counter as "not yet", never as an exception out of record()."""
+    from . import metrics
+    counter = getattr(metrics, "FLIGHT_DROPPED", None)
+    if counter is not None:
+        counter.inc(kind=kind)
 
 
 #: process-global recorder (the REGISTRY analog for events); sized from

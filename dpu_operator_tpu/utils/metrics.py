@@ -568,6 +568,28 @@ KV_PREFIX_BLOCK_HITS = REGISTRY.counter(
     "KV blocks served from the content-addressed prefix index instead "
     "of fresh allocation (each hit is block_size token slots not "
     "duplicated)")
+SERVE_STEP_BREAKDOWN = REGISTRY.histogram_vec(
+    "tpu_serve_step_breakdown_seconds",
+    "Per-iteration scheduler time decomposed by phase (prefill = "
+    "chunk-budget spend, decode = the executor's decode pass, cow = "
+    "KV-pool write/copy-on-write accounting, sched = admission/"
+    "completion/lock overhead) — the cost ledger's fleet view; the "
+    "per-iteration entries live at /debug/serve/ledger",
+    label="phase",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+SERVE_HEADROOM = REGISTRY.gauge(
+    "tpu_serve_headroom",
+    "Replica headroom digest by dimension (free_slots / "
+    "advertisable_slots / free_kv_blocks / chunk_backlog_tokens / "
+    "prefix_index_keys / slo_alerts_firing / fault_gate_capacity) — "
+    "the deterministic record the prefix/load-aware router scores "
+    "replicas by; served at /debug/serve/headroom")
+FLIGHT_DROPPED = REGISTRY.counter(
+    "tpu_flight_dropped_total",
+    "Flight-recorder events evicted by ring overflow, per kind — a "
+    "storm that outruns the ring is visible here instead of silently "
+    "overwriting history (tpuctl flight surfaces the same counts)")
 # -- static-analysis gate (opslint exception-hygiene rule) -------------------
 SWALLOWED_ERRORS = REGISTRY._add(_FlightRecordedCounter(
     "tpu_daemon_swallowed_errors_total",
@@ -742,6 +764,22 @@ class MetricsServer:
                     else:
                         import json
                         body = json.dumps(outer.health_check()).encode()
+                        ctype, code = "application/json", 200
+                elif self.path == "/debug":
+                    # index of the registered debug handlers so
+                    # operators stop guessing endpoint paths; same
+                    # token filter as the endpoints it lists
+                    denied = self._auth_denial()
+                    if denied is not None:
+                        code, body, ctype = denied
+                    else:
+                        import json
+                        paths = {"/debug/flight"}
+                        if outer.health_check is not None:
+                            paths.add("/debug/health")
+                        paths.update(outer.debug_handlers)
+                        body = json.dumps(
+                            {"debugHandlers": sorted(paths)}).encode()
                         ctype, code = "application/json", 200
                 elif self.path in outer.debug_handlers:
                     denied = self._auth_denial()

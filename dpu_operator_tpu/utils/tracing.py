@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
 import json
 import logging
 import os
@@ -85,6 +86,23 @@ def new_trace_id() -> str:
 
 def new_span_id() -> str:
     return uuid.uuid4().hex[:16]
+
+
+def det_trace_id(seed: str) -> str:
+    """Deterministic trace id from a stable seed string (sha256, not
+    PYTHONHASHSEED-dependent). The serving scheduler mints these for
+    requests that arrive without a caller context, so a seeded sim run
+    produces a bit-identical span tree across replays — a uuid4 root
+    would differ every run and break the serve-trace determinism gate."""
+    return hashlib.sha256(("trace:" + seed).encode()).hexdigest()[:32]
+
+
+def det_span_id(trace_id: str, key: str, seq: int) -> str:
+    """Deterministic span id for the *seq*-th span of *key* within
+    *trace_id* (the virtual-clock phase spans' id scheme: same request,
+    same phase order -> same span id, run after run)."""
+    return hashlib.sha256(
+        f"span:{trace_id}:{key}:{seq}".encode()).hexdigest()[:16]
 
 
 def current() -> Optional[SpanContext]:

@@ -193,6 +193,14 @@ class KvBlockPool:
             used = self._written_slots_locked()
             return max(0.0, (allocated - used) / allocated)
 
+    def prefix_index_keys(self) -> int:
+        """Chain keys currently published in the prefix index — the
+        headroom digest's measure of how much reusable prefix KV this
+        replica holds (a router scoring prefix-cache affinity compares
+        this, not raw occupancy)."""
+        with self._lock:
+            return len(self._index)
+
     def owners(self) -> list[str]:
         with self._lock:
             return sorted(self._owned)
@@ -428,4 +436,5 @@ class KvBlockPool:
                                      for b in self._owned.values()),
                 "cowCopies": self.cow_copies,
                 "prefixBlockHits": self.prefix_block_hits,
+                "prefixIndexKeys": len(self._index),
             }

@@ -37,6 +37,17 @@ Operator seams (the reason this lives behind the operator at all):
   ``/debug/serve`` (MetricsServer debug handler) and rendered by
   ``tpuctl serve status``; first tokens are flight-recorded
   (kind=``serve``) so the CLI can compute last-60s TTFT percentiles.
+  The whole request LIFECYCLE is traced: every phase — queued, each
+  prefill chunk, each decode residency episode, preempted waits, CoW
+  copies — lands in the flight ring as a virtual-clock-aware span
+  (kind=``serve``, deterministic ids, the ingress trace's trace_id),
+  rendered by ``tpuctl serve trace <rid>``; each :meth:`Scheduler.step`
+  writes a :class:`StepLedger` cost entry (``/debug/serve/ledger``,
+  ``tpuctl serve top``) whose phase sum reconciles with the observed
+  iteration time; and the replica headroom digest
+  (``/debug/serve/headroom``, ``tpu_serve_headroom{dimension}``) is
+  the router-facing capacity record (doc/observability.md "Serving
+  trace model").
 
 Token generation is pluggable: :class:`SimExecutor` emits synthetic
 tokens (scheduling tests and the serving bench), :class:`JaxSlotExecutor`
@@ -49,6 +60,7 @@ with the fused ``generate()`` scan.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import heapq
 import itertools
@@ -115,6 +127,19 @@ class Request:
     prefix_keys: Optional[list] = dataclasses.field(default=None,
                                                     repr=False)
     shared_tokens: int = 0
+    #: request-lifecycle tracing: every phase span carries trace_id
+    #: (the caller's, via the ingress traceparent, or a deterministic
+    #: one minted from the rid) under parent_span_id; span_seq drives
+    #: the deterministic per-request span-id sequence
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
+    span_seq: int = 0
+    #: phase bookkeeping: when the current wait began (arrival, or the
+    #: eviction that started a preempted wait) and the open decode
+    #: residency episode (start + iterations so far)
+    queued_since_s: Optional[float] = None
+    decode_since_s: Optional[float] = None
+    decode_iters: int = 0
 
     def fresh_copy(self) -> "Request":
         """Spec-only copy (id, lengths, class, arrival, prompt):
@@ -390,6 +415,70 @@ class JaxSlotExecutor:
         return out
 
 
+#: the ledger's phase keys, in render order
+LEDGER_PHASES = ("prefill", "decode", "cow", "sched")
+
+
+class StepLedger:
+    """Bounded ring of per-iteration cost entries: each ``step()``
+    decomposes its measured (real clock) or modeled (virtual clock)
+    time into prefill-budget spend, decode compute, CoW/pool write
+    accounting, and scheduling/lock overhead. Served at
+    ``/debug/serve/ledger``, summarized into
+    ``tpu_serve_step_breakdown_seconds{phase}``, rendered by ``tpuctl
+    serve top`` — and RECONCILED: the phase sum must track the observed
+    iteration time, so attribution cannot silently rot (the serve-check
+    gate asserts :meth:`reconcile` stays clean under a stalling
+    executor)."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        self.capacity = capacity
+        self._entries: collections.deque = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            self._entries.append(entry)
+        for phase, seconds in entry["phases"].items():
+            metrics.SERVE_STEP_BREAKDOWN.observe(phase, seconds)
+
+    def entries(self, last: Optional[int] = None) -> list:
+        with self._lock:
+            out = list(self._entries)
+        return out[-last:] if last else out
+
+    def reconcile(self, tolerance_s: float = 0.005,
+                  rel: float = 0.02) -> dict:
+        """Ledger-vs-measured-step-time check: per entry,
+        ``|sum(phases) - total_s|`` must stay within
+        ``max(tolerance_s, rel * total_s)`` (absolute floor covers
+        timer granularity between segments; the relative term covers
+        long stalled iterations). Returns the verdict the serve gate
+        asserts on."""
+        with self._lock:
+            entries = list(self._entries)
+        violations = 0
+        worst_gap = 0.0
+        worst_it = None
+        for e in entries:
+            gap = abs(sum(e["phases"].values()) - e["total_s"])
+            if gap > max(tolerance_s, rel * e["total_s"]):
+                violations += 1
+            if gap > worst_gap:
+                worst_gap, worst_it = gap, e["iteration"]
+        return {"checked": len(entries), "violations": violations,
+                "maxGapSeconds": round(worst_gap, 6),
+                "worstIteration": worst_it, "ok": violations == 0}
+
+    def snapshot(self) -> dict:
+        """JSON view for ``/debug/serve/ledger``: the ring plus the
+        standing reconciliation verdict."""
+        return {"capacity": self.capacity, "entries": self.entries(),
+                "phases": list(LEDGER_PHASES),
+                "reconciliation": self.reconcile()}
+
+
 class Scheduler:
     """Iteration-level continuous-batching scheduler (the tentpole).
 
@@ -472,6 +561,13 @@ class Scheduler:
         #: artifact (never includes wall-clock values)
         self.trace: list[tuple] = []
         self._recent_ttft: list[float] = []
+        #: per-iteration cost ledger (/debug/serve/ledger); under a
+        #: virtual clock _advance_locked attributes each modeled cost
+        #: to the phase named here, so modeled and measured runs share
+        #: one decomposition path
+        self.ledger = StepLedger()
+        self._ledger_phases: Optional[dict] = None
+        self._ledger_phase: Optional[str] = None
         self._update_gauges()
 
     # -- intake ---------------------------------------------------------------
@@ -530,7 +626,20 @@ class Scheduler:
                 return False
         self.iterations += 1
         it = self.iterations
+        # per-iteration cost ledger: real-clock runs measure each
+        # segment against the injected clock (a stalled executor's 3 s
+        # lands in the phase that stalled, not the modeled cost);
+        # virtual runs attribute the modeled advances via
+        # _advance_locked under self._ledger_phase
+        real = self._clock is not None
+        phases = dict.fromkeys(LEDGER_PHASES, 0.0)
+        self._ledger_phases = phases
+        step_start = self._mark()
+        seg = step_start
+        self._ledger_phase = "sched"
         admitted = self._admit_locked(it)
+        if real:
+            phases["sched"] += self._mark() - seg
         # the ITL an interleaved iteration actually costs includes the
         # prefill chunks it carried — start the clock before them
         iter_start = self.now
@@ -538,12 +647,19 @@ class Scheduler:
             for req in admitted:
                 req.state = PREFILLING
                 self._prefilling.append(req)
+            seg = self._mark()
+            self._ledger_phase = "prefill"
             self._prefill_pass_locked(it)
+            if real:
+                phases["prefill"] += self._mark() - seg
         else:
+            seg = self._mark()
+            self._ledger_phase = "prefill"
             for req in admitted:
                 # legacy atomic prefill at admission (shared-prefix
                 # coverage still skips modeled cost for prefix-aware
                 # executors; prefill_start was set by _admit_locked)
+                prefill_start = self._mark()
                 self._advance_locked(self.cost.prefill_s(
                     req.prefill_target - req.prefill_start))
                 try:
@@ -553,42 +669,67 @@ class Scheduler:
                     self._fail_request_locked(it, req, e)
                     continue
                 req.prefilled = req.prefill_target
+                self._phase_span_locked(
+                    req, "serve.prefill", prefill_start, self._mark(),
+                    tokens=req.prefill_target - req.prefill_start,
+                    offset=req.prefill_start)
                 self._finish_prefill(it, req, tok)
+            if real:
+                phases["prefill"] += self._mark() - seg
             iter_start = self.now
         active = sorted((slot, req) for slot, req in self._active.items()
                         if req.state == RUNNING
                         and len(req.tokens) < req.output_len)
         if active:
+            seg = self._mark()
+            self._ledger_phase = "decode"
             self._advance_locked(self.cost.decode_s(len(active)))
             toks = self.executor.step(active)
             self._tick_locked()
+            if real:
+                phases["decode"] += self._mark() - seg
             # real clock: the MEASURED iteration time (the serve-tokens
             # SLO must see a 3 s stall as 3 s, not as the modeled cost);
             # virtual clock: the modeled cost just advanced — including
             # any prefill chunks this iteration interleaved
-            metrics.SERVE_ITL_SECONDS.observe(self.now - iter_start)
+            metrics.SERVE_ITL_SECONDS.observe(
+                self.now - iter_start,
+                exemplar=({"trace_id": active[0][1].trace_id}
+                          if active[0][1].trace_id else None))
+            seg = self._mark()
+            self._ledger_phase = "cow"
             for slot, req in active:
                 # write accounting only matters under sharing (CoW /
                 # unpublish); skipping it otherwise keeps one mutex
                 # round-trip per slot off the no-sharing hot path
-                if self._share and self.pool.write_token(
-                        req.rid, req.prompt_len + len(req.tokens)) \
-                        is None:
-                    # copy-on-write against a FULL pool: proceed
-                    # UNCOPIED rather than stall — a stalled request
-                    # holds its blocks and frees nothing, so an
-                    # all-interactive share-stalled batch would
-                    # livelock (nothing decodable to preempt). The
-                    # accounting executor stores no data, so the only
-                    # cost is an uncopied divergence, made visible in
-                    # the trace.
-                    self.trace.append(("cow_uncopied", it, req.rid))
+                if self._share:
+                    pos = req.prompt_len + len(req.tokens)
+                    wrote = self.pool.write_token(req.rid, pos)
+                    if wrote is None:
+                        # copy-on-write against a FULL pool: proceed
+                        # UNCOPIED rather than stall — a stalled
+                        # request holds its blocks and frees nothing,
+                        # so an all-interactive share-stalled batch
+                        # would livelock (nothing decodable to
+                        # preempt). The accounting executor stores no
+                        # data, so the only cost is an uncopied
+                        # divergence, made visible in the trace.
+                        self.trace.append(("cow_uncopied", it, req.rid))
+                    elif wrote:
+                        self._phase_span_locked(req, "serve.cow",
+                                                self.now, self.now,
+                                                pos=pos)
                 req.tokens.append(toks[slot])
+                req.decode_iters += 1
                 self.pool.set_used_tokens(
                     req.rid, req.prompt_len + len(req.tokens))
                 metrics.SERVE_TOKENS.inc(phase="decode")
                 self._notify(req, "token", toks[slot])
+            if real:
+                phases["cow"] += self._mark() - seg
             self.trace.append(("decode", it, len(active)))
+        seg = self._mark()
+        self._ledger_phase = "sched"
         for slot in sorted(self._active):
             req = self._active[slot]
             if len(req.tokens) >= req.output_len:
@@ -598,6 +739,22 @@ class Scheduler:
             del self.completed[:-self.history_limit]
             del self.rejected[:-self.history_limit]
         self._update_gauges()
+        if real:
+            phases["sched"] += self._mark() - seg
+        self._ledger_phase = None
+        self._ledger_phases = None
+        self.ledger.record({
+            "iteration": it,
+            "now_s": round(self.now, 6),
+            "activeSlots": len(self._active),
+            "queuedRequests": self._queued_count(),
+            "chunkBacklogTokens": self._prefill_backlog(),
+            "admitted": len(admitted),
+            "phases": {k: round(v, 6) for k, v in phases.items()},
+            "total_s": round(self._mark() - step_start, 6),
+            "preemptionsTotal": self.preemptions,
+            "cowCopiesTotal": self.pool.cow_copies,
+        })
         return True
 
     def run(self, max_steps: int = 1_000_000) -> int:
@@ -611,6 +768,10 @@ class Scheduler:
     def _advance_locked(self, cost_s: float) -> None:
         if self._clock is None:
             self.now += cost_s
+            # virtual-clock ledger attribution: the modeled cost lands
+            # in whatever phase the step loop is currently executing
+            if self._ledger_phases is not None and self._ledger_phase:
+                self._ledger_phases[self._ledger_phase] += cost_s
 
     def _tick_locked(self) -> None:
         """Under a real clock, re-read it so latency stamps (TTFT, ITL)
@@ -618,6 +779,46 @@ class Scheduler:
         modeled cost; virtual time is advanced by _advance_locked instead."""
         if self._clock is not None:
             self.now = self._clock()
+
+    # -- request-lifecycle tracing --------------------------------------------
+    def _ensure_trace_locked(self, req: Request) -> None:
+        """Every request the scheduler touches carries a trace: the
+        ingress stamps the caller's (via traceparent) before submit;
+        anything else gets a DETERMINISTIC id minted from the rid, so
+        seeded sim runs replay bit-identical span trees."""
+        if req.trace_id is None:
+            req.trace_id = tracing.det_trace_id(req.rid)
+
+    def _phase_span_locked(self, req: Request, name: str,
+                           start_s: float, end_s: float,
+                           **attrs: object) -> None:
+        """Record one lifecycle phase span to the flight ring
+        (kind=``serve``, same trace_id as the ingress span). Times are
+        the scheduler's clock — virtual in sim runs, so the span tree
+        (ids, starts, durations, attributes) is a pure function of the
+        seed; ``tpuctl serve trace <rid>`` renders these into the phase
+        timeline."""
+        self._ensure_trace_locked(req)
+        assert req.trace_id is not None
+        span_id = tracing.det_span_id(req.trace_id, req.rid,
+                                      req.span_seq)
+        req.span_seq += 1
+        attributes = {"rid": req.rid, "start_s": f"{start_s:.6f}"}
+        if req.parent_span_id:
+            attributes["parent_span_id"] = req.parent_span_id
+        attributes.update({k: str(v) for k, v in attrs.items()})
+        flight.record("serve", name, trace_id=req.trace_id,
+                      span_id=span_id,
+                      duration_s=round(max(0.0, end_s - start_s), 6),
+                      attributes=attributes)
+
+    def _mark(self) -> float:
+        """The measuring clock for phase/ledger boundaries: the
+        injected clock under real time (a 3 s executor stall must
+        attribute as 3 s of decode, not the modeled cost), the virtual
+        clock otherwise (where _advance_locked has already moved it by
+        the modeled cost)."""
+        return self._clock() if self._clock is not None else self.now
 
     def _next_arrival(self) -> Optional[float]:
         with self._lock:
@@ -661,10 +862,13 @@ class Scheduler:
                              f"({self.config.queue_limit}); rejecting "
                              "new requests (service saturated)")
             else:
+                self._ensure_trace_locked(req)
+                req.queued_since_s = req.arrival_s
                 queue.append(req)
                 self._live_rids.add(req.rid)
 
     def _reject_locked(self, req: Request, reason: str, message: str) -> None:
+        self._ensure_trace_locked(req)
         req.state = REJECTED
         req.reject_reason = reason
         self.rejected.append(req)
@@ -675,8 +879,10 @@ class Scheduler:
             slo_class=req.slo_class, reason=reason)
         metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
                                    outcome="rejected")
-        flight.record("serve", "AdmissionRejected", attributes={
-            "rid": req.rid, "class": req.slo_class, "reason": reason})
+        flight.record("serve", "AdmissionRejected",
+                      trace_id=req.trace_id, attributes={
+                          "rid": req.rid, "class": req.slo_class,
+                          "reason": reason})
         watchdog.emit_health_event(
             "ServeAdmissionRejected", message, "Warning",
             series=f"serve-admission/{req.slo_class}")
@@ -743,6 +949,20 @@ class Scheduler:
             req.slot = slot
             req.state = RUNNING
             req.admitted_s = self.now
+            # close the wait phase: the first admission ends
+            # serve.queued (arrival -> admit); a re-admission after an
+            # eviction ends serve.preempted (evict -> re-admit)
+            wait_start = (req.queued_since_s
+                          if req.queued_since_s is not None
+                          else req.arrival_s)
+            self._phase_span_locked(
+                req,
+                "serve.preempted" if req.preemptions else "serve.queued",
+                wait_start, self.now, slo_class=req.slo_class,
+                slot=slot,
+                **({"preemptions": req.preemptions}
+                   if req.preemptions else {}))
+            req.queued_since_s = None
             req.prefill_target = req.prompt_len + len(req.tokens)
             # shared coverage is already-computed KV: prefill resumes
             # past it (always leaving >= 1 token, whose logits pick the
@@ -775,6 +995,7 @@ class Scheduler:
                 if remaining <= 0:
                     break
                 n = min(budget, remaining, cap or remaining)
+                chunk_start = self._mark()
                 self._advance_locked(self.cost.prefill_s(n))
                 try:
                     tok = self.executor.prefill_chunk(req, req.slot,
@@ -785,6 +1006,10 @@ class Scheduler:
                     # re-raise every iteration and wedge the service
                     self._fail_request_locked(it, req, e)
                     break
+                self._phase_span_locked(req, "serve.prefill_chunk",
+                                        chunk_start, self._mark(),
+                                        tokens=n, offset=req.prefilled,
+                                        iteration=it)
                 req.prefilled += n
                 # per-chunk progress to the pool: a long prompt fills
                 # its blocks over many iterations, and the
@@ -831,14 +1056,26 @@ class Scheduler:
             # unpublish them
             self.pool.register_prefix(req.rid, req.prefix_keys,
                                       req.prompt_len)
-        if self._share and self.pool.write_token(
-                req.rid, req.prompt_len + len(req.tokens)) is None:
-            # copy-on-write against a FULL pool at first-token time:
-            # proceed uncopied but say so — accounting executors store
-            # no data and physical executors never share, but a real
-            # paged kernel would need the one-block headroom
-            log.warning("kv pool exhausted at CoW for %s; divergence "
-                        "proceeds uncopied", req.rid)
+        if self._share:
+            wrote = self.pool.write_token(
+                req.rid, req.prompt_len + len(req.tokens))
+            if wrote is None:
+                # copy-on-write against a FULL pool at first-token
+                # time: proceed uncopied but say so — accounting
+                # executors store no data and physical executors never
+                # share, but a real paged kernel would need the
+                # one-block headroom
+                log.warning("kv pool exhausted at CoW for %s; "
+                            "divergence proceeds uncopied", req.rid)
+            elif wrote:
+                self._phase_span_locked(
+                    req, "serve.cow", self.now, self.now,
+                    pos=req.prompt_len + len(req.tokens))
+        # the decode residency episode opens with this first/
+        # continuation token; iterations accrue in the decode pass and
+        # the serve.decode span closes at completion or preemption
+        req.decode_since_s = self.now
+        req.decode_iters = 0
         req.tokens.append(tok)
         self.pool.set_used_tokens(req.rid,
                                   req.prompt_len + len(req.tokens))
@@ -879,10 +1116,31 @@ class Scheduler:
                             if r.rid == rid), None)
             if req is None:
                 return False
+            self._close_open_phase_locked(req, "cancelled")
             self._release_locked(req)
             self._record_cancel_locked(req)
             self._update_gauges()
             return True
+
+    def _close_open_phase_locked(self, req: Request,
+                                 outcome: str) -> None:
+        """End whatever lifecycle phase *req* is in mid-flight — the
+        open decode residency or an unfinished wait — so an abandoned
+        or poisoned request still renders a complete timeline (the
+        exact requests this tracing exists to debug)."""
+        if req.decode_since_s is not None:
+            self._phase_span_locked(
+                req, "serve.decode", req.decode_since_s, self.now,
+                iterations=req.decode_iters, tokens=len(req.tokens),
+                outcome=outcome)
+            req.decode_since_s = None
+        elif req.queued_since_s is not None and req.slot is None:
+            self._phase_span_locked(
+                req,
+                "serve.preempted" if req.preemptions
+                else "serve.queued",
+                req.queued_since_s, self.now, outcome=outcome)
+            req.queued_since_s = None
 
     def _release_locked(self, req: Request) -> None:
         """Free every per-request resource — chunk-queue entry, batch
@@ -906,7 +1164,7 @@ class Scheduler:
         self.trace.append(("cancel", self.iterations, req.rid))
         metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
                                    outcome="cancelled")
-        flight.record("serve", "Cancelled",
+        flight.record("serve", "Cancelled", trace_id=req.trace_id,
                       attributes={"rid": req.rid})
 
     def _fail_request_locked(self, it: int, req: Request,
@@ -917,6 +1175,7 @@ class Scheduler:
         log.warning("executor failed for %s (failing the request): %s",
                     req.rid, exc)
         metrics.SWALLOWED_ERRORS.inc(site="serve.executor")
+        self._close_open_phase_locked(req, "failed")
         self._release_locked(req)
         req.state = REJECTED
         req.reject_reason = "executor_error"
@@ -925,8 +1184,10 @@ class Scheduler:
         self.trace.append(("fail", it, req.rid))
         metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
                                    outcome="failed")
-        flight.record("serve", "ExecutorFailed", attributes={
-            "rid": req.rid, "error": f"{type(exc).__name__}: {exc}"})
+        flight.record("serve", "ExecutorFailed", trace_id=req.trace_id,
+                      attributes={
+                          "rid": req.rid,
+                          "error": f"{type(exc).__name__}: {exc}"})
         self._notify(req, "rejected", "executor_error")
 
     def _notify(self, req: Request, event: str, value: object) -> None:
@@ -987,6 +1248,16 @@ class Scheduler:
                     self.prefill_tokens_discarded += discarded
                     metrics.SERVE_PREFILL_CHUNK_TOKENS.inc(
                         discarded, outcome="discarded")
+            if phase == "decode" and victim.decode_since_s is not None:
+                # the residency episode ends here; a later re-admission
+                # opens a fresh serve.decode span
+                self._phase_span_locked(
+                    victim, "serve.decode", victim.decode_since_s,
+                    self.now, iterations=victim.decode_iters,
+                    tokens=len(victim.tokens), outcome="preempted")
+            victim.decode_since_s = None
+            victim.decode_iters = 0
+            victim.queued_since_s = self.now
             victim.prefilled = 0
             victim.state = QUEUED
             victim.preemptions += 1
@@ -996,10 +1267,12 @@ class Scheduler:
             self.trace.append(("preempt", it, victim.rid, req.rid,
                                phase, discarded))
             metrics.SERVE_PREEMPTIONS.inc(reason="kv_pressure")
-            flight.record("serve", "Preempted", attributes={
-                "rid": victim.rid, "for": req.rid, "phase": phase,
-                "tokens_done": str(len(victim.tokens)),
-                "prefill_discarded": str(discarded)})
+            flight.record("serve", "Preempted",
+                          trace_id=victim.trace_id, attributes={
+                              "rid": victim.rid, "for": req.rid,
+                              "phase": phase,
+                              "tokens_done": str(len(victim.tokens)),
+                              "prefill_discarded": str(discarded)})
             watchdog.emit_health_event(
                 "ServePreempted",
                 f"batch-class request {victim.rid} evicted "
@@ -1010,6 +1283,12 @@ class Scheduler:
             and self.pool.can_alloc(blocks)
 
     def _complete_locked(self, it: int, slot: int, req: Request) -> None:
+        if req.decode_since_s is not None:
+            self._phase_span_locked(
+                req, "serve.decode", req.decode_since_s, self.now,
+                iterations=req.decode_iters, tokens=len(req.tokens),
+                outcome="complete")
+            req.decode_since_s = None
         self._release_locked(req)
         req.state = DONE
         req.finish_s = self.now
@@ -1018,20 +1297,27 @@ class Scheduler:
         self.trace.append(("complete", it, req.rid, len(req.tokens)))
         metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
                                    outcome="completed")
-        flight.record("serve", "Completed", attributes={
-            "rid": req.rid, "class": req.slo_class,
-            "tokens": str(len(req.tokens)),
-            "preemptions": str(req.preemptions)})
+        flight.record("serve", "Completed", trace_id=req.trace_id,
+                      attributes={
+                          "rid": req.rid, "class": req.slo_class,
+                          "tokens": str(len(req.tokens)),
+                          "preemptions": str(req.preemptions)})
         self._notify(req, "done", len(req.tokens))
 
     def _record_first_token(self, req: Request) -> None:
         ttft = req.ttft_s or 0.0
-        metrics.SERVE_TTFT_SECONDS.observe(ttft)
+        # OpenMetrics exemplar: the tail bucket this TTFT lands in
+        # links straight back to the request's trace (and from there
+        # to its phase timeline in the flight ring)
+        metrics.SERVE_TTFT_SECONDS.observe(
+            ttft, exemplar=({"trace_id": req.trace_id}
+                            if req.trace_id else None))
         self._recent_ttft.append(ttft)
         del self._recent_ttft[:-64]
-        flight.record("serve", "FirstToken", attributes={
-            "rid": req.rid, "class": req.slo_class,
-            "ttft_s": f"{ttft:.6f}"})
+        flight.record("serve", "FirstToken", trace_id=req.trace_id,
+                      attributes={
+                          "rid": req.rid, "class": req.slo_class,
+                          "ttft_s": f"{ttft:.6f}"})
 
     def _prefill_backlog(self) -> int:
         return sum(max(0, r.prefill_target - r.prefilled)
@@ -1044,27 +1330,74 @@ class Scheduler:
             metrics.SERVE_ACTIVE.set(
                 float(sum(1 for r in self._active.values()
                           if r.slo_class == cls)), slo_class=cls)
-        metrics.SERVE_SLOTS.set(float(len(self._free_slots)),
-                                state="free")
+        free_slots = len(self._free_slots)
+        backlog = self._prefill_backlog()
+        metrics.SERVE_SLOTS.set(float(free_slots), state="free")
         metrics.SERVE_SLOTS.set(float(len(self._active)), state="active")
-        metrics.SERVE_PREFILL_BACKLOG.set(float(self._prefill_backlog()))
+        metrics.SERVE_PREFILL_BACKLOG.set(float(backlog))
+        # scheduler-owned headroom dimensions refresh every step so a
+        # scrape never reads stale router signal; the SLO/fault dims
+        # are folded in by DecodeService.headroom(). Everything is
+        # computed from values already in hand (one pool-lock read for
+        # the free list, one more only when sharing is on) — the step
+        # path must not re-pay capacity()'s lock round trips per
+        # iteration
+        free_blocks = self.pool.free_blocks()
+        metrics.SERVE_HEADROOM.set(float(free_slots),
+                                   dimension="free_slots")
+        metrics.SERVE_HEADROOM.set(
+            float(self._advertisable(free_slots, free_blocks)),
+            dimension="advertisable_slots")
+        metrics.SERVE_HEADROOM.set(float(free_blocks),
+                                   dimension="free_kv_blocks")
+        metrics.SERVE_HEADROOM.set(float(backlog),
+                                   dimension="chunk_backlog_tokens")
+        metrics.SERVE_HEADROOM.set(
+            float(self.pool.prefix_index_keys() if self._share else 0),
+            dimension="prefix_index_keys")
 
     # -- operator seams -------------------------------------------------------
+    def _advertisable(self, free_slots: int, free_blocks: int) -> int:
+        """Free slots derated so every advertised slot is backed by
+        enough free KV blocks for a typical request (an unfeedable
+        slot would admit-then-starve)."""
+        typical = self.pool.blocks_for_tokens(self.config.typical_tokens)
+        return min(free_slots, free_blocks // max(typical, 1))
+
     def capacity(self) -> dict:
         """What the device plugin advertises: slots that could take a
-        request NOW — free batch slots, derated so every advertised
-        slot is backed by enough free KV blocks for a typical request
-        (an unfeedable slot would admit-then-starve)."""
-        typical = self.pool.blocks_for_tokens(self.config.typical_tokens)
+        request NOW, KV-derated via :meth:`_advertisable`."""
         with self._state_lock:
             free_slots = len(self._free_slots)
         free_blocks = self.pool.free_blocks()
-        feedable = free_blocks // max(typical, 1)
         return {
             "slots": self.config.slots,
             "freeSlots": free_slots,
             "freeKvBlocks": free_blocks,
-            "advertisableSlots": min(free_slots, feedable),
+            "advertisableSlots": self._advertisable(free_slots,
+                                                    free_blocks),
+        }
+
+    def headroom(self) -> dict:
+        """The replica headroom digest's scheduler-owned dimensions: a
+        compact DETERMINISTIC record computed from the snapshot path —
+        exactly what a prefix/load-aware router scores replicas by
+        (free capacity, how backed-up prefill is, how much reusable
+        prefix KV this replica holds). The DecodeService folds in the
+        SLO alert states and fault-gate capacity and serves the result
+        at ``/debug/serve/headroom``."""
+        with self._state_lock:
+            cap = self.capacity()
+            backlog = self._prefill_backlog()
+            queued = {cls: len(q) for cls, q in self._queues.items()}
+        return {
+            "slots": self.config.slots,
+            "freeSlots": cap["freeSlots"],
+            "advertisableSlots": cap["advertisableSlots"],
+            "freeKvBlocks": cap["freeKvBlocks"],
+            "chunkBacklogTokens": backlog,
+            "queueDepth": queued,
+            "prefixIndexKeys": self.pool.prefix_index_keys(),
         }
 
     def snapshot(self) -> dict:
@@ -1116,13 +1449,23 @@ class DecodeService:
 
     def __init__(self, scheduler: Scheduler,
                  idle_interval_s: float = 0.05,
-                 stream_timeout_s: float = 30.0) -> None:
+                 stream_timeout_s: float = 30.0,
+                 evaluator=None,
+                 fault_capacity_fn: Optional[Callable[[], Optional[int]]]
+                 = None) -> None:
         self.scheduler = scheduler
         self.idle_interval_s = idle_interval_s
         #: how long a streaming response waits for the next token
         #: before giving up on the scheduler (a wedged loop must not
         #: hold client connections forever)
         self.stream_timeout_s = stream_timeout_s
+        #: SLO evaluator whose active serve-* alerts join the headroom
+        #: digest (None -> the process-global slo.EVALUATOR)
+        self.evaluator = evaluator
+        #: fault-gate capacity source (the device plugin's operational
+        #: chip count after fault-domain withdrawal); None -> the
+        #: dimension is reported as null and gauged as 0
+        self.fault_capacity_fn = fault_capacity_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._http = None
@@ -1130,7 +1473,33 @@ class DecodeService:
         self._rid_seq = itertools.count()
 
     def debug_handlers(self) -> dict:
-        return {"/debug/serve": self.scheduler.snapshot}
+        return {"/debug/serve": self.scheduler.snapshot,
+                "/debug/serve/ledger": self.scheduler.ledger.snapshot,
+                "/debug/serve/headroom": self.headroom}
+
+    def headroom(self) -> dict:
+        """The full replica headroom digest: the scheduler's snapshot
+        dimensions plus the health engine's view — active serve SLO
+        alerts and fault-gate capacity — the exact record the fleet
+        router scores against. Also refreshes the
+        ``tpu_serve_headroom`` gauges for those folded dimensions."""
+        from ..utils import slo as _slo
+        digest = self.scheduler.headroom()
+        ev = self.evaluator if self.evaluator is not None \
+            else _slo.EVALUATOR
+        alerts = [{"slo": name, "severity": severity}
+                  for name, severity in ev.active_alerts()
+                  if name.startswith("serve-")]
+        digest["sloAlerts"] = alerts
+        fault_capacity = (self.fault_capacity_fn()
+                          if self.fault_capacity_fn is not None
+                          else None)
+        digest["faultGateCapacity"] = fault_capacity
+        metrics.SERVE_HEADROOM.set(float(len(alerts)),
+                                   dimension="slo_alerts_firing")
+        metrics.SERVE_HEADROOM.set(float(fault_capacity or 0),
+                                   dimension="fault_gate_capacity")
+        return digest
 
     # -- streaming ingress ----------------------------------------------------
     def start_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -1208,7 +1577,15 @@ class DecodeService:
                 req.stream = lambda ev, val: events.put((ev, val))
                 with tracing.context_scope(ctx), tracing.span(
                         "serve.request", rid=req.rid,
-                        slo_class=req.slo_class):
+                        slo_class=req.slo_class) as span_ctx:
+                    # the scheduler's phase spans join this trace;
+                    # they parent on the CALLER's span id when one was
+                    # adopted (deterministic given the same
+                    # traceparent) and on the serve.request span
+                    # otherwise
+                    req.trace_id = span_ctx.trace_id
+                    req.parent_span_id = (ctx.span_id if ctx
+                                          else span_ctx.span_id)
                     t0 = time.monotonic()
                     outer.scheduler.submit_now(req)
                     self.send_response(200)
@@ -1230,7 +1607,9 @@ class DecodeService:
                             if ev == "token":
                                 if first:
                                     metrics.SERVE_WIRE_TTFT_SECONDS \
-                                        .observe(time.monotonic() - t0)
+                                        .observe(
+                                            time.monotonic() - t0,
+                                            exemplar=tracing.exemplar())
                                     first = False
                                 self._write_chunk({"token": val})
                             elif ev == "done":

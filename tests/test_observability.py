@@ -322,3 +322,93 @@ def test_concurrent_render_vs_observe_storm_stays_grammar_valid():
     # post-join totals are exact: nothing torn or lost
     assert hist.count == 4 * 400
     assert counter.total() == 4 * 400
+
+
+def test_flight_ring_counts_evictions_per_kind():
+    """The ring used to overwrite silently; now every eviction is
+    accounted per kind — in the snapshot AND in
+    tpu_flight_dropped_total, so a storm outrunning the ring is
+    visible."""
+    from dpu_operator_tpu.utils import metrics
+
+    rec = flight.FlightRecorder(capacity=4)
+    for i in range(4):
+        rec.record("span", f"keep{i}")
+    assert rec.snapshot()["dropped"] == {}
+    span_before = metrics.FLIGHT_DROPPED.value(kind="span")
+    serve_before = metrics.FLIGHT_DROPPED.value(kind="serve")
+    for i in range(3):
+        rec.record("serve", f"storm{i}")  # evicts three span entries
+    rec.record("breaker", "flip")         # evicts the last span
+    rec.record("watch", "relist")         # evicts a serve entry
+    snap = rec.snapshot()
+    assert snap["dropped"] == {"span": 4, "serve": 1}
+    assert snap["recorded"] == 9 and len(snap["events"]) == 4
+    assert metrics.FLIGHT_DROPPED.value(kind="span") == span_before + 4
+    assert metrics.FLIGHT_DROPPED.value(kind="serve") \
+        == serve_before + 1
+    rec.clear()
+    assert rec.snapshot()["dropped"] == {}
+
+
+def test_tpuctl_flight_surfaces_dropped_counts():
+    from dpu_operator_tpu import tpuctl
+
+    flight.RECORDER.clear()
+    overflow = flight.RECORDER.capacity + 5
+    for i in range(overflow):
+        flight.record("span", f"storm{i}")
+    server = MetricsServer(host="127.0.0.1")
+    server.start()
+    try:
+        args = type("A", (), {"cmd": "flight", "trace": "", "kind": "",
+                              "token": "",
+                              "metrics_addr": f"127.0.0.1:{server.port}",
+                              "agent_socket": "", "vsp_socket": "",
+                              "daemon_addr": ""})()
+        out = tpuctl.run(args)
+    finally:
+        server.stop()
+        flight.RECORDER.clear()
+    assert out["dropped"].get("span") == 5
+    assert out["recorded"] == overflow
+
+
+def test_debug_index_lists_registered_handlers():
+    """GET /debug enumerates the debug surface — built-ins plus every
+    registered handler — behind the same token filter."""
+    server = MetricsServer(
+        host="127.0.0.1", health_check=lambda: {"healthy": True},
+        debug_handlers={"/debug/serve": lambda: {},
+                        "/debug/serve/ledger": lambda: {}})
+    server.start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug", timeout=5).read())
+    finally:
+        server.stop()
+    assert body["debugHandlers"] == [
+        "/debug/flight", "/debug/health", "/debug/serve",
+        "/debug/serve/ledger"]
+
+    # no health snapshot wired -> /debug/health is not advertised
+    server = MetricsServer(host="127.0.0.1")
+    server.start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug", timeout=5).read())
+    finally:
+        server.stop()
+    assert body["debugHandlers"] == ["/debug/flight"]
+
+
+def test_debug_index_shares_metrics_auth():
+    server = MetricsServer(host="127.0.0.1", auth=lambda token: False)
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug", timeout=5)
+        assert exc.value.code == 401
+    finally:
+        server.stop()

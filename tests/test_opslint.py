@@ -16,6 +16,7 @@ from dpu_operator_tpu.analysis import (ALL_CHECKERS,
                                        ExceptionHygieneChecker,
                                        HandoffStateDisciplineChecker,
                                        LockDisciplineChecker,
+                                       MetricDocParityChecker,
                                        MetricsNamingChecker,
                                        RetryDisciplineChecker,
                                        TraceContextChecker,
@@ -620,3 +621,85 @@ def test_list_discipline_pragma_suppresses():
            '  # opslint: disable=list-discipline\n')
     assert check(ListDisciplineChecker(), src,
                  relpath="dpu_operator_tpu/controller/c.py") == []
+
+
+# -- metric-doc-parity --------------------------------------------------------
+
+def _parity_module(tmp_path, source, doc=None,
+                   relpath="dpu_operator_tpu/somemod.py"):
+    """A Module rooted in a real tmp repo so the checker can find (or
+    miss) doc/observability.md next to it."""
+    from dpu_operator_tpu.analysis import MetricDocParityChecker
+    if doc is not None:
+        (tmp_path / "doc").mkdir(exist_ok=True)
+        (tmp_path / "doc" / "observability.md").write_text(doc)
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    module = Module(str(path), relpath, textwrap.dedent(source))
+    return [v for v in MetricDocParityChecker().check(module)
+            if not module.suppressed(v.rule, v.line)]
+
+
+def test_metric_doc_parity_flags_undocumented_family(tmp_path):
+    violations = _parity_module(tmp_path, """
+        GOOD = REGISTRY.counter("tpu_documented_total", "fine")
+        BAD = REGISTRY.gauge("tpu_ghost_series", "undocumented")
+    """, doc="| `tpu_documented_total{kind}` | counter | fine |\n")
+    assert [v.rule for v in violations] == ["metric-doc-parity"]
+    assert "tpu_ghost_series" in violations[0].message
+    assert "doc/observability.md" in violations[0].message
+
+
+def test_metric_doc_parity_passes_documented_and_non_tpu_names(tmp_path):
+    assert _parity_module(tmp_path, """
+        A = REGISTRY.counter("tpu_documented_total", "fine")
+        B = REGISTRY.histogram_vec("tpu_breakdown_seconds", "fine",
+                                   label="phase")
+        C = Histogram("other_namespace_seconds", "not tpu_-prefixed")
+    """, doc=(
+        "| `tpu_documented_total` | counter | fine |\n"
+        "| `tpu_breakdown_seconds{phase}` | histogram | fine |\n")) == []
+
+
+def test_metric_doc_parity_inert_without_doc_and_outside_package(tmp_path):
+    src = 'X = REGISTRY.counter("tpu_ghost_total", "x")\n'
+    # no doc/observability.md at the module's root -> rule stays inert
+    # (fixture Modules under synthetic paths must not trip it)
+    assert _parity_module(tmp_path, src) == []
+    assert check(MetricDocParityChecker(), src) == []
+    # tests and out-of-package files are not scanned
+    assert _parity_module(tmp_path, src, doc="irrelevant\n",
+                          relpath="tests/test_x.py") == []
+    assert _parity_module(tmp_path, src, doc="irrelevant\n",
+                          relpath="tools/helper.py") == []
+
+
+def test_metric_doc_parity_pragma_suppresses(tmp_path):
+    assert _parity_module(tmp_path, """
+        X = REGISTRY.counter("tpu_ghost_total", "x")  # opslint: disable=metric-doc-parity
+    """, doc="nothing documented\n") == []
+
+
+def test_metric_doc_parity_whole_registry_is_documented():
+    # the live registry must satisfy the rule against the live doc —
+    # adding a metric without its observability.md row fails lint
+    from dpu_operator_tpu.analysis import MetricDocParityChecker
+    from dpu_operator_tpu.analysis.core import run_checkers
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert run_checkers([MetricDocParityChecker()],
+                        ["dpu_operator_tpu"], repo) == []
+
+
+def test_metric_doc_parity_prefix_of_documented_name_still_fires(tmp_path):
+    # `tpu_serve_step` must not ride on `tpu_serve_step_breakdown_
+    # seconds`'s row — the match is backtick-anchored, not substring
+    violations = _parity_module(tmp_path, """
+        X = REGISTRY.gauge("tpu_serve_step", "prefix freeloader")
+    """, doc="| `tpu_serve_step_breakdown_seconds{phase}` | histogram "
+             "| fine |\n")
+    assert [v.rule for v in violations] == ["metric-doc-parity"]
+    # labeled and bare backticked rows both satisfy the rule
+    assert _parity_module(tmp_path, """
+        X = REGISTRY.gauge("tpu_serve_step", "now documented")
+    """, doc="| `tpu_serve_step{dim}` | gauge | fine |\n") == []

@@ -1654,3 +1654,299 @@ def test_bench_serving_record_shape_and_determinism():
     # test_continuous_beats_static_by_1_5x over a full-length horizon;
     # this short-horizon record must still show a real win
     assert rec["continuous_vs_static"]["speedup"] > 1.0
+
+
+# -- per-iteration cost ledger (the serve-check reconciliation gate) ----------
+
+
+def test_ledger_reconciles_exactly_in_virtual_time():
+    """Under the virtual clock the decomposition is exact: every
+    entry's phase sum equals the iteration's virtual advance, prefill
+    and decode both carry real spend, and the breakdown metric sees
+    every step."""
+    breakdown_before = metrics.SERVE_STEP_BREAKDOWN.count()
+    sched = serve.Scheduler(
+        _harness_config(prefill_chunk_tokens=16),
+        cost_model=serve.CostModel())
+    sched.submit_all(serve.open_loop_arrivals(SEED, 6.0, 10.0,
+                                              id_prefix="lg"))
+    sched.run()
+    entries = sched.ledger.entries()
+    assert entries and len(entries) <= sched.ledger.capacity
+    rec = sched.ledger.reconcile(tolerance_s=1e-5, rel=0.0)
+    assert rec["checked"] == len(entries)
+    assert rec["ok"], rec
+    assert set(entries[-1]["phases"]) == set(serve.LEDGER_PHASES)
+    assert sum(e["phases"]["prefill"] for e in entries) > 0
+    assert sum(e["phases"]["decode"] for e in entries) > 0
+    # virtual mode: sched/cow are bookkeeping-only, zero modeled cost
+    assert sum(e["phases"]["sched"] for e in entries) == 0
+    assert metrics.SERVE_STEP_BREAKDOWN.count() \
+        >= breakdown_before + len(serve.LEDGER_PHASES)
+
+
+def test_ledger_attributes_stall_to_the_stalled_phase():
+    """The acceptance case: under a REAL (injected) clock a stalling
+    executor's seconds land in the phase that stalled — decode for a
+    step() stall, prefill for a chunk stall — measured against the
+    clock, not the cost model, and the ledger still reconciles."""
+    clock = _Clock()
+
+    class StallingExecutor(serve.SimExecutor):
+        def prefill_chunk(self, req, slot, offset, n):
+            clock.advance(2.0)
+            return super().prefill_chunk(req, slot, offset, n)
+
+        def step(self, active):
+            clock.advance(3.0)
+            return super().step(active)
+
+    sched = serve.Scheduler(
+        _harness_config(slots=2, prefill_chunk_tokens=64),
+        clock=clock, executor=StallingExecutor())
+    sched.submit(serve.Request(rid="stall", prompt_len=8, output_len=3,
+                               arrival_s=0.0))
+    while sched.step():
+        pass
+    assert len(sched.completed) == 1
+    entries = sched.ledger.entries()
+    assert any(e["phases"]["decode"] >= 3.0 for e in entries)
+    assert any(e["phases"]["prefill"] >= 2.0 for e in entries)
+    # the stall must NOT be attributed as modeled time elsewhere
+    for e in entries:
+        assert e["phases"]["sched"] < 1.0
+        assert e["phases"]["cow"] < 1.0
+    assert sched.ledger.reconcile()["ok"]
+
+
+def test_ledger_ring_is_bounded():
+    sched = serve.Scheduler(_harness_config(slots=2))
+    sched.ledger = serve.StepLedger(capacity=8)
+    for i in range(40):
+        sched.submit(serve.Request(rid=f"lb{i}", prompt_len=4,
+                                   output_len=2, arrival_s=0.01 * i))
+    sched.run()
+    assert sched.iterations > 8
+    assert len(sched.ledger.entries()) == 8
+    snap = sched.ledger.snapshot()
+    assert snap["capacity"] == 8
+    assert snap["reconciliation"]["checked"] == 8
+
+
+# -- deterministic phase-span trees -------------------------------------------
+
+
+def test_phase_span_tree_bit_identical_across_seeded_runs():
+    """Two seeded runs of a preemption-heavy chunked workload produce
+    byte-identical serve-kind span trees (names, trace ids, span ids,
+    starts, durations, attributes) — the flight ring's wall-clock
+    ts/seq are the ONLY run-dependent fields."""
+    from dpu_operator_tpu.utils import flight
+
+    arrivals = serve.open_loop_arrivals(SEED, 10.0, 6.0,
+                                        prompt_lens=(24, 64),
+                                        id_prefix="dt")
+
+    def run_once():
+        flight.RECORDER.clear()
+        sched = serve.Scheduler(
+            _harness_config(slots=2, kv_blocks=16, kv_block_size=8,
+                            prefill_chunk_tokens=16),
+            cost_model=CALIBRATED)
+        sched.submit_all([r.fresh_copy() for r in arrivals])
+        sched.run()
+        tree = [(e["name"], e.get("trace_id"), e.get("span_id"),
+                 e.get("duration_s"),
+                 tuple(sorted((e.get("attributes") or {}).items())))
+                for e in flight.RECORDER.events(kind="serve")]
+        return tree, sched.preemptions
+
+    tree1, preempt1 = run_once()
+    tree2, preempt2 = run_once()
+    assert preempt1 > 0  # the workload exercises the preempted phase
+    assert any(name == "serve.preempted" for name, *_ in tree1)
+    assert tree1 == tree2
+    assert preempt1 == preempt2
+
+
+def test_cow_copy_emits_a_phase_span():
+    """A divergent write through the scheduler (identical prompts
+    under sharing) leaves a serve.cow span joined to the writer's
+    trace."""
+    from dpu_operator_tpu.utils import flight
+
+    flight.RECORDER.clear()
+    prompt = tuple(range(24))                   # 1.5 blocks of 16
+    cfg = serve.ServeConfig(slots=2, kv_blocks=16, kv_block_size=16,
+                            prefix_sharing=True,
+                            prefill_chunk_tokens=64)
+    sched = serve.Scheduler(cfg, cost_model=CALIBRATED)
+    sched.submit(serve.Request(rid="cw0", prompt_len=len(prompt),
+                               output_len=24, slo_class=serve.BATCH,
+                               arrival_s=0.0, prompt=prompt))
+    # arrives after cw0's prefill registered the chain, while cw0
+    # still holds its blocks — cw1's first token diverges the mapped
+    # partial tail and copies it
+    sched.submit(serve.Request(rid="cw1", prompt_len=len(prompt),
+                               output_len=8, slo_class=serve.BATCH,
+                               arrival_s=0.007, prompt=prompt))
+    sched.run()
+    assert sched.pool.cow_copies > 0
+    cows = [e for e in flight.RECORDER.events(kind="serve")
+            if e["name"] == "serve.cow"]
+    assert cows
+    assert all(e.get("trace_id") for e in cows)
+
+
+# -- replica headroom digest --------------------------------------------------
+
+
+def test_headroom_digest_matches_capacity_and_gauges():
+    cfg = serve.ServeConfig(slots=4, kv_blocks=64, kv_block_size=16,
+                            prefill_chunk_tokens=16, typical_tokens=64)
+    sched = serve.Scheduler(cfg, cost_model=CALIBRATED)
+    sched.submit(serve.Request(rid="h0", prompt_len=80, output_len=4,
+                               arrival_s=0.0))
+    sched.step()  # admitted, mid-prefill: backlog is live
+    digest = sched.headroom()
+    cap = sched.capacity()
+    assert digest["freeSlots"] == cap["freeSlots"] == 3
+    assert digest["advertisableSlots"] == cap["advertisableSlots"]
+    assert digest["freeKvBlocks"] == cap["freeKvBlocks"]
+    assert digest["chunkBacklogTokens"] > 0
+    assert digest["queueDepth"] == {"interactive": 0, "batch": 0}
+    assert digest["prefixIndexKeys"] == 0
+    assert metrics.SERVE_HEADROOM.value(dimension="free_slots") == 3.0
+    assert metrics.SERVE_HEADROOM.value(
+        dimension="chunk_backlog_tokens") \
+        == float(digest["chunkBacklogTokens"])
+    sched.run()
+    assert sched.headroom()["chunkBacklogTokens"] == 0
+
+
+def test_headroom_counts_prefix_index_keys():
+    prompt = tuple(range(32))
+    cfg = serve.ServeConfig(slots=2, kv_blocks=32, kv_block_size=8,
+                            prefix_sharing=True,
+                            prefill_chunk_tokens=32)
+    sched = serve.Scheduler(cfg, cost_model=CALIBRATED)
+    sched.submit(serve.Request(rid="pk0", prompt_len=32, output_len=2,
+                               arrival_s=0.0, prompt=prompt))
+    sched.submit(serve.Request(rid="pk1", prompt_len=32, output_len=8,
+                               arrival_s=0.0, prompt=prompt))
+    for _ in range(6):
+        sched.step()
+    # the first prompt registered its chain; the digest reports the
+    # affinity signal while blocks are still live
+    assert sched.headroom()["prefixIndexKeys"] > 0
+    assert metrics.SERVE_HEADROOM.value(
+        dimension="prefix_index_keys") > 0
+    sched.run()
+
+
+def test_decode_service_headroom_folds_slo_and_fault_dimensions():
+    class FakeEvaluator:
+        def active_alerts(self):
+            return [("cni-latency", "page"), ("serve-ttft", "page"),
+                    ("serve-tokens", "ticket")]
+
+    sched = serve.Scheduler(_harness_config())
+    service = serve.DecodeService(sched, evaluator=FakeEvaluator(),
+                                  fault_capacity_fn=lambda: 7)
+    digest = service.headroom()
+    # only serve-* alerts belong to the serving replica's digest
+    assert digest["sloAlerts"] == [
+        {"slo": "serve-ttft", "severity": "page"},
+        {"slo": "serve-tokens", "severity": "ticket"}]
+    assert digest["faultGateCapacity"] == 7
+    assert metrics.SERVE_HEADROOM.value(
+        dimension="slo_alerts_firing") == 2.0
+    assert metrics.SERVE_HEADROOM.value(
+        dimension="fault_gate_capacity") == 7.0
+    # no fault gate wired -> null dimension, gauged as 0
+    bare = serve.DecodeService(sched, evaluator=FakeEvaluator())
+    assert bare.headroom()["faultGateCapacity"] is None
+    assert metrics.SERVE_HEADROOM.value(
+        dimension="fault_gate_capacity") == 0.0
+
+
+def test_ledger_headroom_and_index_served_over_debug_endpoints():
+    from dpu_operator_tpu.utils import flight
+    from dpu_operator_tpu.utils.metrics import MetricsServer
+
+    sched = serve.Scheduler(_harness_config(prefill_chunk_tokens=16))
+    sched.submit(serve.Request(rid="dbg0", prompt_len=8, output_len=2,
+                               arrival_s=0.0))
+    sched.run()
+    service = serve.DecodeService(sched)
+    server = MetricsServer(host="127.0.0.1", port=0,
+                           debug_handlers=service.debug_handlers())
+    server.start()
+    addr = f"127.0.0.1:{server.port}"
+    try:
+        ledger = flight.fetch(addr, path="/debug/serve/ledger")
+        assert ledger["entries"] and ledger["reconciliation"]["ok"]
+        headroom = flight.fetch(addr, path="/debug/serve/headroom")
+        assert headroom["freeSlots"] == 4
+        assert "sloAlerts" in headroom
+        index = flight.fetch(addr, path="/debug")
+        assert set(index["debugHandlers"]) >= {
+            "/debug/flight", "/debug/serve", "/debug/serve/ledger",
+            "/debug/serve/headroom"}
+    finally:
+        server.stop()
+
+
+def test_render_serve_top_folds_ledger_window():
+    from dpu_operator_tpu import tpuctl
+
+    sched = serve.Scheduler(_harness_config(slots=1,
+                                            prefill_chunk_tokens=16))
+    sched.submit(serve.Request(rid="tp0", prompt_len=8, output_len=16,
+                               slo_class=serve.BATCH, arrival_s=0.0))
+    sched.submit(serve.Request(rid="tp1", prompt_len=4, output_len=2,
+                               slo_class=serve.INTERACTIVE,
+                               arrival_s=0.05))
+    sched.run()
+    assert sched.preemptions >= 1
+    view = tpuctl.render_serve_top(sched.snapshot(),
+                                   sched.ledger.snapshot(), last=50)
+    assert view["iterations"] == len(sched.ledger.entries())
+    assert view["phaseSeconds"]["decode"] > 0
+    assert abs(sum(view["phaseShare"].values()) - 1.0) < 0.01
+    assert view["preemptionsPerIteration"] > 0
+    assert view["reconciliation"]["ok"]
+    assert view["capacity"]["slots"] == 1
+    # empty ledger renders, not crashes
+    empty = tpuctl.render_serve_top({}, {"entries": []})
+    assert empty["iterations"] == 0 and empty["phaseSeconds"] == {}
+
+
+def test_cancel_closes_the_open_phase_span():
+    """An abandoned request's timeline must not end in the dark: a
+    cancel mid-decode closes the residency span (outcome=cancelled),
+    and a cancel while still queued closes the wait span."""
+    from dpu_operator_tpu.utils import flight
+
+    flight.RECORDER.clear()
+    sched = serve.Scheduler(_harness_config(slots=1,
+                                            prefill_chunk_tokens=16))
+    sched.submit(serve.Request(rid="live", prompt_len=8, output_len=50,
+                               arrival_s=0.0))
+    sched.submit(serve.Request(rid="waiting", prompt_len=8,
+                               output_len=4, arrival_s=0.0))
+    for _ in range(4):
+        sched.step()  # "live" is decoding; "waiting" is slotless
+    assert sched.cancel("live") and sched.cancel("waiting")
+    events = flight.RECORDER.events(kind="serve")
+
+    def spans(rid, name):
+        return [e for e in events if e["name"] == name
+                and (e.get("attributes") or {}).get("rid") == rid]
+
+    (decode,) = spans("live", "serve.decode")
+    assert decode["attributes"]["outcome"] == "cancelled"
+    assert decode["duration_s"] > 0
+    (queued,) = spans("waiting", "serve.queued")
+    assert queued["attributes"]["outcome"] == "cancelled"
+    assert sched.pool.outstanding() == 0

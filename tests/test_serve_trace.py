@@ -1,0 +1,283 @@
+"""Serve-trace e2e (`make obs-check`): request-lifecycle tracing over
+the streaming ingress.
+
+One `POST /v1/generate` with a caller traceparent against a CHUNKED
+scheduler with a forced preemption must produce:
+
+- ONE trace_id — the caller's — on the ingress `serve.request` span,
+  every `serve.prefill_chunk` span, the `serve.decode` spans and the
+  FirstToken flight entry;
+- a `tpuctl serve trace <rid>` phase timeline reading queued → prefill
+  chunks → decode → preempted → re-prefill → decode → complete;
+- a span tree that is BIT-IDENTICAL across two seeded runs (virtual
+  clock start/durations, sha256-derived span ids — no wall clock, no
+  uuid4 anywhere in the phase path);
+- OpenMetrics exemplars on the serve histograms that are grammar-valid
+  and join back to flight-recorded FirstToken trace ids, with classic
+  0.0.4 scrapes byte-unchanged.
+
+The scheduler is stepped MANUALLY on the test thread (the DecodeService
+loop is never started), so the interleaving of POSTs and iterations —
+and therefore the virtual span tree — is a pure function of the
+scenario.
+"""
+
+import itertools
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from dpu_operator_tpu import tpuctl
+from dpu_operator_tpu.utils import flight, metrics, tracing
+from dpu_operator_tpu.workloads import serve
+
+pytestmark = pytest.mark.obs
+
+#: fixed caller trace contexts: the same traceparent both runs, so the
+#: adopted trace ids (and the parent span ids the phase spans hang
+#: under) are identical run-to-run
+BG_TRACE = "ab" * 16
+BG_PARENT = f"00-{BG_TRACE}-{'12' * 8}-01"
+FG_TRACE = "cd" * 16
+FG_PARENT = f"00-{FG_TRACE}-{'34' * 8}-01"
+
+
+def _stream_post(port, body, traceparent):
+    """POST /v1/generate and read the whole chunked NDJSON stream."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", "/v1/generate", json.dumps(body),
+                     {"Content-Type": "application/json",
+                      "traceparent": traceparent})
+        resp = conn.getresponse()
+        raw = resp.read()
+    finally:
+        conn.close()
+    return [json.loads(line) for line in raw.split(b"\n") if line]
+
+
+def _pending_count(sched):
+    with sched._lock:
+        return len(sched._pending)
+
+
+def _wait_for(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, "condition never held"
+        time.sleep(0.002)
+
+
+def _run_scenario():
+    """The forced-preemption scenario: a streamed batch-class request
+    is admitted and decoding when a streamed interactive request
+    arrives on the single slot — the victim is evicted mid-decode,
+    waits out the interactive request, re-prefills and completes. Both
+    requests ride HTTP with caller traceparents; the scheduler is only
+    ever stepped from this thread."""
+    flight.RECORDER.clear()
+    cfg = serve.ServeConfig(slots=1, kv_blocks=16, kv_block_size=4,
+                            prefill_chunk_tokens=4, queue_limit=8)
+    sched = serve.Scheduler(cfg)
+    service = serve.DecodeService(sched)
+    port = service.start_http()
+    streams = {}
+
+    def post(name, body, parent):
+        streams[name] = _stream_post(port, body, parent)
+
+    bg = threading.Thread(target=post, args=(
+        "bg", {"rid": "bg", "prompt_len": 10, "output_len": 6,
+               "slo_class": "batch"}, BG_PARENT))
+    bg.start()
+    _wait_for(lambda: _pending_count(sched) == 1)
+    # admit + chunk-prefill the batch request until it is decoding
+    steps = 0
+    while not any(r.tokens for r in sched._active.values()):
+        assert sched.step() and steps < 50
+        steps += 1
+    fg = threading.Thread(target=post, args=(
+        "fg", {"rid": "fg", "prompt_len": 6, "output_len": 2,
+               "slo_class": "interactive"}, FG_PARENT))
+    fg.start()
+    _wait_for(lambda: _pending_count(sched) == 1)
+    steps = 0
+    while sched.completed_total < 2:
+        assert sched.step() and steps < 200
+        steps += 1
+    bg.join(timeout=10)
+    fg.join(timeout=10)
+    service.stop()
+    events = flight.RECORDER.snapshot()["events"]
+    assert sched.preemptions == 1  # the scenario's whole point
+    return events, streams
+
+
+def _serve_events(events, rid):
+    return [e for e in events if e.get("kind") == "serve"
+            and (e.get("attributes") or {}).get("rid") == rid]
+
+
+def _span_tree(events):
+    """The determinism artifact: every serve-kind event minus the
+    wall-clock ring fields (ts, seq)."""
+    return [(e["name"], e.get("trace_id"), e.get("span_id"),
+             e.get("duration_s"),
+             tuple(sorted((e.get("attributes") or {}).items())))
+            for e in events if e.get("kind") == "serve"]
+
+
+def test_one_trace_id_from_ingress_to_every_phase_span():
+    events, streams = _run_scenario()
+    # the streams themselves completed
+    assert streams["bg"][-1] == {"done": True, "tokens": 6}
+    assert streams["fg"][-1] == {"done": True, "tokens": 2}
+    for rid, trace_id in (("bg", BG_TRACE), ("fg", FG_TRACE)):
+        mine = _serve_events(events, rid)
+        assert mine, f"no serve events for {rid}"
+        # EVERY phase span and lifecycle entry carries the caller's id
+        assert {e.get("trace_id") for e in mine} == {trace_id}
+        names = [e["name"] for e in mine]
+        assert "serve.queued" in names
+        assert "serve.prefill_chunk" in names
+        assert "serve.decode" in names
+        assert any(e["name"] == "FirstToken" for e in mine)
+        # the ingress serve.request span adopted the same trace
+        ingress = [e for e in events if e.get("kind") == "span"
+                   and e.get("name") == "serve.request"
+                   and (e.get("attributes") or {}).get("rid") == rid]
+        assert ingress and ingress[0]["trace_id"] == trace_id
+    # the victim's decode episodes: one ended by the preemption, one
+    # by completion
+    decodes = [e for e in _serve_events(events, "bg")
+               if e["name"] == "serve.decode"]
+    assert [(e["attributes"] or {}).get("outcome") for e in decodes] \
+        == ["preempted", "complete"]
+
+
+def test_tpuctl_timeline_reads_the_whole_lifecycle():
+    events, _ = _run_scenario()
+    view = tpuctl.render_serve_trace(events, "bg")
+    assert view["found"] and view["terminal"] == "Completed"
+    assert view["traceId"] == BG_TRACE
+    assert view["ttftSeconds"] is not None
+    order = [k for k, _ in itertools.groupby(
+        p["phase"] for p in view["phases"])]
+    assert order == ["serve.queued", "serve.prefill_chunk",
+                     "serve.decode", "serve.preempted",
+                     "serve.prefill_chunk", "serve.decode"]
+    # phases are timeline-ordered with durations
+    starts = [p["startSeconds"] for p in view["phases"]]
+    assert starts == sorted(starts)
+    assert all(p["durationSeconds"] >= 0.0 for p in view["phases"])
+    # the preempted wait covers the gap between the two residencies
+    preempted = next(p for p in view["phases"]
+                     if p["phase"] == "serve.preempted")
+    assert preempted["durationSeconds"] > 0.0
+
+
+def test_span_tree_bit_identical_across_two_runs():
+    events1, _ = _run_scenario()
+    events2, _ = _run_scenario()
+    assert _span_tree(events1) == _span_tree(events2)
+
+
+def test_tpuctl_serve_trace_and_top_over_http():
+    """The full CLI path: tpuctl fetches /debug/flight for the
+    timeline and /debug/serve{,/ledger} for the top view from a live
+    MetricsServer."""
+    from dpu_operator_tpu.utils.metrics import MetricsServer
+
+    events, _ = _run_scenario()  # leaves the scenario in the ring
+    cfg = serve.ServeConfig(slots=1, kv_blocks=16, kv_block_size=4,
+                            prefill_chunk_tokens=4)
+    sched = serve.Scheduler(cfg)
+    sched.submit(serve.Request(rid="t0", prompt_len=6, output_len=2,
+                               arrival_s=0.0))
+    sched.run()
+    service = serve.DecodeService(sched)
+    server = MetricsServer(host="127.0.0.1", port=0,
+                           debug_handlers=service.debug_handlers())
+    server.start()
+    try:
+        def args(**kw):
+            base = {"cmd": "serve", "metrics_addr":
+                    f"127.0.0.1:{server.port}", "token": "",
+                    "window": 60.0, "last": 10, "rid": "",
+                    "agent_socket": "", "vsp_socket": "",
+                    "daemon_addr": ""}
+            base.update(kw)
+            return type("A", (), base)()
+
+        trace = tpuctl.run(args(action="trace", rid="bg"))
+        assert trace["found"] and trace["traceId"] == BG_TRACE
+        assert trace["phases"]
+        top = tpuctl.run(args(action="top", last=5))
+        assert top["iterations"] > 0
+        assert set(top["phaseSeconds"]) <= set(serve.LEDGER_PHASES)
+        assert top["reconciliation"]["ok"]
+    finally:
+        server.stop()
+
+
+# -- exemplar rendering on the serve histograms -------------------------------
+
+_EXEMPLAR_RE = re.compile(
+    r' # \{trace_id="([0-9a-f]{32})"\} [0-9][0-9.e+-]*$')
+
+
+def test_openmetrics_exemplars_join_flight_first_tokens_mid_storm():
+    """An OpenMetrics scrape taken mid-storm renders grammar-valid
+    exemplars on the serve TTFT histogram whose trace ids resolve to
+    flight-recorded FirstToken entries (histograms are process-global,
+    so other suites' exemplars may occupy untouched buckets — the join
+    is asserted on intersection, the grammar on every exemplar)."""
+    flight.RECORDER.clear()
+    cfg = serve.ServeConfig(slots=2, kv_blocks=64, kv_block_size=8,
+                            prefill_chunk_tokens=16, queue_limit=512)
+    sched = serve.Scheduler(cfg)
+    sched.submit_all(serve.open_loop_arrivals(
+        seed=20260804, rate_rps=8.0, horizon_s=4.0, id_prefix="om"))
+    sched.run()
+    first_ids = {e.get("trace_id")
+                 for e in flight.RECORDER.events(kind="serve")
+                 if e["name"] == "FirstToken"}
+    assert first_ids
+    om = metrics.REGISTRY.render(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    exemplar_ids = set()
+    for line in om.splitlines():
+        if not line.startswith("tpu_serve_ttft_seconds_bucket"):
+            continue
+        if " # " not in line:
+            continue
+        m = _EXEMPLAR_RE.search(line)
+        assert m, f"exemplar violates the OpenMetrics grammar: {line}"
+        exemplar_ids.add(m.group(1))
+    assert exemplar_ids, "storm produced no TTFT exemplars"
+    assert exemplar_ids & first_ids, (
+        "no TTFT exemplar joins a flight-recorded FirstToken")
+
+
+def test_classic_scrape_stays_byte_unchanged_by_exemplars():
+    """The 0.0.4 text parser rejects exemplars, so a classic scrape of
+    a histogram WITH exemplars must be byte-identical to one without:
+    exemplars exist only in the OpenMetrics negotiation."""
+    from dpu_operator_tpu.utils.metrics import Histogram
+    bare = Histogram("tpu_serve_ttft_seconds", "ttft",
+                     buckets=(0.1, 1.0))
+    exemplared = Histogram("tpu_serve_ttft_seconds", "ttft",
+                           buckets=(0.1, 1.0))
+    for value in (0.05, 0.4, 2.0):
+        bare.observe(value)
+        exemplared.observe(value,
+                           exemplar={"trace_id": tracing.det_trace_id(
+                               f"x{value}")})
+    assert bare._render() == exemplared._render()
+    assert not any(" # {" in line for line in exemplared._render())
+    assert any(" # {" in line
+               for line in exemplared._render(openmetrics=True))
