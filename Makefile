@@ -5,10 +5,10 @@ TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
         upgrade-check fault-check scale-check serve-check lint-check \
-        type-check bench native traffic-flow images smoke-images deploy \
-        undeploy graft-check clean
+        race-check type-check bench native traffic-flow images \
+        smoke-images deploy undeploy graft-check clean
 
-test: lint-check native
+test: lint-check race-check native
 	$(PYTHON) -m pytest tests/ -q
 
 # reference `fast-test`: skip the slow e2e tier
@@ -123,19 +123,36 @@ serve-check:
 
 # opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST
 # checkers — wire-seam, retry-discipline, exception-hygiene,
-# metrics-naming, chaos-determinism, lock-discipline. Nonzero on any
-# violation not pragma'd or in opslint-baseline.json (the vet/race-
-# detector analog the reference gets from the Go toolchain)
+# metrics-naming, chaos-determinism, lock-discipline, and the v2
+# whole-program passes (lock-order-graph, resource-lifecycle). Nonzero
+# on any violation not pragma'd or in opslint-baseline.json (the vet/
+# race-detector analog the reference gets from the Go toolchain).
+# `--format json|sarif` emits the same findings for CI diff annotation.
 lint-check:
 	$(PYTHON) -m dpu_operator_tpu.analysis
 
-# mypy strict over utils/ ici/ k8s/ ([tool.mypy] in pyproject.toml).
-# The CI image does not ship mypy; the target degrades to a no-op there
-# rather than failing the whole gate on a missing dev tool
+# race gate, both halves (doc/static-analysis.md "Lock ordering"):
+# 1. STATIC — the interprocedural lock-order graph must be acyclic and
+#    every tracked resource (sockets, fds, KV owners, slots) released
+#    on every exit path, whole-tree, no test interleaving required;
+# 2. DYNAMIC — the race-marked LockTracer storms drive the scheduler,
+#    KV pool and watch-core queue under real contention and fail on
+#    any lock-order edge cycle the run records.
+race-check:
+	$(PYTHON) -m dpu_operator_tpu.analysis \
+	  --select lock-order-graph --select resource-lifecycle
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m race \
+	  -p no:randomly -p no:cacheprovider
+
+# mypy strict over utils/ ici/ k8s/ workloads/ controller/ ([tool.mypy]
+# in pyproject.toml). The CI image does not ship mypy; the target
+# degrades to a no-op there rather than failing the whole gate on a
+# missing dev tool
 type-check:
 	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
 	  $(PYTHON) -m mypy dpu_operator_tpu/utils dpu_operator_tpu/ici \
-	    dpu_operator_tpu/k8s; \
+	    dpu_operator_tpu/k8s dpu_operator_tpu/workloads \
+	    dpu_operator_tpu/controller; \
 	else \
 	  echo "type-check: mypy not installed; skipping (pip install mypy)"; \
 	fi

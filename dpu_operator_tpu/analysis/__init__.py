@@ -1,9 +1,11 @@
 """opslint — the repo-native invariant linter (`make lint-check`).
 
 AST checkers enforcing the invariants PR 1/PR 2 established by hand on
-the wire path, plus a static guarded-by lock checker. Run as
-``python -m dpu_operator_tpu.analysis``; rules, pragma and baseline
-workflow are documented in doc/static-analysis.md.
+the wire path, plus the v2 whole-program passes: an interprocedural
+guarded-by lock checker, a static lock-ORDER graph (`make race-check`
+runs it alongside the LockTracer suite), and a path-sensitive resource
+lifecycle rule. Run as ``python -m dpu_operator_tpu.analysis``; rules,
+pragma and baseline workflow are documented in doc/static-analysis.md.
 """
 
 from .checkers import (ChaosDeterminismChecker, EventsSeamChecker,
@@ -13,7 +15,8 @@ from .checkers import (ChaosDeterminismChecker, EventsSeamChecker,
                        MetricsNamingChecker, RetryDisciplineChecker,
                        TraceContextChecker, WireSeamChecker)
 from .core import Baseline, Checker, Module, Violation, run_checkers
-from .lockcheck import LockDisciplineChecker
+from .lifecycle import ResourceLifecycleChecker
+from .lockcheck import LockDisciplineChecker, LockOrderGraphChecker
 
 ALL_CHECKERS = (
     WireSeamChecker,
@@ -27,6 +30,8 @@ ALL_CHECKERS = (
     MetricDocParityChecker,
     ChaosDeterminismChecker,
     LockDisciplineChecker,
+    LockOrderGraphChecker,
+    ResourceLifecycleChecker,
 )
 
 __all__ = [
@@ -35,6 +40,7 @@ __all__ = [
     "EventsSeamChecker", "HandoffStateDisciplineChecker",
     "ListDisciplineChecker", "RetryDisciplineChecker",
     "ExceptionHygieneChecker", "MetricDocParityChecker",
-    "MetricsNamingChecker",
-    "ChaosDeterminismChecker", "LockDisciplineChecker",
+    "MetricsNamingChecker", "ChaosDeterminismChecker",
+    "LockDisciplineChecker", "LockOrderGraphChecker",
+    "ResourceLifecycleChecker",
 ]

@@ -3,11 +3,20 @@
 Exit status: 0 when every finding is pragma'd or baselined, 1 when new
 violations fired, 2 on usage errors. ``--write-baseline`` records the
 current findings so the gate starts at zero and ratchets down.
+
+``--format`` selects the output: ``human`` (default, unchanged),
+``json`` (one object: findings + stale entries, machine-stable field
+names) or ``sarif`` (SARIF 2.1.0 — what CI diff-annotators consume;
+rule ids are the checker names, which are STABLE identifiers: they
+double as the pragma tokens and baseline keys). Exit codes are
+identical across formats, so a pipeline can gate on the status while
+archiving the structured report.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -16,6 +25,76 @@ from .core import Baseline, run_checkers
 
 DEFAULT_ROOTS = ("dpu_operator_tpu", "tests")
 DEFAULT_BASELINE = "opslint-baseline.json"
+
+
+def _split_key(key: str) -> tuple:
+    """(path, rule, message) from a baseline key — the inverse of
+    Violation.key()."""
+    parts = key.split("::", 2)
+    while len(parts) < 3:
+        parts.append("")
+    return parts[0], parts[1], parts[2]
+
+
+def _stale_line(key: str, baseline_path: str) -> str:
+    path, rule, message = _split_key(key)
+    return (f"stale baseline entry (fix landed?): delete rule "
+            f"`{rule}` for `{path}` from "
+            f"{os.path.basename(baseline_path)}"
+            + (f" — {message}" if message else ""))
+
+
+def _emit_json(new: list, baselined: list, stale: list,
+               checkers: list) -> None:
+    def row(v, status):
+        return {"rule": v.rule, "file": v.path, "line": v.line,
+                "message": v.message, "status": status}
+    print(json.dumps({
+        "version": 1,
+        "rules": [{"id": c.name, "description": c.description}
+                  for c in checkers],
+        "findings": ([row(v, "new") for v in new]
+                     + [row(v, "baselined") for v in baselined]),
+        "staleBaselineEntries": [
+            dict(zip(("file", "rule", "message"), _split_key(k)))
+            for k in stale],
+    }, indent=2, sort_keys=True))
+
+
+def _emit_sarif(new: list, baselined: list, checkers: list) -> None:
+    def result(v, baselined_flag):
+        out = {
+            "ruleId": v.rule,
+            "level": "warning",
+            "message": {"text": v.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": v.line},
+                },
+            }],
+        }
+        if baselined_flag:
+            out["suppressions"] = [{"kind": "external",
+                                    "justification":
+                                        "opslint-baseline.json"}]
+        return out
+    print(json.dumps({
+        "$schema": ("https://json.schemastore.org/sarif-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "opslint",
+                "informationUri":
+                    "doc/static-analysis.md",
+                "rules": [{"id": c.name,
+                           "shortDescription": {"text": c.description}}
+                          for c in checkers],
+            }},
+            "results": ([result(v, False) for v in new]
+                        + [result(v, True) for v in baselined]),
+        }],
+    }, indent=2, sort_keys=True))
 
 
 def _repo_root() -> str:
@@ -42,6 +121,10 @@ def main(argv=None) -> int:
                         help="record current findings as the baseline")
     parser.add_argument("--select", action="append", default=None,
                         metavar="RULE", help="run only these rules")
+    parser.add_argument("--format", choices=("human", "json", "sarif"),
+                        default="human",
+                        help="output format (default: human; json/"
+                             "sarif for CI diff annotation)")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -87,13 +170,21 @@ def main(argv=None) -> int:
         if subset:
             stale = []  # unscanned entries are not stale
 
+    if args.format == "json":
+        _emit_json(new, baselined, stale, checkers)
+        return 1 if new else 0
+    if args.format == "sarif":
+        _emit_sarif(new, baselined, checkers)
+        return 1 if new else 0
     for v in new:
         print(v.render())
     for v in baselined:
         print(f"{v.render()}  (baselined)")
     for key in stale:
-        print(f"stale baseline entry (fix landed? run --write-baseline "
-              f"to ratchet): {key}")
+        print(_stale_line(key, baseline_path))
+    if stale:
+        print("ratchet: remove the entries above, or run "
+              "--write-baseline to rewrite the file")
     print(f"opslint: {len(new)} new, {len(baselined)} baselined, "
           f"{len(stale)} stale baseline entries "
           f"({len(checkers)} rules)")

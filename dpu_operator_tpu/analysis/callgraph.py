@@ -1,0 +1,692 @@
+"""Project call graph + interprocedural lock-held propagation.
+
+The per-function checkers in :mod:`.lockcheck` see one method at a
+time; this module gives opslint the whole-program view the v2 rules
+need (doc/static-analysis.md "How interprocedural propagation works"):
+
+- :class:`ProjectIndex` — a name-based symbol table over every scanned
+  module: classes (with their lock attributes and the inferred classes
+  of their ``self.<attr>`` instance attributes), module-level functions,
+  module-level lock globals, and module-global singleton instances.
+- :class:`LockFlow` — a depth-first walk from every function with the
+  empty lock set that tracks which locks are held at each program
+  point, follows resolved calls with the caller's held set (memoized on
+  ``(function, held-set)``), and produces (a) the static lock-ORDER
+  graph — an edge ``A -> B`` whenever code acquires B while holding A —
+  and (b) for every private method, whether each of its resolved call
+  sites held a lock of the method's own class (the guarded-by
+  relaxation: a helper called ONLY from lock-held sites runs lock-held
+  by contract, ``*_locked`` suffix or not).
+
+Call-graph assumptions (deliberately conservative — a resolution the
+index is not sure of contributes NOTHING, so a missed edge is possible
+but a fabricated one is not):
+
+- classes resolve by bare name; a name defined by two modules is
+  AMBIGUOUS and never resolved;
+- ``self.<attr>``'s class comes from a ``self.<attr> = ClassName(...)``
+  assignment (or an annotated parameter default of that shape) in the
+  owning class; re-assignment to a different class drops the inference;
+- ``self.m()`` resolves within the class only (no inheritance walk, no
+  dynamic dispatch); bare ``f()`` resolves to the same module's
+  top-level ``f``; ``alias.f()`` resolves through intra-package
+  imports; locals bound by ``x = ClassName(...)`` resolve one level;
+- lock identity aggregates by declaration site (``Class.attr`` /
+  ``module.global``), the static analog of LockTracer's
+  allocation-site aggregation; ``threading.Condition(self._lock)``
+  aliases to the wrapped lock's node;
+- recursion is cut by the memo; call depth is capped (``MAX_DEPTH``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Optional
+
+from .core import Module, dotted_name, walk_in_frame
+
+_LOCK_KINDS = {
+    "threading.Lock": "lock", "Lock": "lock",
+    "threading.RLock": "rlock", "RLock": "rlock",
+    "threading.Condition": "cond", "Condition": "cond",
+}
+
+#: propagation depth cap: deep enough for any real call chain in this
+#: repo, shallow enough that a pathological cycle costs nothing
+MAX_DEPTH = 16
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lockish(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or "cond" in low
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function or method definition."""
+
+    module: Module
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    class_name: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def qualname(self) -> str:
+        base = os.path.basename(self.module.relpath).rsplit(".", 1)[0]
+        if self.class_name:
+            return f"{base}.{self.class_name}.{self.name}"
+        return f"{base}.{self.name}"
+
+    @property
+    def key(self) -> tuple:
+        return (self.module.relpath, self.class_name, self.name)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: Module
+    node: ast.ClassDef
+    #: lock attr -> kind ("lock" | "rlock" | "cond" | "alias:<attr>" |
+    #: "unknown" for lockish-named attrs with no visible ctor)
+    lock_attrs: dict = dataclasses.field(default_factory=dict)
+    #: instance attr -> class name (from `self.x = ClassName(...)`)
+    attr_types: dict = dataclasses.field(default_factory=dict)
+    methods: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def modbase(self) -> str:
+        return os.path.basename(self.module.relpath).rsplit(".", 1)[0]
+
+    def lock_node(self, attr: str) -> str:
+        """Stable node id for `self.<attr>` of this class, resolving
+        Condition-wraps-lock aliases to the wrapped lock."""
+        seen = set()
+        while True:
+            kind = self.lock_attrs.get(attr, "unknown")
+            if not kind.startswith("alias:") or attr in seen:
+                break
+            seen.add(attr)
+            attr = kind.split(":", 1)[1]
+        return f"{self.modbase}.{self.name}.{attr}"
+
+    def lock_kind(self, attr: str) -> str:
+        seen = set()
+        while True:
+            kind = self.lock_attrs.get(attr, "unknown")
+            if not kind.startswith("alias:") or attr in seen:
+                return kind
+            seen.add(attr)
+            attr = kind.split(":", 1)[1]
+
+
+_AMBIGUOUS = object()
+
+
+class ProjectIndex:
+    """Symbol table + resolver over one set of scanned modules."""
+
+    def __init__(self, modules: Iterable[Module]):
+        self.modules = [m for m in modules if not m.is_test]
+        #: class name -> ClassInfo (or _AMBIGUOUS on collision)
+        self.classes: dict = {}
+        #: relpath -> {func name -> FuncInfo}
+        self.module_funcs: dict = {}
+        #: relpath -> {global name -> lock node id}
+        self.module_locks: dict = {}
+        #: relpath -> {global name -> class name} (singleton instances)
+        self.module_instances: dict = {}
+        #: relpath -> {alias -> relpath of the aliased module}
+        self.imports: dict = {}
+        #: nested defs (closures, worker bodies): never resolvable as
+        #: call targets, but walked as their own lock-flow roots so a
+        #: closure acquiring locks still contributes ordering edges
+        self.nested: list = []
+        self._relpaths = {m.relpath for m in self.modules}
+        for m in self.modules:
+            self._index_module(m)
+
+    # -- indexing -------------------------------------------------------------
+    def _index_module(self, module: Module) -> None:
+        funcs: dict = {}
+        locks: dict = {}
+        instances: dict = {}
+        modbase = os.path.basename(module.relpath).rsplit(".", 1)[0]
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._index_class(module, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs[node.name] = FuncInfo(module, node)
+                self._collect_nested(FuncInfo(module, node))
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                ctor = dotted_name(node.value.func) or ""
+                for target in node.targets:
+                    if not isinstance(target, ast.Name):
+                        continue
+                    if ctor in _LOCK_KINDS:
+                        locks[target.id] = f"{modbase}.{target.id}"
+                    elif ctor.split(".")[-1] in self.classes \
+                            or ctor.split(".")[-1][:1].isupper():
+                        instances[target.id] = ctor.split(".")[-1]
+        self.module_funcs[module.relpath] = funcs
+        self.module_locks[module.relpath] = locks
+        self.module_instances[module.relpath] = instances
+        self.imports[module.relpath] = self._module_imports(module)
+
+    def _index_class(self, module: Module, node: ast.ClassDef) -> None:
+        info = ClassInfo(node.name, module, node)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[item.name] = FuncInfo(module, item,
+                                                   node.name)
+                self._collect_nested(FuncInfo(module, item, node.name))
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call):
+                self._record_attr_assign(info, sub.targets, sub.value)
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None \
+                    and isinstance(sub.value, ast.Call):
+                self._record_attr_assign(info, [sub.target], sub.value)
+        # lockish-named attrs written anywhere in the class but never
+        # constructed here (inherited locks): own node, unknown kind
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Attribute,)):
+                attr = _self_attr(sub)
+                if attr and _lockish(attr) \
+                        and attr not in info.lock_attrs:
+                    info.lock_attrs[attr] = "unknown"
+        if node.name in self.classes:
+            self.classes[node.name] = _AMBIGUOUS
+        else:
+            self.classes[node.name] = info
+
+    def _record_attr_assign(self, info: ClassInfo, targets: list,
+                            value: ast.Call) -> None:
+        ctor = dotted_name(value.func) or ""
+        for target in targets:
+            attr = _self_attr(target)
+            if attr is None:
+                # class-level `X = threading.Lock()` (ClassVar locks)
+                if isinstance(target, ast.Name) and ctor in _LOCK_KINDS:
+                    info.lock_attrs[target.id] = _LOCK_KINDS[ctor]
+                continue
+            if ctor in _LOCK_KINDS:
+                kind = _LOCK_KINDS[ctor]
+                if kind == "cond" and value.args:
+                    wrapped = _self_attr(value.args[0])
+                    if wrapped is not None:
+                        info.lock_attrs[attr] = f"alias:{wrapped}"
+                        continue
+                info.lock_attrs[attr] = kind
+            else:
+                tail = ctor.split(".")[-1]
+                if tail[:1].isupper():
+                    prev = info.attr_types.get(attr)
+                    if prev is not None and prev != tail:
+                        info.attr_types[attr] = None  # conflicting
+                    elif prev is None and attr not in info.attr_types:
+                        info.attr_types[attr] = tail
+
+    def _module_imports(self, module: Module) -> dict:
+        """alias -> relpath for intra-package imports (`from . import
+        kv_pool`, `from ..utils import metrics`, `import x.y as z`)."""
+        out: dict = {}
+        pkg_dir = os.path.dirname(module.relpath)
+        for node in module.tree.body:
+            if isinstance(node, ast.ImportFrom):
+                base = pkg_dir
+                for _ in range((node.level or 1) - 1):
+                    base = os.path.dirname(base)
+                if node.level and node.module:
+                    base = os.path.join(base, *node.module.split("."))
+                elif not node.level:
+                    base = os.path.join(*node.module.split(".")) \
+                        if node.module else ""
+                for alias in node.names:
+                    rel = os.path.join(base, alias.name + ".py") \
+                        .replace(os.sep, "/")
+                    if rel in self._relpaths:
+                        out[alias.asname or alias.name] = rel
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    rel = alias.name.replace(".", "/") + ".py"
+                    if rel in self._relpaths:
+                        out[alias.asname or alias.name] = rel
+        return out
+
+    # -- lookups --------------------------------------------------------------
+    def class_of(self, name: Optional[str]) -> Optional[ClassInfo]:
+        info = self.classes.get(name)
+        return info if isinstance(info, ClassInfo) else None
+
+    def _collect_nested(self, parent: FuncInfo) -> None:
+        """Register *parent*'s nested defs (at any depth) as lock-flow
+        roots, inheriting the class context — `self` in a closure is
+        the enclosing method's `self`."""
+        for sub in ast.walk(parent.node):
+            if sub is parent.node:
+                continue
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.nested.append(
+                    FuncInfo(parent.module, sub, parent.class_name))
+
+    def all_functions(self) -> Iterable[FuncInfo]:
+        for funcs in self.module_funcs.values():
+            yield from funcs.values()
+        for info in self.classes.values():
+            if isinstance(info, ClassInfo):
+                yield from info.methods.values()
+        yield from self.nested
+
+    # -- resolution -----------------------------------------------------------
+    def resolve_call(self, call: ast.Call, caller: FuncInfo,
+                     local_types: dict) -> Optional[FuncInfo]:
+        """The unique FuncInfo *call* targets, or None. `local_types`
+        maps the caller's local names to class names."""
+        func = call.func
+        name = dotted_name(func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if len(parts) == 1:
+            # bare f(): same-module function, or ClassName() ctor
+            target = self.module_funcs[caller.module.relpath] \
+                .get(parts[0])
+            if target is not None:
+                return target
+            cls = self.class_of(parts[0])
+            if cls is not None:
+                return cls.methods.get("__init__")
+            return None
+        if len(parts) == 2:
+            recv, meth = parts
+            if recv == "self" and caller.class_name:
+                cls = self.class_of(caller.class_name)
+                if cls is not None:
+                    return cls.methods.get(meth)
+                return None
+            if recv == "cls":
+                return None
+            # local var of inferred class
+            cls = self.class_of(local_types.get(recv))
+            if cls is not None:
+                return cls.methods.get(meth)
+            # imported module alias
+            rel = self.imports[caller.module.relpath].get(recv)
+            if rel is not None:
+                return self.module_funcs.get(rel, {}).get(meth)
+            # module-global singleton instance
+            inst = self.module_instances[caller.module.relpath] \
+                .get(recv)
+            cls = self.class_of(inst)
+            if cls is not None:
+                return cls.methods.get(meth)
+            # ClassName.method (static-ish call)
+            cls = self.class_of(recv)
+            if cls is not None:
+                return cls.methods.get(meth)
+            return None
+        if len(parts) == 3 and parts[0] == "self" and caller.class_name:
+            # self.attr.m(): inferred instance-attr class
+            cls = self.class_of(caller.class_name)
+            if cls is None:
+                return None
+            target_cls = self.class_of(cls.attr_types.get(parts[1]))
+            if target_cls is not None:
+                return target_cls.methods.get(parts[2])
+            return None
+        if len(parts) == 3:
+            # alias.Global.m() / alias submodule — one supported shape:
+            # imported module's singleton instance
+            rel = self.imports[caller.module.relpath].get(parts[0])
+            if rel is not None:
+                inst = self.module_instances.get(rel, {}).get(parts[1])
+                cls = self.class_of(inst)
+                if cls is not None:
+                    return cls.methods.get(parts[2])
+        return None
+
+    def lock_node_for(self, expr: ast.AST, caller: FuncInfo,
+                      local_types: Optional[dict] = None) \
+            -> Optional[tuple]:
+        """(node_id, kind) when *expr* is a recognized lock acquisition
+        target in *caller*'s context, else None."""
+        attr = _self_attr(expr)
+        if attr is not None and caller.class_name:
+            cls = self.class_of(caller.class_name)
+            if cls is not None and (attr in cls.lock_attrs
+                                    or _lockish(attr)):
+                return cls.lock_node(attr), cls.lock_kind(attr)
+            if _lockish(attr):
+                modbase = os.path.basename(caller.module.relpath) \
+                    .rsplit(".", 1)[0]
+                return (f"{modbase}.{caller.class_name}.{attr}",
+                        "unknown")
+            return None
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if len(parts) == 1:
+            node = self.module_locks[caller.module.relpath] \
+                .get(parts[0])
+            if node is not None:
+                return node, "lock"
+            return None
+        if len(parts) == 2:
+            cls = self.class_of(parts[0])
+            if cls is None and local_types:
+                cls = self.class_of(local_types.get(parts[0]))
+            if cls is None:
+                inst = self.module_instances[caller.module.relpath] \
+                    .get(parts[0])
+                cls = self.class_of(inst)
+            if cls is not None and parts[1] in cls.lock_attrs:
+                return cls.lock_node(parts[1]), cls.lock_kind(parts[1])
+        return None
+
+
+#: single-slot (key, strong refs, flow) — see build_flow
+_FLOW_CACHE: dict = {}
+
+
+def build_flow(modules: list) -> "LockFlow":
+    """One LockFlow per module set: LockDisciplineChecker and
+    LockOrderGraphChecker consume the same propagation products, so a
+    full lint run pays the whole-program fixpoint once. Single-slot
+    cache keyed on the Module object identities; the cached entry
+    holds the modules, so their ids cannot be recycled while the
+    entry is alive."""
+    key = tuple(id(m) for m in modules)
+    slot = _FLOW_CACHE.get("slot")
+    if slot is not None and slot[0] == key:
+        return slot[2]
+    flow = LockFlow(ProjectIndex(modules))
+    _FLOW_CACHE["slot"] = (key, list(modules), flow)
+    return flow
+
+
+@dataclasses.dataclass
+class EdgeWitness:
+    relpath: str
+    lineno: int
+    holder: str  # qualname of the function where the edge was observed
+    chain: str   # call chain that carried the held lock to this frame
+
+
+class LockFlow:
+    """Interprocedural lock-held propagation over a ProjectIndex.
+
+    Entry contexts are computed as a worklist fixpoint: a function is
+    (re)walked once per distinct set of locks held at some resolved
+    call site reaching it. Externally-reachable functions (public
+    names, module-level functions, callback-referenced methods) also
+    get the empty context — only PRIVATE methods' contexts come purely
+    from their observed call sites, which is exactly what lets a
+    private helper called only from lock-held sites inherit the
+    lock-held contract."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        #: (held_node, acquired_node) -> EdgeWitness (first observed)
+        self.edges: dict = {}
+        #: node id -> kind
+        self.node_kinds: dict = {}
+        #: func key -> list[bool]: per (resolved call site, caller
+        #: context), was a lock of the callee's own class held?
+        self.callsites: dict = {}
+        #: func keys referenced as values (callbacks) — run on
+        #: schedules the call graph cannot see
+        self.referenced: set = set()
+        self._memo: set = set()
+        self._worklist: list = []
+        self._run()
+
+    # -- public results -------------------------------------------------------
+    def lock_held_only_methods(self) -> set:
+        """Keys of PRIVATE methods every resolved call site of which
+        (in every reaching context) held a lock of the method's own
+        class — >= 1 site, never referenced as a callback value. These
+        run lock-held by contract, exactly like ``*_locked`` naming."""
+        out = set()
+        for key, sites in self.callsites.items():
+            _relpath, class_name, name = key
+            if class_name is None or not name.startswith("_") \
+                    or name.startswith("__"):
+                continue
+            if key in self.referenced:
+                continue
+            if sites and all(sites):
+                out.add(key)
+        return out
+
+    def find_cycles(self) -> list:
+        """Elementary cycles (tuples of node ids, rotated to smallest
+        first, deduplicated) — LockTracer.find_cycles on the static
+        graph."""
+        graph: dict = {}
+        for a, b in self.edges:
+            graph.setdefault(a, set()).add(b)
+        cycles = set()
+        for start in sorted(graph):
+            stack = [(start, (start,))]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start:
+                        k = path.index(min(path))
+                        cycles.add(path[k:] + path[:k])
+                    elif nxt not in path and len(path) < 16:
+                        stack.append((nxt, path + (nxt,)))
+        return sorted(cycles)
+
+    # -- propagation ----------------------------------------------------------
+    def _run(self) -> None:
+        for func in self.index.all_functions():
+            self._mark_references(func)
+        for func in self.index.all_functions():
+            if self._externally_reachable(func):
+                self._enqueue(func, frozenset(), ())
+        while self._worklist:
+            func, held, chain = self._worklist.pop()
+            self._walk_function(func, held, chain)
+        # private helpers no resolved caller reached (dead or
+        # dynamically-invoked code) and nested defs: walk once with the
+        # empty context so their internal acquisition edges still land
+        # in the graph
+        walked = {node_id for node_id, _held in self._memo}
+        for func in self.index.all_functions():
+            if id(func.node) not in walked:
+                self._enqueue(func, frozenset(), ())
+        while self._worklist:
+            func, held, chain = self._worklist.pop()
+            self._walk_function(func, held, chain)
+
+    def _externally_reachable(self, func: FuncInfo) -> bool:
+        if func.class_name is None:
+            return True  # module-level functions: callable from anywhere
+        name = func.name
+        if not name.startswith("_") or name.startswith("__"):
+            return True  # public and dunder methods
+        return func.key in self.referenced
+
+    def _enqueue(self, func: FuncInfo, held: frozenset,
+                 chain: tuple) -> None:
+        # memo on the AST node identity: nested defs may share a
+        # (relpath, class, name) key with a same-named method
+        memo_key = (id(func.node), held)
+        if memo_key in self._memo or len(chain) > MAX_DEPTH:
+            return
+        self._memo.add(memo_key)
+        self._worklist.append((func, held, chain))
+
+    def _mark_references(self, func: FuncInfo) -> None:
+        """Methods referenced as VALUES (`Thread(target=self._worker)`,
+        `cb = self._flush`) are never relaxation candidates. A
+        ``self.m`` Load that is a call's own func node does not count —
+        those ARE the resolvable call sites."""
+        cls = self.index.class_of(func.class_name) \
+            if func.class_name else None
+        if cls is None:
+            return
+        loads: dict = {}
+        callfuncs: dict = {}
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr is not None:
+                    callfuncs[attr] = callfuncs.get(attr, 0) + 1
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                attr = _self_attr(node)
+                if attr is not None:
+                    loads[attr] = loads.get(attr, 0) + 1
+        for attr, n_loads in loads.items():
+            if n_loads > callfuncs.get(attr, 0):
+                target = cls.methods.get(attr)
+                if target is not None:
+                    self.referenced.add(target.key)
+
+    def _walk_function(self, func: FuncInfo, held: frozenset,
+                       chain: tuple) -> None:
+        local_types = self._local_types(func)
+        self._walk_block(func.node.body, func, held,
+                         chain + (func.qualname,), local_types)
+
+    def _local_types(self, func: FuncInfo) -> dict:
+        out: dict = {}
+        for node in walk_in_frame(func.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                ctor = (dotted_name(node.value.func) or "").split(".")[-1]
+                if self.index.class_of(ctor) is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out[target.id] = ctor
+        return out
+
+    def _walk_block(self, stmts: list, func: FuncInfo, held: frozenset,
+                    chain: tuple, local_types: dict) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, func, held, chain, local_types)
+
+    def _walk_stmt(self, stmt: ast.AST, func: FuncInfo, held: frozenset,
+                   chain: tuple, local_types: dict) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # nested defs run elsewhere; ProjectIndex registers them as
+            # their own lock-flow roots (empty entry context), so their
+            # internal acquisitions still contribute ordering edges
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # `with a, b:` acquires sequentially: b is taken while a is
+            # already held, so each item sees the edges of its
+            # predecessors too
+            inner = held
+            for item in stmt.items:
+                got = self.index.lock_node_for(item.context_expr, func,
+                                               local_types)
+                if got is not None:
+                    self._acquire(got, inner, func, item.context_expr,
+                                  chain)
+                    inner = frozenset(inner | {got[0]})
+                else:
+                    self._visit_calls(item.context_expr, func, inner,
+                                      chain, local_types)
+            self._walk_block(stmt.body, func, inner, chain, local_types)
+            return
+        if isinstance(stmt, ast.Try):
+            for part in (stmt.body, stmt.orelse, stmt.finalbody):
+                self._walk_block(part, func, held, chain, local_types)
+            for handler in stmt.handlers:
+                self._walk_block(handler.body, func, held, chain,
+                                 local_types)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._visit_calls(stmt.test, func, held, chain, local_types)
+            self._walk_block(stmt.body, func, held, chain, local_types)
+            self._walk_block(stmt.orelse, func, held, chain,
+                             local_types)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_calls(stmt.iter, func, held, chain, local_types)
+            self._walk_block(stmt.body, func, held, chain, local_types)
+            self._walk_block(stmt.orelse, func, held, chain,
+                             local_types)
+            return
+        self._visit_calls(stmt, func, held, chain, local_types)
+
+    def _visit_calls(self, node: ast.AST, func: FuncInfo,
+                     held: frozenset, chain: tuple,
+                     local_types: dict) -> None:
+        # walk_in_frame: a call inside a lambda runs when the lambda is
+        # invoked, not here — attributing it to this frame would both
+        # fabricate lock-order edges and wrongly certify the callee as
+        # called-under-lock
+        for sub in walk_in_frame(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            # bare `self.<lock>.acquire()` counts as an acquisition
+            # event for ordering purposes (the try/finally shape)
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "acquire":
+                got = self.index.lock_node_for(sub.func.value, func,
+                                               local_types)
+                if got is not None:
+                    self._acquire(got, held, func, sub, chain)
+                    continue
+            target = self.index.resolve_call(sub, func, local_types)
+            if target is None:
+                continue
+            self._record_callsite(target, func, held)
+            self._enqueue(target, held, chain)
+
+    def _record_callsite(self, target: FuncInfo, caller: FuncInfo,
+                         held: frozenset) -> None:
+        cls = self.index.class_of(target.class_name) \
+            if target.class_name else None
+        if cls is None:
+            return
+        own_nodes = {cls.lock_node(a) for a in cls.lock_attrs}
+        # a *_locked caller of the SAME class carries the lock-held
+        # contract even though the lock object was taken further up a
+        # call path the index could not resolve
+        contract = (caller.class_name == target.class_name
+                    and caller.name.endswith("_locked"))
+        self.callsites.setdefault(target.key, []).append(
+            bool(own_nodes & held) or contract)
+
+    def _acquire(self, got: tuple, held: frozenset, func: FuncInfo,
+                 node: ast.AST, chain: tuple) -> None:
+        lock_node, kind = got
+        self.node_kinds[lock_node] = kind
+        for h in held:
+            if h == lock_node:
+                # re-entry: only a known non-reentrant Lock is a
+                # self-deadlock candidate; RLock/Condition re-entry
+                # (and unknown kinds — inherited locks are usually
+                # reentrant helpers) records nothing
+                if kind != "lock":
+                    continue
+            edge = (h, lock_node)
+            if edge not in self.edges:
+                self.edges[edge] = EdgeWitness(
+                    func.module.relpath,
+                    getattr(node, "lineno", 1),
+                    func.qualname,
+                    " -> ".join(chain[-4:]))

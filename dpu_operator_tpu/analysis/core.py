@@ -103,6 +103,22 @@ class Checker:
 
 # -- shared AST helpers -------------------------------------------------------
 
+def walk_in_frame(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested function or
+    lambda bodies: their code runs when CALLED, not where it is
+    defined, so frame-local analyses (lock context, resource liveness,
+    discharge scanning) must not attribute it to the definition site."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
 def dotted_name(node: ast.AST) -> Optional[str]:
     """'a.b.c' for Name/Attribute chains, else None."""
     parts = []
@@ -188,14 +204,36 @@ class Baseline:
 
 def run_checkers(checkers: Iterable[Checker], roots: Iterable[str],
                  repo_root: str) -> list:
-    """All non-suppressed violations, ordered by (path, line, rule)."""
-    violations = []
+    """All non-suppressed violations, ordered by (path, line, rule).
+
+    Checkers exposing ``check_project(modules)`` are whole-program
+    passes (the interprocedural v2 rules): they receive every loaded
+    module at once instead of one ``check(module)`` call per file, so
+    cross-module evidence (call-site lock-held-ness, the lock-order
+    graph) is complete. Pragma suppression still applies per line of
+    the file each violation lands in."""
+    modules = []
+    by_relpath: dict = {}
     for path in iter_python_files(roots, repo_root):
         module = load_module(path, repo_root)
         if module is None:
             continue
-        for checker in checkers:
+        modules.append(module)
+        by_relpath[module.relpath] = module
+    violations = []
+
+    def _keep(module: Optional[Module], v: Violation) -> bool:
+        return module is None or not module.suppressed(v.rule, v.line)
+
+    for checker in checkers:
+        project = getattr(checker, "check_project", None)
+        if project is not None:
+            for v in project(modules):
+                if _keep(by_relpath.get(v.path), v):
+                    violations.append(v)
+            continue
+        for module in modules:
             for v in checker.check(module):
-                if not module.suppressed(v.rule, v.line):
+                if _keep(module, v):
                     violations.append(v)
     return sorted(violations, key=lambda v: (v.path, v.line, v.rule))

@@ -1,0 +1,564 @@
+"""opslint resource-lifecycle: path-sensitive acquire/release checking.
+
+The serving layer and the daemon live on strict acquire/release pairing
+— KV blocks, batch slots, sockets, raw fds — and the repo's worst
+historical bugs are the quiet kind where an error path skips the
+release (a leaked fd per retry, a KV owner that never frees). This rule
+walks each function as a small control-flow interpretation with
+EXCEPTION EDGES: a tracked resource acquired on some path must be
+discharged on every exit of that path, where "discharged" is any of
+
+- an explicit release (``close()``/``os.close(fd)``/``pool.free(owner)``
+  /putting a slot back on its free list);
+- a ``finally`` whose body releases it (applied to every exit that
+  unwinds through it) or acquisition directly in a ``with`` item
+  (released by ``__exit__`` by construction);
+- ownership TRANSFER: returning it, storing it into an attribute or
+  container (``self._sock = s``, ``self._active[slot] = req``,
+  ``admitted.append(req)``), handing an fd to ``os.fdopen``, or passing
+  it to a cleanup-shaped helper (``_cleanup_listener(sock, ...)``) —
+  the serve scheduler's ``_release_locked`` hoist is the canonical
+  transfer-then-shared-teardown pattern this rule is built around.
+
+Tracked resources and their checking depth:
+
+==========  ==========================================  ==============
+kind        acquirer                                    exception edges
+==========  ==========================================  ==============
+socket      ``socket.socket(...)``, ``<sock>.accept()``  yes
+fd          ``os.open(...)``                             yes
+slot        ``<*slot*>.pop(...)``                        yes
+kv          ``<*pool*>.alloc(owner, ..)`` /              no — normal
+            ``<*pool*>.map_prefix(owner, ..)``           exits only
+==========  ==========================================  ==============
+
+KV accounting lives behind the scheduler's own exception boundary (a
+failing step excises the request through ``_fail_request_locked``), so
+only returns/raises/fall-through are checked there; handles get the
+full treatment — any call that can raise while a handle is live and
+unprotected is an exception-edge leak.
+
+The analysis is per-function (the interprocedural lock pass has no
+bearing here) and deliberately may-leak: a resource released on one
+branch but live on another is reported at the exit the live branch
+reaches. Suppress intentional cases with
+``# opslint: disable=resource-lifecycle`` plus the justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from .core import Checker, Module, Violation, dotted_name, walk_in_frame
+
+#: function-name shapes that take ownership of (or destroy) arguments
+_RELEASEISH = re.compile(
+    r"(?:^|_)(?:close|cleanup|release|free|dispose|teardown|shutdown|"
+    r"excise|destroy)")
+
+#: container mutators that capture an object into longer-lived state
+_CAPTURE_METHODS = {"append", "add", "insert", "setdefault", "push",
+                    "put", "put_nowait", "appendleft", "extend"}
+
+_EXIT_KIND_HUMAN = {
+    "return": "still held when this `return` executes",
+    "raise": "still held when this exception leaves the function",
+    "end": "still held when the function falls off the end",
+}
+
+
+class _Resource:
+    __slots__ = ("kind", "var", "owner", "owner_root", "node", "what",
+                 "exc_checked")
+    _COUNTER = 0
+
+    def __init__(self, kind: str, node: ast.AST, what: str,
+                 var: Optional[str] = None,
+                 owner: Optional[str] = None):
+        self.kind = kind
+        self.var = var
+        self.owner = owner
+        self.owner_root = None
+        if owner:
+            root = owner.split(".")[0].split("[")[0]
+            if root not in ("self", ""):
+                self.owner_root = root
+        self.node = node
+        self.what = what
+        self.exc_checked = kind != "kv"
+
+    def describe(self) -> str:
+        if self.kind == "kv":
+            return f"KV blocks of owner `{self.owner}` ({self.what})"
+        bound = f" bound to `{self.var}`" if self.var else " (unbound)"
+        return f"{self.kind} from {self.what}{bound}"
+
+
+class _TryFrame:
+    __slots__ = ("node", "part", "exc_live")
+
+    def __init__(self, node: ast.Try):
+        self.node = node
+        self.part = "body"  # body | orelse | handler | finally
+        self.exc_live: set = set()
+
+
+def _contains_call(node: ast.AST) -> bool:
+    # a call inside a lambda runs when the lambda does — not here
+    for sub in walk_in_frame(node):
+        if isinstance(sub, ast.Call):
+            return True
+    return False
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _names_outside_calls(node: ast.AST) -> set:
+    """Bare names in *node* excluding anything inside a Call: in
+    `self.buf = conn.recv(64)` the value mentions `conn` but stores
+    only recv's RESULT — that is not an ownership transfer."""
+    out: set = set()
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Call):
+            continue
+        if isinstance(cur, ast.Name):
+            out.add(cur.id)
+        stack.extend(ast.iter_child_nodes(cur))
+    return out
+
+
+class _FunctionWalker:
+    """One function's abstract interpretation. Collects (node, message)
+    violation tuples; the checker wraps them."""
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.frames: list = []
+        self.findings: list = []
+        self._reported: set = set()
+
+    # -- entry ----------------------------------------------------------------
+    def run(self) -> list:
+        live = self._block(self.func.body, frozenset())
+        if live:
+            for r in live:
+                self._leak(r, self.func, "end")
+        return self.findings
+
+    # -- acquisition / discharge recognition ----------------------------------
+    def _acquisition(self, call: ast.Call,
+                     live: frozenset) -> Optional[_Resource]:
+        name = dotted_name(call.func)
+        if name == "socket.socket":
+            return _Resource("socket", call, "socket.socket(...)")
+        if name == "os.open":
+            return _Resource("fd", call, "os.open(...)")
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        recv = dotted_name(call.func.value) or ""
+        if meth == "accept":
+            tail = recv.split(".")[-1]
+            if any(r.kind == "socket" and r.var == recv for r in live) \
+                    or "listen" in tail or "sock" in tail:
+                return _Resource("socket", call, f"{recv}.accept()")
+        if meth in ("alloc", "map_prefix") and "pool" in recv.lower() \
+                and call.args:
+            owner = ast.unparse(call.args[0])
+            return _Resource("kv", call, f"{recv}.{meth}(...)",
+                             owner=owner)
+        if meth == "pop":
+            tail = recv.split(".")[-1].lower()
+            if "slot" in tail:
+                return _Resource("slot", call, f"{recv}.pop(...)")
+        return None
+
+    def _discharges(self, stmt: ast.AST, live: frozenset) -> set:
+        """Resources *stmt* releases or transfers. walk_in_frame: a
+        `cleanup = lambda: s.close()` DEFINES a release, it does not
+        perform one — counting it would mask the leak when the lambda
+        is never invoked."""
+        done: set = set()
+        for sub in walk_in_frame(stmt):
+            if isinstance(sub, ast.Call):
+                done |= self._call_discharges(sub, live)
+        for sub in walk_in_frame(stmt):
+            if isinstance(sub, ast.Assign):
+                done |= self._assign_transfers(sub, live)
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            names = _names_outside_calls(stmt.value)
+            for r in live:
+                if (r.var and r.var in names) \
+                        or (r.owner_root and r.owner_root in names):
+                    done.add(r)
+        return done
+
+    def _call_discharges(self, call: ast.Call, live: frozenset) -> set:
+        done: set = set()
+        name = dotted_name(call.func) or ""
+        parts = name.split(".")
+        arg_names = set()
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            arg_names |= _names_in(a)
+        if isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            recv = dotted_name(call.func.value) or ""
+            for r in live:
+                # sock.close() / fd-holder method release
+                if r.var and recv == r.var and meth in (
+                        "close", "detach", "shutdown", "release"):
+                    done.add(r)
+                # pool.free(owner) / pool.release(owner) by owner expr
+                if r.kind == "kv" and meth in ("free", "release") \
+                        and call.args \
+                        and ast.unparse(call.args[0]) == r.owner:
+                    done.add(r)
+                # free-list put-back: <*slot*>.append(slot)
+                if r.kind == "slot" and r.var \
+                        and meth in ("append", "extend", "insert") \
+                        and "slot" in recv.split(".")[-1].lower() \
+                        and r.var in arg_names:
+                    done.add(r)
+                # capture into longer-lived state: owner root or the
+                # handle itself stored in a container
+                if meth in _CAPTURE_METHODS:
+                    if r.owner_root and r.owner_root in arg_names:
+                        done.add(r)
+                    elif r.var and r.var in arg_names \
+                            and r.kind != "slot":
+                        done.add(r)
+        # os.close(fd) / os.fdopen(fd, ...) ownership transfer
+        if name in ("os.close", "os.fdopen") and call.args:
+            first = _names_in(call.args[0])
+            for r in live:
+                if r.kind == "fd" and r.var and r.var in first:
+                    done.add(r)
+        # cleanup-shaped helper owning its arguments:
+        # _cleanup_listener(sock, path), self._release_locked(req)
+        if parts and _RELEASEISH.search(parts[-1]):
+            for r in live:
+                if (r.var and r.var in arg_names) \
+                        or (r.owner_root and r.owner_root in arg_names):
+                    done.add(r)
+        return done
+
+    def _assign_transfers(self, assign: ast.Assign,
+                          live: frozenset) -> set:
+        """`self._sock = s`, `req.slot = slot`, `self._active[slot] =
+        req`, plain re-alias `t = s` — storing a live resource (or, for
+        KV, its owning object) somewhere else transfers ownership."""
+        done: set = set()
+        value_names = _names_outside_calls(assign.value)
+        for target in assign.targets:
+            if isinstance(target, (ast.Attribute, ast.Subscript)):
+                index_names = set()
+                if isinstance(target, ast.Subscript):
+                    index_names = _names_in(target.slice)
+                for r in live:
+                    if r.var and (r.var in value_names
+                                  or r.var in index_names):
+                        done.add(r)
+                    elif r.owner_root and r.owner_root in value_names:
+                        done.add(r)
+            elif isinstance(target, ast.Name):
+                for r in live:
+                    if r.var and r.var in value_names \
+                            and target.id != r.var:
+                        done.add(r)  # re-aliased: track stops here
+        return done
+
+    # -- violations -----------------------------------------------------------
+    def _leak(self, r: _Resource, node: ast.AST, exit_kind: str,
+              detail: str = "") -> None:
+        key = (id(r.node), exit_kind)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        # anchor at the ACQUISITION: that is the line a pragma naturally
+        # sits on, and the one stable location per resource
+        node = r.node
+        if exit_kind == "edge":
+            msg = (f"{r.describe()} may leak: {detail} can raise while "
+                   "it is held and no enclosing finally/handler "
+                   "releases it — release in a finally, use `with`, or "
+                   "transfer ownership first")
+        elif exit_kind == "rebind":
+            msg = (f"{r.describe()} reacquired into the same name "
+                   "while the previous one is unreleased — each "
+                   "retry/iteration leaks one; release before "
+                   "reacquiring")
+        else:
+            how = _EXIT_KIND_HUMAN[exit_kind]
+            if r.kind == "kv":
+                fix = (f"free it on this path (`...free({r.owner})`) "
+                       "or transfer ownership (store/append/return "
+                       "the owning object)")
+            else:
+                fix = ("release it on every exit path or transfer "
+                       "ownership (return it / store it on self)")
+            msg = f"{r.describe()} {how} — {fix}"
+        self.findings.append((node, msg))
+
+    # -- exception edges ------------------------------------------------------
+    def _exception_edge(self, live: frozenset, stmt: ast.AST,
+                        source: str) -> None:
+        """An exception may leave *stmt* with *live* held: unwind
+        through enclosing frames — finallys release, the innermost
+        try currently executing its BODY is assumed to catch — and
+        report whatever would escape the function unreleased."""
+        live = {r for r in live if r.exc_checked}
+        if not live:
+            return
+        for frame in reversed(self.frames):
+            if frame.part == "body" and frame.node.handlers:
+                frame.exc_live |= live
+                return
+            live -= self._discharges_in(frame.node.finalbody, live)
+            if not live:
+                return
+        for r in live:
+            self._leak(r, stmt, "edge", detail=source)
+
+    def _discharges_in(self, stmts: list, live) -> set:
+        done: set = set()
+        frozen = frozenset(live)
+        for stmt in stmts:
+            done |= self._discharges(stmt, frozen)
+        return done
+
+    def _unwind_finallys(self, live: set) -> set:
+        """Apply every pending enclosing finally's releases — what a
+        return/raise actually executes on the way out."""
+        for frame in reversed(self.frames):
+            if frame.part != "finally":
+                live -= self._discharges_in(frame.node.finalbody, live)
+        return live
+
+    # -- statement interpretation ---------------------------------------------
+    def _block(self, stmts: list,
+               live: frozenset) -> Optional[frozenset]:
+        """Returns the fall-through live set, or None when every path
+        exits (return/raise)."""
+        live = frozenset(live)
+        for stmt in stmts:
+            out = self._stmt(stmt, live)
+            if out is None:
+                return None
+            live = out
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                break
+        return live
+
+    def _stmt(self, stmt: ast.AST,
+              live: frozenset) -> Optional[frozenset]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return live  # nested defs are walked as their own functions
+        if isinstance(stmt, ast.Return):
+            live = live - self._discharges(stmt, live)
+            remaining = self._unwind_finallys(set(live))
+            for r in remaining:
+                self._leak(r, stmt, "return")
+            return None
+        if isinstance(stmt, ast.Raise):
+            remaining = self._unwind_raise(set(live))
+            for r in remaining:  # an explicit raise checks every kind
+                self._leak(r, stmt, "raise")
+            return None
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, live)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, live)
+        if isinstance(stmt, ast.If):
+            live = self._expr(stmt.test, live)
+            a = self._block(stmt.body, live)
+            b = self._block(stmt.orelse, live)
+            return self._join(a, b)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, live)
+        if isinstance(stmt, (ast.Break, ast.Continue, ast.Pass,
+                             ast.Global, ast.Nonlocal, ast.Import,
+                             ast.ImportFrom)):
+            return live
+        # plain statement (Assign/AugAssign/Expr/Assert/Delete/...)
+        return self._expr(stmt, live)
+
+    def _unwind_raise(self, live: set) -> set:
+        """A `raise` unwinds like an exception edge, except frames
+        whose handlers are already running cannot re-catch."""
+        for frame in reversed(self.frames):
+            if frame.part == "body" and frame.node.handlers:
+                frame.exc_live |= {r for r in live}
+                return set()
+            live -= self._discharges_in(frame.node.finalbody, live)
+            if not live:
+                return set()
+        return live
+
+    def _expr(self, stmt: ast.AST,
+              live: frozenset) -> frozenset:
+        """The workhorse for non-control-flow statements: apply
+        discharges, run the exception edge, then add acquisitions."""
+        live = live - self._discharges(stmt, live)
+        if _contains_call(stmt) or isinstance(stmt, ast.Assert):
+            src = self._raise_source(stmt)
+            self._exception_edge(live, stmt, src)
+        # acquiring straight into longer-lived state
+        # (`self._sock = socket.socket()`) transfers in the same
+        # statement — never tracked
+        if isinstance(stmt, ast.Assign) and all(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in stmt.targets):
+            return live
+        acquired = []
+        for sub in walk_in_frame(stmt):
+            if isinstance(sub, ast.Call):
+                res = self._acquisition(sub, live)
+                if res is not None:
+                    acquired.append(res)
+        if not acquired:
+            return live
+        out = set(live)
+        for res in acquired:
+            if res.kind == "kv" and any(
+                    p.kind == "kv" and p.owner == res.owner
+                    for p in out):
+                continue  # map_prefix + alloc on one owner: one charge
+            res.var = self._bind_target(stmt, res)
+            if res.var:
+                for prev in list(out):
+                    if prev.var == res.var and prev.kind == res.kind:
+                        self._leak(res, res.node, "rebind")
+                        out.discard(prev)
+            out.add(res)
+        return frozenset(out)
+
+    @staticmethod
+    def _bind_target(stmt: ast.AST, res: _Resource) -> Optional[str]:
+        """The local name an acquisition lands in (`fd = os.open(..)`,
+        `conn, _ = listener.accept()` binds elt 0)."""
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            return None
+        target = stmt.targets[0]
+        if isinstance(target, ast.Name) and stmt.value is res.node:
+            return target.id
+        if isinstance(target, ast.Tuple) and stmt.value is res.node \
+                and target.elts \
+                and isinstance(target.elts[0], ast.Name):
+            return target.elts[0].id
+        return None
+
+    @staticmethod
+    def _raise_source(stmt: ast.AST) -> str:
+        for sub in walk_in_frame(stmt):
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func)
+                if name:
+                    return f"`{name}(...)`"
+        return "a call here"
+
+    def _with(self, stmt: ast.AST,
+              live: frozenset) -> Optional[frozenset]:
+        for item in stmt.items:
+            # acquisition AS the context expr is released by __exit__
+            # by construction — discharge transfers (os.fdopen(fd))
+            # and run the edge, but never track the item itself
+            live = live - self._discharges(item.context_expr, live)
+            if _contains_call(item.context_expr):
+                self._exception_edge(
+                    live, stmt, self._raise_source(item.context_expr))
+        return self._block(stmt.body, live)
+
+    def _loop(self, stmt: ast.AST,
+              live: frozenset) -> Optional[frozenset]:
+        head = stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+            else stmt.test
+        # _expr never returns None; an EMPTY frozenset (head discharged
+        # everything) is a valid result, not a miss
+        live = self._expr(head if isinstance(head, ast.expr)
+                          else stmt, live)
+        # two passes catch loop-carried leaks (reacquire-before-release)
+        first = self._block(stmt.body, live)
+        carried = live if first is None else frozenset(live | first)
+        second = self._block(stmt.body, carried)
+        exits = [x for x in (first, second) if x is not None]
+        after = frozenset(live.union(*exits)) if exits else live
+        if isinstance(stmt, ast.While) \
+                and isinstance(stmt.test, ast.Constant) \
+                and bool(stmt.test.value) \
+                and not any(isinstance(s, ast.Break)
+                            for s in ast.walk(stmt)):
+            return None  # `while True` with no break never falls through
+        if stmt.orelse:
+            return self._block(stmt.orelse, after)
+        return after
+
+    def _try(self, stmt: ast.Try,
+             live: frozenset) -> Optional[frozenset]:
+        frame = _TryFrame(stmt)
+        self.frames.append(frame)
+        try:
+            body_out = self._block(stmt.body, live)
+            frame.part = "orelse"
+            if body_out is not None and stmt.orelse:
+                body_out = self._block(stmt.orelse, body_out)
+            handler_outs = []
+            for handler in stmt.handlers:
+                frame.part = "handler"
+                handler_outs.append(
+                    self._block(handler.body,
+                                frozenset(frame.exc_live)))
+            frame.part = "finally"
+            joined = None
+            for out in [body_out] + handler_outs:
+                joined = self._join(joined, out)
+        finally:
+            self.frames.pop()
+        if joined is None:
+            return None
+        if stmt.finalbody:
+            # the finalbody is cleanup context: apply its releases but
+            # do not second-guess failure cascades INSIDE the cleanup
+            # (an unlock raising before the close is out of scope)
+            return frozenset(joined
+                             - self._discharges_in(stmt.finalbody,
+                                                   joined))
+        return joined
+
+    @staticmethod
+    def _join(a: Optional[frozenset],
+              b: Optional[frozenset]) -> Optional[frozenset]:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return frozenset(a | b)
+
+
+class ResourceLifecycleChecker(Checker):
+    name = "resource-lifecycle"
+    description = ("tracked resources (sockets, raw fds, KV-pool "
+                   "owners, batch slots) must be released or "
+                   "ownership-transferred on every exit path, "
+                   "including exception edges")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if module.is_test:
+            return
+        if not module.relpath.startswith("dpu_operator_tpu/"):
+            return
+        if module.relpath.startswith("dpu_operator_tpu/analysis/"):
+            return  # the rule tables name the very calls they match
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for anchor, msg in _FunctionWalker(node).run():
+                yield self.violation(module, anchor,
+                                     f"in `{node.name}`: {msg}")

@@ -1,11 +1,11 @@
-"""opslint lock-discipline: a static guarded-by checker.
+"""opslint lock-discipline: static guarded-by + static lock ordering.
 
-The heuristic mirrors Java's @GuardedBy and Go's "mu protects the fields
-below it" convention, inferred instead of declared: within a class that
-owns a lock, any instance attribute written at least once under `with
-self.<lock>:` is *guarded*; a write to a guarded attribute outside every
-lock block (and outside ``__init__``, which happens-before publication)
-is a candidate race.
+**Guarded-by** mirrors Java's @GuardedBy and Go's "mu protects the
+fields below it" convention, inferred instead of declared: within a
+class that owns a lock, any instance attribute written at least once
+under `with self.<lock>:` is *guarded*; a write to a guarded attribute
+outside every lock block (and outside ``__init__``, which
+happens-before publication) is a candidate race.
 
 Only writes are flagged. Lock-free reads of guarded state are a
 deliberate non-goal: the codebase uses benign racy reads (gauges,
@@ -20,14 +20,25 @@ Recognized lock-acquisition shapes:
 - methods whose name ends ``_locked`` — the repo-wide convention for
   "caller holds the lock" helpers (metrics, resilience);
 - a ``try`` block whose preceding statement calls
-  ``self.<lock>.acquire(...)`` and whose finally releases it.
+  ``self.<lock>.acquire(...)`` and whose finally releases it;
+- **interprocedural (v2)**: a PRIVATE helper whose every resolved call
+  site across the scanned modules holds a lock of its own class runs
+  lock-held by contract, ``*_locked`` suffix or not — the
+  :mod:`.callgraph` propagation supplies the call-site evidence.
+
+**Lock ordering** (:class:`LockOrderGraphChecker`) is the static
+complement to ``testing/locktrace.py``: the same propagation records an
+edge ``A -> B`` whenever code acquires lock B while (transitively)
+holding lock A, and any cycle in that graph is a potential deadlock —
+reported without needing a test to drive the bad interleaving.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Optional
+from typing import Iterable, Iterator, Optional
 
+from .callgraph import build_flow
 from .core import Checker, Module, Violation, dotted_name
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
@@ -68,11 +79,15 @@ class _MethodScanner(ast.NodeVisitor):
     """Collect self-attribute writes in one method, tracking whether
     each write happens under a recognized lock acquisition."""
 
-    def __init__(self, method_name: str, known_locks: set):
+    def __init__(self, method_name: str, known_locks: set,
+                 lock_held: bool = False):
         self.known_locks = known_locks
         self.method = method_name
-        # *_locked helpers run with the caller's lock held by contract
-        self.depth = 1 if method_name.endswith("_locked") else 0
+        # *_locked helpers run with the caller's lock held by contract;
+        # lock_held=True marks helpers the interprocedural pass proved
+        # are called only from lock-held sites (same contract, inferred)
+        self.depth = 1 if (method_name.endswith("_locked")
+                           or lock_held) else 0
         self.writes: list = []
 
     # -- lock scopes ----------------------------------------------------------
@@ -176,19 +191,29 @@ class _MethodScanner(ast.NodeVisitor):
 class LockDisciplineChecker(Checker):
     name = "lock-discipline"
     description = ("attributes written under a class's lock anywhere must "
-                   "be written under it everywhere (outside __init__)")
+                   "be written under it everywhere (outside __init__); "
+                   "helpers called only from lock-held sites pass")
 
     def check(self, module: Module) -> Iterator[Violation]:
-        if module.is_test:
-            return
-        if not module.relpath.startswith("dpu_operator_tpu/"):
-            return
-        for node in ast.walk(module.tree):
-            if isinstance(node, ast.ClassDef):
-                yield from self._check_class(module, node)
+        yield from self.check_modules([module])
 
-    def _check_class(self, module: Module,
-                     cls: ast.ClassDef) -> Iterator[Violation]:
+    def check_project(self, modules: list) -> Iterator[Violation]:
+        yield from self.check_modules(modules)
+
+    def check_modules(self, modules: Iterable[Module]) \
+            -> Iterator[Violation]:
+        in_scope = [m for m in modules if not m.is_test
+                    and m.relpath.startswith("dpu_operator_tpu/")]
+        if not in_scope:
+            return
+        relaxed = build_flow(in_scope).lock_held_only_methods()
+        for module in in_scope:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(module, node, relaxed)
+
+    def _check_class(self, module: Module, cls: ast.ClassDef,
+                     relaxed: set) -> Iterator[Violation]:
         known_locks = self._lock_attrs(cls)
         writes: list = []
         uses_locks = bool(known_locks)
@@ -196,7 +221,10 @@ class LockDisciplineChecker(Checker):
             if not isinstance(item, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
-            scanner = _MethodScanner(item.name, known_locks)
+            contract = ((module.relpath, cls.name, item.name)
+                        in relaxed)
+            scanner = _MethodScanner(item.name, known_locks,
+                                     lock_held=contract)
             for stmt in item.body:
                 scanner.visit(stmt)
             writes.extend(scanner.writes)
@@ -214,7 +242,9 @@ class LockDisciplineChecker(Checker):
                     f"attribute `self.{w.attr}` is written under "
                     f"`{cls.name}`'s lock elsewhere but written here "
                     f"(in `{w.method}`) without it — either take the "
-                    "lock, or pragma with the happens-before argument")
+                    "lock (or make every call site of this helper "
+                    "lock-held), or pragma with the happens-before "
+                    "argument")
 
     @staticmethod
     def _lock_attrs(cls: ast.ClassDef) -> set:
@@ -240,3 +270,51 @@ class LockDisciplineChecker(Checker):
                     if attr is not None and _lockish(attr, known_locks):
                         return True
         return False
+
+
+class LockOrderGraphChecker(Checker):
+    """Static lock-order cycles: the LockTracer invariant, no test
+    required. One violation per elementary cycle, anchored at the call
+    site that contributed the cycle's first edge; the message names
+    every edge with its witness so the inversion is actionable."""
+
+    name = "lock-order-graph"
+    description = ("the static lock acquisition-order graph "
+                   "(interprocedural, aggregated by declaring "
+                   "class/module) must be acyclic — a cycle is a "
+                   "potential deadlock even if no test interleaves it")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        yield from self.check_modules([module])
+
+    def check_project(self, modules: list) -> Iterator[Violation]:
+        yield from self.check_modules(modules)
+
+    def check_modules(self, modules: Iterable[Module]) \
+            -> Iterator[Violation]:
+        in_scope = [m for m in modules if not m.is_test
+                    and m.relpath.startswith("dpu_operator_tpu/")]
+        if not in_scope:
+            return
+        flow = build_flow(in_scope)
+        for cycle in flow.find_cycles():
+            edges = list(zip(cycle, cycle[1:] + (cycle[0],)))
+            witnesses = [(edge, flow.edges.get(edge))
+                         for edge in edges]
+            anchor = next((w for _, w in witnesses if w is not None),
+                          None)
+            if anchor is None:  # pragma: no cover — defensive
+                continue
+            parts = []
+            for (a, b), w in witnesses:
+                if w is None:
+                    continue
+                parts.append(f"{a} held while acquiring {b} "
+                             f"(in {w.holder}, via {w.chain})")
+            rendered = " -> ".join(cycle + (cycle[0],))
+            yield Violation(
+                self.name, anchor.relpath, anchor.lineno,
+                f"lock-order cycle {rendered}: " + "; ".join(parts)
+                + " — impose one global acquisition order (release "
+                "before calling across, or hoist the second acquire "
+                "out of the held region)")

@@ -181,11 +181,18 @@ def _helper_main(argv: list) -> int:
             continue
     try:
         fd = os.open(netns, os.O_RDONLY)
-        os.setns(fd, os.CLONE_NEWNET)
-        os.close(fd)
     except OSError:
         print(0)
         return 0
+    try:
+        os.setns(fd, os.CLONE_NEWNET)
+    except OSError:
+        print(0)
+        return 0
+    finally:
+        # the handle is only needed for the setns call itself; close it
+        # on both outcomes — a failing setns must not leak the netns fd
+        os.close(fd)
     print(_send_frames(ifname, parsed))
     return 0
 

@@ -9,11 +9,13 @@ Kept for parity so the cluster manager watches the CRD and surfaces events.
 from __future__ import annotations
 
 from ..api.types import API_VERSION
+from ..k8s.client import KubeClient
 from ..k8s.manager import ReconcileResult, Request
 
 
 class ServiceFunctionChainClusterReconciler:
     watches = (API_VERSION, "ServiceFunctionChain")
 
-    def reconcile(self, client, req: Request) -> ReconcileResult:
+    def reconcile(self, client: KubeClient,
+                  req: Request) -> ReconcileResult:
         return ReconcileResult()

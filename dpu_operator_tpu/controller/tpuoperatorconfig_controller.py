@@ -13,8 +13,11 @@ import json
 import logging
 import os
 
+from typing import Any, Callable, Optional
+
 from ..api.types import API_VERSION, TpuOperatorConfig
 from ..images import merge_vars_with_images
+from ..k8s.client import KubeClient
 from ..k8s.manager import ReconcileResult, Request
 from ..render import apply_all_from_bindata
 from ..utils import vars as v
@@ -30,9 +33,11 @@ _BINDATA = os.path.join(os.path.dirname(__file__), "bindata")
 class TpuOperatorConfigReconciler:
     watches = (API_VERSION, "TpuOperatorConfig")
 
-    def __init__(self, image_manager, path_manager: PathManager | None = None,
+    def __init__(self, image_manager: Any,
+                 path_manager: PathManager | None = None,
                  fs_detector: FilesystemModeDetector | None = None,
-                 health_provider=None):
+                 health_provider: Optional[Callable[[], dict]]
+                 = None) -> None:
         """*health_provider*: callable returning the health-engine
         snapshot (utils/slo.py health_snapshot shape) folded into the
         CR's Healthy/Degraded conditions each reconcile; defaults to
@@ -51,7 +56,8 @@ class TpuOperatorConfigReconciler:
         self.vsp_rollout = VspRollout(health_provider=health_provider)
 
     # -- template vars (reference: yamlVars :131-167) -------------------------
-    def _yaml_vars(self, client, cfg: TpuOperatorConfig) -> dict:
+    def _yaml_vars(self, client: KubeClient,
+                   cfg: TpuOperatorConfig) -> dict:
         flavour = ClusterEnvironment(client).flavour()
         # PermissionError propagates: detection failure must fail the
         # reconcile (and retry) rather than render a wrong CniBinDir.
@@ -74,11 +80,14 @@ class TpuOperatorConfigReconciler:
         return merge_vars_with_images(self.image_manager, data)
 
     # -- ensure steps ---------------------------------------------------------
-    def _ensure_daemon_daemonset(self, client, cfg_obj: dict, data: dict):
+    def _ensure_daemon_daemonset(self, client: KubeClient,
+                                 cfg_obj: dict, data: dict) -> None:
         apply_all_from_bindata(
             client, os.path.join(_BINDATA, "daemon"), data, owner=cfg_obj)
 
-    def _ensure_network_function_nad(self, client, cfg_obj: dict, data: dict):
+    def _ensure_network_function_nad(self, client: KubeClient,
+                                     cfg_obj: dict,
+                                     data: dict) -> None:
         """Mode-switched NAD (reference: controller.go:189-204). On the host
         side the NAD routes pod attachments through the TPU CNI in chip-mount
         mode; on the tpu side in netdev/network-function mode."""
@@ -107,14 +116,16 @@ class TpuOperatorConfigReconciler:
         set_owner_reference(cfg_obj, nad)
         client.apply(nad)
 
-    def _ensure_network_resources_injector(self, client, cfg_obj: dict,
-                                           data: dict):
+    def _ensure_network_resources_injector(self, client: KubeClient,
+                                           cfg_obj: dict,
+                                           data: dict) -> None:
         apply_all_from_bindata(
             client, os.path.join(_BINDATA, "network-resources-injector"),
             data, owner=cfg_obj)
 
     # -- Reconcile ------------------------------------------------------------
-    def reconcile(self, client, req: Request) -> ReconcileResult:
+    def reconcile(self, client: KubeClient,
+                  req: Request) -> ReconcileResult:
         obj = client.get(API_VERSION, "TpuOperatorConfig", req.name)
         if obj is None:
             return ReconcileResult()  # deleted; GC handles children
@@ -136,7 +147,8 @@ class TpuOperatorConfigReconciler:
         return ReconcileResult(requeue_after=requeue)
 
     # -- health conditions (utils/watchdog.py + utils/slo.py) -----------------
-    def _fold_health(self, client, obj: dict, status: dict):
+    def _fold_health(self, client: KubeClient, obj: dict,
+                     status: dict) -> None:
         """Fold the health-engine snapshot into Healthy/Degraded
         conditions with per-component reasons, and emit an Event on
         each transition — the CR is where cluster operators look first

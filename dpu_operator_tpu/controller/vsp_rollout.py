@@ -33,9 +33,10 @@ they are the zero-downtime upgrade path (doc/architecture.md
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import Callable, Optional
 
 from ..api.types import UpgradeStrategy
+from ..k8s.client import KubeClient
 from ..utils import vars as v
 
 log = logging.getLogger(__name__)
@@ -54,7 +55,8 @@ class VspRollout:
     ``status.upgrade`` + live cluster objects, so a restarted operator
     resumes a half-done rollout exactly where it stood."""
 
-    def __init__(self, health_provider=None,
+    def __init__(self,
+                 health_provider: Optional[Callable[[], dict]] = None,
                  namespace: str = v.NAMESPACE) -> None:
         # health_provider sees THIS process's health engine only; the
         # node daemons' verdicts reach the gate as SFC CR conditions
@@ -96,14 +98,15 @@ class VspRollout:
             },
         }
 
-    def _apply_ds(self, client, cfg_obj: dict, color: str,
+    def _apply_ds(self, client: KubeClient, cfg_obj: dict, color: str,
                   image: str) -> None:
         ds = self._render_ds(color, image)
         from ..k8s.client import set_owner_reference
         set_owner_reference(cfg_obj, ds)
         client.apply(ds)
 
-    def _emit(self, client, cfg_obj: dict, reason: str, message: str,
+    def _emit(self, client: KubeClient, cfg_obj: dict,
+              reason: str, message: str,
               type_: str = "Normal", series: str = "") -> None:
         from ..k8s.events import EventRecorder, object_reference
         try:
@@ -117,8 +120,8 @@ class VspRollout:
             log.exception("upgrade event %s emission failed", reason)
 
     # -- gate -----------------------------------------------------------------
-    def _gate(self, client, strategy: UpgradeStrategy, color: str,
-              image: str) -> str:
+    def _gate(self, client: KubeClient, strategy: UpgradeStrategy,
+              color: str, image: str) -> str:
         """Empty string when the staged VSP may be promoted; otherwise
         the hold reason (surfaced in status + the UpgradeHeld Event)."""
         from ..k8s.informer import cached_list
@@ -179,7 +182,7 @@ class VspRollout:
             return "health engine degraded: " + ", ".join(degraded)
         return ""
 
-    def _degraded_chains(self, client) -> list:
+    def _degraded_chains(self, client: KubeClient) -> list:
         """SFC CRs carrying a True Degraded/ChainDegraded condition —
         the daemons' own health verdicts, readable from any process."""
         from ..api.types import API_VERSION
@@ -203,7 +206,7 @@ class VspRollout:
         return sorted(out)
 
     # -- reconcile ------------------------------------------------------------
-    def reconcile(self, client, cfg_obj: dict,
+    def reconcile(self, client: KubeClient, cfg_obj: dict,
                   strategy: Optional[UpgradeStrategy],
                   status: dict) -> Optional[float]:
         """One rollout step. Mutates ``status['upgrade']`` in place and
@@ -256,8 +259,9 @@ class VspRollout:
         return self._blue_green(client, cfg_obj, strategy, up, color,
                                 current, target)
 
-    def _recreate(self, client, cfg_obj: dict, up: dict, color: str,
-                  current: str, target: str) -> Optional[float]:
+    def _recreate(self, client: KubeClient, cfg_obj: dict, up: dict,
+                  color: str, current: str,
+                  target: str) -> Optional[float]:
         self._emit(client, cfg_obj, "UpgradeStarted",
                    f"VSP recreate: {current} -> {target} (in-place; "
                    "brief dataplane gap accepted)", series=target)
@@ -268,7 +272,7 @@ class VspRollout:
                    f"VSP recreated on {target}", series=target)
         return None
 
-    def _blue_green(self, client, cfg_obj: dict,
+    def _blue_green(self, client: KubeClient, cfg_obj: dict,
                     strategy: UpgradeStrategy, up: dict, color: str,
                     current: str, target: str) -> Optional[float]:
         staged = _other(color)

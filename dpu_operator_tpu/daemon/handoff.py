@@ -662,8 +662,8 @@ def adopt_into(manager, socket_path: str, timeout: float = 5.0) -> bool:
     started = time.monotonic()
     hid = next(_handoff_ids)
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    sock.settimeout(timeout)
     try:
+        sock.settimeout(timeout)
         try:
             sock.connect(socket_path)
         except OSError as e:

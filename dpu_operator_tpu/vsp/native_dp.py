@@ -77,15 +77,20 @@ class AgentClient:
         self._lock = threading.Lock()
         deadline = time.monotonic() + connect_timeout
         while True:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             try:
-                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                 s.connect(socket_path)
-                self._sock = s
-                return
             except OSError:
+                # a failed attempt's socket must not outlive the retry:
+                # the agent can take seconds to come up, and leaking one
+                # fd per 50 ms poll exhausts the daemon's fd budget
+                s.close()
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.05)
+                continue
+            self._sock = s
+            return
 
     def close(self):
         if self._sock:

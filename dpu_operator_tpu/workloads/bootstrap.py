@@ -21,12 +21,13 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Optional
+from typing import Callable, Mapping, Optional
 
 log = logging.getLogger(__name__)
 
 
-def distributed_env(environ=None) -> Optional[dict]:
+def distributed_env(environ: Optional[Mapping[str, str]] = None) \
+        -> Optional[dict]:
     """`jax.distributed.initialize` kwargs from the merged operator+job
     env, or None for a single-host workload (initialize must NOT be
     called then — a one-process "cluster" would wedge waiting on a
@@ -50,8 +51,10 @@ def distributed_env(environ=None) -> Optional[dict]:
     }
 
 
-def initialize_from_operator_env(environ=None,
-                                 initialize=None) -> Optional[dict]:
+def initialize_from_operator_env(
+        environ: Optional[Mapping[str, str]] = None,
+        initialize: Optional[Callable[..., object]] = None) \
+        -> Optional[dict]:
     """Bring up the multi-host runtime when the env says so; returns the
     kwargs used (None = single-host, nothing to do). *initialize* is
     injectable for tests; defaults to ``jax.distributed.initialize``."""

@@ -21,7 +21,7 @@ log = logging.getLogger(__name__)
 
 
 class TrainCheckpointer:
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3) -> None:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -58,8 +58,8 @@ class TrainCheckpointer:
                 mesh = leaf.sharding.mesh
                 break
 
-        def as_abstract(tree):
-            def one(x):
+        def as_abstract(tree: Any) -> Any:
+            def one(x: Any) -> Any:
                 if not hasattr(x, "sharding"):
                     return x
                 sharding = x.sharding
@@ -74,5 +74,5 @@ class TrainCheckpointer:
             opt_state=ocp.args.StandardRestore(as_abstract(opt_state_like))))
         return restored["params"], restored["opt_state"], step
 
-    def close(self):
+    def close(self) -> None:
         self._mgr.close()

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -27,19 +28,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .smap import shard_map
 
 
-def psum_allreduce(mesh: Mesh, axis: str = "model"):
+def psum_allreduce(mesh: Mesh,
+                   axis: str = "model") -> Callable[..., jax.Array]:
     """Jitted x -> allreduce(x) over *axis* via the native collective."""
     spec = P(axis)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
              check_vma=False)
-    def _ar(x):
+    def _ar(x: jax.Array) -> jax.Array:
         return lax.psum(x, axis)
 
     return jax.jit(_ar)
 
 
-def ring_allreduce(mesh: Mesh, axis: str = "model"):
+def ring_allreduce(mesh: Mesh,
+                   axis: str = "model") -> Callable[..., jax.Array]:
     """Jitted allreduce built from 2*(n-1) single-hop ppermute steps.
 
     reduce-scatter then all-gather around the ring — the bandwidth-optimal
@@ -52,7 +55,7 @@ def ring_allreduce(mesh: Mesh, axis: str = "model"):
 
     @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
              check_vma=False)
-    def _ar(x):
+    def _ar(x: jax.Array) -> jax.Array:
         if n == 1:
             return x
         me = lax.axis_index(axis)
@@ -61,7 +64,7 @@ def ring_allreduce(mesh: Mesh, axis: str = "model"):
         # reduce-scatter: at step i rank r sends chunk (r-i)%n one hop
         # forward; the receiver accumulates it. After n-1 steps rank r
         # holds the fully-reduced chunk (r+1)%n.
-        def rs(i, chunks):
+        def rs(i: jax.Array, chunks: jax.Array) -> jax.Array:
             moved = lax.ppermute(
                 lax.dynamic_index_in_dim(chunks, (me - i) % n,
                                          keepdims=False), axis, fwd)
@@ -73,7 +76,7 @@ def ring_allreduce(mesh: Mesh, axis: str = "model"):
         chunks = lax.fori_loop(0, n - 1, rs, chunks)
 
         # all-gather: rotate completed chunks around the ring
-        def ag(i, chunks):
+        def ag(i: jax.Array, chunks: jax.Array) -> jax.Array:
             moved = lax.ppermute(
                 lax.dynamic_index_in_dim(chunks, (me + 1 - i) % n,
                                          keepdims=False), axis, fwd)
@@ -86,7 +89,9 @@ def ring_allreduce(mesh: Mesh, axis: str = "model"):
     return jax.jit(_ar)
 
 
-def all_to_all_exchange(mesh: Mesh, axis: str = "model"):
+def all_to_all_exchange(mesh: Mesh,
+                        axis: str = "model") \
+        -> Callable[..., jax.Array]:
     """All-to-all over *axis*: device i's j-th chunk lands on device j as
     chunk i — the MoE dispatch collective (ep sends each expert its
     tokens; workloads/moe.py's einsum dispatch lowers to this under the
@@ -95,7 +100,7 @@ def all_to_all_exchange(mesh: Mesh, axis: str = "model"):
 
     @partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
              check_vma=False)
-    def _a2a(x):
+    def _a2a(x: jax.Array) -> jax.Array:
         # local x: (n, chunk) — one outgoing chunk per peer
         return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
                               tiled=True)
@@ -103,7 +108,8 @@ def all_to_all_exchange(mesh: Mesh, axis: str = "model"):
     return jax.jit(_a2a)
 
 
-def ppermute_hop(mesh: Mesh, axis: str = "model"):
+def ppermute_hop(mesh: Mesh,
+                 axis: str = "model") -> Callable[..., jax.Array]:
     """One neighbor rotation over *axis* — the unit hop of both the ring
     attention KV rotation and the pipeline stage handoff; its rate is the
     single-ICI-link bandwidth."""
@@ -113,13 +119,14 @@ def ppermute_hop(mesh: Mesh, axis: str = "model"):
 
     @partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
              check_vma=False)
-    def _hop(x):
+    def _hop(x: jax.Array) -> jax.Array:
         return lax.ppermute(x, axis, perm)
 
     return jax.jit(_hop)
 
 
-def _time_collective(fn, x, iters: int) -> float:
+def _time_collective(fn: Callable[..., jax.Array], x: jax.Array,
+                     iters: int) -> float:
     fn(x).block_until_ready()  # compile
     t0 = time.perf_counter()
     out = x

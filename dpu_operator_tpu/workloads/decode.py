@@ -21,6 +21,7 @@ every token otherwise (the standard serving behavior).
 from __future__ import annotations
 
 from functools import partial
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +40,7 @@ from .model import TransformerConfig, _rmsnorm
 # 2x tokens/s at B1. Quality: per-channel scales keep logits close
 # (tested against the bf16 path); KV cache stays bf16.
 
-def _quantize_weight(w, axis: int = 0) -> dict:
+def _quantize_weight(w: jax.Array, axis: int = 0) -> dict:
     """Symmetric per-channel int8: scale over *axis* (the contraction
     axis), so dequant is a per-output-column (or per-row) multiply."""
     w32 = w.astype(jnp.float32)
@@ -70,11 +71,11 @@ def quantize_decode_params(params: dict) -> dict:
     return out
 
 
-def _is_q(w) -> bool:
+def _is_q(w: object) -> bool:
     return isinstance(w, dict) and "q" in w
 
 
-def _act_quant(x):
+def _act_quant(x: jax.Array) -> tuple:
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
     xs = jnp.maximum(amax, 1e-8) / 127.0
     xq = jnp.clip(jnp.round(x.astype(jnp.float32) / xs),
@@ -82,7 +83,7 @@ def _act_quant(x):
     return xq, xs
 
 
-def _mm(x, w):
+def _mm(x: jax.Array, w: jax.Array | dict) -> jax.Array:
     """x @ w for plain bf16 weights OR the W8A8 path for quantized ones
     (int8 MXU dot, rescale by activation x weight scales)."""
     if not _is_q(w):
@@ -94,13 +95,14 @@ def _mm(x, w):
     return (acc.astype(jnp.float32) * xs * w["scale"]).astype(x.dtype)
 
 
-def _embed_rows(embed, tokens):
+def _embed_rows(embed: jax.Array | dict,
+                tokens: jax.Array) -> jax.Array:
     if not _is_q(embed):
         return embed[tokens]
     return embed["q"][tokens].astype(jnp.float32) * embed["scale"][tokens]
 
 
-def _logits(x, embed):
+def _logits(x: jax.Array, embed: jax.Array | dict) -> jax.Array:
     """x @ embed.T — for quantized embeds, contract over d (axis 1 of q)
     and rescale by the per-vocab-row scales."""
     if not _is_q(embed):
@@ -134,7 +136,7 @@ def init_kv_cache(cfg: TransformerConfig, batch: int,
             for _ in range(cfg.n_layers)]
 
 
-def _kv_quant(t):
+def _kv_quant(t: jax.Array) -> tuple:
     """Symmetric int8 over the head dim: t (B, T, H, Dh) -> (q, scale)
     with scale (B, T, H, 1). Same numerics as the activation quant —
     one implementation so a rounding/floor tweak can never diverge the
@@ -142,13 +144,14 @@ def _kv_quant(t):
     return _act_quant(t)
 
 
-def _scale_bhqk(s):
+def _scale_bhqk(s: jax.Array) -> jax.Array:
     """(B, S, H, 1) per-position scales -> (B, H, 1, S) to broadcast
     over attention scores/weights."""
     return s[..., 0].transpose(0, 2, 1)[:, :, None, :]
 
 
-def _cache_write(cache_t: jax.Array, new_t: jax.Array, pos) -> jax.Array:
+def _cache_write(cache_t: jax.Array, new_t: jax.Array,
+                 pos: jax.Array) -> jax.Array:
     """Write one step's K/V rows (B, 1, H, ...) into the cache at *pos*
     — a shared scalar position (the fused generate scan, every row in
     lockstep) or a per-row (B,) vector (the continuous-batching serve
@@ -162,7 +165,7 @@ def _cache_write(cache_t: jax.Array, new_t: jax.Array, pos) -> jax.Array:
 
 
 def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
-                tokens: jax.Array, pos: jax.Array):
+                tokens: jax.Array, pos: jax.Array) -> tuple:
     """One decode step: *tokens* (B,) at position *pos* -> (logits (B, V),
     updated cache). *pos* is a scalar (all rows at the same position —
     the generate scan) or a (B,) vector (per-slot positions — the serve
@@ -186,7 +189,7 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
         qkv = _mm(h, lp["wqkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        def heads(t):
+        def heads(t: jax.Array) -> jax.Array:
             return t.reshape(B, 1, cfg.n_heads, cfg.d_head)
 
         q, k, v = heads(q), heads(k), heads(v)
@@ -241,7 +244,7 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
 
 @partial(jax.jit, static_argnames=("cfg",))
 def decode_step(params: dict, cfg: TransformerConfig, cache: list,
-                tokens: jax.Array, pos: jax.Array):
+                tokens: jax.Array, pos: jax.Array) -> tuple:
     """One compiled decode iteration — the reusable half of the
     prefill/decode pair the serve scheduler drives. *tokens* (B,) at
     *pos* (scalar, or a (B,) vector of per-slot positions) -> (logits
@@ -254,7 +257,7 @@ def decode_step(params: dict, cfg: TransformerConfig, cache: list,
 
 
 def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,
-            kv_int8: bool = False):
+            kv_int8: bool = False) -> tuple:
     """Warm the cache with ONE batched forward over the whole prompt
     (time-to-first-token costs a single parameter sweep, not P sequential
     decode steps); returns (cache, last_logits). prompt: (B, P) int32.
@@ -271,7 +274,7 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,
         qkv = _mm(h, lp["wqkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        def heads(t):
+        def heads(t: jax.Array) -> jax.Array:
             return t.reshape(B, P, cfg.n_heads, cfg.d_head)
 
         q, k, v = heads(q), heads(k), heads(v)
@@ -315,7 +318,7 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,
 @partial(jax.jit, static_argnames=("cfg",))
 def prefill_chunk(params: dict, cfg: TransformerConfig, cache: list,
                   slot: jax.Array, tokens: jax.Array, offset: jax.Array,
-                  n_valid: jax.Array):
+                  n_valid: jax.Array) -> tuple:
     """One CHUNK of a prefill, written into row *slot* of a slotted
     cache at position *offset* — the schedulable unit that lets the
     serve loop interleave long prompts with decode iterations instead
@@ -354,13 +357,14 @@ def prefill_chunk(params: dict, cfg: TransformerConfig, cache: list,
     mask = positions[None, :] <= rows[:, None]          # (C, S) causal
     slot_idx = jnp.full((C,), slot)
 
-    def put(cache_t, new_t):
+    def put(cache_t: jax.Array, new_t: jax.Array) -> jax.Array:
         # scatter the chunk's rows at (slot, offset+i); out-of-range
         # rows (a final chunk's padding past max_seq) are dropped
         return cache_t.at[slot_idx, rows].set(
             new_t.astype(cache_t.dtype), mode="drop")
 
-    def kscale(s):  # (S, H, 1) per-position scales -> (H, 1, S)
+    def kscale(s: jax.Array) -> jax.Array:
+        # (S, H, 1) per-position scales -> (H, 1, S)
         return s[..., 0].T[:, None, :]
 
     new_cache = []
@@ -369,7 +373,7 @@ def prefill_chunk(params: dict, cfg: TransformerConfig, cache: list,
         qkv = _mm(h, lp["wqkv"])
         q, k, v = jnp.split(qkv[0], 3, axis=-1)
 
-        def heads(t):
+        def heads(t: jax.Array) -> jax.Array:
             return t.reshape(C, cfg.n_heads, cfg.d_head)
 
         q, k, v = heads(q), heads(k), heads(v)
@@ -420,13 +424,13 @@ def prefill_chunk(params: dict, cfg: TransformerConfig, cache: list,
 @partial(jax.jit, static_argnames=("cfg", "steps", "top_k", "greedy",
                                    "kv_int8"))
 def _generate_compiled(params: dict, cfg: TransformerConfig,
-                       prompt: jax.Array, steps: int, temperature,
+                       prompt: jax.Array, steps: int, temperature: float,
                        top_k: int, greedy: bool,
                        key: jax.Array, kv_int8: bool = False) -> jax.Array:
     P = prompt.shape[1]
     cache, last_logits = prefill(params, cfg, prompt, kv_int8=kv_int8)
 
-    def pick(logits, k):
+    def pick(logits: jax.Array, k: jax.Array) -> jax.Array:
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # temperature rides as a TRACED scalar: per-request temperature
@@ -438,7 +442,7 @@ def _generate_compiled(params: dict, cfg: TransformerConfig,
             scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
         return jax.random.categorical(k, scaled, axis=-1).astype(jnp.int32)
 
-    def body(carry, i):
+    def body(carry: tuple, i: jax.Array) -> tuple:
         cache, logits, k = carry
         k, sub = jax.random.split(k)
         token = pick(logits, sub)
@@ -497,8 +501,8 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
         params = quantize_decode_params(params)
     prompt = jnp.ones((batch, prompt_len), jnp.int32)
 
-    def make_chained(n):
-        def go():
+    def make_chained(n: int) -> Callable[[], None]:
+        def go() -> None:
             out = generate(params, cfg, prompt, n, kv_int8=kv_int8)
             float(out[0, -1])
         return go

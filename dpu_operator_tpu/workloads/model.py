@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 import functools
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -80,7 +80,7 @@ class TransformerConfig:
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     keys = iter(jax.random.split(rng, 4 + 5 * cfg.n_layers))
 
-    def dense(key, shape):
+    def dense(key: jax.Array, shape: tuple) -> jax.Array:
         return (jax.random.normal(key, shape, jnp.float32)
                 / np.sqrt(shape[0])).astype(cfg.dtype)
 
@@ -146,27 +146,29 @@ def param_specs(cfg: TransformerConfig) -> dict:
 
 
 @functools.lru_cache(maxsize=8)
-def _ring_attn(mesh: Mesh):
+def _ring_attn(mesh: Mesh) -> Callable[..., jax.Array]:
     from .ring_attention import ring_attention
     return ring_attention(mesh, "model", causal=True)
 
 
 @functools.lru_cache(maxsize=8)
-def _ulysses_attn(mesh: Mesh, block_q: int, block_k: int):
+def _ulysses_attn(mesh: Mesh, block_q: int,
+                  block_k: int) -> Callable[..., jax.Array]:
     from .ulysses import ulysses_attention
     return ulysses_attention(mesh, "model", causal=True,
                              block_q=block_q, block_k=block_k)
 
 
 @functools.lru_cache(maxsize=8)
-def _flash_attn(mesh: Mesh | None, block_q: int, block_k: int):
+def _flash_attn(mesh: Mesh | None, block_q: int,
+                block_k: int) -> Callable[..., jax.Array]:
     """Differentiable flash attention, head-sharded over "model" when a
     mesh is present (heads are independent, so tp shards partition the
     kernel grid; Pallas calls need shard_map — XLA cannot auto-partition
     them)."""
     from ..ops.flash_attention import flash_attention_vjp
 
-    def call(q, k, v):
+    def call(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         return flash_attention_vjp(q, k, v, True, block_q, block_k)
 
     if mesh is None:
@@ -178,12 +180,12 @@ def _flash_attn(mesh: Mesh | None, block_q: int, block_k: int):
                      out_specs=spec, check_vma=False)
 
 
-def _rmsnorm(x, scale):
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale
 
 
-def _batch_axes(mesh):
+def _batch_axes(mesh: Mesh | None) -> Any:
     """Mesh axes carrying the batch dimension: plain data-parallel uses
     "data"; a mesh with a leading "dcn" axis (multi-slice groups joined
     over the datacenter network, workloads/multislice.py) shards batch
@@ -195,7 +197,8 @@ def _batch_axes(mesh):
     return "data"
 
 
-def _sp(x, cfg: TransformerConfig, mesh):
+def _sp(x: jax.Array, cfg: TransformerConfig,
+        mesh: Mesh | None) -> jax.Array:
     """Sequence-parallel region: residual stream sharded (data, model) on
     (batch, seq). A no-op without a mesh (single-device compile checks)."""
     if mesh is None or not cfg.sequence_parallel:
@@ -204,7 +207,7 @@ def _sp(x, cfg: TransformerConfig, mesh):
         x, NamedSharding(mesh, P(_batch_axes(mesh), "model", None)))
 
 
-def _tp_act(x, mesh):
+def _tp_act(x: jax.Array, mesh: Mesh | None) -> jax.Array:
     """Tensor-parallel region: activations sharded (batch, ., heads/ff)."""
     if mesh is None:
         return x
@@ -213,7 +216,8 @@ def _tp_act(x, mesh):
 
 
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
-            mesh: Mesh | None = None, return_aux: bool = False):
+            mesh: Mesh | None = None,
+            return_aux: bool = False) -> jax.Array | tuple:
     """Logits for next-token prediction. tokens: (B, S) int32.
     With return_aux, also returns the MoE load-balance loss (0 for dense
     models)."""
@@ -222,12 +226,12 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     x = x.astype(cfg.dtype)
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
-    def layer(x, lp):
+    def layer(x: jax.Array, lp: dict) -> jax.Array:
         h = _rmsnorm(_sp(x, cfg, mesh), lp["ln1"])
         qkv = _tp_act(h @ lp["wqkv"], mesh)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        def heads(t):
+        def heads(t: jax.Array) -> jax.Array:
             return t.reshape(B, S, cfg.n_heads, cfg.d_head)
 
         q, k, v = heads(q), heads(k), heads(v)
@@ -286,7 +290,7 @@ def make_example_batch(cfg: TransformerConfig, batch: int = 8,
             "targets": jnp.asarray(toks[:, 1:])}
 
 
-def make_train_step(cfg: TransformerConfig, mesh: Mesh):
+def make_train_step(cfg: TransformerConfig, mesh: Mesh) -> tuple:
     """Jitted (params, opt_state, batch) -> (params, opt_state, loss) with
     full dp/tp/sp shardings bound at compile time."""
     tx = optax.adamw(cfg.learning_rate)
@@ -298,7 +302,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh):
     bshard = {"tokens": NamedSharding(mesh, batch_spec),
               "targets": NamedSharding(mesh, batch_spec)}
 
-    def step(params, opt_state, batch):
+    def step(params: dict, opt_state: tuple, batch: dict) -> tuple:
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
         updates, opt_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
@@ -309,14 +313,14 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh):
         new_params = jax.lax.with_sharding_constraint(new_params, pshard)
         return new_params, opt_state, loss
 
-    def init_state(rng):
+    def init_state(rng: jax.Array) -> tuple:
         params = jax.device_put(init_params(rng, cfg), pshard)
         opt_state = tx.init(params)
         return params, opt_state
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
 
-    def place_batch(batch):
+    def place_batch(batch: dict) -> dict:
         return jax.device_put(batch, bshard)
 
     return jstep, init_state, place_batch

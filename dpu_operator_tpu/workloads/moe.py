@@ -28,10 +28,12 @@ def moe_param_specs() -> dict:
 
 
 def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
-                    n_experts: int, dtype=jnp.bfloat16) -> dict:
+                    n_experts: int,
+                    dtype: jnp.dtype = jnp.bfloat16) -> dict:
     k1, k2, k3 = jax.random.split(rng, 3)
 
-    def dense(key, shape, fan_in):
+    def dense(key: jax.Array, shape: tuple,
+              fan_in: int) -> jax.Array:
         return (jax.random.normal(key, shape, jnp.float32)
                 / np.sqrt(fan_in)).astype(dtype)
 

@@ -15,7 +15,7 @@ collective schedule is identical.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import numpy as np
@@ -54,7 +54,8 @@ def make_multislice_mesh(n_slices: int,
 
 
 def hierarchical_allreduce(mesh: Mesh, ici_axis: str = "model",
-                           dcn_axis: str = "dcn"):
+                           dcn_axis: str = "dcn") \
+        -> Callable[..., jax.Array]:
     """Jitted allreduce over both axes with the DCN-minimizing schedule:
     psum_scatter(ici) -> psum(dcn) -> all_gather(ici). DCN bytes per host
     drop by the ICI axis size versus a flat psum over both axes."""
@@ -63,7 +64,7 @@ def hierarchical_allreduce(mesh: Mesh, ici_axis: str = "model",
 
     @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
              check_vma=False)
-    def _ar(x):
+    def _ar(x: jax.Array) -> jax.Array:
         shard = lax.psum_scatter(x, ici_axis, tiled=True)   # ICI
         shard = lax.psum(shard, dcn_axis)                    # DCN (1/n_ici)
         return lax.all_gather(shard, ici_axis, tiled=True)   # ICI
@@ -72,14 +73,14 @@ def hierarchical_allreduce(mesh: Mesh, ici_axis: str = "model",
 
 
 def flat_allreduce(mesh: Mesh, ici_axis: str = "model",
-                   dcn_axis: str = "dcn"):
+                   dcn_axis: str = "dcn") -> Callable[..., jax.Array]:
     """Baseline: one psum over both axes (XLA may or may not pick the
     hierarchical schedule itself; this is the comparison point)."""
     spec = P((dcn_axis, ici_axis))
 
     @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec,
              check_vma=False)
-    def _ar(x):
+    def _ar(x: jax.Array) -> jax.Array:
         return lax.psum(x, (dcn_axis, ici_axis))
 
     return jax.jit(_ar)

@@ -16,10 +16,16 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any, Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+if TYPE_CHECKING:  # annotation-only: perf must not import the model
+    from jax.sharding import Mesh
+
+    from .model import TransformerConfig
 
 #: chip kind (jax.devices()[0].device_kind, lowered) -> peak bf16 TFLOPS.
 #: Public spec-sheet numbers.
@@ -48,7 +54,7 @@ HBM_GBPS = {
 _CPU_FALLBACK_HBM_GBPS = 20.0
 
 
-def hbm_bandwidth_gbps(device=None) -> float:
+def hbm_bandwidth_gbps(device: Any = None) -> float:
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
     for key, val in HBM_GBPS.items():
@@ -57,7 +63,7 @@ def hbm_bandwidth_gbps(device=None) -> float:
     return _CPU_FALLBACK_HBM_GBPS
 
 
-def peak_tflops(device=None) -> float:
+def peak_tflops(device: Any = None) -> float:
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
     for key, val in PEAK_TFLOPS_BF16.items():
@@ -69,7 +75,7 @@ def peak_tflops(device=None) -> float:
     return _CPU_FALLBACK_TFLOPS
 
 
-def param_count(cfg) -> int:
+def param_count(cfg: TransformerConfig) -> int:
     attn = (2 * cfg.d_model                            # ln1, ln2
             + cfg.d_model * 3 * cfg.d_model            # wqkv
             + cfg.d_model * cfg.d_model)               # wo
@@ -85,7 +91,7 @@ def param_count(cfg) -> int:
     return total
 
 
-def active_param_count(cfg) -> int:
+def active_param_count(cfg: TransformerConfig) -> int:
     """Params each token actually multiplies against. Equal to
     param_count for dense models; for top-1 MoE layers only the router
     plus ONE expert's FFN counts — counting all experts would inflate
@@ -99,7 +105,8 @@ def active_param_count(cfg) -> int:
     return total
 
 
-def train_step_flops(cfg, batch: int, seq: int) -> float:
+def train_step_flops(cfg: TransformerConfig, batch: int,
+                     seq: int) -> float:
     """Model FLOPs of one fwd+bwd step with causal-attention accounting
     (and per-token ACTIVE params for MoE — see active_param_count)."""
     tokens = batch * seq
@@ -114,7 +121,8 @@ def attention_flops(b: int, s: int, h: int, d: int, causal: bool) -> float:
     return full / 2.0 if causal else full
 
 
-def marginal_time(make_chained, n_short: int = 10, n_long: int = 50,
+def marginal_time(make_chained: Callable[[int], Callable[[], None]],
+                  n_short: int = 10, n_long: int = 50,
                   repeats: int = 5) -> float:
     """Per-iteration steady-state seconds via the two-length slope method.
 
@@ -152,8 +160,10 @@ def marginal_time(make_chained, n_short: int = 10, n_long: int = 50,
     return max((min(longs) - min(shorts)) / (n_long - n_short), 1e-9)
 
 
-def best_marginal_time(make_chained, n_short: int = 10, n_long: int = 50,
-                       repeats: int = 5, best_of: int = 3) -> float:
+def best_marginal_time(
+        make_chained: Callable[[int], Callable[[], None]],
+        n_short: int = 10, n_long: int = 50,
+        repeats: int = 5, best_of: int = 3) -> float:
     """Min of *best_of* independent marginal_time measurements.
 
     The tunnel is time-shared in PHASES longer than one marginal_time
@@ -179,7 +189,8 @@ class TrainPerf:
     steps_timed: int
 
 
-def measure_train(cfg, mesh, batch: int = 8, steps: int = 50,
+def measure_train(cfg: TransformerConfig, mesh: Mesh,
+                  batch: int = 8, steps: int = 50,
                   warmup: int = 0, best_of: int = 3) -> TrainPerf:
     """Steady-state train-step timing via marginal_time: the step is
     scanned on-device (donated carry, reused batch) so the tunnel's fixed
@@ -196,8 +207,9 @@ def measure_train(cfg, mesh, batch: int = 8, steps: int = 50,
     data = place(make_example_batch(cfg, batch=batch))
 
     @partial(jax.jit, static_argnames="n", donate_argnums=(0, 1))
-    def run_n(params, opt, data, n):
-        def body(carry, _):
+    def run_n(params: dict, opt: Any, data: dict,
+              n: int) -> tuple:
+        def body(carry: tuple, _: None) -> tuple:
             p, o, loss = step(*carry, data)
             return (p, o), loss
 
@@ -207,8 +219,8 @@ def measure_train(cfg, mesh, batch: int = 8, steps: int = 50,
 
     state = {"params": params, "opt": opt}
 
-    def make_chained(n):
-        def go():
+    def make_chained(n: int) -> Callable[[], None]:
+        def go() -> None:
             p, o, loss = run_n(state["params"], state["opt"], data, n)
             state["params"], state["opt"] = p, o
             float(loss)
@@ -260,16 +272,17 @@ def measure_flash_attention(b: int = 4, s: int = 2048, h: int = 8,
     from functools import partial
 
     @partial(jax.jit, static_argnames="n")
-    def run_n(q, k, v, n):
-        def body(qc, _):
+    def run_n(q: jax.Array, k: jax.Array, v: jax.Array,
+              n: int) -> jax.Array:
+        def body(qc: jax.Array, _: None) -> tuple:
             return flash_attention(qc, k, v, causal=causal,
                                    block_q=min(block_q, s),
                                    block_k=min(block_k, s)), None
         out, _ = jax.lax.scan(body, q, None, length=n)
         return out
 
-    def make_chained(n):
-        def go():
+    def make_chained(n: int) -> Callable[[], None]:
+        def go() -> None:
             float(jnp.sum(run_n(q, k, v, n)))
         return go
 
@@ -282,7 +295,7 @@ def measure_flash_attention(b: int = 4, s: int = 2048, h: int = 8,
                      frac_of_peak=tf / peak, peak_tflops=peak)
 
 
-def flagship_config():
+def flagship_config() -> TransformerConfig:
     """The config bench.py times on the real chip: ~390M params
     (d_model 1536, 12 layers, d_head 128) — VERDICT r3 #1: the round-3
     111M/d768 flagship underfed the v5e MXU and pinned MFU at ~0.50;

@@ -20,7 +20,7 @@ this follows the public GPipe/shard_map pipelining recipe.
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import TYPE_CHECKING, Callable
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +28,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .smap import shard_map
+
+if TYPE_CHECKING:  # annotation-only: model imports stay lazy at runtime
+    from .model import TransformerConfig
 
 
 def _layer_fwd(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
@@ -53,7 +56,8 @@ def _layer_fwd(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
     return x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
 
 
-def init_pipeline_params(rng: jax.Array, cfg, n_stages: int) -> dict:
+def init_pipeline_params(rng: jax.Array, cfg: TransformerConfig,
+                         n_stages: int) -> dict:
     """Params with per-stage stacking: every layer tensor gets shape
     (n_stages, layers_per_stage, ...) so spec P("pipe") puts each stage's
     group on its device."""
@@ -63,11 +67,11 @@ def init_pipeline_params(rng: jax.Array, cfg, n_stages: int) -> dict:
     lps = cfg.n_layers // n_stages
     keys = iter(jax.random.split(rng, 2 + 4 * cfg.n_layers))
 
-    def dense(key, shape):
+    def dense(key: jax.Array, shape: tuple) -> jax.Array:
         return (jax.random.normal(key, shape, jnp.float32)
                 / np.sqrt(shape[0])).astype(cfg.dtype)
 
-    def stacked(shape):
+    def stacked(shape: tuple) -> jax.Array:
         return jnp.stack([
             jnp.stack([dense(next(keys), shape) for _ in range(lps)])
             for _ in range(n_stages)])
@@ -91,7 +95,7 @@ def pipeline_param_specs() -> dict:
     return {"embed": P(), "pos": P(), "out_norm": P(), "stages": stage}
 
 
-def make_pipeline_forward(cfg, mesh: Mesh,
+def make_pipeline_forward(cfg: TransformerConfig, mesh: Mesh,
                           n_micro: int) -> Callable:
     """(params, tokens (B, S)) -> logits (B, S, V), pipelined over the
     mesh's "pipe" axis with *n_micro* microbatches (B % n_micro == 0).
@@ -101,7 +105,7 @@ def make_pipeline_forward(cfg, mesh: Mesh,
     n_stages = mesh.shape["pipe"]
     has_data = "data" in mesh.axis_names and mesh.shape["data"] > 1
 
-    def fwd(params, tokens):
+    def fwd(params: dict, tokens: jax.Array) -> jax.Array:
         B, S = tokens.shape
         if B % n_micro:
             raise ValueError(
@@ -121,14 +125,14 @@ def make_pipeline_forward(cfg, mesh: Mesh,
             shard_map, mesh=mesh,
             in_specs=(pipeline_param_specs()["stages"], act_spec),
             out_specs=act_spec, check_vma=False)
-        def run(stages, xm):
+        def run(stages: dict, xm: jax.Array) -> jax.Array:
             # local stage group: (1, layers_per_stage, ...) -> drop dim 0
             sp = jax.tree_util.tree_map(lambda t: t[0], stages)
             stage_id = jax.lax.axis_index("pipe")
             n_ticks = n_micro + n_stages - 1
 
-            def stage_fn(x_in):
-                def body(x, lp):
+            def stage_fn(x_in: jax.Array) -> jax.Array:
+                def body(x: jax.Array, lp: dict) -> tuple:
                     return _layer_fwd(lp, x, cfg.n_heads), None
                 out, _ = jax.lax.scan(body, x_in, sp)
                 return out
@@ -136,7 +140,7 @@ def make_pipeline_forward(cfg, mesh: Mesh,
             zero = jnp.zeros_like(xm[0])
             fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-            def tick(carry, t):
+            def tick(carry: jax.Array, t: jax.Array) -> tuple:
                 buf = carry
                 m_in = jnp.clip(t, 0, n_micro - 1)
                 x_t = jax.lax.dynamic_index_in_dim(xm, m_in, 0,
@@ -165,7 +169,8 @@ def make_pipeline_forward(cfg, mesh: Mesh,
     return fwd
 
 
-def make_pipeline_train_step(cfg, mesh: Mesh, n_micro: int):
+def make_pipeline_train_step(cfg: TransformerConfig, mesh: Mesh,
+                             n_micro: int) -> tuple:
     """Jitted pipelined (params, opt_state, batch) -> (params, opt_state,
     loss) — pp over "pipe" (x dp over "data" when present)."""
     import optax
@@ -180,30 +185,31 @@ def make_pipeline_train_step(cfg, mesh: Mesh, n_micro: int):
     bshard = {"tokens": NamedSharding(mesh, P(data_dim, None)),
               "targets": NamedSharding(mesh, P(data_dim, None))}
 
-    def loss_fn(params, batch):
+    def loss_fn(params: dict, batch: dict) -> jax.Array:
         logits = fwd(params, batch["tokens"])
         logp = jax.nn.log_softmax(logits, -1)
         nll = -jnp.take_along_axis(logp, batch["targets"][..., None],
                                    -1)[..., 0]
         return nll.mean()
 
-    def step(params, opt_state, batch):
+    def step(params: dict, opt_state: tuple, batch: dict) -> tuple:
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    def init_state(rng):
+    def init_state(rng: jax.Array) -> tuple:
         params = jax.device_put(
             init_pipeline_params(rng, cfg, mesh.shape["pipe"]), pshard)
         return params, tx.init(params)
 
-    def place(batch):
+    def place(batch: dict) -> dict:
         return jax.device_put(batch, bshard)
 
     return jax.jit(step, donate_argnums=(0, 1)), init_state, place
 
 
-def sequential_forward(cfg, params, tokens):
+def sequential_forward(cfg: TransformerConfig, params: dict,
+                       tokens: jax.Array) -> jax.Array:
     """Reference: the same stacked params applied sequentially (no
     pipelining) — the correctness oracle for the pipelined forward."""
     B, S = tokens.shape

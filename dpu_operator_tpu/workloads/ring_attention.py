@@ -15,6 +15,7 @@ communication); implementation is shard_map + ppermute, XLA-native.
 from __future__ import annotations
 
 from functools import partial
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -27,7 +28,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, qpos, kpos, causal):
+def _block_attn(q: jax.Array, k: jax.Array, v: jax.Array,
+                qpos: jax.Array, kpos: jax.Array,
+                causal: bool) -> tuple:
     """One Q-block x KV-block pass -> (unnormalized out, row-sum, row-max).
 
     q: (B, Sq, H, D), k/v: (B, Sk, H, D); fp32 accumulation.
@@ -46,7 +49,8 @@ def _block_attn(q, k, v, qpos, kpos, causal):
     return out.astype(jnp.float32), blk_sum, blk_max
 
 
-def ring_attention(mesh: Mesh, axis: str = "model", causal: bool = True):
+def ring_attention(mesh: Mesh, axis: str = "model",
+                   causal: bool = True) -> Callable[..., jax.Array]:
     """Jitted (q, k, v) -> attention output with sequence sharded on *axis*.
 
     q/k/v: (B, S, H, D) global; each device sees (B, S/n, H, D). Returns
@@ -58,7 +62,8 @@ def ring_attention(mesh: Mesh, axis: str = "model", causal: bool = True):
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
-    def _attn(q, k, v):
+    def _attn(q: jax.Array, k: jax.Array,
+              v: jax.Array) -> jax.Array:
         me = lax.axis_index(axis)
         sq = q.shape[1]
         qpos = me * sq + jnp.arange(sq)
@@ -72,7 +77,7 @@ def ring_attention(mesh: Mesh, axis: str = "model", causal: bool = True):
         # O(1) in the axis size (a Python-unrolled ring is O(n) — fine at
         # n=8, hostile at a v5p-256's n). One extra final permute returns
         # K/V to their owners; XLA overlaps it with the epilogue.
-        def body(step, carry):
+        def body(step: jax.Array, carry: tuple) -> tuple:
             k_cur, v_cur, acc, row_max, row_sum = carry
             blk = (me - step) % n
             kpos = blk * sq + jnp.arange(sq)
@@ -97,7 +102,8 @@ def ring_attention(mesh: Mesh, axis: str = "model", causal: bool = True):
     return jax.jit(_attn)
 
 
-def full_attention(q, k, v, causal: bool = True):
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True) -> jax.Array:
     """Reference O(S^2)-memory attention for numerics checks."""
     s = q.shape[1]
     scores = (jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)

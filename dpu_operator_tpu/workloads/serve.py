@@ -67,7 +67,7 @@ import itertools
 import logging
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from ..utils import flight, metrics, tracing, watchdog
 from ..utils.stats import nearest_rank
@@ -238,7 +238,7 @@ def chunked_config(cost_model: Optional["CostModel"] = None,
                    slots: int = 24, kv_blocks: int = 256,
                    kv_block_size: int = 16,
                    itl_bound_s: float = 0.05,
-                   **kw) -> ServeConfig:
+                   **kw: Any) -> ServeConfig:
     """The production serving shape this PR ships: chunked prefill
     (budget sized from the cost model) + prefix sharing, over a slot
     set wide enough that the KV pool — not the slot count — is the
@@ -310,7 +310,7 @@ class JaxSlotExecutor:
     #: here — the scheduler must not skip prefill or map prefixes
     prefix_aware = False
 
-    def __init__(self, params: dict, cfg, slots: int,
+    def __init__(self, params: dict, cfg: Any, slots: int,
                  chunk_tokens: int = 0) -> None:
         import numpy as np
 
@@ -489,7 +489,7 @@ class Scheduler:
     """
 
     def __init__(self, config: ServeConfig,
-                 executor=None,
+                 executor: Optional[Any] = None,
                  cost_model: Optional[CostModel] = None,
                  clock: Optional[Callable[[], float]] = None,
                  heartbeat: Optional[watchdog.Heartbeat] = None) -> None:
@@ -1450,7 +1450,7 @@ class DecodeService:
     def __init__(self, scheduler: Scheduler,
                  idle_interval_s: float = 0.05,
                  stream_timeout_s: float = 30.0,
-                 evaluator=None,
+                 evaluator: Optional[Callable] = None,
                  fault_capacity_fn: Optional[Callable[[], Optional[int]]]
                  = None) -> None:
         self.scheduler = scheduler
@@ -1868,7 +1868,7 @@ def compare_batching(config: ServeConfig, cost_model: CostModel,
             "speedup": round(ratio, 3)}
 
 
-def calibrate_cost_model(cfg=None, slots: int = 8,
+def calibrate_cost_model(cfg: Optional[Any] = None, slots: int = 8,
                          prompt_len: int = 32) -> CostModel:
     """Measure real per-iteration costs of the refactored kernel pair
     on the local backend (tiny config on CPU CI, the flagship on a
@@ -1888,7 +1888,7 @@ def calibrate_cost_model(cfg=None, slots: int = 8,
                                 n_layers=2, d_ff=128, max_seq=256)
     params = init_params(jax.random.key(0), cfg)
 
-    def timed(fn, iters: int = 8) -> float:
+    def timed(fn: Callable[[], object], iters: int = 8) -> float:
         fn()  # compile
         t0 = _time.perf_counter()
         for _ in range(iters):

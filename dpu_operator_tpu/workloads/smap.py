@@ -10,6 +10,8 @@ to ``jax.experimental.shard_map`` and translating the keyword.
 
 from __future__ import annotations
 
+from typing import Any, Callable, Optional
+
 try:  # jax >= 0.6: public API, check_vma keyword
     from jax import shard_map as _shard_map
 
@@ -20,7 +22,9 @@ except ImportError:  # jax 0.4.x: experimental API, check_rep keyword
     _CHECK_KW = "check_rep"
 
 
-def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+def shard_map(f: Callable[..., Any], *, mesh: Any, in_specs: Any,
+              out_specs: Any, check_vma: Optional[bool] = None,
+              **kw: Any) -> Callable[..., Any]:
     """``jax.shard_map`` with the replication-check keyword translated to
     whatever this jax version calls it. Used via ``partial`` exactly like
     the real thing."""

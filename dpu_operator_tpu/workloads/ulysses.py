@@ -21,6 +21,7 @@ is shard_map + lax.all_to_all over the mesh axis, XLA-native.
 from __future__ import annotations
 
 from functools import partial
+from typing import Callable
 
 import jax
 from jax import lax
@@ -31,7 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def ulysses_attention(mesh: Mesh, axis: str = "model",
                       causal: bool = True, block_q: int = 512,
-                      block_k: int = 512):
+                      block_k: int = 512) -> Callable[..., jax.Array]:
     """Jitted (q, k, v) -> attention with sequence sharded on *axis*.
 
     q/k/v: (B, S, H, D) global, sequence-sharded on entry and exit; heads
@@ -43,17 +44,18 @@ def ulysses_attention(mesh: Mesh, axis: str = "model",
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
-    def _attn(q, k, v):
+    def _attn(q: jax.Array, k: jax.Array,
+              v: jax.Array) -> jax.Array:
         if n == 1:
             return flash_attention_vjp(q, k, v, causal, block_q, block_k)
 
-        def seq_to_heads(t):
+        def seq_to_heads(t: jax.Array) -> jax.Array:
             # (B, S/n, H, D) -> all-to-all: scatter heads, gather seq
             # -> (B, S, H/n, D)
             return lax.all_to_all(t, axis, split_axis=2, concat_axis=1,
                                   tiled=True)
 
-        def heads_to_seq(t):
+        def heads_to_seq(t: jax.Array) -> jax.Array:
             return lax.all_to_all(t, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 

@@ -1,0 +1,785 @@
+"""opslint v2 tests: interprocedural lock rules + resource lifecycle.
+
+Per-rule pass/fail fixtures for `lock-order-graph` and
+`resource-lifecycle`, the interprocedural guarded-by relaxation in
+`lock-discipline`, the structured CLI formats, and the ratchet's
+actionable stale-entry message. Fixtures build Modules directly (the
+repo-relative path drives rule scoping), mirroring test_opslint.py.
+"""
+
+import json
+import os
+import textwrap
+
+from dpu_operator_tpu.analysis import (LockDisciplineChecker,
+                                       LockOrderGraphChecker,
+                                       ResourceLifecycleChecker)
+from dpu_operator_tpu.analysis.__main__ import main as opslint_main
+from dpu_operator_tpu.analysis.core import Module, run_checkers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check(checker, source, relpath="dpu_operator_tpu/somemod.py"):
+    module = Module("/x/" + relpath, relpath, textwrap.dedent(source))
+    return [v for v in checker.check(module)
+            if not module.suppressed(v.rule, v.line)]
+
+
+def check_many(checker, sources):
+    """sources: {relpath: source} — a multi-module project pass."""
+    modules = [Module("/x/" + rel, rel, textwrap.dedent(src))
+               for rel, src in sources.items()]
+    by_rel = {m.relpath: m for m in modules}
+    return [v for v in checker.check_project(modules)
+            if not by_rel[v.path].suppressed(v.rule, v.line)]
+
+
+# -- lock-order-graph ---------------------------------------------------------
+
+_AB_CYCLE = """
+    import threading
+
+    class Alpha:
+        def __init__(self, beta):
+            self._lock = threading.Lock()
+            self.beta = Beta(self)
+
+        def poke(self):
+            with self._lock:
+                self.beta.tick()
+
+        def tock(self):
+            with self._lock:
+                pass
+
+    class Beta:
+        def __init__(self, alpha):
+            self._lock = threading.Lock()
+            self.alpha = Alpha(self)
+
+        def tick(self):
+            with self._lock:
+                pass
+
+        def storm(self):
+            with self._lock:
+                self.alpha.tock()
+"""
+
+
+def test_lock_order_graph_flags_ab_ba_cycle():
+    violations = check(LockOrderGraphChecker(), _AB_CYCLE)
+    assert [v.rule for v in violations] == ["lock-order-graph"]
+    msg = violations[0].message
+    assert "Alpha._lock" in msg and "Beta._lock" in msg
+    assert "cycle" in msg
+
+
+def test_lock_order_graph_passes_one_directional_nesting():
+    # Alpha -> Beta only: a strict global order, no cycle
+    src = _AB_CYCLE.replace("self.alpha.tock()", "pass")
+    assert check(LockOrderGraphChecker(), src) == []
+
+
+def test_lock_order_graph_flags_self_deadlock_through_helper():
+    # non-reentrant Lock reacquired through a resolved call chain: the
+    # classic "public method calls public method" self-deadlock
+    violations = check(LockOrderGraphChecker(), """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def free_count(self):
+                with self._lock:
+                    return 1
+
+            def snapshot(self):
+                with self._lock:
+                    return {"free": self.free_count()}
+    """)
+    assert len(violations) == 1
+    assert "Pool._lock" in violations[0].message
+
+
+def test_lock_order_graph_allows_rlock_reentry():
+    assert check(LockOrderGraphChecker(), """
+        import threading
+
+        class Sched:
+            def __init__(self):
+                self._state_lock = threading.RLock()
+
+            def capacity(self):
+                with self._state_lock:
+                    return 3
+
+            def snapshot(self):
+                with self._state_lock:
+                    return {"cap": self.capacity()}
+    """) == []
+
+
+def test_lock_order_graph_condition_aliases_to_wrapped_lock():
+    # Condition(self._lock) IS self._lock: holding the condition while
+    # calling into a `with self._lock:` method is a real self-deadlock
+    violations = check(LockOrderGraphChecker(), """
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def _len(self):
+                with self._lock:
+                    return 0
+
+            def get(self):
+                with self._cond:
+                    return self._len()
+    """)
+    assert len(violations) == 1
+    assert "Queue._lock" in violations[0].message
+
+
+def test_lock_order_graph_cross_module_cycle():
+    """The edge evidence spans modules: serve holds its lock calling
+    the pool; the pool (wrongly) calls back into serve under its own
+    lock."""
+    violations = check_many(LockOrderGraphChecker(), {
+        "dpu_operator_tpu/workloads/fake_serve.py": """
+            import threading
+            from . import fake_pool
+
+            class Sched:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.pool = fake_pool.Pool(self)
+
+                def step(self):
+                    with self._lock:
+                        self.pool.alloc_blocks()
+
+                def on_free(self):
+                    with self._lock:
+                        pass
+        """,
+        "dpu_operator_tpu/workloads/fake_pool.py": """
+            import threading
+            from . import fake_serve
+
+            class Pool:
+                def __init__(self, sched):
+                    self.sched = fake_serve.Sched()
+
+                def alloc_blocks(self):
+                    with self._lock:
+                        return []
+
+                def free_all(self):
+                    with self._lock:
+                        self.sched.on_free()
+        """,
+    })
+    assert len(violations) == 1
+    assert "Sched._lock" in violations[0].message
+    assert "Pool._lock" in violations[0].message
+
+
+def test_lock_order_graph_multi_item_with_orders_sequentially():
+    # `with a, b:` acquires b while holding a: combined with the
+    # reverse order elsewhere it is the textbook AB/BA deadlock
+    violations = check(LockOrderGraphChecker(), """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def forward(self):
+                with self._a_lock, self._b_lock:
+                    pass
+
+            def backward(self):
+                with self._b_lock, self._a_lock:
+                    pass
+    """)
+    assert len(violations) == 1
+    assert "Pair._a_lock" in violations[0].message
+    assert "Pair._b_lock" in violations[0].message
+
+
+def test_lock_order_graph_ignores_calls_inside_lambdas():
+    # a lambda's body runs when invoked, not where it is defined:
+    # holding a lock while BINDING a deferred call must not fabricate
+    # an edge (and must not certify the callee as called-under-lock)
+    assert check(LockOrderGraphChecker(), """
+        import threading
+
+        class Deferred:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other_lock = threading.Lock()
+
+            def schedule(self, timer):
+                with self._lock:
+                    timer(lambda: self._fire())
+
+            def _fire(self):
+                with self._other_lock:
+                    pass
+
+            def also(self):
+                with self._other_lock:
+                    pass
+    """) == []
+
+
+def test_guarded_by_lambda_call_site_gives_no_relaxation():
+    violations = check(LockDisciplineChecker(), _HELPER_BASE.replace(
+        "                self._spill()",
+        "                cb = lambda: self._spill()"))
+    # the only "call" is deferred: _spill runs lock-free later, so its
+    # off-lock guarded write must still fire
+    assert [v.rule for v in violations] == ["lock-discipline"]
+
+
+def test_lock_order_graph_sees_closure_acquisitions():
+    # a worker closure handed to a thread is its own lock-flow root:
+    # its internal nesting must contribute edges (here: a cycle against
+    # the reverse order taken by a method)
+    violations = check(LockOrderGraphChecker(), """
+        import threading
+
+        class Spawner:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def start(self, spawn):
+                def worker():
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                spawn(worker)
+
+            def other(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """)
+    assert len(violations) == 1
+    assert "Spawner._a_lock" in violations[0].message
+
+
+def test_lock_order_graph_live_repo_is_acyclic():
+    assert run_checkers([LockOrderGraphChecker()],
+                        ["dpu_operator_tpu"], REPO) == []
+
+
+# -- lock-discipline: interprocedural relaxation ------------------------------
+
+_HELPER_BASE = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.total = 0
+
+        def bump(self):
+            with self._lock:
+                self.total += 1
+                self._spill()
+
+        def _spill(self):
+            self.total = 0
+"""
+
+
+def test_guarded_by_passes_helper_called_only_from_locked_sites():
+    # _spill writes a guarded attr off-lock, but its ONLY call site
+    # holds the lock: the interprocedural pass proves the contract
+    assert check(LockDisciplineChecker(), _HELPER_BASE) == []
+
+
+def test_guarded_by_flags_helper_with_an_unlocked_call_site():
+    src = _HELPER_BASE + """
+        def poke(self):
+            self._spill()
+    """
+    violations = check(LockDisciplineChecker(), src)
+    assert [v.rule for v in violations] == ["lock-discipline"]
+    assert "_spill" in violations[0].message
+    assert "total" in violations[0].message
+
+
+def test_guarded_by_flags_helper_used_as_callback():
+    # a method handed off as a VALUE runs on a schedule the call graph
+    # cannot see — call-site evidence no longer covers it
+    src = _HELPER_BASE + """
+        def schedule(self, timer):
+            timer(self._spill)
+    """
+    violations = check(LockDisciplineChecker(), src)
+    assert [v.rule for v in violations] == ["lock-discipline"]
+
+
+def test_guarded_by_relaxation_is_transitive():
+    assert check(LockDisciplineChecker(), """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def bump(self):
+                with self._lock:
+                    self._mid()
+
+            def _mid(self):
+                self._spill()
+
+            def _spill(self):
+                self.total = 0
+    """) == []
+
+
+def test_guarded_by_public_helpers_get_no_relaxation():
+    # public methods are callable from anywhere; call-site evidence
+    # inside the package proves nothing
+    violations = check(LockDisciplineChecker(), """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def bump(self):
+                with self._lock:
+                    self.total += 1
+                    self.spill()
+
+            def spill(self):
+                self.total = 0
+    """)
+    assert [v.rule for v in violations] == ["lock-discipline"]
+
+
+def test_guarded_by_cross_module_call_site_counts():
+    """The lock-held call site lives in another module: the project
+    pass must still prove the helper's contract."""
+    assert check_many(LockDisciplineChecker(), {
+        "dpu_operator_tpu/workloads/fake_core.py": """
+            import threading
+
+            class Table:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = 0
+
+                def _reset(self):
+                    self.rows = 0
+
+                def wipe(self):
+                    with self._lock:
+                        self.rows += 1
+        """,
+        "dpu_operator_tpu/workloads/fake_driver.py": """
+            from .fake_core import Table
+
+            def drain(table):
+                t = Table()
+                with t._lock:
+                    t._reset()
+        """,
+    }) == []
+
+
+# -- resource-lifecycle: handles ----------------------------------------------
+
+def test_lifecycle_flags_exception_edge_leak():
+    violations = check(ResourceLifecycleChecker(), """
+        import socket
+
+        def dial(addr):
+            s = socket.socket()
+            s.connect(addr)
+            s.close()
+    """, relpath="dpu_operator_tpu/k8s/pool.py")
+    assert [v.rule for v in violations] == ["resource-lifecycle"]
+    assert "connect" in violations[0].message
+
+
+def test_lifecycle_passes_try_finally_release():
+    assert check(ResourceLifecycleChecker(), """
+        import socket
+
+        def dial(addr):
+            s = socket.socket()
+            try:
+                s.connect(addr)
+                return s.recv(1)
+            finally:
+                s.close()
+    """, relpath="dpu_operator_tpu/k8s/pool.py") == []
+
+
+def test_lifecycle_passes_with_statement():
+    assert check(ResourceLifecycleChecker(), """
+        import socket
+
+        def dial(addr):
+            with socket.socket() as s:
+                s.connect(addr)
+                return s.recv(1)
+    """, relpath="dpu_operator_tpu/k8s/pool.py") == []
+
+
+def test_lifecycle_passes_ownership_transfer_forms():
+    # return, store-into-self, os.fdopen, cleanup-shaped helper
+    assert check(ResourceLifecycleChecker(), """
+        import os
+        import socket
+
+        def make():
+            return socket.socket()
+
+        class Client:
+            def adopt(self):
+                self._sock = socket.socket()
+
+        def claim(path):
+            fd = os.open(path, os.O_RDONLY)
+            with os.fdopen(fd) as f:
+                return f.read()
+
+        def serve(path):
+            listener = socket.socket()
+            try:
+                listener.bind(path)
+            except OSError:
+                _cleanup_listener(listener, path)
+                return None
+            return listener
+
+        def _cleanup_listener(listener, path):
+            listener.close()
+    """, relpath="dpu_operator_tpu/daemon/handoff.py") == []
+
+
+def test_lifecycle_flags_handler_that_leaks_on_return():
+    # the announce._helper_main shape the audit fixed: handler exits
+    # without releasing what the try body acquired
+    violations = check(ResourceLifecycleChecker(), """
+        import os
+
+        def enter(netns):
+            try:
+                fd = os.open(netns, os.O_RDONLY)
+                os.setns(fd, 0)
+                os.close(fd)
+            except OSError:
+                return 0
+            return 1
+    """, relpath="dpu_operator_tpu/cni/announce.py")
+    assert violations, "handler return with a live fd must fire"
+    assert all(v.rule == "resource-lifecycle" for v in violations)
+
+
+def test_lifecycle_flags_retry_loop_rebind():
+    # the native_dp shape the audit fixed: one leaked socket per retry
+    violations = check(ResourceLifecycleChecker(), """
+        import socket
+        import time
+
+        def connect(path, deadline):
+            while True:
+                try:
+                    s = socket.socket()
+                    s.connect(path)
+                    return s
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.05)
+    """, relpath="dpu_operator_tpu/vsp/native_dp.py")
+    assert violations
+    assert any("reacquired" in v.message or "raise" in v.message
+               for v in violations)
+
+
+def test_lifecycle_passes_close_before_retry():
+    assert check(ResourceLifecycleChecker(), """
+        import socket
+        import time
+
+        def connect(path, deadline):
+            while True:
+                s = socket.socket()
+                try:
+                    s.connect(path)
+                except OSError:
+                    s.close()
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.05)
+                    continue
+                return s
+    """, relpath="dpu_operator_tpu/vsp/native_dp.py") == []
+
+
+def test_lifecycle_tracks_accept_as_new_socket():
+    violations = check(ResourceLifecycleChecker(), """
+        import socket
+
+        def serve_one(path):
+            listener = socket.socket()
+            try:
+                listener.bind(path)
+                conn, _ = listener.accept()
+                data = conn.recv(64)
+                return data
+            finally:
+                listener.close()
+    """, relpath="dpu_operator_tpu/daemon/handoff.py")
+    assert len(violations) >= 1
+    assert any("accept" in v.message for v in violations)
+
+
+# -- resource-lifecycle: KV owners and slots ----------------------------------
+
+def test_lifecycle_flags_kv_alloc_without_free_on_error_path():
+    violations = check(ResourceLifecycleChecker(), """
+        def admit(self, req, blocks):
+            mapped = self.pool.map_prefix(req.rid, req.keys)
+            if self.pool.alloc(req.rid, blocks - mapped) is None:
+                return False
+            self._active[req.slot] = req
+            return True
+    """, relpath="dpu_operator_tpu/workloads/serve.py")
+    assert [v.rule for v in violations] == ["resource-lifecycle"]
+    assert "req.rid" in violations[0].message
+
+
+def test_lifecycle_passes_kv_rollback_and_transfer():
+    # the _admit_locked shape: roll back on failure, transfer the
+    # owning object into scheduler state on success
+    assert check(ResourceLifecycleChecker(), """
+        def admit(self, req, blocks):
+            mapped = self.pool.map_prefix(req.rid, req.keys)
+            if self.pool.alloc(req.rid, blocks - mapped) is None:
+                self.pool.free(req.rid)
+                return False
+            self._active[req.slot] = req
+            return True
+    """, relpath="dpu_operator_tpu/workloads/serve.py") == []
+
+
+def test_lifecycle_passes_kv_release_via_release_locked_hoist():
+    assert check(ResourceLifecycleChecker(), """
+        def excise(self, req):
+            self.pool.alloc(req.rid, 4)
+            self._release_locked(req)
+    """, relpath="dpu_operator_tpu/workloads/serve.py") == []
+
+
+def test_lifecycle_flags_slot_pop_without_putback_or_store():
+    violations = check(ResourceLifecycleChecker(), """
+        def grab(self):
+            slot = self._free_slots.pop(0)
+            return None
+    """, relpath="dpu_operator_tpu/workloads/serve.py")
+    assert [v.rule for v in violations] == ["resource-lifecycle"]
+    assert "slot" in violations[0].message
+
+
+def test_lifecycle_passes_slot_claim_and_putback():
+    assert check(ResourceLifecycleChecker(), """
+        def grab(self, req):
+            slot = self._free_slots.pop(0)
+            req.slot = slot
+            self._active[slot] = req
+
+        def release(self, req):
+            self._free_slots.append(req.slot)
+    """, relpath="dpu_operator_tpu/workloads/serve.py") == []
+
+
+def test_lifecycle_pragma_suppresses():
+    assert check(ResourceLifecycleChecker(), """
+        import socket
+
+        def dial(addr):
+            s = socket.socket()  # opslint: disable=resource-lifecycle
+            s.connect(addr)
+            s.close()
+    """, relpath="dpu_operator_tpu/k8s/pool.py") == []
+
+
+def test_lifecycle_scopes_to_package_non_test_files():
+    leaky = """
+        import socket
+
+        def dial(addr):
+            s = socket.socket()
+            s.connect(addr)
+            s.close()
+    """
+    assert check(ResourceLifecycleChecker(), leaky,
+                 relpath="tests/test_x.py") == []
+    assert check(ResourceLifecycleChecker(), leaky,
+                 relpath="tools/helper.py") == []
+
+
+def test_lifecycle_loop_head_discharge_is_not_resurrected():
+    # the loop-head expression discharging the LAST live resource must
+    # yield the empty set, not fall back to the pre-head live set
+    assert check(ResourceLifecycleChecker(), """
+        import socket
+
+        def drain(addr):
+            s = socket.socket()
+            for item in _cleanup_sock(s):
+                handle(item)
+            return None
+
+        def _cleanup_sock(s):
+            s.close()
+            return []
+    """, relpath="dpu_operator_tpu/k8s/pool.py") == []
+
+
+def test_lifecycle_lambda_defines_neither_leak_nor_release():
+    # defining `lambda: socket.socket()` acquires nothing here...
+    assert check(ResourceLifecycleChecker(), """
+        import socket
+
+        def make_factory():
+            factory = lambda: socket.socket()
+            return factory
+    """, relpath="dpu_operator_tpu/k8s/pool.py") == []
+    # ...and `cleanup = lambda: s.close()` releases nothing here: the
+    # socket is still leaked if the lambda is never invoked
+    violations = check(ResourceLifecycleChecker(), """
+        import socket
+
+        def dial(addr):
+            s = socket.socket()
+            cleanup = lambda: s.close()
+            return None
+    """, relpath="dpu_operator_tpu/k8s/pool.py")
+    assert [v.rule for v in violations] == ["resource-lifecycle"]
+
+
+def test_lifecycle_live_repo_is_clean():
+    assert run_checkers([ResourceLifecycleChecker()],
+                        ["dpu_operator_tpu"], REPO) == []
+
+
+# -- CLI formats --------------------------------------------------------------
+
+def _seeded_tree(tmp_path):
+    pkg = tmp_path / "dpu_operator_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text("import socket\n")
+    return tmp_path
+
+
+def test_cli_json_format_is_machine_stable(tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    assert opslint_main(["--repo-root", root, "--format", "json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["version"] == 1
+    (finding,) = data["findings"]
+    assert finding["rule"] == "wire-seam"
+    assert finding["file"] == "dpu_operator_tpu/bad.py"
+    assert finding["line"] == 1
+    assert finding["status"] == "new"
+    assert "socket" in finding["message"]
+    rule_ids = {r["id"] for r in data["rules"]}
+    assert {"lock-order-graph", "resource-lifecycle",
+            "lock-discipline"} <= rule_ids
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    assert opslint_main(["--repo-root", root, "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "opslint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"lock-order-graph", "resource-lifecycle"} <= rule_ids
+    (result,) = run["results"]
+    assert result["ruleId"] == "wire-seam"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "dpu_operator_tpu/bad.py"
+    assert loc["region"]["startLine"] == 1
+    assert "suppressions" not in result
+
+
+def test_cli_sarif_marks_baselined_as_suppressed(tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    assert opslint_main(["--repo-root", root, "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert opslint_main(["--repo-root", root, "--format", "sarif"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    (result,) = doc["runs"][0]["results"]
+    assert result["suppressions"][0]["kind"] == "external"
+
+
+def test_cli_json_exit_code_still_gates(tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    assert opslint_main(["--repo-root", root, "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert opslint_main(["--repo-root", root, "--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"][0]["status"] == "baselined"
+
+
+# -- ratchet message ----------------------------------------------------------
+
+def test_stale_baseline_message_names_rule_and_file(tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    assert opslint_main(["--repo-root", root, "--write-baseline"]) == 0
+    (tmp_path / "dpu_operator_tpu" / "bad.py").write_text("import os\n")
+    assert opslint_main(["--repo-root", root]) == 0
+    out = capsys.readouterr().out
+    assert "stale baseline entry" in out
+    assert "delete rule `wire-seam` for `dpu_operator_tpu/bad.py`" \
+        in out
+    assert "--write-baseline" in out  # the rewrite escape hatch
+
+
+def test_stale_baseline_message_names_overridden_baseline_file(
+        tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    custom = str(tmp_path / "ci-baseline.json")
+    assert opslint_main(["--repo-root", root, "--baseline", custom,
+                         "--write-baseline"]) == 0
+    (tmp_path / "dpu_operator_tpu" / "bad.py").write_text("import os\n")
+    capsys.readouterr()
+    assert opslint_main(["--repo-root", root,
+                         "--baseline", custom]) == 0
+    out = capsys.readouterr().out
+    assert "ci-baseline.json" in out
+    assert "opslint-baseline.json" not in out
+
+
+def test_stale_entries_in_json_format(tmp_path, capsys):
+    root = str(_seeded_tree(tmp_path))
+    assert opslint_main(["--repo-root", root, "--write-baseline"]) == 0
+    (tmp_path / "dpu_operator_tpu" / "bad.py").write_text("import os\n")
+    capsys.readouterr()
+    assert opslint_main(["--repo-root", root, "--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    (stale,) = data["staleBaselineEntries"]
+    assert stale["rule"] == "wire-seam"
+    assert stale["file"] == "dpu_operator_tpu/bad.py"

@@ -1,0 +1,129 @@
+"""`make race-check`: LockTracer-wrapped concurrency storms.
+
+The static half of race-check is the opslint `lock-order-graph` +
+`resource-lifecycle` pass (exercised by tests/test_opslint_v2.py and
+run directly by the Makefile target); this file is the DYNAMIC half —
+the highest-contention components driven under
+`testing.locktrace.traced()`, which fails on any lock-order inversion
+the run exhibits even when no deadlock actually fires. Components are
+constructed INSIDE the traced region so their locks are the patched,
+edge-recording kind.
+
+Seeded workloads, bounded thread counts: these storms run in tier-1
+(`make test`) as well as under `-m race`.
+"""
+
+import concurrent.futures
+import threading
+
+import pytest
+
+from dpu_operator_tpu.testing.locktrace import traced
+
+pytestmark = pytest.mark.race
+
+SEED = 20260804
+
+
+def _storm(n_threads, fn):
+    barrier = threading.Barrier(n_threads)
+
+    def wrapped(i):
+        barrier.wait()
+        return fn(i)
+
+    with concurrent.futures.ThreadPoolExecutor(n_threads) as pool:
+        futures = [pool.submit(wrapped, i) for i in range(n_threads)]
+        return [f.result() for f in futures]
+
+
+def test_serve_scheduler_has_no_lock_inversions_under_contention():
+    """The scheduler's documented order (_state_lock before _lock,
+    scheduler before pool/ledger/flight) must hold while submitters,
+    a stepper, cancellers and snapshot readers collide — the exact
+    thread mix of DecodeService + HTTP ingress + device plugin."""
+    from dpu_operator_tpu.workloads import serve
+
+    with traced() as tracer:
+        sched = serve.Scheduler(serve.ServeConfig(
+            slots=4, kv_blocks=64, kv_block_size=16, queue_limit=256,
+            prefill_chunk_tokens=32, prefix_sharing=True))
+        reqs = serve.open_loop_arrivals(SEED, 40.0, 2.0)
+
+        def submit(i):
+            for req in reqs[i::3]:
+                sched.submit(req)
+            return True
+
+        def drive(_):
+            for _step in range(400):
+                if not sched.step():
+                    break
+            return True
+
+        def observe(_):
+            for _n in range(50):
+                sched.snapshot()
+                sched.capacity()
+                sched.cancel(f"absent-{_n}")
+            return True
+
+        assert all(_storm(6, lambda i: (submit, drive, observe)
+                          [i % 3](i)))
+        sched.run()
+    assert tracer.find_cycles() == []
+
+
+def test_kv_pool_sharing_storm_has_no_lock_inversions():
+    from dpu_operator_tpu.workloads.kv_pool import KvBlockPool, chain_keys
+
+    with traced() as tracer:
+        pool = KvBlockPool(128, 8, sharing=True)
+        prompt = tuple(range(32))
+        keys = chain_keys(prompt, 8)
+
+        def lifecycle(i):
+            owner = f"r{i}"
+            mapped = pool.map_prefix(owner, keys)
+            need = pool.blocks_for_tokens(len(prompt)) - mapped
+            if pool.alloc(owner, need) is None:
+                pool.free(owner)
+                return 0
+            if i == 0:
+                pool.register_prefix(owner, keys, len(prompt))
+            for pos in range(len(prompt)):
+                pool.write_token(owner, pos)
+            pool.set_used_tokens(owner, len(prompt))
+            snapshot = pool.snapshot()
+            pool.free(owner)
+            return snapshot["usedBlocks"]
+
+        _storm(8, lifecycle)
+        assert pool.outstanding() == 0
+    assert tracer.find_cycles() == []
+
+
+def test_workqueue_informer_storm_has_no_lock_inversions():
+    """The watch core's queue + store are the fleet gate's hottest
+    locks; adders, workers and re-queuers must order cleanly."""
+    from dpu_operator_tpu.k8s.workqueue import RateLimitingQueue
+
+    with traced() as tracer:
+        queue = RateLimitingQueue()
+
+        def add(i):
+            for n in range(40):
+                queue.add(f"key-{(i * 40 + n) % 17}")
+            return True
+
+        def work(_):
+            for _n in range(40):
+                key = queue.get(timeout=0.2)
+                if key is None:
+                    break
+                queue.done(key)
+            return True
+
+        _storm(6, lambda i: (add if i % 2 else work)(i))
+        queue.shutdown()
+    assert tracer.find_cycles() == []
