@@ -8,6 +8,7 @@ lifecycle rule. Run as ``python -m dpu_operator_tpu.analysis``; rules,
 pragma and baseline workflow are documented in doc/static-analysis.md.
 """
 
+from .blocking import BlockingUnderLockChecker
 from .checkers import (ChaosDeterminismChecker, EventsSeamChecker,
                        ExceptionHygieneChecker,
                        HandoffStateDisciplineChecker,
@@ -17,6 +18,7 @@ from .checkers import (ChaosDeterminismChecker, EventsSeamChecker,
 from .core import Baseline, Checker, Module, Violation, run_checkers
 from .lifecycle import ResourceLifecycleChecker
 from .lockcheck import LockDisciplineChecker, LockOrderGraphChecker
+from .taint import WireTaintChecker
 
 ALL_CHECKERS = (
     WireSeamChecker,
@@ -32,6 +34,8 @@ ALL_CHECKERS = (
     LockDisciplineChecker,
     LockOrderGraphChecker,
     ResourceLifecycleChecker,
+    WireTaintChecker,
+    BlockingUnderLockChecker,
 )
 
 __all__ = [
@@ -42,5 +46,6 @@ __all__ = [
     "ExceptionHygieneChecker", "MetricDocParityChecker",
     "MetricsNamingChecker", "ChaosDeterminismChecker",
     "LockDisciplineChecker", "LockOrderGraphChecker",
-    "ResourceLifecycleChecker",
+    "ResourceLifecycleChecker", "WireTaintChecker",
+    "BlockingUnderLockChecker",
 ]

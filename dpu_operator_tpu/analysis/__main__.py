@@ -21,7 +21,9 @@ import os
 import sys
 
 from . import ALL_CHECKERS
-from .core import Baseline, run_checkers
+from .core import (Baseline, load_modules, pragma_inventory,
+                   run_checkers_on)
+from typing import Any, Optional
 
 DEFAULT_ROOTS = ("dpu_operator_tpu", "tests")
 DEFAULT_BASELINE = "opslint-baseline.json"
@@ -46,7 +48,7 @@ def _stale_line(key: str, baseline_path: str) -> str:
 
 def _emit_json(new: list, baselined: list, stale: list,
                checkers: list) -> None:
-    def row(v, status):
+    def row(v: Any, status: Any) -> Any:
         return {"rule": v.rule, "file": v.path, "line": v.line,
                 "message": v.message, "status": status}
     print(json.dumps({
@@ -61,8 +63,8 @@ def _emit_json(new: list, baselined: list, stale: list,
     }, indent=2, sort_keys=True))
 
 
-def _emit_sarif(new: list, baselined: list, checkers: list) -> None:
-    def result(v, baselined_flag):
+def _sarif_doc(new: list, baselined: list, checkers: list) -> dict:
+    def result(v: Any, baselined_flag: Any) -> Any:
         out = {
             "ruleId": v.rule,
             "level": "warning",
@@ -79,7 +81,7 @@ def _emit_sarif(new: list, baselined: list, checkers: list) -> None:
                                     "justification":
                                         "opslint-baseline.json"}]
         return out
-    print(json.dumps({
+    return {
         "$schema": ("https://json.schemastore.org/sarif-2.1.0.json"),
         "version": "2.1.0",
         "runs": [{
@@ -94,7 +96,12 @@ def _emit_sarif(new: list, baselined: list, checkers: list) -> None:
             "results": ([result(v, False) for v in new]
                         + [result(v, True) for v in baselined]),
         }],
-    }, indent=2, sort_keys=True))
+    }
+
+
+def _emit_sarif(new: list, baselined: list, checkers: list) -> None:
+    print(json.dumps(_sarif_doc(new, baselined, checkers),
+                     indent=2, sort_keys=True))
 
 
 def _repo_root() -> str:
@@ -102,7 +109,7 @@ def _repo_root() -> str:
         os.path.dirname(os.path.abspath(__file__))))
 
 
-def main(argv=None) -> int:
+def main(argv: Optional[list] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dpu_operator_tpu.analysis",
         description="opslint: repo-native invariant linter")
@@ -125,6 +132,11 @@ def main(argv=None) -> int:
                         default="human",
                         help="output format (default: human; json/"
                              "sarif for CI diff annotation)")
+    parser.add_argument("--sarif-out", default=None, metavar="PATH",
+                        help="ALSO write the SARIF 2.1.0 report to "
+                             "PATH (independent of --format): the "
+                             "stable CI artifact diff-annotators "
+                             "consume")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -155,7 +167,8 @@ def main(argv=None) -> int:
         return 2
     roots = args.paths or [r for r in DEFAULT_ROOTS
                            if os.path.exists(os.path.join(repo_root, r))]
-    violations = run_checkers(checkers, roots, repo_root)
+    modules = load_modules(roots, repo_root)
+    violations = run_checkers_on(checkers, modules)
 
     baseline_path = args.baseline or os.path.join(repo_root,
                                                   DEFAULT_BASELINE)
@@ -169,6 +182,15 @@ def main(argv=None) -> int:
         new, baselined, stale = Baseline(baseline_path).split(violations)
         if subset:
             stale = []  # unscanned entries are not stale
+
+    if args.sarif_out:
+        sarif_path = args.sarif_out if os.path.isabs(args.sarif_out) \
+            else os.path.join(repo_root, args.sarif_out)
+        os.makedirs(os.path.dirname(sarif_path) or ".", exist_ok=True)
+        with open(sarif_path, "w") as fh:
+            json.dump(_sarif_doc(new, baselined, checkers), fh,
+                      indent=2, sort_keys=True)
+            fh.write("\n")
 
     if args.format == "json":
         _emit_json(new, baselined, stale, checkers)
@@ -185,6 +207,18 @@ def main(argv=None) -> int:
     if stale:
         print("ratchet: remove the entries above, or run "
               "--write-baseline to rewrite the file")
+    # the suppression ratchet, visible: a pragma added in a diff shows
+    # up as a count bump here even when every rule is otherwise green
+    inventory = pragma_inventory(modules)
+    if inventory:
+        rendered = " ".join(f"{rule}={count}" for rule, count
+                            in sorted(inventory.items()))
+        print(f"pragmas: {rendered} "
+              f"(total {sum(inventory.values())})")
+    else:
+        print("pragmas: none")
+    if args.sarif_out:
+        print(f"sarif: wrote {args.sarif_out}")
     print(f"opslint: {len(new)} new, {len(baselined)} baselined, "
           f"{len(stale)} stale baseline entries "
           f"({len(checkers)} rules)")

@@ -1,0 +1,70 @@
+"""opslint blocking-under-lock: no unbounded blocking while locked.
+
+The static complement to the watchdog (doc/static-analysis.md
+"Blocking under lock"): the repo's worst wedge shapes — Event wire-I/O
+inside a breaker's lock, an untimed ``queue.get`` under the scheduler
+lock — hang every thread that wants the lock, and no test interleaving
+reliably drives them. This rule reuses :mod:`.callgraph`'s
+interprocedural lock-held propagation: any call in the blocking sink
+set (socket send/recv/connect/accept, ``requests``-style wire calls,
+``queue.get``/``Event.wait``/``Condition.wait`` without timeout,
+``subprocess``, ``time.sleep`` at/above ``SLEEP_THRESHOLD_S``,
+untimed ``join``/``Future.result``) that is transitively reachable
+while a NON-REENTRANT ``threading.Lock`` is held is reported with the
+witness call chain that carried the lock there.
+
+Deliberate scope cuts (conservative in both directions):
+
+- RLock/Condition/unknown-kind locks do not trigger the rule: an
+  inherited or reentrant lock under a long wait is a latency question,
+  not a self-wedge, and unknown kinds would fabricate findings;
+- ``Condition.wait`` on a condition built over the held lock RELEASES
+  it while waiting — that lock is subtracted before judging;
+- timeout-bounded variants (``q.get(timeout=...)``,
+  ``evt.wait(5)``, ``fut.result(timeout=...)``) always pass: the rule
+  is about indefinite wedges, not latency budgets;
+- only UNRESOLVED calls are classified as sinks — a call the index
+  resolves is walked instead, so the finding lands on the leaf
+  blocking call with the full chain as witness.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+from .callgraph import build_flow
+from .core import Checker, Module, Violation
+
+
+class BlockingUnderLockChecker(Checker):
+    name = "blocking-under-lock"
+    description = ("no unbounded blocking call (socket I/O, wire "
+                   "requests, untimed queue.get/Event.wait/join, "
+                   "subprocess, long sleeps) may be transitively "
+                   "reachable while a non-reentrant lock is held")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        yield from self.check_modules([module])
+
+    def check_project(self, modules: list) -> Iterator[Violation]:
+        yield from self.check_modules(modules)
+
+    def check_modules(self, modules: Iterable[Module]) \
+            -> Iterator[Violation]:
+        in_scope = [m for m in modules if not m.is_test
+                    and m.relpath.startswith("dpu_operator_tpu/")]
+        if not in_scope:
+            return
+        flow = build_flow(in_scope)
+        witnesses = sorted(flow.blocking.values(),
+                           key=lambda w: (w.relpath, w.lineno, w.what))
+        for w in witnesses:
+            locks = ", ".join(w.locks)
+            yield Violation(
+                self.name, w.relpath, w.lineno,
+                f"blocking call {w.what} runs while non-reentrant "
+                f"lock(s) {locks} are held (in {w.holder}, via "
+                f"{w.chain}) — every thread wanting the lock wedges "
+                "behind this call: move the blocking work outside the "
+                "held region, bound it with a timeout, or hand it to "
+                "a worker")

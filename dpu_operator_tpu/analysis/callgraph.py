@@ -78,6 +78,11 @@ class FuncInfo:
     module: Module
     node: ast.AST  # FunctionDef | AsyncFunctionDef
     class_name: Optional[str] = None
+    #: for NESTED defs: enclosing-frame aliases the closure captures —
+    #: name -> class name. Covers the repo's handler idiom (`outer =
+    #: self` before a nested request-handler class), without which no
+    #: call from a handler body resolves anywhere.
+    closure_types: dict = dataclasses.field(default_factory=dict)
 
     @property
     def name(self) -> str:
@@ -139,7 +144,13 @@ _AMBIGUOUS = object()
 class ProjectIndex:
     """Symbol table + resolver over one set of scanned modules."""
 
-    def __init__(self, modules: Iterable[Module]):
+    #: process-wide construction counter: the shared-build test asserts
+    #: one full lint run builds the symbol table ONCE, not once per
+    #: whole-program rule (the v3 perf satellite)
+    builds = 0
+
+    def __init__(self, modules: Iterable[Module]) -> None:
+        ProjectIndex.builds += 1
         self.modules = [m for m in modules if not m.is_test]
         #: class name -> ClassInfo (or _AMBIGUOUS on collision)
         self.classes: dict = {}
@@ -201,6 +212,32 @@ class ProjectIndex:
             elif isinstance(sub, ast.AnnAssign) and sub.value is not None \
                     and isinstance(sub.value, ast.Call):
                 self._record_attr_assign(info, [sub.target], sub.value)
+        # `self.x = param` where the param is annotated with a class:
+        # the annotation is the attr's class (the docstring's "annotated
+        # parameter" shape — what makes `self.scheduler.submit(...)`
+        # resolve when the scheduler arrives through __init__)
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            ann = {}
+            for arg in item.args.args:
+                if arg.annotation is not None:
+                    tname = dotted_name(arg.annotation)
+                    if tname and tname.split(".")[-1][:1].isupper():
+                        ann[arg.arg] = tname.split(".")[-1]
+            for sub in walk_in_frame(item):
+                if not isinstance(sub, ast.Assign) \
+                        or not isinstance(sub.value, ast.Name):
+                    continue
+                tname = ann.get(sub.value.id)
+                if tname is None:
+                    continue
+                for target in sub.targets:
+                    attr = _self_attr(target)
+                    if attr is not None \
+                            and attr not in info.attr_types:
+                        info.attr_types[attr] = tname
         # lockish-named attrs written anywhere in the class but never
         # constructed here (inherited locks): own node, unknown kind
         for sub in ast.walk(node):
@@ -276,13 +313,33 @@ class ProjectIndex:
     def _collect_nested(self, parent: FuncInfo) -> None:
         """Register *parent*'s nested defs (at any depth) as lock-flow
         roots, inheriting the class context — `self` in a closure is
-        the enclosing method's `self`."""
+        the enclosing method's `self` — plus the enclosing frame's
+        `alias = self` / `alias = ClassName(...)` bindings, which the
+        closure reads at call time (`outer = self` in every request
+        handler)."""
+        aliases: dict = {}
+        for sub in walk_in_frame(parent.node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for target in sub.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if isinstance(sub.value, ast.Name) \
+                        and sub.value.id == "self" \
+                        and parent.class_name:
+                    aliases[target.id] = parent.class_name
+                elif isinstance(sub.value, ast.Call):
+                    ctor = (dotted_name(sub.value.func) or "") \
+                        .split(".")[-1]
+                    if ctor[:1].isupper():
+                        aliases[target.id] = ctor
         for sub in ast.walk(parent.node):
             if sub is parent.node:
                 continue
             if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.nested.append(
-                    FuncInfo(parent.module, sub, parent.class_name))
+                    FuncInfo(parent.module, sub, parent.class_name,
+                             closure_types=dict(aliases)))
 
     def all_functions(self) -> Iterable[FuncInfo]:
         for funcs in self.module_funcs.values():
@@ -358,6 +415,13 @@ class ProjectIndex:
                 cls = self.class_of(inst)
                 if cls is not None:
                     return cls.methods.get(parts[2])
+            # local/closure var of a known class, then its inferred
+            # instance attr: `outer.scheduler.submit_now(...)`
+            cls = self.class_of(local_types.get(parts[0]))
+            if cls is not None:
+                target_cls = self.class_of(cls.attr_types.get(parts[1]))
+                if target_cls is not None:
+                    return target_cls.methods.get(parts[2])
         return None
 
     def lock_node_for(self, expr: ast.AST, caller: FuncInfo,
@@ -400,23 +464,37 @@ class ProjectIndex:
         return None
 
 
-#: single-slot (key, strong refs, flow) — see build_flow
+#: single-slot (key, strong refs, index, flow-or-None) — see build_index
 _FLOW_CACHE: dict = {}
 
 
-def build_flow(modules: list) -> "LockFlow":
-    """One LockFlow per module set: LockDisciplineChecker and
-    LockOrderGraphChecker consume the same propagation products, so a
-    full lint run pays the whole-program fixpoint once. Single-slot
-    cache keyed on the Module object identities; the cached entry
-    holds the modules, so their ids cannot be recycled while the
-    entry is alive."""
+def build_index(modules: list) -> "ProjectIndex":
+    """One ProjectIndex per module set, shared by EVERY whole-program
+    pass (lock-discipline, lock-order-graph, blocking-under-lock,
+    wire-taint): a full lint run pays the symbol-table build once.
+    Single-slot cache keyed on the Module object identities; the
+    cached entry holds the modules, so their ids cannot be recycled
+    while the entry is alive."""
     key = tuple(id(m) for m in modules)
     slot = _FLOW_CACHE.get("slot")
     if slot is not None and slot[0] == key:
         return slot[2]
-    flow = LockFlow(ProjectIndex(modules))
-    _FLOW_CACHE["slot"] = (key, list(modules), flow)
+    index = ProjectIndex(modules)
+    _FLOW_CACHE["slot"] = (key, list(modules), index, None)
+    return index
+
+
+def build_flow(modules: list) -> "LockFlow":
+    """One LockFlow per module set, lazily built on the shared index:
+    the lock-discipline/lock-order/blocking rules consume the same
+    propagation products, so a full lint run pays the whole-program
+    fixpoint once (and the symbol table once — see build_index)."""
+    index = build_index(modules)
+    slot = _FLOW_CACHE["slot"]
+    if slot[3] is not None:
+        return slot[3]
+    flow = LockFlow(index)
+    _FLOW_CACHE["slot"] = (slot[0], slot[1], index, flow)
     return flow
 
 
@@ -426,6 +504,86 @@ class EdgeWitness:
     lineno: int
     holder: str  # qualname of the function where the edge was observed
     chain: str   # call chain that carried the held lock to this frame
+
+
+@dataclasses.dataclass
+class BlockingWitness:
+    """One blocking call observed while a non-reentrant lock was held."""
+
+    relpath: str
+    lineno: int
+    holder: str   # qualname of the function containing the call
+    chain: str    # call chain that carried the held lock to this frame
+    what: str     # human description of the blocking call
+    locks: tuple  # sorted node ids of the non-reentrant locks held
+
+
+#: time.sleep below this is a deliberate micro-backoff, not a wedge
+SLEEP_THRESHOLD_S = 0.05
+
+#: dotted-name prefixes/names that hit the wire or block unconditionally
+_BLOCKING_CALLS = {
+    "subprocess.run": "subprocess.run(...)",
+    "subprocess.call": "subprocess.call(...)",
+    "subprocess.check_call": "subprocess.check_call(...)",
+    "subprocess.check_output": "subprocess.check_output(...)",
+    "subprocess.Popen": "subprocess.Popen(...)",
+    "socket.create_connection": "socket.create_connection(...)",
+}
+
+#: socket-flavored method names, gated on a socket-ish receiver name
+_SOCKET_METHODS = {"accept", "connect", "connect_ex", "recv", "recv_into",
+                   "recvfrom", "send", "sendall", "makefile"}
+_SOCKETISH = ("sock", "conn", "listener")
+_QUEUEISH = ("queue", "events", "inbox")
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def blocking_call(call: ast.Call) -> Optional[str]:
+    """Human description when *call* is a recognized potentially
+    UNBOUNDED blocking shape (wire I/O, untimed waits, subprocess,
+    long sleeps), else None. Timeout-bounded variants pass: the rule
+    is about indefinite wedges, not latency."""
+    name = dotted_name(call.func) or ""
+    if name in _BLOCKING_CALLS:
+        return _BLOCKING_CALLS[name]
+    if name.startswith("requests.") and name.split(".", 1)[1] in (
+            "get", "post", "put", "patch", "delete", "head",
+            "request", "Session"):
+        # the verb allowlist keeps a local dict named `requests` from
+        # pattern-matching as the HTTP library
+        return f"{name}(...) wire call"
+    if name in ("time.sleep", "sleep"):
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, (int, float)) \
+                and call.args[0].value < SLEEP_THRESHOLD_S:
+            return None
+        return "time.sleep(...) at/above the wedge threshold"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    meth = call.func.attr
+    recv = dotted_name(call.func.value) or ""
+    tail = recv.split(".")[-1].lower()
+    if meth in _SOCKET_METHODS \
+            and any(s in tail for s in _SOCKETISH) \
+            and not _has_timeout(call):
+        return f"{recv}.{meth}(...) socket I/O"
+    if meth == "communicate" and not _has_timeout(call):
+        return f"{recv}.communicate()"
+    if meth == "get" and not call.args and not _has_timeout(call) \
+            and any(s in tail for s in _QUEUEISH):
+        return f"{recv}.get() without timeout"
+    if meth == "wait" and not call.args and not _has_timeout(call):
+        return f"{recv}.wait() without timeout"
+    if meth == "join" and not call.args and not call.keywords:
+        return f"{recv}.join() without timeout"
+    if meth == "result" and not call.args and not _has_timeout(call) \
+            and "fut" in tail:
+        return f"{recv}.result() without timeout"
+    return None
 
 
 class LockFlow:
@@ -440,10 +598,13 @@ class LockFlow:
     private helper called only from lock-held sites inherit the
     lock-held contract."""
 
-    def __init__(self, index: ProjectIndex):
+    def __init__(self, index: ProjectIndex) -> None:
         self.index = index
         #: (held_node, acquired_node) -> EdgeWitness (first observed)
         self.edges: dict = {}
+        #: id(call node) -> BlockingWitness: blocking calls reached
+        #: with a non-reentrant lock held (first witness per site)
+        self.blocking: dict = {}
         #: node id -> kind
         self.node_kinds: dict = {}
         #: func key -> list[bool]: per (resolved call site, caller
@@ -568,7 +729,7 @@ class LockFlow:
                          chain + (func.qualname,), local_types)
 
     def _local_types(self, func: FuncInfo) -> dict:
-        out: dict = {}
+        out: dict = dict(func.closure_types)
         for node in walk_in_frame(func.node):
             if isinstance(node, ast.Assign) \
                     and isinstance(node.value, ast.Call):
@@ -651,10 +812,41 @@ class LockFlow:
                     self._acquire(got, held, func, sub, chain)
                     continue
             target = self.index.resolve_call(sub, func, local_types)
-            if target is None:
+            if target is not None:
+                self._record_callsite(target, func, held)
+                self._enqueue(target, held, chain)
                 continue
-            self._record_callsite(target, func, held)
-            self._enqueue(target, held, chain)
+            # unresolved calls: the blocking-under-lock sink set. A
+            # resolved call is walked instead — a blocking leaf inside
+            # it is found there, with the full chain as witness.
+            if held:
+                self._check_blocking(sub, func, held, chain, local_types)
+
+    def _check_blocking(self, call: ast.Call, func: FuncInfo,
+                        held: frozenset, chain: tuple,
+                        local_types: dict) -> None:
+        what = blocking_call(call)
+        if what is None:
+            return
+        effective = set(held)
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "wait":
+            # Condition.wait RELEASES its own lock while waiting: a
+            # held lock that IS the wait target's node is not wedged
+            got = self.index.lock_node_for(call.func.value, func,
+                                           local_types)
+            if got is not None:
+                effective.discard(got[0])
+        wedged = tuple(sorted(
+            node for node in effective
+            if self.node_kinds.get(node) == "lock"))
+        if not wedged:
+            return
+        key = id(call)
+        if key not in self.blocking:
+            self.blocking[key] = BlockingWitness(
+                func.module.relpath, getattr(call, "lineno", 1),
+                func.qualname, " -> ".join(chain[-4:]), what, wedged)
 
     def _record_callsite(self, target: FuncInfo, caller: FuncInfo,
                          held: frozenset) -> None:

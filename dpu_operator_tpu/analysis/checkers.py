@@ -11,7 +11,7 @@ from __future__ import annotations
 import ast
 import os
 import re
-from typing import Iterator, Optional
+from typing import Any, Iterator, Optional
 
 from .core import Checker, Module, Violation, calls_in, dotted_name
 
@@ -604,7 +604,7 @@ _DETERMINISTIC_MARKS = ("pytest.mark.chaos", "pytest.mark.fault",
                         "pytest.mark.serve")
 
 
-def _is_deterministic_mark(target) -> bool:
+def _is_deterministic_mark(target: Any) -> bool:
     name = dotted_name(target) or ""
     return any(name.endswith(mark) for mark in _DETERMINISTIC_MARKS)
 

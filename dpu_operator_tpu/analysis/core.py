@@ -28,7 +28,7 @@ import json
 import os
 import re
 import tokenize
-from typing import Iterable, Iterator, Optional
+from typing import Any, Iterable, Iterator, Optional
 
 _PRAGMA_RE = re.compile(r"#\s*opslint:\s*disable=([\w\-, ]+)")
 
@@ -52,7 +52,7 @@ class Violation:
 class Module:
     """One parsed source file handed to every checker."""
 
-    def __init__(self, path: str, relpath: str, source: str):
+    def __init__(self, path: str, relpath: str, source: str) -> None:
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.source = source
@@ -66,7 +66,7 @@ class Module:
     def is_test(self) -> bool:
         return self.relpath.startswith("tests/")
 
-    def _scan_pragmas(self):
+    def _scan_pragmas(self) -> None:
         first_code_line = min(
             (n.lineno for n in self.tree.body), default=1)
         for lineno, line in enumerate(self.lines, start=1):
@@ -84,6 +84,18 @@ class Module:
         if rule in self._file_pragmas:
             return True
         return rule in self._line_pragmas.get(line, set())
+
+    def pragma_counts(self) -> dict:
+        """rule -> number of pragma mentions in this file (file-wide
+        pragmas count once per rule) — the suppression-ratchet
+        inventory `make lint-check` prints."""
+        out: dict = {}
+        for rule in self._file_pragmas:
+            out[rule] = out.get(rule, 0) + 1
+        for rules in self._line_pragmas.values():
+            for rule in rules:
+                out[rule] = out.get(rule, 0) + 1
+        return out
 
 
 class Checker:
@@ -176,7 +188,7 @@ def load_module(path: str, repo_root: str) -> Optional[Module]:
 # -- baseline -----------------------------------------------------------------
 
 class Baseline:
-    def __init__(self, path: str):
+    def __init__(self, path: str) -> None:
         self.path = path
         self.entries: set = set()
         self.loaded = False
@@ -186,14 +198,14 @@ class Baseline:
             self.entries = set(data.get("entries", []))
             self.loaded = True
 
-    def write(self, violations: Iterable[Violation]):
+    def write(self, violations: Iterable[Violation]) -> None:
         data = {"version": 1,
                 "entries": sorted({v.key() for v in violations})}
         with open(self.path, "w") as fh:
             json.dump(data, fh, indent=2, sort_keys=True)
             fh.write("\n")
 
-    def split(self, violations: list):
+    def split(self, violations: list) -> Any:
         """-> (new, baselined, stale_entries)."""
         fired = {v.key() for v in violations}
         new = [v for v in violations if v.key() not in self.entries]
@@ -202,24 +214,44 @@ class Baseline:
         return new, baselined, stale
 
 
-def run_checkers(checkers: Iterable[Checker], roots: Iterable[str],
-                 repo_root: str) -> list:
+def load_modules(roots: Iterable[str], repo_root: str) -> list:
+    """Parse every scannable file ONCE per invocation: the module list
+    is shared by all checkers (and, through analysis/callgraph.py's
+    single-slot cache keyed on these object identities, so are the
+    symbol table and the lock-flow fixpoint)."""
+    modules = []
+    for path in iter_python_files(roots, repo_root):
+        module = load_module(path, repo_root)
+        if module is not None:
+            modules.append(module)
+    return modules
+
+
+def pragma_inventory(modules: Iterable[Module]) -> dict:
+    """rule -> total pragma mentions across the PRODUCTION *modules*
+    (the visible suppression ratchet). Test files are excluded: the
+    linter's own fixture suites quote pragmas as strings, and a
+    fixture is not a suppression."""
+    out: dict = {}
+    for module in modules:
+        if module.is_test:
+            continue
+        for rule, count in module.pragma_counts().items():
+            out[rule] = out.get(rule, 0) + count
+    return out
+
+
+def run_checkers_on(checkers: Iterable[Checker],
+                    modules: list) -> list:
     """All non-suppressed violations, ordered by (path, line, rule).
 
     Checkers exposing ``check_project(modules)`` are whole-program
-    passes (the interprocedural v2 rules): they receive every loaded
-    module at once instead of one ``check(module)`` call per file, so
-    cross-module evidence (call-site lock-held-ness, the lock-order
-    graph) is complete. Pragma suppression still applies per line of
-    the file each violation lands in."""
-    modules = []
-    by_relpath: dict = {}
-    for path in iter_python_files(roots, repo_root):
-        module = load_module(path, repo_root)
-        if module is None:
-            continue
-        modules.append(module)
-        by_relpath[module.relpath] = module
+    passes (the interprocedural v2/v3 rules): they receive every
+    loaded module at once instead of one ``check(module)`` call per
+    file, so cross-module evidence (call-site lock-held-ness, the
+    lock-order graph, taint flows) is complete. Pragma suppression
+    still applies per line of the file each violation lands in."""
+    by_relpath = {m.relpath: m for m in modules}
     violations = []
 
     def _keep(module: Optional[Module], v: Violation) -> bool:
@@ -237,3 +269,8 @@ def run_checkers(checkers: Iterable[Checker], roots: Iterable[str],
                 if _keep(module, v):
                     violations.append(v)
     return sorted(violations, key=lambda v: (v.path, v.line, v.rule))
+
+
+def run_checkers(checkers: Iterable[Checker], roots: Iterable[str],
+                 repo_root: str) -> list:
+    return run_checkers_on(checkers, load_modules(roots, repo_root))

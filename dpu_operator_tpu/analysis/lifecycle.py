@@ -49,7 +49,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator, Optional
+from typing import Any, Iterator, Optional
 
 from .core import Checker, Module, Violation, dotted_name, walk_in_frame
 
@@ -76,7 +76,7 @@ class _Resource:
 
     def __init__(self, kind: str, node: ast.AST, what: str,
                  var: Optional[str] = None,
-                 owner: Optional[str] = None):
+                 owner: Optional[str] = None) -> None:
         self.kind = kind
         self.var = var
         self.owner = owner
@@ -99,7 +99,7 @@ class _Resource:
 class _TryFrame:
     __slots__ = ("node", "part", "exc_live")
 
-    def __init__(self, node: ast.Try):
+    def __init__(self, node: ast.Try) -> None:
         self.node = node
         self.part = "body"  # body | orelse | handler | finally
         self.exc_live: set = set()
@@ -137,7 +137,7 @@ class _FunctionWalker:
     """One function's abstract interpretation. Collects (node, message)
     violation tuples; the checker wraps them."""
 
-    def __init__(self, func: ast.AST):
+    def __init__(self, func: ast.AST) -> None:
         self.func = func
         self.frames: list = []
         self.findings: list = []
@@ -325,7 +325,7 @@ class _FunctionWalker:
         for r in live:
             self._leak(r, stmt, "edge", detail=source)
 
-    def _discharges_in(self, stmts: list, live) -> set:
+    def _discharges_in(self, stmts: list, live: Any) -> set:
         done: set = set()
         frozen = frozenset(live)
         for stmt in stmts:

@@ -68,7 +68,7 @@ class _Write:
     __slots__ = ("attr", "node", "under_lock", "method")
 
     def __init__(self, attr: str, node: ast.AST, under_lock: bool,
-                 method: str):
+                 method: str) -> None:
         self.attr = attr
         self.node = node
         self.under_lock = under_lock
@@ -80,7 +80,7 @@ class _MethodScanner(ast.NodeVisitor):
     each write happens under a recognized lock acquisition."""
 
     def __init__(self, method_name: str, known_locks: set,
-                 lock_held: bool = False):
+                 lock_held: bool = False) -> None:
         self.known_locks = known_locks
         self.method = method_name
         # *_locked helpers run with the caller's lock held by contract;
@@ -91,7 +91,7 @@ class _MethodScanner(ast.NodeVisitor):
         self.writes: list = []
 
     # -- lock scopes ----------------------------------------------------------
-    def visit_With(self, node: ast.With):
+    def visit_With(self, node: ast.With) -> None:
         held = 0
         for item in node.items:
             attr = _self_attr(item.context_expr)
@@ -102,7 +102,7 @@ class _MethodScanner(ast.NodeVisitor):
             self.visit(stmt)
         self.depth -= held
 
-    def visit_Try(self, node: ast.Try):
+    def visit_Try(self, node: ast.Try) -> None:
         # acquire()/finally-release() shape: self.<lock>.acquire(...)
         # directly guarding this try means the try body runs locked
         held = 1 if self._guarded_try(node) else 0
@@ -126,7 +126,7 @@ class _MethodScanner(ast.NodeVisitor):
                         return True
         return False
 
-    def visit_FunctionDef(self, node: ast.FunctionDef):
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         # a closure's body does not run where it is defined: timer and
         # watch-callback closures execute on other threads later, so
         # scan them with the lock depth RESET — their writes only count
@@ -140,13 +140,13 @@ class _MethodScanner(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
-    def visit_Lambda(self, node: ast.Lambda):
+    def visit_Lambda(self, node: ast.Lambda) -> None:
         saved, self.depth = self.depth, 0
         self.visit(node.body)
         self.depth = saved
 
     # -- writes ---------------------------------------------------------------
-    def _record(self, target: ast.AST):
+    def _record(self, target: ast.AST) -> None:
         attr = _self_attr(target)
         if attr is None and isinstance(target, (ast.Subscript,)):
             attr = _self_attr(target.value)
@@ -159,7 +159,7 @@ class _MethodScanner(ast.NodeVisitor):
         self.writes.append(_Write(attr, target, self.depth > 0,
                                   self.method))
 
-    def visit_Assign(self, node: ast.Assign):
+    def visit_Assign(self, node: ast.Assign) -> None:
         for target in node.targets:
             if isinstance(target, ast.Tuple):
                 for elt in target.elts:
@@ -168,20 +168,20 @@ class _MethodScanner(ast.NodeVisitor):
                 self._record(target)
         self.visit(node.value)
 
-    def visit_AugAssign(self, node: ast.AugAssign):
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
         self._record(node.target)
         self.visit(node.value)
 
-    def visit_AnnAssign(self, node: ast.AnnAssign):
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
         if node.value is not None:
             self._record(node.target)
             self.visit(node.value)
 
-    def visit_Delete(self, node: ast.Delete):
+    def visit_Delete(self, node: ast.Delete) -> None:
         for target in node.targets:
             self._record(target)
 
-    def visit_Call(self, node: ast.Call):
+    def visit_Call(self, node: ast.Call) -> None:
         if isinstance(node.func, ast.Attribute) \
                 and node.func.attr in _MUTATORS:
             self._record(node.func.value)

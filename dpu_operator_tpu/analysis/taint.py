@@ -1,0 +1,723 @@
+"""opslint wire-taint: untrusted ingress bytes vs dangerous sinks.
+
+Every boundary this operator mediates is an ingress for bytes nobody
+vetted: HTTP request bodies at the serve endpoint, CNI stdin netconf
+from kubelet, gRPC request messages on the VSP seam, CR ``spec``
+fields from the apiserver, handoff bundles from the peer daemon. The
+bugs we have fixed by hand — ``kv_too_large`` wedges from unbounded
+sizes, string prompt ids detonating ``chain_keys``, path traversal one
+``..`` away — are all the same shape: a tainted value reached a sink
+without passing a sanitizer. This rule is that invariant as a
+whole-program forward dataflow pass over :mod:`.callgraph`'s shared
+symbol table.
+
+**Taint model.** A value's taint is the set of sink kinds it still
+threatens (``path``, ``subprocess``, ``label``, ``alloc``, ``logfmt``,
+``index``). Sources seed with every kind; sanitizers DISCHARGE kinds
+(``int(x)`` can no longer traverse a path but is still an unbounded
+allocation size; ``clamped_int`` discharges everything). A violation
+fires when a value still carrying kind K reaches a K-sink, and the
+message carries the witness call chain that brought it there.
+
+**Propagation** is deliberately conservative in the same direction as
+the lock rules — a resolution the index is unsure of taints the
+RESULT (an unknown call laundering taint would hide real flows) but
+never fabricates a resolved edge:
+
+- assignment/tuple-unpack/for-target/walrus propagate; attribute and
+  subscript reads of a tainted object are tainted (no field
+  sensitivity);
+- unknown calls return the union of their argument + receiver taint;
+- resolved calls map tainted arguments onto the callee's parameters
+  and the callee is (re)walked per distinct context, memoized; the
+  callee's return taint comes from a summary fixpoint (bounded global
+  iterations);
+- a ``raise``-guarded comparison (``if n > CAP: raise``) discharges
+  the bounded kinds (``alloc``/``index``) from the guarded name; a
+  membership guard (``if x not in (...): raise``) discharges all.
+
+Known holes, on purpose (documented in doc/static-analysis.md): taint
+parked on ``self`` attributes between methods is not tracked; closures
+do not import their enclosing frame's tainted locals; dynamically
+dispatched handlers (``getattr``-built method tables) are invisible.
+The hostile-input corpus (``make fuzz-check``) covers the gap at
+runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterable, Iterator, Optional
+
+from .callgraph import FuncInfo, ProjectIndex, build_index
+from .core import Checker, Module, Violation, dotted_name
+
+#: sink kinds a tainted value can threaten
+ALL_KINDS = frozenset(
+    {"path", "subprocess", "label", "alloc", "logfmt", "index"})
+
+#: propagation depth cap, mirroring LockFlow
+MAX_DEPTH = 16
+
+#: global summary iterations: pass 2 consumes pass 1's return-taint
+#: summaries; a third pass only runs when summaries still changed
+MAX_PASSES = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class SourceSpec:
+    """One ingress seeding rule (the source catalog in
+    doc/static-analysis.md)."""
+
+    name: str      # stable id, shown in findings
+    modules: str   # regex on the repo-relative module path
+    kind: str      # "call" | "param" | "attr" | "key"
+    pattern: str   # regex on the dotted call name / param / attr / key
+    what: str      # human description of the ingress
+
+
+SOURCES = (
+    SourceSpec("http-body", r"workloads/serve\.py$", "call",
+               r"(?:^|\.)loads$", "HTTP request body at the serve "
+               "ingress"),
+    SourceSpec("http-read", r"workloads/serve\.py$", "call",
+               r"\.rfile\.read$", "raw HTTP body bytes"),
+    SourceSpec("http-header", r"workloads/serve\.py$", "call",
+               r"\.headers\.get$", "HTTP request header"),
+    SourceSpec("cni-stdin", r"cni/(?:server|shim)\.py$", "call",
+               r"(?:^|\.)loads$", "CNI stdin netconf from kubelet"),
+    SourceSpec("cni-read", r"cni/server\.py$", "call",
+               r"\.rfile\.read$", "raw CNI request bytes"),
+    SourceSpec("cni-header", r"cni/server\.py$", "call",
+               r"\.headers\.get$", "CNI request header"),
+    SourceSpec("grpc-request", r"vsp/rpc\.py$", "param",
+               r"^request$", "gRPC request message on the VSP seam"),
+    SourceSpec("cr-spec", r"(?:controller/.*|daemon/sfc_reconciler)"
+               r"\.py$", "attr", r"\.spec(?:\.|$)",
+               "CR spec field from the apiserver"),
+    SourceSpec("cr-spec-key", r"(?:controller/.*|daemon/"
+               r"sfc_reconciler)\.py$", "key", r"^spec$",
+               "CR spec field from the apiserver"),
+    SourceSpec("handoff-bundle", r"daemon/handoff\.py$", "call",
+               r"(?:^|\.)recv_frame$", "handoff bundle from the peer "
+               "daemon"),
+    SourceSpec("handoff-bundle-param", r"daemon/handoff\.py$",
+               "param", r"^(?:bundle|pending)$",
+               "handoff bundle from the peer daemon"),
+)
+
+#: numeric coercion: the result cannot traverse a path, spawn a
+#: process or forge a log record — but it is STILL an unbounded size
+#: and an unbounded label/index
+_NUMERIC = frozenset({"path", "subprocess", "logfmt"})
+
+#: sanitizer registry: regex on the dotted call name -> kinds the call
+#: DISCHARGES from its result. In-tree helpers (utils/validate.py,
+#: metrics.bounded_label) discharge everything because they refuse or
+#: bound; add new entries with the justification in
+#: doc/static-analysis.md's sanitizer catalog.
+SANITIZERS: tuple = (
+    (re.compile(r"^(?:int|float|len|ord|round|abs)$"), _NUMERIC),
+    (re.compile(r"^(?:bool|isinstance|hasattr|callable)$"), ALL_KINDS),
+    (re.compile(r"(?:^|\.)clamped_int$"), ALL_KINDS),
+    (re.compile(r"(?:^|\.)parse_choice$"), ALL_KINDS),
+    (re.compile(r"(?:^|\.)safe_path_segment$"), ALL_KINDS),
+    (re.compile(r"(?:^|\.)bounded_str$"), ALL_KINDS),
+    (re.compile(r"(?:^|\.)bounded_label$"), ALL_KINDS),
+    # validated W3C parse: returns a checked context or None
+    (re.compile(r"(?:^|\.)extract_traceparent$"), ALL_KINDS),
+    (re.compile(r"(?:^|\.)(?:sha256|md5|blake2b|hexdigest|digest)$"),
+     ALL_KINDS),
+)
+
+# -- sink tables --------------------------------------------------------------
+
+_PATH_SINKS = {
+    "open", "tokenize.open", "os.open", "os.makedirs", "os.mkdir",
+    "os.unlink", "os.remove", "os.rename", "os.replace", "os.rmdir",
+    "os.chmod", "os.stat", "os.listdir", "os.link", "os.symlink",
+    "os.path.join", "shutil.rmtree", "shutil.copy", "shutil.move",
+}
+_PATH_SINK_RE = re.compile(r"(?:^|\.)atomic_(?:write|claim)$")
+
+_SUBPROCESS_SINKS = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen", "os.system",
+    "os.popen",
+}
+_SUBPROCESS_PREFIXES = ("os.exec", "os.spawn")
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+
+#: allocation-shaped callees: tainted sizes reaching these are the
+#: kv_too_large wedge class
+_ALLOC_METHODS = {"read", "recv", "recv_into"}
+_ALLOC_NAME_RE = re.compile(r"(?:^|_)(?:alloc|reserve|resize)")
+_ALLOC_BUILTINS = {"bytes", "bytearray"}
+
+#: receivers whose raw indexing is the topology/allocation-map sink
+_INDEX_RECV_RE = re.compile(
+    r"(?:topo|alloc|chain|wire|chip|port|slot|table)")
+
+_REMEDY = {
+    "path": "derive the component via utils.validate.safe_path_segment"
+            " (refuses separators/dotdot) before building paths",
+    "subprocess": "never hand wire-derived strings to subprocess; "
+                  "validate with utils.validate.parse_choice",
+    "label": "route through metrics.bounded_label (membership or "
+             "charset+length bound) before using as a metric label — "
+             "unbounded label values are unbounded cardinality",
+    "alloc": "bound with utils.validate.clamped_int (or an explicit "
+             "`if n > CAP: raise` guard) before sizing "
+             "reads/allocations",
+    "logfmt": "pass untrusted data as a lazy %s argument, never as "
+              "the log format string",
+    "index": "guard membership (`if k not in m: raise` / use .get) "
+             "or clamp before raw-indexing topology/allocation maps",
+}
+
+
+def _sanitized_kinds(name: str) -> Optional[frozenset]:
+    for pattern, discharged in SANITIZERS:
+        if pattern.search(name):
+            return discharged
+    return None
+
+
+@dataclasses.dataclass
+class _Finding:
+    relpath: str
+    lineno: int
+    sink: str
+    what: str   # description of the sink expression
+    chain: str  # witness call chain
+
+
+class _TaintAnalysis:
+    """One whole-program taint run over a shared ProjectIndex."""
+
+    def __init__(self, index: ProjectIndex) -> None:
+        self.index = index
+        #: (func.key, ctx) -> frozenset of return kinds
+        self.summaries: dict = {}
+        self.findings: dict = {}
+        self._memo: set = set()
+        self._worklist: list = []
+        self._summaries_changed = False
+        self._source_mods = {
+            m.relpath: [s for s in SOURCES
+                        if re.search(s.modules, m.relpath)]
+            for m in index.modules}
+
+    def run(self) -> list:
+        for _pass in range(MAX_PASSES):
+            self._memo.clear()
+            self._worklist.clear()
+            self.findings.clear()
+            self._summaries_changed = False
+            for func in self.index.all_functions():
+                if self._source_mods.get(func.module.relpath):
+                    self._enqueue(func, (), ())
+            while self._worklist:
+                func, ctx, chain = self._worklist.pop(0)
+                _FuncWalker(self, func, ctx, chain).run()
+            if not self._summaries_changed:
+                break
+        return sorted(self.findings.values(),
+                      key=lambda f: (f.relpath, f.lineno, f.sink))
+
+    # -- worklist -------------------------------------------------------------
+    def _enqueue(self, func: FuncInfo, ctx: tuple, chain: tuple) -> None:
+        memo_key = (id(func.node), ctx)
+        if memo_key in self._memo or len(chain) > MAX_DEPTH:
+            return
+        self._memo.add(memo_key)
+        self._worklist.append((func, ctx, chain))
+
+    def call_into(self, target: FuncInfo, param_taints: dict,
+                  chain: tuple) -> frozenset:
+        """Record a resolved call carrying *param_taints*; returns the
+        callee's current return-taint summary for that context."""
+        ctx = tuple(sorted((name, tuple(sorted(kinds)))
+                           for name, kinds in param_taints.items()
+                           if kinds))
+        if ctx:
+            self._enqueue(target, ctx, chain)
+        return self.summaries.get((target.key, ctx), frozenset())
+
+    def record_return(self, func: FuncInfo, ctx: tuple,
+                      kinds: frozenset) -> None:
+        key = (func.key, ctx)
+        prev = self.summaries.get(key, frozenset())
+        merged = prev | kinds
+        if merged != prev:
+            self.summaries[key] = merged
+            self._summaries_changed = True
+
+    def record_finding(self, func: FuncInfo, node: ast.AST, sink: str,
+                       what: str, chain: tuple) -> None:
+        lineno = getattr(node, "lineno", 1)
+        key = (func.module.relpath, lineno, sink)
+        if key not in self.findings:
+            self.findings[key] = _Finding(
+                func.module.relpath, lineno, sink, what,
+                " -> ".join(chain[-4:]) or func.qualname)
+
+    def sources_for(self, func: FuncInfo) -> list:
+        return self._source_mods.get(func.module.relpath, [])
+
+
+class _FuncWalker:
+    """Walk one function body with a taint environment."""
+
+    def __init__(self, analysis: _TaintAnalysis, func: FuncInfo,
+                 ctx: tuple, chain: tuple) -> None:
+        self.a = analysis
+        self.func = func
+        self.ctx = ctx  # the context key this walk was enqueued under
+        self.chain = chain + (func.qualname,)
+        self.env: dict = {}
+        self.local_types = self._local_types()
+        self.sources = analysis.sources_for(func)
+        for name, kinds in ctx:
+            self.env[name] = frozenset(kinds)
+        for spec in self.sources:
+            if spec.kind != "param":
+                continue
+            for arg in self._all_args():
+                if re.search(spec.pattern, arg):
+                    self.env[arg] = \
+                        self.env.get(arg, frozenset()) | ALL_KINDS
+
+    def _all_args(self) -> list:
+        args = self.func.node.args
+        return [a.arg for a in
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)]
+
+    def _local_types(self) -> dict:
+        out: dict = dict(self.func.closure_types)
+        for node in ast.walk(self.func.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                ctor = (dotted_name(node.value.func) or "") \
+                    .split(".")[-1]
+                if self.a.index.class_of(ctor) is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out[target.id] = ctor
+        return out
+
+    def run(self) -> None:
+        self._block(self.func.node.body)
+
+    # -- statements -----------------------------------------------------------
+    def _block(self, stmts: list) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs are their own roots
+        if isinstance(stmt, ast.Return):
+            kinds = self._eval(stmt.value) if stmt.value else frozenset()
+            # summary keyed on the entry context, matching call_into
+            self.a.record_return(self.func, self.ctx, kinds)
+            return
+        if isinstance(stmt, ast.Assign):
+            kinds = self._eval(stmt.value)
+            for target in stmt.targets:
+                self._assign(target, kinds)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, self._eval(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            kinds = self._eval(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                self.env[stmt.target.id] = \
+                    self.env.get(stmt.target.id, frozenset()) | kinds
+            return
+        if isinstance(stmt, ast.If):
+            self._eval(stmt.test)
+            before = dict(self.env)
+            self._block(stmt.body)
+            after_body = self.env
+            self.env = dict(before)
+            self._block(stmt.orelse)
+            for name, kinds in after_body.items():
+                self.env[name] = self.env.get(name, frozenset()) | kinds
+            self._guard_discharge(stmt)
+            return
+        if isinstance(stmt, (ast.While,)):
+            self._eval(stmt.test)
+            self._block(stmt.body)
+            self._block(stmt.body)  # loop-carried taint
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            kinds = self._eval(stmt.iter)
+            self._assign(stmt.target, kinds)
+            self._block(stmt.body)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                kinds = self._eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, kinds)
+            self._block(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._block(stmt.body)
+            for handler in stmt.handlers:
+                self._block(handler.body)
+            self._block(stmt.orelse)
+            self._block(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._eval(stmt.exc)
+            return
+        if isinstance(stmt, (ast.Expr, ast.Assert)):
+            self._eval(getattr(stmt, "value", None)
+                       or getattr(stmt, "test", None))
+            return
+        # Pass/Break/Continue/Import/Global/Delete/...: nothing flows
+
+    def _assign(self, target: ast.AST, kinds: frozenset) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = kinds
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(elt, kinds)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, kinds)
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            # weak update onto the holding object
+            base = target.value
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in self.env:
+                self.env[base.id] = self.env[base.id] | kinds
+
+    def _guard_discharge(self, stmt: ast.If) -> None:
+        """`if <cmp involving n>: raise/return` discharges the bounded
+        kinds from n afterwards; `if n not in (...): raise` discharges
+        everything (validated enumeration)."""
+        if stmt.orelse or not stmt.body:
+            return
+        last = stmt.body[-1]
+        if not isinstance(last, (ast.Raise, ast.Return, ast.Continue)):
+            return
+        tests = [stmt.test]
+        if isinstance(stmt.test, ast.BoolOp):
+            tests = list(stmt.test.values)
+        for test in tests:
+            if not isinstance(test, ast.Compare):
+                continue
+            names = [n for n in [test.left] + list(test.comparators)
+                     if isinstance(n, ast.Name)]
+            membership = any(isinstance(op, (ast.NotIn, ast.In))
+                             for op in test.ops)
+            for name_node in names:
+                name = name_node.id
+                if name not in self.env:
+                    continue
+                if membership:
+                    self.env[name] = frozenset()
+                else:
+                    self.env[name] = self.env[name] - {"alloc", "index"}
+
+    # -- expressions ----------------------------------------------------------
+    def _eval(self, node: Optional[ast.AST]) -> frozenset:
+        if node is None or isinstance(node, ast.Constant):
+            return frozenset()
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, frozenset())
+        if isinstance(node, ast.Attribute):
+            kinds = self._eval(node.value)
+            return kinds | self._attr_source(node)
+        if isinstance(node, ast.Subscript):
+            container = self._eval(node.value)
+            key_kinds = self._eval(node.slice)
+            self._check_index_sink(node, key_kinds)
+            return container | key_kinds | self._key_source(node)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left)
+            right = self._eval(node.right)
+            self._check_mult_alloc(node, left, right)
+            return left | right
+        if isinstance(node, ast.BoolOp):
+            out: frozenset = frozenset()
+            for value in node.values:
+                out |= self._eval(value)
+            return out
+        if isinstance(node, ast.UnaryOp):
+            if isinstance(node.op, ast.Not):
+                self._eval(node.operand)
+                return frozenset()
+            return self._eval(node.operand)
+        if isinstance(node, ast.Compare):
+            self._eval(node.left)
+            for comp in node.comparators:
+                self._eval(comp)
+            return frozenset()  # booleans carry no taint
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test)
+            return self._eval(node.body) | self._eval(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = frozenset()
+            for elt in node.elts:
+                out |= self._eval(elt)
+            return out
+        if isinstance(node, ast.Dict):
+            out = frozenset()
+            for part in list(node.keys) + list(node.values):
+                if part is not None:
+                    out |= self._eval(part)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            out = frozenset()
+            for value in node.values:
+                if isinstance(value, ast.FormattedValue):
+                    out |= self._eval(value.value)
+            return out
+        if isinstance(node, ast.FormattedValue):
+            return self._eval(node.value)
+        if isinstance(node, ast.NamedExpr):
+            kinds = self._eval(node.value)
+            self._assign(node.target, kinds)
+            return kinds
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # bind loop targets from their iterables, then evaluate the
+            # element — so `tuple(int(t) for t in prompt)` applies the
+            # int sanitizer to the elements instead of smearing the
+            # iterable's full taint onto the result
+            saved = dict(self.env)
+            for gen in node.generators:
+                self._assign(gen.target, self._eval(gen.iter))
+                for cond in gen.ifs:
+                    self._eval(cond)
+            if isinstance(node, ast.DictComp):
+                out = self._eval(node.key) | self._eval(node.value)
+            else:
+                out = self._eval(node.elt)
+            self.env = saved
+            return out
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value)
+        if isinstance(node, ast.Lambda):
+            return frozenset()  # runs elsewhere
+        if isinstance(node, (ast.Await, ast.Yield, ast.YieldFrom)):
+            inner = getattr(node, "value", None)
+            return self._eval(inner) if inner is not None \
+                else frozenset()
+        return frozenset()
+
+    # -- sources --------------------------------------------------------------
+    def _attr_source(self, node: ast.Attribute) -> frozenset:
+        name = dotted_name(node)
+        if name is None:
+            return frozenset()
+        for spec in self.sources:
+            if spec.kind == "attr" and re.search(spec.pattern, name):
+                return ALL_KINDS
+        return frozenset()
+
+    def _key_source(self, node: ast.Subscript) -> frozenset:
+        if isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            for spec in self.sources:
+                if spec.kind == "key" \
+                        and re.search(spec.pattern, node.slice.value):
+                    return ALL_KINDS
+        return frozenset()
+
+    def _call_source(self, name: str, call: ast.Call) -> frozenset:
+        for spec in self.sources:
+            if spec.kind == "call" and re.search(spec.pattern, name):
+                return ALL_KINDS
+        # `d.get("spec")` — the key-source shape spelled as a call
+        if name.endswith(".get") and call.args \
+                and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            for spec in self.sources:
+                if spec.kind == "key" \
+                        and re.search(spec.pattern, call.args[0].value):
+                    return ALL_KINDS
+        return frozenset()
+
+    # -- calls: sanitizers, sinks, propagation --------------------------------
+    def _eval_call(self, call: ast.Call) -> frozenset:
+        name = dotted_name(call.func) or ""
+        arg_kinds = [self._eval(a) for a in call.args]
+        kw_kinds = {kw.arg: self._eval(kw.value)
+                    for kw in call.keywords}
+        recv_kinds = frozenset()
+        if isinstance(call.func, ast.Attribute):
+            recv_kinds = self._eval(call.func.value)
+        union = recv_kinds
+        for k in arg_kinds:
+            union |= k
+        for k in kw_kinds.values():
+            union |= k
+
+        discharged = _sanitized_kinds(name)
+        if discharged is not None:
+            return (union - discharged) | self._call_source(name, call)
+
+        self._check_sinks(call, name, arg_kinds, kw_kinds)
+
+        target = self.a.index.resolve_call(call, self.func,
+                                           self.local_types)
+        if target is not None:
+            param_taints = self._map_params(target, call, arg_kinds,
+                                            kw_kinds)
+            summary = self.a.call_into(target, param_taints, self.chain)
+            return summary | recv_kinds | self._call_source(name, call)
+        # unknown call: taint passes through
+        return union | self._call_source(name, call)
+
+    def _map_params(self, target: FuncInfo, call: ast.Call,
+                    arg_kinds: list, kw_kinds: dict) -> dict:
+        args = target.node.args
+        params = [a.arg for a in list(args.posonlyargs)
+                  + list(args.args)]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        out: dict = {}
+        for i, kinds in enumerate(arg_kinds):
+            if i < len(params) and kinds:
+                out[params[i]] = kinds
+        kwonly = {a.arg for a in args.kwonlyargs}
+        for name, kinds in kw_kinds.items():
+            if kinds and name is not None \
+                    and (name in params or name in kwonly):
+                out[name] = kinds
+        return out
+
+    def _check_sinks(self, call: ast.Call, name: str,
+                     arg_kinds: list, kw_kinds: dict) -> None:
+        tainted_arg = [k for k in arg_kinds if k]
+        any_kinds: frozenset = frozenset()
+        for k in list(arg_kinds) + list(kw_kinds.values()):
+            any_kinds |= k
+        # filesystem path construction / use
+        if (name in _PATH_SINKS or _PATH_SINK_RE.search(name)) \
+                and "path" in any_kinds:
+            self._finding(call, "path",
+                          f"untrusted data flows into `{name}(...)`")
+        # subprocess arguments
+        if (name in _SUBPROCESS_SINKS
+                or name.startswith(_SUBPROCESS_PREFIXES)) \
+                and "subprocess" in any_kinds:
+            self._finding(call, "subprocess",
+                          f"untrusted data flows into `{name}(...)`")
+        if isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            recv = dotted_name(call.func.value) or ""
+            recv_tail = recv.split(".")[-1]
+            metricish = (recv.startswith("metrics.")
+                         or recv_tail.isupper())
+            # metric label values: unbounded cardinality
+            if metricish and meth in ("inc", "set"):
+                for kw, kinds in kw_kinds.items():
+                    if kw is not None and "label" in kinds:
+                        self._finding(
+                            call, "label",
+                            f"untrusted data becomes metric label "
+                            f"`{kw}` on `{recv}.{meth}(...)`")
+            if metricish and meth == "labels" and arg_kinds \
+                    and "label" in arg_kinds[0]:
+                self._finding(call, "label",
+                              f"untrusted data becomes a metric label "
+                              f"via `{recv}.labels(...)`")
+            # format-into-log-record: tainted FORMAT string
+            if meth in _LOG_METHODS and "log" in recv.lower() \
+                    and arg_kinds and "logfmt" in arg_kinds[0]:
+                self._finding(
+                    call, "logfmt",
+                    f"untrusted data is the log format string in "
+                    f"`{recv}.{meth}(...)`")
+            # allocation-size expressions: .read(n)/.recv(n)
+            if meth in _ALLOC_METHODS and arg_kinds \
+                    and "alloc" in arg_kinds[0]:
+                self._finding(
+                    call, "alloc",
+                    f"untrusted size reaches `{recv}.{meth}(n)`")
+        # alloc/reserve-shaped callees with tainted size args
+        tail = name.split(".")[-1]
+        if _ALLOC_NAME_RE.search(tail) and any(
+                "alloc" in k for k in tainted_arg):
+            self._finding(call, "alloc",
+                          f"untrusted size reaches `{name}(...)`")
+        if tail in _ALLOC_BUILTINS and arg_kinds \
+                and "alloc" in arg_kinds[0]:
+            self._finding(call, "alloc",
+                          f"untrusted size reaches `{tail}(n)`")
+
+    def _check_mult_alloc(self, node: ast.BinOp, left: frozenset,
+                          right: frozenset) -> None:
+        if not isinstance(node.op, ast.Mult):
+            return
+        for side, kinds in ((node.left, right), (node.right, left)):
+            if isinstance(side, (ast.List, ast.Constant)) \
+                    and "alloc" in kinds:
+                self._finding(node, "alloc",
+                              "untrusted size scales a sequence "
+                              "allocation (`seq * n`)")
+                return
+
+    def _check_index_sink(self, node: ast.Subscript,
+                          key_kinds: frozenset) -> None:
+        if "index" not in key_kinds:
+            return
+        if not isinstance(node.ctx, ast.Load):
+            return
+        recv = dotted_name(node.value) or ""
+        if recv and _INDEX_RECV_RE.search(recv.split(".")[-1].lower()):
+            self._finding(node, "index",
+                          f"untrusted key raw-indexes `{recv}[...]`")
+
+    def _finding(self, node: ast.AST, sink: str, what: str) -> None:
+        self.a.record_finding(self.func, node, sink, what, self.chain)
+
+
+class WireTaintChecker(Checker):
+    name = "wire-taint"
+    description = ("untrusted ingress data (HTTP bodies, CNI stdin, "
+                   "gRPC requests, CR specs, handoff bundles) must "
+                   "pass a registered sanitizer before reaching "
+                   "path/subprocess/metric-label/allocation-size/"
+                   "log-format/raw-index sinks")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        yield from self.check_modules([module])
+
+    def check_project(self, modules: list) -> Iterator[Violation]:
+        yield from self.check_modules(modules)
+
+    def check_modules(self, modules: Iterable[Module]) \
+            -> Iterator[Violation]:
+        in_scope = [m for m in modules if not m.is_test
+                    and m.relpath.startswith("dpu_operator_tpu/")]
+        if not in_scope:
+            return
+        index = build_index(in_scope)
+        for f in _TaintAnalysis(index).run():
+            remedy = _REMEDY[f.sink]
+            yield Violation(
+                self.name, f.relpath, f.lineno,
+                f"[{f.sink}] {f.what} without passing a registered "
+                f"sanitizer (via {f.chain}) — {remedy}")

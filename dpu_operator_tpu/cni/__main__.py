@@ -10,9 +10,10 @@ import signal
 import threading
 
 from .server import CniServer
+from typing import Any, Optional
 
 
-def main(argv=None):
+def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser("tpu-cni-server")
     parser.add_argument("--socket", required=True)
     args = parser.parse_args(argv)
@@ -20,7 +21,7 @@ def main(argv=None):
     from ..utils import tracing
     tracing.install_log_context()
 
-    def echo(req):
+    def echo(req: Any) -> Any:
         logging.info("CNI %s sandbox=%s if=%s device=%s", req.command,
                      req.sandbox_id, req.ifname, req.device_id)
         return {"cniVersion": req.netconf.cni_version, "echo": True}

@@ -24,6 +24,7 @@ import logging
 import os
 import socket
 import struct
+from typing import Any
 
 log = logging.getLogger(__name__)
 
@@ -155,7 +156,7 @@ def announce_ips(ifname: str, ips: list, netns: str = "") -> int:
         return 0
 
 
-def announce_result(ifname: str, result, netns: str = "") -> int:
+def announce_result(ifname: str, result: Any, netns: str = '') -> int:
     """Announce every address in an ipam_add result fragment — the one
     call both CNI ADD paths make after addressing succeeds."""
     if not result:

@@ -10,19 +10,32 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Optional
+from typing import Any, Optional
 
+from ..utils import validate
 from ..utils.atomicfile import atomic_claim, atomic_write
 
 
 class NetConfCache:
-    def __init__(self, cache_dir: str):
+    def __init__(self, cache_dir: str) -> None:
         self.cache_dir = cache_dir
 
     def _path(self, sandbox_id: str, ifname: str) -> str:
-        return os.path.join(self.cache_dir, f"{sandbox_id}-{ifname}.json")
+        # belt to the parse-time refusal (PodRequest.from_cni_request):
+        # ids become file names, so they must never traverse out of the
+        # cache dir no matter which caller built them. Validated PER
+        # COMPONENT and only when non-empty — teardown DELs legally
+        # carry an empty ifname (and defensive loads an empty sandbox),
+        # and those must keep hitting the existing None/no-op paths
+        # instead of raising out of them
+        if sandbox_id:
+            validate.safe_path_segment(sandbox_id, what="sandbox id")
+        if ifname:
+            validate.safe_path_segment(ifname, what="ifname", extra="@")
+        return os.path.join(self.cache_dir,
+                            f"{sandbox_id}-{ifname}.json")
 
-    def save(self, sandbox_id: str, ifname: str, data: dict):
+    def save(self, sandbox_id: str, ifname: str, data: dict) -> None:
         # crash-safe: temp file + fsync + atomic rename (a kill -9
         # mid-save must never leave a truncated JSON that poisons the
         # DEL-time load of this sandbox after the next daemon start)
@@ -36,7 +49,7 @@ class NetConfCache:
         except (OSError, json.JSONDecodeError):
             return None  # DEL is defensive about missing cache (sriov.go:553-566)
 
-    def delete(self, sandbox_id: str, ifname: str):
+    def delete(self, sandbox_id: str, ifname: str) -> None:
         try:
             os.unlink(self._path(sandbox_id, ifname))
         except OSError:
@@ -74,7 +87,7 @@ class NetConfCache:
                     continue
         return out
 
-    def delete_sandbox(self, sandbox_id: str):
+    def delete_sandbox(self, sandbox_id: str) -> None:
         try:
             entries = os.listdir(self.cache_dir)
         except OSError:
@@ -90,7 +103,7 @@ class NetConfCache:
 class ChipAllocator:
     """File-per-chip allocation locks (pci_allocator.go analog)."""
 
-    def __init__(self, alloc_dir: str):
+    def __init__(self, alloc_dir: str) -> None:
         self.alloc_dir = alloc_dir
         # serializes poison recovery: without it, two concurrent
         # allocates seeing the same empty lock could each unlink-and-
@@ -100,7 +113,10 @@ class ChipAllocator:
         self._poison_lock = threading.Lock()
 
     def _path(self, chip_id: str) -> str:
-        return os.path.join(self.alloc_dir, chip_id.replace("/", "_"))
+        return os.path.join(
+            self.alloc_dir,
+            validate.safe_path_segment(chip_id.replace("/", "_"),
+                                       what="chip id", extra=":"))
 
     def allocate(self, chip_id: str, owner: str) -> bool:
         """Record *owner* (sandbox id) as holding *chip_id*; False if held

@@ -21,7 +21,7 @@ import ipaddress
 import json
 import os
 import subprocess
-from typing import Optional
+from typing import Any, Optional
 
 from ..utils.atomicfile import atomic_claim
 
@@ -49,13 +49,13 @@ class HostLocalIpam:
     (optionally bounded by rangeStart/rangeEnd), gateway excluded, one
     file per allocated IP recording ``<sandbox> <ifname>``."""
 
-    def __init__(self, data_dir: str):
+    def __init__(self, data_dir: str) -> None:
         self.data_dir = data_dir
 
     def _net_dir(self, name: str) -> str:
         return os.path.join(self.data_dir, name or "default")
 
-    def _iter_candidates(self, cfg: dict):
+    def _iter_candidates(self, cfg: dict) -> Any:
         subnet = cfg.get("subnet")
         if not subnet:
             raise IpamError("host-local IPAM requires 'subnet'")
@@ -76,7 +76,7 @@ class HostLocalIpam:
             yield ip, net
 
     @contextlib.contextmanager
-    def _net_lock(self, net_dir: str):
+    def _net_lock(self, net_dir: str) -> Any:
         """Per-network flock serializing add(): the scan-then-O_EXCL-create
         idempotency check is not atomic on its own, so two concurrent ADDs
         for the same sandbox+ifname (overlapping kubelet retries) could each
@@ -126,7 +126,7 @@ class HostLocalIpam:
                 return self._result(cfg, ip, net)
         raise IpamError(f"host-local range exhausted in {cfg.get('subnet')}")
 
-    def _result(self, cfg: dict, ip, net) -> dict:
+    def _result(self, cfg: dict, ip: Any, net: Any) -> dict:
         return {
             "ips": [_ip_result(f"{ip}/{net.prefixlen}", cfg.get("gateway"))],
             "routes": list(cfg.get("routes") or []),
@@ -134,7 +134,7 @@ class HostLocalIpam:
         }
 
     def delete(self, cfg: dict, network: str, sandbox: str,
-               ifname: Optional[str] = None):
+               ifname: Optional[str] = None) -> None:
         """Release this sandbox's address for *ifname*; with ifname None,
         release every address the sandbox holds (full sandbox teardown).
 
@@ -148,7 +148,7 @@ class HostLocalIpam:
             self._delete_locked(net_dir, sandbox, ifname)
 
     def _delete_locked(self, net_dir: str, sandbox: str,
-                       ifname: Optional[str]):
+                       ifname: Optional[str]) -> None:
         owner = f"{sandbox} {ifname}" if ifname else None
         try:
             entries = os.listdir(net_dir)
@@ -185,7 +185,7 @@ class StaticIpam:
                 "dns": dict(cfg.get("dns") or {})}
 
     def delete(self, cfg: dict, network: str, sandbox: str,
-               ifname: Optional[str] = None):
+               ifname: Optional[str] = None) -> None:
         pass  # nothing allocated
 
 
@@ -218,7 +218,7 @@ class ExecIpam:
     TIMEOUT = 45.0  # dhcp leases can take a while; bounded regardless
 
     def __init__(self, binary: str, netns: str = "",
-                 cni_path: Optional[str] = None):
+                 cni_path: Optional[str] = None) -> None:
         self.binary = binary
         self.netns = netns
         self.cni_path = (cni_path if cni_path is not None
@@ -280,11 +280,11 @@ class ExecIpam:
                 "dns": dict(result.get("dns") or {})}
 
     def delete(self, cfg: dict, network: str, sandbox: str,
-               ifname: Optional[str] = None):
+               ifname: Optional[str] = None) -> None:
         self._invoke("DEL", cfg, network, sandbox, ifname or "")
 
 
-def _delegate(cfg: dict, data_dir: str, netns: str = ""):
+def _delegate(cfg: dict, data_dir: str, netns: str = '') -> Any:
     kind = cfg.get("type", "")
     if kind == "host-local":
         # built-ins stay authoritative for host-local/static: their
@@ -315,7 +315,7 @@ def ipam_add(netconf_ipam: dict, data_dir: str, network: str,
 
 
 def ipam_del(netconf_ipam: dict, data_dir: str, network: str,
-             sandbox: str, ifname: Optional[str] = None, netns: str = ""):
+             sandbox: str, ifname: Optional[str] = None, netns: str = "") -> None:
     """Delegate-DEL; ifname None releases all of the sandbox's addresses."""
     if not netconf_ipam:
         return

@@ -11,13 +11,14 @@ from __future__ import annotations
 import logging
 
 from ..utils.tracing import TraceContextFilter
+from typing import Any
 
 _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
            "warning": logging.WARNING, "error": logging.ERROR,
            "panic": logging.CRITICAL}
 
 
-def request_logger(pod_req) -> logging.LoggerAdapter:
+def request_logger(pod_req: Any) -> logging.LoggerAdapter:
     """Logger for one CNI invocation, labelled and routed per NetConf.
     Records are stamped with the request's trace_id/span_id (the context
     the CNI server adopted from the shim's traceparent), so a pod's CNI

@@ -16,9 +16,9 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutTimeout
 from http.server import BaseHTTPRequestHandler
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
-from ..utils import metrics, resilience, tracing, watchdog
+from ..utils import metrics, resilience, tracing, validate, watchdog
 from ..utils.tracing import span
 from .logging import request_logger
 from .types import (
@@ -31,6 +31,21 @@ from .types import (
 
 log = logging.getLogger(__name__)
 
+#: the shims enforce MAX_BODY = 1 MiB on the raw netconf; the wrapped
+#: CniRequest (env + escaped config JSON) needs headroom above that,
+#: and anything past 2 MiB is not a netconf — refuse before the read
+#: sizes a buffer
+MAX_BODY_BYTES = 2 * 1024 * 1024
+
+#: the CNI_COMMAND enumeration — metric labels derived from the wire
+#: ride through bounded_label against this set (unbounded label values
+#: are unbounded cardinality)
+_COMMANDS = frozenset({"ADD", "DEL", "CHECK"})
+
+
+def _cmd_label(pod_req: PodRequest) -> str:
+    return metrics.bounded_label(pod_req.command, _COMMANDS)
+
 
 class _UnixHTTPServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     daemon_threads = True
@@ -40,7 +55,7 @@ class _UnixHTTPServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer
     # reference listens with somaxconn, cniserver.go:52-67)
     request_queue_size = 128
 
-    def get_request(self):
+    def get_request(self) -> Any:
         request, _ = super().get_request()
         # BaseHTTPRequestHandler wants a client address tuple
         return request, ("unix", 0)
@@ -59,7 +74,7 @@ class _FrozenRequest:
     The server thread blocks on ``done``; whoever completes the handoff
     (or aborts it) supplies the response."""
 
-    def __init__(self, pod_req: PodRequest):
+    def __init__(self, pod_req: PodRequest) -> None:
         self.pod_req = pod_req
         self.done = threading.Event()
         self.response: Optional[CniResponse] = None
@@ -81,7 +96,7 @@ class CniServer:
                  add_handler: Optional[Callable[[PodRequest], dict]] = None,
                  del_handler: Optional[Callable[[PodRequest], dict]] = None,
                  timeout: float = CNI_TIMEOUT,
-                 retry: Optional[resilience.RetryPolicy] = None):
+                 retry: Optional[resilience.RetryPolicy] = None) -> None:
         self.socket_path = socket_path
         self.add_handler = add_handler
         self.del_handler = del_handler
@@ -115,7 +130,7 @@ class CniServer:
         #: plus slack means the timeout machinery itself wedged
         self._heartbeat = None
 
-    def start(self):
+    def start(self) -> None:
         os.makedirs(os.path.dirname(self.socket_path), mode=0o700,
                     exist_ok=True)
         if os.path.exists(self.socket_path):
@@ -125,15 +140,19 @@ class CniServer:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def log_message(self, fmt, *args):
+            def log_message(self, fmt: str, *args: object) -> None:
                 log.debug("cni-server: " + fmt, *args)
 
-            def do_POST(self):
+            def do_POST(self) -> None:
                 if self.path != "/cni":
                     self._reply(404, CniResponse(error="not found"))
                     return
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
+                    # clamped BEFORE it sizes the read: a hostile
+                    # Content-Length must refuse here, not allocate
+                    length = validate.clamped_int(
+                        self.headers.get("Content-Length", 0),
+                        0, MAX_BODY_BYTES, "Content-Length")
                     body = json.loads(self.rfile.read(length) or b"{}")
                     # adopt the shim's trace context (W3C traceparent);
                     # a malformed/hostile header extracts to None and
@@ -147,7 +166,7 @@ class CniServer:
                     log.exception("cni request failed")
                     self._reply(500, CniResponse(error=str(e)))
 
-            def _reply(self, code: int, resp: CniResponse):
+            def _reply(self, code: int, resp: CniResponse) -> None:
                 data = json.dumps(resp.to_dict()).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -166,7 +185,7 @@ class CniServer:
         self._thread.start()
         log.info("CNI server on %s", self.socket_path)
 
-    def stop(self):
+    def stop(self) -> None:
         if self._server:
             self._server.shutdown()
             self._server.server_close()
@@ -289,7 +308,7 @@ class CniServer:
                 # late mutation here would steer state the new daemon
                 # never learns about — fail fast, kubelet's retry hits
                 # the socket the new daemon has (re)bound
-                metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                metrics.CNI_REQUESTS.inc(command=_cmd_label(pod_req),
                                          result="handed_off")
                 return CniResponse(error=(
                     "daemon handed off; retry against the new daemon"))
@@ -303,7 +322,7 @@ class CniServer:
                 # dispatch in drain()'s count
                 self._inflight_mutations += 1
         if frozen is not None:
-            metrics.CNI_REQUESTS.inc(command=pod_req.command,
+            metrics.CNI_REQUESTS.inc(command=_cmd_label(pod_req),
                                      result="queued_handoff")
             if not frozen.done.wait(timeout=self.timeout):
                 with self._freeze_lock:
@@ -346,7 +365,7 @@ class CniServer:
         devices."""
         return isinstance(exc, (AlreadyGone, FileNotFoundError))
 
-    def _dispatch(self, handler, pod_req: PodRequest) -> CniResponse:
+    def _dispatch(self, handler: Any, pod_req: PodRequest) -> CniResponse:
         deadline = time.monotonic() + self.timeout
         attempt = 0
         # thread-local contexts do not follow work into the dispatch
@@ -362,7 +381,7 @@ class CniServer:
                 fut = self._pool.submit(handler, pod_req)
                 try:
                     result = fut.result(timeout=max(remaining, 0.0))
-                    metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                    metrics.CNI_REQUESTS.inc(command=_cmd_label(pod_req),
                                              result="ok")
                 except FutTimeout:
                     return self._timed_out(fut, pod_req, attempt)
@@ -401,7 +420,7 @@ class CniServer:
                             site="cni.ADD",
                             outcome="gave_up"
                             if resilience.is_transient(e) else "aborted")
-                    metrics.CNI_REQUESTS.inc(command=pod_req.command,
+                    metrics.CNI_REQUESTS.inc(command=_cmd_label(pod_req),
                                              result="error")
                     raise
                 if attempt:
@@ -411,9 +430,9 @@ class CniServer:
                     result=result or {"cniVersion":
                                       pod_req.netconf.cni_version})
 
-    def _timed_out(self, fut, pod_req: PodRequest,
+    def _timed_out(self, fut: Any, pod_req: PodRequest,
                    attempt: int = 0) -> CniResponse:
-        metrics.CNI_REQUESTS.inc(command=pod_req.command, result="timeout")
+        metrics.CNI_REQUESTS.inc(command=_cmd_label(pod_req), result="timeout")
         if attempt:
             # a retried ADD that then hung still closes its accounting:
             # retried − ok − gave_up must balance per site
@@ -428,7 +447,7 @@ class CniServer:
         if pod_req.command == "ADD" and self.del_handler is not None:
             rollback = self.del_handler
 
-            def _undo_late_add(f):
+            def _undo_late_add(f: Any) -> None:
                 if f.cancelled() or f.exception() is not None:
                     return
                 log.warning("late CNI ADD success after timeout; "

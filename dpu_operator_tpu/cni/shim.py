@@ -20,6 +20,7 @@ import socket
 import sys
 import time
 import uuid
+from typing import Any, Optional
 
 _CNI_ENV_KEYS = ("CNI_COMMAND", "CNI_CONTAINERID", "CNI_NETNS", "CNI_IFNAME",
                  "CNI_ARGS", "CNI_PATH")
@@ -53,9 +54,9 @@ def _trace_context() -> tuple:
     return trace_id, uuid.uuid4().hex[:16], parent_id
 
 
-def _emit_span(trace_id: str, span_id: str, parent_id, name: str,
-               start: float, duration_s: float, error: str = "",
-               **attributes) -> None:
+def _emit_span(trace_id: str, span_id: str, parent_id: Any, name: str,
+               start: float, duration_s: float, error: str = '',
+               **attributes: object) -> None:
     """Append one span record to TPU_OPERATOR_TRACE, matching
     utils/tracing.py's JSONL shape so one file holds the whole tree.
     O_APPEND single-write keeps concurrent shims from interleaving."""
@@ -78,7 +79,7 @@ def _emit_span(trace_id: str, span_id: str, parent_id, name: str,
         pass  # tracing must never fail the CNI result contract
 
 
-def _connect(sock, socket_path: str, deadline: float):
+def _connect(sock: Any, socket_path: str, deadline: float) -> None:
     """connect() on AF_UNIX returns EAGAIN immediately when the server's
     listen backlog is full (it never blocks like TCP) — retry briefly so
     bursts of parallel pod ADDs don't fail spuriously."""
@@ -152,10 +153,10 @@ def _traced_post(socket_path: str, payload: dict) -> dict:
 class CniShim:
     """Importable wrapper used by tests and the in-package client."""
 
-    def __init__(self, socket_path: str):
+    def __init__(self, socket_path: str) -> None:
         self.socket_path = socket_path
 
-    def invoke(self, env: dict, stdin_data: str):
+    def invoke(self, env: dict, stdin_data: str) -> Any:
         from .types import CniResponse
         config = json.loads(stdin_data or "{}")
         if env.get("CNI_COMMAND") == "CHECK":
@@ -168,7 +169,7 @@ class CniShim:
                            error=raw.get("error", ""))
 
 
-def main(argv=None) -> int:
+def main(argv: Optional[list] = None) -> int:
     socket_path = os.environ.get("TPU_CNI_SOCKET", DEFAULT_SOCKET)
     try:
         env = {k: os.environ[k] for k in _CNI_ENV_KEYS if k in os.environ}

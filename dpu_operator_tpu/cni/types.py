@@ -13,6 +13,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..utils import validate
+
 #: CNI request deadline — kubelet CRI op timeout parity (cniserver.go:226-227)
 CNI_TIMEOUT = 120.0
 
@@ -156,14 +158,30 @@ class PodRequest:
         if command not in ("ADD", "DEL", "CHECK"):
             raise ValueError(f"unexpected CNI_COMMAND {command!r}")
         netconf = NetConf.from_dict(req.config)
+        # ids that become file names deeper in (NetConf cache entries,
+        # chip-allocation locks) are refused at the boundary when they
+        # could escape the state dirs — kubelet never sends such ids,
+        # so anything hostile here is a forged request on the socket
+        sandbox_id = env.get("CNI_CONTAINERID", "")
+        if sandbox_id:
+            sandbox_id = validate.safe_path_segment(
+                sandbox_id, what="CNI_CONTAINERID")
+        ifname = env.get("CNI_IFNAME", "")
+        if ifname:
+            ifname = validate.safe_path_segment(
+                ifname, what="CNI_IFNAME", extra="@")
+        device_id = netconf.device_id or args.get("deviceID", "")
+        if device_id:
+            device_id = validate.safe_path_segment(
+                device_id, what="deviceID", extra=":/")
         return cls(
             command=command,
             pod_namespace=args.get("K8S_POD_NAMESPACE", ""),
             pod_name=args.get("K8S_POD_NAME", ""),
-            sandbox_id=env.get("CNI_CONTAINERID", ""),
+            sandbox_id=sandbox_id,
             netns=env.get("CNI_NETNS", ""),
-            ifname=env.get("CNI_IFNAME", ""),
-            device_id=netconf.device_id or args.get("deviceID", ""),
+            ifname=ifname,
+            device_id=device_id,
             netconf=netconf,
         )
 

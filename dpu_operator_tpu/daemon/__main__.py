@@ -11,9 +11,10 @@ from ..images import EnvImageManager
 from ..platform import HardwarePlatform
 from ..utils.path_manager import PathManager
 from .daemon import Daemon
+from typing import Optional
 
 
-def main(argv=None):
+def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser("tpu-daemon")
     parser.add_argument("--mode", default="auto",
                         choices=["host", "tpu", "auto"])

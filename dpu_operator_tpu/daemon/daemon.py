@@ -15,7 +15,7 @@ import os
 import shutil
 import threading
 import time
-from typing import Optional
+from typing import Any, Optional
 
 from ..platform.vendordetector import DetectorManager
 from ..utils.path_manager import PathManager
@@ -54,13 +54,13 @@ def _static_shim_binary() -> Optional[str]:
 
 
 class Daemon:
-    def __init__(self, platform, mode: str = "auto",
+    def __init__(self, platform: Any, mode: str = 'auto',
                  path_manager: Optional[PathManager] = None,
-                 client=None, image_manager=None,
+                 client: Any = None, image_manager: Any = None,
                  detector_manager: Optional[DetectorManager] = None,
-                 node_name: str = "", flavour: str = "kind",
-                 vsp_plugin_factory=None,
-                 detect_interval: float = 1.0):
+                 node_name: str = '', flavour: str = 'kind',
+                 vsp_plugin_factory: Any = None,
+                 detect_interval: float = 1.0) -> None:
         self.platform = platform
         self.mode = mode
         self.path_manager = path_manager or PathManager()
@@ -90,7 +90,7 @@ class Daemon:
         self._mgr_stopped = False
 
     # -- prepare (daemon.go:69, :195-209) -------------------------------------
-    def prepare(self):
+    def prepare(self) -> None:
         cni_dir = self.path_manager.cni_host_dir(self.flavour)
         os.makedirs(cni_dir, exist_ok=True)
         target = os.path.join(cni_dir, "tpu-cni")
@@ -109,14 +109,14 @@ class Daemon:
         os.replace(staging, target)
         log.info("installed CNI shim at %s (from %s)", target, source)
 
-    def _default_vsp(self, detection):
+    def _default_vsp(self, detection: Any) -> Any:
         return GrpcPlugin(detection, client=self.client,
                           image_manager=self.image_manager,
                           path_manager=self.path_manager,
                           node_name=self.node_name)
 
     # -- detection + lifecycle (daemon.go:86-193) -----------------------------
-    def detect_once(self):
+    def detect_once(self) -> Any:
         result = self.detector_manager.detect(self.platform)
         if result is None:
             return None
@@ -126,7 +126,7 @@ class Daemon:
             return None
         return result
 
-    def _create_manager(self, detection):
+    def _create_manager(self, detection: Any) -> Any:
         vsp = self.vsp_plugin_factory(detection)
         workload_image = ""
         if self.image_manager is not None:
@@ -143,7 +143,7 @@ class Daemon:
         return HostSideManager(vsp, self.path_manager, client=self.client,
                                workload_image=workload_image)
 
-    def _run_manager(self, mgr):
+    def _run_manager(self, mgr: Any) -> None:
         try:
             mgr.start_vsp()
             mgr.setup_devices()
@@ -181,7 +181,7 @@ class Daemon:
         return (self.manager is not None and self._error is None
                 and not self._stop.is_set())
 
-    def _start_health_server(self):
+    def _start_health_server(self) -> None:
         port = os.environ.get("TPU_DAEMON_HEALTH_PORT", "")
         if not port or self.health_server is not None:
             return
@@ -199,7 +199,7 @@ class Daemon:
             self.health_server = None  # the daemon down
             log.exception("daemon health server failed to start")
 
-    def _start_health_engine(self):
+    def _start_health_engine(self) -> None:
         """Watchdog checker + SLO evaluator threads (idempotent
         globals) and the Kubernetes Event seam anchored to this node.
         The health engine must come up even when the apiserver is down
@@ -217,7 +217,7 @@ class Daemon:
             except Exception:  # noqa: BLE001 — observability must not
                 log.exception("event recorder setup failed")  # kill it
 
-    def serve(self, block: bool = True):
+    def serve(self, block: bool = True) -> None:
         """1 Hz detect loop; returns when stopped or a manager errored."""
         self._start_health_engine()
         self._start_health_server()
@@ -236,7 +236,7 @@ class Daemon:
             if heartbeat is not None:
                 heartbeat.close()
 
-    def _serve_loop(self, block: bool, heartbeat):
+    def _serve_loop(self, block: bool, heartbeat: Any) -> None:
         while not self._stop.is_set():
             if heartbeat is not None:
                 heartbeat.beat()
@@ -271,7 +271,7 @@ class Daemon:
         if self._error is not None:
             raise RuntimeError("side manager failed") from self._error
 
-    def prepare_and_serve(self, block: bool = True):
+    def prepare_and_serve(self, block: bool = True) -> None:
         self.prepare()
         self.serve(block=block)
 
@@ -288,14 +288,14 @@ class Daemon:
             time.sleep(0.05)
         return False
 
-    def _stop_manager(self):
+    def _stop_manager(self) -> None:
         with self._mgr_stop_lock:
             if self._mgr_stopped or self.manager is None:
                 return
             self._mgr_stopped = True
         self.manager.stop()
 
-    def request_stop(self):
+    def request_stop(self) -> None:
         """Signal-handler-safe stop: only set the event. A handler runs
         on the main thread, which may be inside _stop_manager() holding
         the non-reentrant _mgr_stop_lock (the serve-loop exit path) —
@@ -304,7 +304,7 @@ class Daemon:
         observes the event and runs the orderly teardown itself."""
         self._stop.set()
 
-    def stop(self):
+    def stop(self) -> None:
         self._stop.set()
         self._stop_manager()
         if self._serve_thread:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import re
 import threading
+from typing import Any
 
 log = logging.getLogger(__name__)
 
@@ -24,9 +25,9 @@ _PCI_RE = re.compile(
 
 
 class TpuDeviceHandler:
-    def __init__(self, vsp, tpu_mode: bool,
+    def __init__(self, vsp: Any, tpu_mode: bool,
                  num_chips: int = DEFAULT_NUM_CHIPS,
-                 topology_provider=None):
+                 topology_provider: Any = None) -> None:
         """*topology_provider*: optional callable -> SliceTopology | None.
         Host-side devices arrive with a stable ``chip_index`` but no
         torus coords (the host VSP enumerates PCIe functions, not the
@@ -40,7 +41,7 @@ class TpuDeviceHandler:
         self.topology_provider = topology_provider
         self._setup_done = threading.Event()
 
-    def setup_devices(self):
+    def setup_devices(self) -> None:
         """SetNumChips; failures tolerated in tpu mode (the VSP may not
         support resizing a fixed slice — dpudevicehandler.go:92-97)."""
         try:
@@ -66,7 +67,7 @@ class TpuDeviceHandler:
             self._decorate_coords(devs)
         return devs
 
-    def _decorate_coords(self, devs: dict):
+    def _decorate_coords(self, devs: dict) -> None:
         topo = self.topology_provider() if self.topology_provider else None
         if topo is None:
             return
@@ -89,7 +90,8 @@ class IciPortDeviceHandler:
     torus coords so GetPreferredAllocation can co-locate a pod's ports
     with its chips."""
 
-    def __init__(self, topology_provider, link_prober_provider=None):
+    def __init__(self, topology_provider: Any,
+                 link_prober_provider: Any = None) -> None:
         """*topology_provider*: callable returning (SliceTopology | None,
         host_index). *link_prober_provider*: callable returning the
         current prober (chip -> [{"port","up","wired","fault"}]) or
@@ -98,7 +100,7 @@ class IciPortDeviceHandler:
         self.topology_provider = topology_provider
         self.link_prober_provider = link_prober_provider
 
-    def _port_states(self, prober, chip: int, cache: dict) -> dict:
+    def _port_states(self, prober: Any, chip: int, cache: dict) -> dict:
         if chip not in cache:
             try:
                 cache[chip] = {p["port"]: p for p in prober(chip)}

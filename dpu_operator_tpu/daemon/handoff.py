@@ -48,12 +48,12 @@ import struct
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from ..cni.server import handoff_key
 from ..cni.types import NetConf, PodRequest
 from ..k8s import events
-from ..utils import flight, metrics, resilience
+from ..utils import flight, metrics, resilience, validate
 from ..utils.atomicfile import atomic_claim, atomic_write
 
 log = logging.getLogger(__name__)
@@ -190,7 +190,7 @@ class HandoffStatus:
 STATUS = HandoffStatus()
 
 
-def freeze_mutations(cni_server, manager) -> bool:
+def freeze_mutations(cni_server: Any, manager: Any) -> bool:
     """Shared freeze sequence for both side managers: queue CNI
     mutations, pause the reconciler, then DRAIN both so nothing is
     mid-mutation when the bundle serializes. Returns False when
@@ -214,7 +214,8 @@ def freeze_mutations(cni_server, manager) -> bool:
     return drained
 
 
-def drain_mutations(cni_server, manager, timeout: float = 5.0) -> bool:
+def drain_mutations(cni_server: Any, manager: Any,
+                    timeout: float = 5.0) -> bool:
     """Re-check the freeze drain (dispatch pool + reconciler) with a
     fresh *timeout* — the serve path converts the time spent waiting
     for the incoming daemon to connect into extra drain budget."""
@@ -224,7 +225,7 @@ def drain_mutations(cni_server, manager, timeout: float = 5.0) -> bool:
     return drained
 
 
-def thaw_mutations(cni_server, manager,
+def thaw_mutations(cni_server: Any, manager: Any,
                    dispatch_queued: bool = True) -> None:
     """Shared abort-path thaw. *dispatch_queued*=False when the bundle
     already reached the peer and the ACK was lost: the peer may have
@@ -247,7 +248,7 @@ class HandoffStarter:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
-    def begin(self, manager, socket_path: str, timeout: float = 30.0,
+    def begin(self, manager: Any, socket_path: str, timeout: float = 30.0,
               on_complete: Optional[Callable[[], None]] = None) -> bool:
         """Serve *manager*'s state bundle in a background thread
         (SIGUSR2 / AdminService.BeginHandoff). Returns False when a
@@ -306,7 +307,7 @@ def _dump_state_dir(path: str) -> dict:
     return out
 
 
-def collect_bundle(manager, pending_cni: tuple = ()) -> dict:
+def collect_bundle(manager: Any, pending_cni: tuple = ()) -> dict:
     """Assemble the versioned state bundle from a live side manager
     (duck-typed: tpu- and host-side managers carry different subsets)."""
     bundle: dict = {"schema": SCHEMA_VERSION,
@@ -378,13 +379,24 @@ def _reconcile_state_dir(directory: str, entries: dict, label: str,
     defensive DEL path owns its cleanup)."""
     on_disk = _dump_state_dir(directory)
     for name, content in entries.items():
+        try:
+            # bundle entry names become file names: a corrupt (or
+            # hostile) bundle must not write outside the state dir —
+            # refused entries are discrepancies, not crashes, so
+            # adoption of the healthy remainder proceeds
+            safe_name = validate.safe_path_segment(
+                name, what=f"{label} bundle entry name")
+        except ValueError as e:
+            report.discrepancy(f"{label}-invalid-name",
+                               f"refused bundle entry: {e}")
+            continue
         if name not in on_disk:
             report.discrepancy(
                 f"{label}-missing-on-disk",
                 f"{name}: restored from the handoff bundle")
             try:
                 os.makedirs(directory, exist_ok=True)
-                writer(os.path.join(directory, name), content)
+                writer(os.path.join(directory, safe_name), content)
             except OSError:
                 log.exception("restoring %s/%s from bundle failed",
                               directory, name)
@@ -400,7 +412,7 @@ def _reconcile_state_dir(directory: str, entries: dict, label: str,
                 f"{name}: on disk but unknown to the outgoing daemon")
 
 
-def adopt_bundle(manager, bundle: dict,
+def adopt_bundle(manager: Any, bundle: dict,
                  handoff_id: int = 0) -> AdoptionReport:
     """Adopt a received bundle into a freshly-constructed side manager
     (its servers must not be listening yet), reconciling every layer
@@ -479,7 +491,7 @@ def adopt_bundle(manager, bundle: dict,
     return report
 
 
-def _apply_pending_cni(manager, pending: list) -> dict:
+def _apply_pending_cni(manager: Any, pending: list) -> dict:
     """Apply CNI mutations queued during the outgoing daemon's freeze
     window — exactly once, here, on the adopted state. The results ride
     the ACK frame back so the outgoing daemon can answer the blocked
@@ -513,7 +525,7 @@ def _apply_pending_cni(manager, pending: list) -> dict:
 
 # -- outgoing side ------------------------------------------------------------
 
-def serve_handoff(manager, socket_path: str, timeout: float = 30.0,
+def serve_handoff(manager: Any, socket_path: str, timeout: float = 30.0,
                   on_complete: Optional[Callable[[], None]] = None) -> str:
     """Freeze *manager* and serve its state bundle on *socket_path*
     until an incoming daemon adopts (ACK) or *timeout* expires.
@@ -624,9 +636,8 @@ def _cleanup_listener(listener: socket.socket, socket_path: str) -> None:
         pass
 
 
-def _abort_handoff(manager, socket_path: str, started: float,
-                   hid: int, reason: str,
-                   dispatch_queued: bool = True) -> str:
+def _abort_handoff(manager: Any, socket_path: str, started: float, hid: int,
+                   reason: str, dispatch_queued: bool = True) -> str:
     duration = time.monotonic() - started
     log.warning("handoff aborted after %.3fs: %s — thawing and "
                 "continuing to serve%s", duration, reason,
@@ -644,7 +655,7 @@ def _abort_handoff(manager, socket_path: str, started: float,
 
 # -- incoming side ------------------------------------------------------------
 
-def adopt_into(manager, socket_path: str, timeout: float = 5.0) -> bool:
+def adopt_into(manager: Any, socket_path: str, timeout: float = 5.0) -> bool:
     """Dial an outgoing daemon's handoff socket and adopt its bundle.
 
     Returns True on successful adoption (the caller must SKIP cold-start

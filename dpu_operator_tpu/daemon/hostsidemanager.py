@@ -15,7 +15,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import grpc
 
@@ -38,9 +38,9 @@ log = logging.getLogger(__name__)
 
 
 class HostSideManager:
-    def __init__(self, vsp_plugin, path_manager: PathManager,
-                 client=None, dial_retries: int = 8,
-                 dial_backoff: float = 0.25, workload_image: str = ""):
+    def __init__(self, vsp_plugin: Any, path_manager: PathManager,
+                 client: Any = None, dial_retries: int = 8,
+                 dial_backoff: float = 0.25, workload_image: str = '') -> None:
         self.vsp = vsp_plugin
         self.path_manager = path_manager
         self.client = client
@@ -76,15 +76,15 @@ class HostSideManager:
         self.handoff_on_complete: Optional[Callable[[], None]] = None
 
     # -- SideManager lifecycle (daemon.go:23-28) ------------------------------
-    def start_vsp(self):
+    def start_vsp(self) -> None:
         ip, port = self.vsp.start(tpu_mode=False)
         self._tpu_daemon_addr = (ip, port)
         log.info("host side: tpu-side daemon at %s:%d", ip, port)
 
-    def setup_devices(self):
+    def setup_devices(self) -> None:
         self.device_handler.setup_devices()
 
-    def listen(self):
+    def listen(self) -> None:
         # adopt a live handoff from an outgoing daemon before any
         # server binds: the device-plugin allocation snapshot, NetConf
         # cache and chip-allocation locks carry over so no pod observes
@@ -97,7 +97,7 @@ class HostSideManager:
         self.device_plugin.start()
         self.cni_server.start()
 
-    def serve(self):
+    def serve(self) -> None:
         self.device_plugin.register_with_kubelet()
         # survive kubelet restarts: re-register when kubelet.sock is
         # recreated (the restart wipes the plugin registry)
@@ -120,7 +120,7 @@ class HostSideManager:
         return sites + handoff.STATUS.degraded_components()
 
     # -- live handoff (daemon/handoff.py) -------------------------------------
-    def freeze_for_handoff(self):
+    def freeze_for_handoff(self) -> Any:
         """Stop mutating (CNI ADD/DEL queue, reconciler pauses, both
         drained — nothing is mid-mutation when the bundle serializes;
         False on drain timeout, re-checked by the serve path) while
@@ -132,12 +132,12 @@ class HostSideManager:
         return handoff_mod.drain_mutations(self.cni_server, self._manager,
                                            timeout=timeout)
 
-    def thaw_after_handoff(self, dispatch_queued: bool = True):
+    def thaw_after_handoff(self, dispatch_queued: bool = True) -> None:
         handoff_mod.thaw_mutations(self.cni_server, self._manager,
                                    dispatch_queued=dispatch_queued)
 
     def begin_handoff(self, timeout: float = 30.0,
-                      on_complete=None) -> bool:
+                      on_complete: Any = None) -> bool:
         """Serve a live state handoff in the background (SIGUSR2 /
         AdminService.BeginHandoff); without an explicit *on_complete*
         the daemon-set ``handoff_on_complete`` hook stops the process
@@ -146,7 +146,7 @@ class HostSideManager:
             self, self.path_manager.handoff_socket(), timeout=timeout,
             on_complete=on_complete or self.handoff_on_complete)
 
-    def stop(self):
+    def stop(self) -> None:
         if self._manager:
             self._manager.stop()
         self.cni_server.stop()
@@ -199,13 +199,13 @@ class HostSideManager:
     #: ListAndWatch poll and CNI ADD
     TOPOLOGY_RETRY_COOLDOWN = 5.0
 
-    def _fetch_slice_topology(self):
+    def _fetch_slice_topology(self) -> Any:
         """Slice topology for host-side coords decoration, learned from
         the TPU-side daemon's GetSliceInfo over the cross-boundary plane.
         ONE dial attempt with a short deadline, TTL'd on success,
         cooldown'd on failure; a failed refresh keeps serving the last
         known topology (stale coords beat none until the next success)."""
-        def stale():
+        def stale() -> Any:
             now = time.monotonic()
             fresh = (self._slice_topology is not None
                      and now - self._topology_ok_at < self.TOPOLOGY_TTL)

@@ -17,6 +17,7 @@ from ..k8s.informer import cached_list
 from ..k8s.manager import ReconcileResult, Request
 from ..utils import resilience, tracing
 from ..utils import vars as v
+from typing import Any, Optional
 
 log = logging.getLogger(__name__)
 
@@ -41,11 +42,11 @@ class SfcReconciler:
     #: repair change status without generating SFC watch events
     RESYNC_SECONDS = 5.0
 
-    def __init__(self, workload_image: str = "",
-                 chain_status_provider=None, boundary_sync=None,
-                 cross_host_sync=None, degraded_provider=None,
-                 slice_degraded_provider=None,
-                 retry: resilience.RetryPolicy = None):
+    def __init__(self, workload_image: str = '',
+                 chain_status_provider: Any = None, boundary_sync: Any = None,
+                 cross_host_sync: Any = None, degraded_provider: Any = None,
+                 slice_degraded_provider: Any = None,
+                 retry: Optional[resilience.RetryPolicy] = None) -> None:
         """*chain_status_provider*: callable (namespace, name) -> list of
         hop dicts ({index, input, output, degraded}) from the live wire
         table — the TpuSideManager passes its own (chain_status).
@@ -76,7 +77,7 @@ class SfcReconciler:
         self.retry = retry or resilience.RetryPolicy(
             max_attempts=3, base=0.05, cap=0.5)
 
-    def _network_function_pod(self, sfc: ServiceFunctionChain, nf,
+    def _network_function_pod(self, sfc: ServiceFunctionChain, nf: Any,
                               index: int = 0) -> dict:
         """NF pod spec (sfc.go:32-72): two NAD attachments + 2 chips.
         Chain annotations let the tpu-side manager steer traffic between
@@ -122,7 +123,7 @@ class SfcReconciler:
             },
         }
 
-    def reconcile(self, client, req: Request) -> ReconcileResult:
+    def reconcile(self, client: Any, req: Request) -> ReconcileResult:
         obj = client.get(API_VERSION, "ServiceFunctionChain", req.name,
                          namespace=req.namespace)
         if obj is None:
@@ -136,7 +137,7 @@ class SfcReconciler:
                           namespace=sfc.namespace, name=sfc.name):
             return self._reconcile_traced(client, obj, sfc)
 
-    def _reconcile_traced(self, client, obj: dict,
+    def _reconcile_traced(self, client: Any, obj: dict,
                           sfc: ServiceFunctionChain) -> ReconcileResult:
         scheduled = ready = 0
         # the pod read rides the informer cache (k8s/informer.py): under
@@ -210,7 +211,7 @@ class SfcReconciler:
         self._write_status(client, obj, sfc, scheduled, ready)
         return ReconcileResult(requeue_after=self.RESYNC_SECONDS)
 
-    def _rollback(self, client, namespace: str, created: list):
+    def _rollback(self, client: Any, namespace: str, created: list) -> None:
         """Undo this pass's partial NF programming: the chain either
         lands whole or not at all (a lone mid-chain NF pod would wire a
         dangling hop the moment its CNI ADD runs). Best-effort — the
@@ -224,8 +225,8 @@ class SfcReconciler:
             except Exception:  # noqa: BLE001 — GC catches leftovers
                 log.warning("rollback of NF pod %s failed", name)
 
-    def _write_status(self, client, obj: dict, sfc: ServiceFunctionChain,
-                      scheduled: int, ready: int):
+    def _write_status(self, client: Any, obj: dict, sfc: ServiceFunctionChain,
+                      scheduled: int, ready: int) -> None:
         """Surface chain readiness on the CR (the reference's cluster-side
         SFC controller is an empty stub, servicefunctionchain_controller.go
         :49-55 — this is a beat-not-match feature): NF pods scheduled/

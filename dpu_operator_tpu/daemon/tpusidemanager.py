@@ -17,7 +17,7 @@ import random
 import re
 import threading
 import zlib
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from ..cni import CniServer
 from ..cni.announce import announce_result
@@ -46,7 +46,7 @@ class _SliceServiceForwarder:
     the daemon's admin plane (resize with drain — the path tpuctl
     resize-chips uses instead of raw SetNumChips)."""
 
-    def __init__(self, vsp, manager=None):
+    def __init__(self, vsp: Any, manager: Any = None) -> None:
         self.vsp = vsp
         self.manager = manager
 
@@ -148,8 +148,9 @@ class _SliceServiceForwarder:
 
 
 class TpuSideManager:
-    def __init__(self, vsp_plugin, path_manager: PathManager, client=None,
-                 workload_image: str = "", node_name: str = ""):
+    def __init__(self, vsp_plugin: Any, path_manager: PathManager,
+                 client: Any = None, workload_image: str = '',
+                 node_name: str = '') -> None:
         self.vsp = vsp_plugin
         self.path_manager = path_manager
         self.client = client
@@ -223,14 +224,14 @@ class TpuSideManager:
         self.handoff_on_complete: Optional[Callable[[], None]] = None
 
     # -- SideManager lifecycle ------------------------------------------------
-    def start_vsp(self):
+    def start_vsp(self) -> None:
         ip, port = self.vsp.start(tpu_mode=True)
         self._addr = (ip, port)
 
-    def setup_devices(self):
+    def setup_devices(self) -> None:
         self.device_handler.setup_devices()
 
-    def listen(self):
+    def listen(self) -> None:
         # state recovery strictly BEFORE any server goes live: a
         # retried CNI DEL landing pre-recovery would find an empty
         # attach store, release only IPAM, then be clobbered by recovery
@@ -255,7 +256,7 @@ class TpuSideManager:
         self.device_plugin.start()
         self.cni_server.start()
 
-    def serve(self):
+    def serve(self) -> None:
         # advertise google.com/ici-port once the VSP reported its slice
         # topology (the BASELINE north-star: ICI links schedulable
         # alongside chips); worker index from the TPU VM environment
@@ -313,8 +314,9 @@ class TpuSideManager:
                 log.warning("chain repair disabled: agent socket %s not "
                             "connectable", agent_sock)
 
-    def enable_chain_repair(self, prober, interval: float = 5.0,
-                            max_interval: float = 0.0, jitter_seed=None):
+    def enable_chain_repair(self, prober: Any, interval: float = 5.0,
+                            max_interval: float = 0.0,
+                            jitter_seed: Any = None) -> None:
         """Start the periodic hop-repair loop (reference has no analog:
         its chain flow rules stay broken until pod churn; the bar is
         beat, not match).
@@ -351,7 +353,8 @@ class TpuSideManager:
             return interval
         return min(delay * 2, max_interval)
 
-    def _repair_loop(self, interval: float, max_interval: float, rng):
+    def _repair_loop(self, interval: float, max_interval: float,
+                     rng: Any) -> None:
         from ..utils import watchdog
         heartbeat = watchdog.register(
             "tpuside.chain-repair", deadline=max(30.0, max_interval * 6))
@@ -373,7 +376,7 @@ class TpuSideManager:
         finally:
             heartbeat.close()
 
-    def _repair_tick(self, heartbeat) -> bool:
+    def _repair_tick(self, heartbeat: Any) -> bool:
         """One guarded probe+repair pass; True when it found work (the
         backoff resets). A raising prober (or any bug in the pass) must
         not silently end the pass: the swallow is COUNTED
@@ -439,7 +442,7 @@ class TpuSideManager:
                 engine.ingest_link_probe(chip.index, ports))
         return transitions, probe_cache
 
-    def _slice_topology(self):
+    def _slice_topology(self) -> Any:
         """SliceTopology of this slice, or None before the VSP reported
         one (the fault engine degrades to per-unit verdicts until
         then)."""
@@ -452,7 +455,7 @@ class TpuSideManager:
         except ValueError:
             return None
 
-    def _on_fault_transition(self, transition) -> None:
+    def _on_fault_transition(self, transition: Any) -> None:
         """Fault-engine listener: withdraw/restore must not wait for
         the next 5 s poll. Wake both ListAndWatch streams so kubelet
         sees the verdict now, and nudge the repair loop so steering
@@ -486,7 +489,7 @@ class TpuSideManager:
             if dp is not None:
                 dp.poke()
 
-    def stop(self):
+    def stop(self) -> None:
         self._flush_chains()
         with self._peer_channels_lock:
             channels = list(self._peer_channels.values())
@@ -568,7 +571,7 @@ class TpuSideManager:
                         log.exception("uncordon %s failed", node_name)
             return evicted
 
-    def _refresh_device_plugins(self):
+    def _refresh_device_plugins(self) -> None:
         """Force both device plugins to re-advertise immediately."""
         for dp in (self.device_plugin, self.ici_device_plugin):
             if dp is not None:
@@ -586,7 +589,7 @@ class TpuSideManager:
                     log.exception("device plugin refresh failed")
 
     # -- CNI network-function handlers (dpusidemanager.go:104-139) ------------
-    def _unwire_quietly(self, ids: tuple, context: str):
+    def _unwire_quietly(self, ids: tuple, context: str) -> None:
         """Defensive unwind: best-effort delete_network_function with the
         failure logged, never raised (DEL/unwind paths must make progress)."""
         try:
@@ -720,7 +723,7 @@ class TpuSideManager:
         in_id = down_ports[0] if down_ports else downstream["in"]
         return (out_id, in_id)
 
-    def _update_chain(self, req: PodRequest, pair: tuple):
+    def _update_chain(self, req: PodRequest, pair: tuple) -> None:
         """After a pod's own NF is wired, steer the chain: wire this NF's
         egress to the next NF's ingress (and previous egress to this
         ingress) once both sides exist — the ICI analog of the reference's
@@ -819,8 +822,8 @@ class TpuSideManager:
     INGRESS_HOP = -1
     EGRESS_HOP = -2
 
-    def _desired_boundary_hops(self, chain: dict, ingress: str,
-                               egress: str, last_index) -> dict:
+    def _desired_boundary_hops(self, chain: dict, ingress: str, egress: str,
+                               last_index: Any) -> dict:
         """Boundary hops the current chain state calls for (lock held)."""
         desired = {}
         if ingress and 0 in chain:
@@ -957,7 +960,7 @@ class TpuSideManager:
         return self.__dict__.setdefault("_peer_channels_lock_obj",
                                         threading.Lock())
 
-    def _advertise_address(self):
+    def _advertise_address(self) -> None:
         """Publish this daemon's cross-boundary ip:port on its Node
         object so peer daemons can steer cross-host hops through it."""
         if self.client is None or not self.node_name:
@@ -1025,7 +1028,7 @@ class TpuSideManager:
                           exc_info=True)
             raise
 
-    def _unwire_remote(self, addr: str, ids: tuple, context: str):
+    def _unwire_remote(self, addr: str, ids: tuple, context: str) -> None:
         """Best-effort remote-half unwind (the cross-host analog of
         _unwire_quietly)."""
         try:
@@ -1060,7 +1063,7 @@ class TpuSideManager:
         self._sync_cross_host(namespace, name, sfc_obj)
         self._flush_chains()
 
-    def _sync_cross_host(self, namespace: str, name: str, sfc_obj: dict):
+    def _sync_cross_host(self, namespace: str, name: str, sfc_obj: dict) -> None:
         nfs = (sfc_obj.get("spec", {}) or {}).get("networkFunctions") or []
         key = (namespace, name)
         with tracing.span("tpuside.cross_host_sync", namespace=namespace,
@@ -1070,7 +1073,7 @@ class TpuSideManager:
             self._sync_cross_host_traced(key, nfs, namespace, name)
 
     def _sync_cross_host_traced(self, key: tuple, nfs: list,
-                                namespace: str, name: str):
+                                namespace: str, name: str) -> None:
         self._retry_mirror_pending()
         with self._attach_lock:
             chain = {i: dict(e)
@@ -1092,7 +1095,7 @@ class TpuSideManager:
                 log.exception("cross-host hop %s/%s[%d] sync failed",
                               namespace, name, i)
 
-    def _rewire_migrated_hop(self, key: tuple, i: int):
+    def _rewire_migrated_hop(self, key: tuple, i: int) -> None:
         """Both NFs of hop i are local now, but the hop table still
         carries a cross-host wire (remote-marked): wire the local pair,
         then tear the stale wire down on both dataplanes, so a
@@ -1132,7 +1135,7 @@ class TpuSideManager:
         self._unwire_quietly(old, "migrated NF hop")
         self._unwire_remote(remote, old, "migrated NF hop")
 
-    def _retry_mirror_pending(self):
+    def _retry_mirror_pending(self) -> None:
         """Re-drive peer-dataplane mirrors that failed during repair:
         without this, a briefly unreachable peer would keep steering its
         half of a repaired hop through the dead pair forever (the
@@ -1170,8 +1173,8 @@ class TpuSideManager:
             log.info("repair mirror caught up for %s at %s", hop_key,
                      addr)
 
-    def _remote_chain_entry(self, namespace: str, sfc_name: str,
-                            nf_spec: dict, index: int):
+    def _remote_chain_entry(self, namespace: str, sfc_name: str, nf_spec: dict,
+                            index: int) -> Any:
         """(addr, entry, reachable) for the daemon hosting NF *index*.
         entry=None with reachable=True means the peer answered 'not
         wired' (safe to tear the hop down); reachable=False means we
@@ -1215,7 +1218,7 @@ class TpuSideManager:
     #: same resync pass, and that must not fast-forward the threshold
     PEER_FAIL_DEDUP_S = 2.0
 
-    def _note_peer_unreachable(self, addr: str, hop_ids) -> None:
+    def _note_peer_unreachable(self, addr: str, hop_ids: Any) -> None:
         """Track consecutive peer-daemon failure rounds; at (and past)
         the threshold, feed the fault engine the authoritative
         host-lost signal (the 'peer daemon gone' case observe_host_lost
@@ -1247,7 +1250,7 @@ class TpuSideManager:
                             "engine", addr, count, host)
             engine.observe_host_lost(host)
 
-    def _note_peer_reachable(self, addr: str, hop_ids=None) -> None:
+    def _note_peer_reachable(self, addr: str, hop_ids: Any = None) -> None:
         """Reset the failure count AND feed the engine good chip probes
         for the peer's host while any of its chips are not healthy: a
         host-lost quarantine has no other probe source (only local
@@ -1285,7 +1288,7 @@ class TpuSideManager:
 
     _NF_ATTACH_RE = re.compile(r"^nf(\d+)-(\d+)$")
 
-    def _peer_host_of(self, hop_ids) -> Optional[int]:
+    def _peer_host_of(self, hop_ids: Any) -> Optional[int]:
         if not hop_ids:
             return None
         in_id = hop_ids[1]
@@ -1301,7 +1304,7 @@ class TpuSideManager:
         return None
 
     def _converge_remote_hop(self, key: tuple, i: int, up_entry: dict,
-                             nf_spec: dict):
+                             nf_spec: dict) -> None:
         hop_key = key + (i,)
         addr, entry, reachable = self._remote_chain_entry(
             key[0], key[1], nf_spec, i + 1)
@@ -1390,7 +1393,7 @@ class TpuSideManager:
     _CHIP_ID_RE = re.compile(r"^chip-(\d+)$")
 
     @staticmethod
-    def _slice_attachment_for(device_id) -> Optional[tuple]:
+    def _slice_attachment_for(device_id: Any) -> Optional[tuple]:
         """(attachment name, chip index) for an NF-consumed chip, or None
         for non-chip devices. The name is deliberately in the NF
         namespace (nf<worker>-<chip>) so it can never collide with — or
@@ -1403,7 +1406,7 @@ class TpuSideManager:
         return f"nf{worker}-{m.group(1)}", int(m.group(1))
 
     def _endpoint_link_down(self, endpoint: str, probe_cache: dict,
-                            dark=frozenset()) -> bool:
+                            dark: Any = frozenset()) -> bool:
         """True when *endpoint* is a port-addressed id whose physical
         link is down — or whose link the fault engine has JUDGED dark
         (*dark*: quarantined/held-down links plus links darkened by a
@@ -1556,7 +1559,7 @@ class TpuSideManager:
                         series=f"{hop_key[0]}/{hop_key[1]}#{hop_key[2]}")
         return repaired
 
-    def _save_chains_locked(self):
+    def _save_chains_locked(self) -> None:
         """Every wire-table MUTATION site calls this (lock held): keeps
         the /metrics gauge fresh and marks the journal dirty, so a daemon
         restart does not orphan steered hops (VERDICT r4 weak #3b).
@@ -1605,7 +1608,7 @@ class TpuSideManager:
                 if e.get("wired") and e.get("pair")},
         }
 
-    def _flush_chains(self):
+    def _flush_chains(self) -> None:
         """Coalesced journal writer. Called at the END of every public
         entry point that may have mutated the wire table (locks
         released); cheap no-op when nothing changed. One snapshot + one
@@ -1658,7 +1661,7 @@ class TpuSideManager:
                     self.__dict__["_chains_dirty"] = True
 
     @staticmethod
-    def _load_journal(path: str):
+    def _load_journal(path: str) -> Any:
         """Read the journal snapshot, falling back to the last-good
         hardlink when the primary is truncated/corrupt (a crash
         mid-write at the filesystem level). Never raises: daemon
@@ -1702,7 +1705,7 @@ class TpuSideManager:
         metrics.JOURNAL_RECOVERIES.inc(result="empty")
         return None
 
-    def _dataplane_ground(self):
+    def _dataplane_ground(self) -> Any:
         """Persisted wire pairs from the dataplane, or None when the
         VSP cannot enumerate them (None = UNKNOWN, not empty)."""
         lister = getattr(self.vsp, "list_network_functions", None)
@@ -1716,7 +1719,7 @@ class TpuSideManager:
                         "journaled/adopted wire table as-is")
             return None
 
-    def _recover_chains(self):
+    def _recover_chains(self) -> None:
         """Rebuild the wire table after a daemon restart: load the
         journal, then reconcile it against the dataplane's persisted wire
         list (the native agent's crash-safe state file is the ground
@@ -1753,7 +1756,7 @@ class TpuSideManager:
         details) for the adoption discrepancy accounting."""
         return self._apply_wire_table(data, self._dataplane_ground())
 
-    def freeze_for_handoff(self):
+    def freeze_for_handoff(self) -> Any:
         """Stop mutating: CNI ADD/DEL queue, the reconciler pauses,
         the chain-repair loop parks, then everything DRAINS — a
         dispatch, reconcile or repair pass already past its gate
@@ -1777,7 +1780,7 @@ class TpuSideManager:
         return handoff_mod.drain_mutations(self.cni_server, self._manager,
                                            timeout=timeout)
 
-    def thaw_after_handoff(self, dispatch_queued: bool = True):
+    def thaw_after_handoff(self, dispatch_queued: bool = True) -> None:
         """Abort path: resume normal service (queued CNI requests are
         dispatched locally when unambiguous — this daemon still owns
         the dataplane; see handoff.thaw_mutations)."""
@@ -1789,7 +1792,7 @@ class TpuSideManager:
         self._repair_frozen.clear()
 
     def begin_handoff(self, timeout: float = 30.0,
-                      on_complete=None) -> bool:
+                      on_complete: Any = None) -> bool:
         """Serve a live state handoff in the background (SIGUSR2 /
         AdminService.BeginHandoff). Returns False when one is already
         in flight. Without an explicit *on_complete*, the daemon-set
@@ -1799,7 +1802,7 @@ class TpuSideManager:
             self, self.path_manager.handoff_socket(), timeout=timeout,
             on_complete=on_complete or self.handoff_on_complete)
 
-    def _apply_wire_table(self, data: dict, ground) -> tuple:
+    def _apply_wire_table(self, data: dict, ground: Any) -> tuple:
         restored = 0
         dropped: list = []
         with self._attach_lock:
@@ -1881,19 +1884,19 @@ class TpuSideManager:
         return {"enabled": True, "units": engine.state_table(),
                 "sliceDegraded": engine.slice_degraded()}
 
-    def slice_degraded_status(self):
+    def slice_degraded_status(self) -> Any:
         """Degraded-slice verdict for the SFC reconciler's
         ``SliceDegraded`` CR condition (None while fully operational)."""
         engine = getattr(self, "fault_engine", None)
         return engine.slice_degraded() if engine is not None else None
 
-    def export_fault_state(self):
+    def export_fault_state(self) -> Any:
         """Fault-engine state for the handoff bundle (schema v2
         section)."""
         engine = getattr(self, "fault_engine", None)
         return engine.export_state() if engine is not None else None
 
-    def adopt_fault_state(self, data) -> list:
+    def adopt_fault_state(self, data: Any) -> list:
         """Adopt the handed-off fault section: quarantines and
         hold-downs survive the upgrade (a withdrawn chip must NOT
         briefly re-enter kubelet's allocatable set under a new daemon).
@@ -1927,7 +1930,7 @@ class TpuSideManager:
                             key=lambda h: h["index"])}
             for ns, name in keys]}
 
-    def _teardown_chain(self, sandbox_id: str):
+    def _teardown_chain(self, sandbox_id: str) -> None:
         """Unwire chain hops touching a departing sandbox (remote halves
         of cross-host hops too)."""
         to_unwire = []  # (ids, remote_addr or "")
@@ -2055,7 +2058,7 @@ class TpuSideManager:
         self._flush_chains()
         return {}
 
-    def _release_attachments(self, names: list):
+    def _release_attachments(self, names: list) -> None:
         """Best-effort slice-attachment release (chips are exclusively
         allocated, so the departing sandbox owned them); DEL must make
         progress even with the VSP down."""
@@ -2066,14 +2069,15 @@ class TpuSideManager:
                 log.warning("slice-attachment release failed for %s", name)
 
     # -- ICI port advertisement ----------------------------------------------
-    def _note_chip_allocation(self, ids: list):
+    def _note_chip_allocation(self, ids: list) -> None:
         """Record chip Allocates newest-first (bounded) for port affinity."""
         with self._attach_lock:
             merged = list(ids) + [c for c in self._recent_chip_allocs
                                   if c not in ids]
             self._recent_chip_allocs = merged[:32]
 
-    def _preferred_ports(self, available, must_include, size, devices):
+    def _preferred_ports(self, available: Any, must_include: Any, size: Any,
+                         devices: Any) -> Any:
         from ..deviceplugin.server import preferred_ici_ports
         with self._attach_lock:
             recent = list(self._recent_chip_allocs)
@@ -2094,7 +2098,7 @@ class TpuSideManager:
                      "(ports-before-chips ordering); clustering pick used")
         return picked
 
-    def enable_ici_ports(self, topology_provider):
+    def enable_ici_ports(self, topology_provider: Any) -> None:
         """Advertise google.com/ici-port as a second device plugin. Port
         health rides the native agent's link state (late-bound: the
         prober appears when chain repair connects the agent client), and

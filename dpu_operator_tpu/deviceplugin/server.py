@@ -342,7 +342,12 @@ class DevicePlugin:
                          self.resource, self.socket_path)
         except OSError:
             parked = None  # no file to protect
-        self._server.stop(0.5).wait()
+        # bounded: this runs under _lifecycle_lock — an unbounded wait
+        # on a wedged grpc shutdown would freeze every lifecycle path
+        # (kubelet watch, handoff, stop) behind this call
+        if not self._server.stop(0.5).wait(timeout=5.0):
+            log.warning("device plugin %s: gRPC server did not stop "
+                        "within 5s; abandoning it", self.resource)
         self._server = None
         self._bound_socket_id = None
         if parked is not None:

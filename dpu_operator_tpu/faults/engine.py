@@ -58,7 +58,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Iterable, Optional
+from typing import Any, Callable, Iterable, Optional
 
 from ..k8s import events
 from ..utils import flight, metrics
@@ -112,7 +112,7 @@ class _Unit:
     __slots__ = ("unit", "kind", "state", "bad", "good", "hold_until",
                  "episodes", "quarantined_at", "reason")
 
-    def __init__(self, unit: str, kind: str):
+    def __init__(self, unit: str, kind: str) -> None:
         self.unit = unit
         self.kind = kind
         self.state = HEALTHY
@@ -135,7 +135,7 @@ class FaultEngine:
     def __init__(self, topology_provider: Optional[Callable] = None,
                  policy: Optional[FaultPolicy] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 journal_path: str = ""):
+                 journal_path: str = "") -> None:
         """*topology_provider*: callable -> SliceTopology | None (may be
         None early — propagation degrades to per-unit verdicts until the
         slice shape is known). *clock* is injectable so fault tests
@@ -346,7 +346,7 @@ class FaultEngine:
         self._listeners.append(fn)
 
     # -- derived views --------------------------------------------------------
-    def _topology(self):
+    def _topology(self) -> Any:
         if self.topology_provider is None:
             return None
         try:
@@ -403,7 +403,7 @@ class FaultEngine:
         return result
 
     @staticmethod
-    def _largest_component(topo, dead_idx: set, dark: set) -> set:
+    def _largest_component(topo: Any, dead_idx: set, dark: set) -> set:
         """Chip ids of the largest connected component over live chips
         and non-dark links (BFS over the adjacency index)."""
         alive = [c for c in topo.chips if c.index not in dead_idx]
@@ -561,7 +561,7 @@ class FaultEngine:
         return dropped
 
     @staticmethod
-    def _unknown_unit(topo, unit_id: str, kind: str) -> bool:
+    def _unknown_unit(topo: Any, unit_id: str, kind: str) -> bool:
         if kind == CHIP:
             return topo.chip_by_id(unit_id) is None
         return topo.link_by_id(unit_id) is None

@@ -16,7 +16,7 @@ zero spurious deletions across a fault storm.
 from __future__ import annotations
 
 import re
-from typing import Optional
+from typing import Any, Optional
 
 from ..utils import vars as _vars
 from . import engine as _engine
@@ -59,9 +59,9 @@ class FaultGatedHandler:
     #: call — only the FEEDING is rate-limited.
     PROBE_MIN_INTERVAL_S = 1.0
 
-    def __init__(self, inner, engine: Optional["_engine.FaultEngine"],
+    def __init__(self, inner: Any, engine: Optional['_engine.FaultEngine'],
                  kind: str = _engine.CHIP,
-                 min_probe_interval: Optional[float] = None):
+                 min_probe_interval: Optional[float] = None) -> None:
         self.inner = inner
         self.engine = engine
         self.kind = kind
@@ -70,11 +70,11 @@ class FaultGatedHandler:
                                    else min_probe_interval)
         self._last_feed: Optional[float] = None
 
-    def __getattr__(self, name: str):
+    def __getattr__(self, name: str) -> Any:
         # setup_devices, topology providers, test hooks: pass through
         return getattr(self.inner, name)
 
-    def _chip_units(self, dev_ids) -> Optional[dict]:
+    def _chip_units(self, dev_ids: Any) -> Optional[dict]:
         """dev id -> global chip unit, or None while observations
         cannot be attributed: on a worker > 0 the local/global spaces
         differ, and feeding identity-mapped probes before the topology

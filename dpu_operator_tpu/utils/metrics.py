@@ -10,6 +10,7 @@ binary (operator, daemon, webhook) exposes the same observability surface.
 from __future__ import annotations
 
 import logging
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -258,6 +259,24 @@ class _Timer:
             elapsed,
             exemplar=self.exemplar() if self.exemplar is not None else None)
         return False
+
+
+def bounded_label(value: object, allowed: Optional[set] = None,
+                  fallback: str = "other", max_len: int = 64) -> str:
+    """Clamp a label value derived from request/CR data to a BOUNDED
+    set before it becomes a metric label: with *allowed*, membership
+    (anything else collapses to *fallback*); without, a charset +
+    length clamp (non-identifier characters become ``_``). Unbounded
+    label values are unbounded cardinality — one hostile client can
+    mint a fresh time series per request and OOM every scraper.
+    Registered as the wire-taint label sanitizer; unlike the
+    utils/validate helpers this CLAMPS instead of refusing, because a
+    metric bump must never fail the request it accounts for."""
+    text = str(value)
+    if allowed is not None:
+        return text if text in allowed else fallback
+    text = re.sub(r"[^A-Za-z0-9._-]", "_", text[:max_len])
+    return text or fallback
 
 
 def _escape(v: object) -> str:

@@ -14,9 +14,10 @@ from ..utils.path_manager import PathManager
 from .google import GoogleTpuVsp
 from .mock import MockTpuVsp
 from .rpc import VspServer
+from typing import Optional
 
 
-def main(argv=None):
+def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser("tpu-vsp")
     parser.add_argument("--mock", action="store_true",
                         help="serve the mock VSP (tests/dev)")

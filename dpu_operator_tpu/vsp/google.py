@@ -17,7 +17,7 @@ from __future__ import annotations
 import logging
 import os
 import re
-from typing import Optional, Protocol
+from typing import Any, Optional, Protocol
 
 from ..ici import SliceTopology
 from ..platform.platform import Platform
@@ -55,33 +55,33 @@ class IciDataplane(Protocol):
 class DebugIciDataplane:
     """Logging no-op dataplane (reference: marvell/debug-dp/debugdp.go)."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.events: list[tuple] = []
         self.wires: list[tuple] = []
 
-    def init_dataplane(self, topology):
+    def init_dataplane(self, topology: Any) -> None:
         self.events.append(("init", topology.topology))
         log.info("ici-debug-dp: init %s", topology.topology)
 
-    def attach_chip(self, chip_index, ici_ports):
+    def attach_chip(self, chip_index: Any, ici_ports: Any) -> None:
         self.events.append(("attach", chip_index, tuple(ici_ports)))
         log.info("ici-debug-dp: attach chip %d ports %s", chip_index, ici_ports)
 
-    def detach_chip(self, chip_index):
+    def detach_chip(self, chip_index: Any) -> None:
         self.events.append(("detach", chip_index))
 
-    def wire_network_function(self, input_id, output_id):
+    def wire_network_function(self, input_id: Any, output_id: Any) -> None:
         self.events.append(("wire-nf", input_id, output_id))
         self.wires.append((input_id, output_id))
 
-    def unwire_network_function(self, input_id, output_id):
+    def unwire_network_function(self, input_id: Any, output_id: Any) -> None:
         self.events.append(("unwire-nf", input_id, output_id))
         try:
             self.wires.remove((input_id, output_id))
         except ValueError:
             pass
 
-    def list_wires(self):
+    def list_wires(self) -> Any:
         return list(self.wires)
 
 
@@ -95,7 +95,7 @@ class GoogleTpuVsp:
     _ATTACH_RE = re.compile(_vars.ATTACHMENT_NAME_PATTERN)
 
     def __init__(self, platform: Platform, dataplane: Optional[IciDataplane]
-                 = None, comm_ip: str = "127.0.0.1", comm_port: int = 50151):
+                 = None, comm_ip: str = "127.0.0.1", comm_port: int = 50151) -> None:
         self.platform = platform
         self.dataplane = dataplane or DebugIciDataplane()
         self.comm_ip = comm_ip
@@ -206,12 +206,12 @@ class GoogleTpuVsp:
                 by_serial[serial] = dev.address
         return devs
 
-    def _device_serial(self, dev) -> str:
+    def _device_serial(self, dev: Any) -> str:
         reader = getattr(self.platform, "read_device_serial", None)
         serial = reader(dev.address) if reader is not None else ""
         return serial or dev.serial
 
-    def _host_chip_healthy(self, dev) -> bool:
+    def _host_chip_healthy(self, dev: Any) -> bool:
         """Config-space liveness: a surprise-removed endpoint reads 0xffff
         (platform.device_alive); platforms without the probe stay healthy
         (parity with the reference's probe-less vendors)."""
@@ -296,7 +296,7 @@ class GoogleTpuVsp:
     #: attachment-id endpoints have no port-level existence to check
     _ICI_ENDPOINT_RE = re.compile(r"^ici-(\d+)-(.+)$")
 
-    def _check_port_endpoint(self, endpoint: str):
+    def _check_port_endpoint(self, endpoint: str) -> None:
         """Flag a port-addressed endpoint absent from the programmed
         topology (O(1) via the link_by_id index): such a hop rides a
         port the torus does not have, i.e. a likely blackhole that

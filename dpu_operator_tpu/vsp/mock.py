@@ -16,7 +16,7 @@ from ..ici import SliceTopology
 
 class MockTpuVsp:
     def __init__(self, topology: str = "v5e-4", ip: str = "127.0.0.1",
-                 port: int = 50051):
+                 port: int = 50051) -> None:
         self.topology = topology
         self.ip = ip
         self.port = port

@@ -17,7 +17,7 @@ import struct
 import subprocess
 import threading
 import time
-from typing import Optional
+from typing import Any, Optional
 
 log = logging.getLogger(__name__)
 
@@ -57,7 +57,7 @@ MAX_PORTS = 8
 
 
 class AgentError(RuntimeError):
-    def __init__(self, status: int, message: str = ""):
+    def __init__(self, status: int, message: str = "") -> None:
         super().__init__(f"agent status {status}: {message}")
         self.status = status
 
@@ -70,7 +70,14 @@ class AgentClient:
     """Framed-protocol client; one connection, sequential request/response
     (the agent serializes on its db mutex anyway)."""
 
-    def __init__(self, socket_path: str, connect_timeout: float = 5.0):
+    #: per-operation socket deadline: the agent answers locally in
+    #: microseconds, so anything near this is a wedged agent — and
+    #: because _lock serializes the framed protocol, an UNbounded recv
+    #: here would wedge every AgentClient caller behind the lock (the
+    #: blocking-under-lock audit finding)
+    IO_TIMEOUT_S = 30.0
+
+    def __init__(self, socket_path: str, connect_timeout: float = 5.0) -> None:
         self.socket_path = socket_path
         self._sock: Optional[socket.socket] = None
         self._seq = 0
@@ -79,6 +86,9 @@ class AgentClient:
         while True:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             try:
+                # inside the try: a settimeout on a dead fd must ride
+                # the same close-don't-leak path as a failed connect
+                s.settimeout(self.IO_TIMEOUT_S)
                 s.connect(socket_path)
             except OSError:
                 # a failed attempt's socket must not outlive the retry:
@@ -92,7 +102,7 @@ class AgentClient:
             self._sock = s
             return
 
-    def close(self):
+    def close(self) -> None:
         if self._sock:
             self._sock.close()
             self._sock = None
@@ -100,7 +110,10 @@ class AgentClient:
     def _recv_all(self, n: int) -> bytes:
         buf = b""
         while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
+            # held-lock I/O is deliberate here: _lock serializes the
+            # framed request/response protocol on the one connection,
+            # and IO_TIMEOUT_S on the socket bounds the wedge
+            chunk = self._sock.recv(n - len(buf))  # opslint: disable=blocking-under-lock
             if not chunk:
                 raise ConnectionError("agent closed connection")
             buf += chunk
@@ -110,7 +123,9 @@ class AgentClient:
         with self._lock:
             self._seq += 1
             seq = self._seq
-            self._sock.sendall(_HEADER.pack(MAGIC, VERSION, msg_type, seq,
+            # same justification as _recv_all: protocol-serializing
+            # lock + socket-level IO_TIMEOUT_S bound
+            self._sock.sendall(_HEADER.pack(MAGIC, VERSION, msg_type, seq,  # opslint: disable=blocking-under-lock
                                             len(payload)) + payload)
             magic, version, rtype, rseq, rlen = _HEADER.unpack(
                 self._recv_all(_HEADER.size))
@@ -121,7 +136,7 @@ class AgentClient:
                     f"out-of-order response (type={rtype:#x} seq={rseq})")
             return self._recv_all(rlen) if rlen else b""
 
-    def _status_call(self, msg_type: int, payload: bytes):
+    def _status_call(self, msg_type: int, payload: bytes) -> None:
         status, err = _STATUS_RESP.unpack(self._call(msg_type, payload))
         if status != ST_OK:
             raise AgentError(status, _cstr(err))
@@ -150,7 +165,7 @@ class AgentClient:
                           "attached": bool(attached), "nports": nports})
         return chips
 
-    def attach(self, chip: int, ports: Optional[list] = None):
+    def attach(self, chip: int, ports: Optional[list] = None) -> None:
         ports = ports or []
         if len(ports) > MAX_PORTS:
             raise ValueError(f"at most {MAX_PORTS} ports")
@@ -158,14 +173,14 @@ class AgentClient:
         self._status_call(MSG_ATTACH,
                           _ATTACH_REQ.pack(chip, len(ports), *padded))
 
-    def detach(self, chip: int):
+    def detach(self, chip: int) -> None:
         self._status_call(MSG_DETACH, _DETACH_REQ.pack(chip))
 
-    def wire_nf(self, input_id: str, output_id: str):
+    def wire_nf(self, input_id: str, output_id: str) -> None:
         self._status_call(MSG_WIRE_NF, _WIRE_REQ.pack(
             input_id.encode(), output_id.encode()))
 
-    def unwire_nf(self, input_id: str, output_id: str):
+    def unwire_nf(self, input_id: str, output_id: str) -> None:
         self._status_call(MSG_UNWIRE_NF, _WIRE_REQ.pack(
             input_id.encode(), output_id.encode()))
 
@@ -199,12 +214,12 @@ class AgentClient:
             wires.append((_cstr(raw_in), _cstr(raw_out)))
         return wires
 
-    def set_link(self, chip: int, port: str, up: bool):
+    def set_link(self, chip: int, port: str, up: bool) -> None:
         """Fault injection: force a port down (or restore it)."""
         self._status_call(MSG_SET_LINK, _SET_LINK_REQ.pack(
             chip, port.encode(), 1 if up else 0))
 
-    def shutdown(self):
+    def shutdown(self) -> None:
         try:
             self._status_call(MSG_SHUTDOWN, b"")
         except (ConnectionError, OSError):
@@ -216,7 +231,7 @@ class AgentProcess:
     like cp-agent-run.go:9-73 starts octep_cp_agent)."""
 
     def __init__(self, binary: str, socket_path: str, state_file: str = "",
-                 dev_dir: str = "", allow_regular_dev: bool = False):
+                 dev_dir: str = "", allow_regular_dev: bool = False) -> None:
         self.binary = binary
         self.socket_path = socket_path
         self.state_file = state_file
@@ -225,7 +240,7 @@ class AgentProcess:
         self.allow_regular_dev = allow_regular_dev
         self._proc: Optional[subprocess.Popen] = None
 
-    def start(self, timeout: float = 5.0):
+    def start(self, timeout: float = 5.0) -> None:
         cmd = [self.binary, "--socket", self.socket_path]
         if self.state_file:
             cmd += ["--state-file", self.state_file]
@@ -243,7 +258,7 @@ class AgentProcess:
                 raise TimeoutError("tpu_cp_agent socket never appeared")
             time.sleep(0.02)
 
-    def stop(self):
+    def stop(self) -> None:
         if self._proc and self._proc.poll() is None:
             self._proc.terminate()
             try:
@@ -256,37 +271,37 @@ class AgentProcess:
 class NativeIciDataplane:
     """IciDataplane (google.py) backed by the native agent."""
 
-    def __init__(self, client: AgentClient):
+    def __init__(self, client: AgentClient) -> None:
         self.client = client
 
-    def init_dataplane(self, topology):
+    def init_dataplane(self, topology: Any) -> None:
         info = self.client.init(topology.topology)
         if info["num_chips"] != topology.num_chips:
             raise RuntimeError(
                 f"agent chip count {info['num_chips']} != topology "
                 f"{topology.num_chips}")
 
-    def attach_chip(self, chip_index, ici_ports):
+    def attach_chip(self, chip_index: Any, ici_ports: Any) -> None:
         # IciLink objects or raw port names both accepted
         ports = [getattr(p, "port", p) for p in ici_ports]
         self.client.attach(chip_index, ports[:MAX_PORTS])
 
-    def detach_chip(self, chip_index):
+    def detach_chip(self, chip_index: Any) -> None:
         self.client.detach(chip_index)
 
-    def wire_network_function(self, input_id, output_id):
+    def wire_network_function(self, input_id: Any, output_id: Any) -> None:
         self.client.wire_nf(input_id, output_id)
 
-    def unwire_network_function(self, input_id, output_id):
+    def unwire_network_function(self, input_id: Any, output_id: Any) -> None:
         self.client.unwire_nf(input_id, output_id)
 
-    def list_wires(self):
+    def list_wires(self) -> Any:
         """Ground truth for daemon wire-table recovery: the agent's wire
         table survives both daemon and agent restarts (crash-safe state
         file replay, native/tpucp/agent.cc)."""
         return self.client.list_wires()
 
-    def chip_links_ok(self, chip_index) -> bool:
+    def chip_links_ok(self, chip_index: Any) -> bool:
         """Health input for the VSP: every wired ICI port trained. An
         unattached chip (no wired ports) is healthy by definition."""
         try:

@@ -13,7 +13,7 @@ import logging
 import os
 import threading
 import time
-from typing import Optional, Protocol
+from typing import Any, Optional, Protocol
 
 from ..render import apply_all_from_bindata
 from ..utils import resilience, tracing
@@ -24,7 +24,7 @@ from .rpc import VspChannel, unix_target
 log = logging.getLogger(__name__)
 
 
-def _grpc_code_name(exc: BaseException):
+def _grpc_code_name(exc: BaseException) -> Any:
     """Status-code name of a gRPC error, None for non-gRPC errors."""
     code = getattr(exc, "code", None)
     if callable(code):
@@ -77,11 +77,12 @@ class VendorPlugin(Protocol):
 
 
 class GrpcPlugin:
-    def __init__(self, detection, client=None, image_manager=None,
+    def __init__(self, detection: Any, client: Any = None,
+                 image_manager: Any = None,
                  path_manager: Optional[PathManager] = None,
-                 node_name: str = "", init_timeout: float = 10.0,
+                 node_name: str = '', init_timeout: float = 10.0,
                  retry: Optional[resilience.RetryPolicy] = None,
-                 breaker: Optional[resilience.CircuitBreaker] = None):
+                 breaker: Optional[resilience.CircuitBreaker] = None) -> None:
         """*detection* is a DetectionResult; *client* a KubeClient (None skips
         VSP DaemonSet deployment — used when the VSP runs in-process)."""
         self.detection = detection
@@ -105,7 +106,7 @@ class GrpcPlugin:
         self._channel_lock = threading.Lock()
 
     # -- lifecycle ------------------------------------------------------------
-    def _deploy_vsp(self):
+    def _deploy_vsp(self) -> None:
         """Render + apply the VSP DaemonSet (vendorplugin.go:141-164)."""
         if self.client is None or self.image_manager is None:
             return
@@ -155,7 +156,7 @@ class GrpcPlugin:
             f"VSP Init did not succeed within {self.init_timeout}s: "
             f"{last_err}")
 
-    def close(self):
+    def close(self) -> None:
         # under _channel_lock: close() racing a retry's _reconnect must
         # not let the reconnect resurrect a channel after we closed it
         # (the fresh dial would leak, and the plugin would look alive)
@@ -171,7 +172,7 @@ class GrpcPlugin:
         return VspChannel(
             unix_target(self.path_manager.vendor_plugin_socket()))
 
-    def _reconnect(self, _exc: BaseException = None):
+    def _reconnect(self, _exc: Optional[BaseException] = None) -> None:
         """Swap in a fresh channel before a retry: gRPC channels can wedge
         on a unix socket whose server restarted (the old inode is gone);
         redialing binds the new one. Serialized so concurrent retries
@@ -196,11 +197,12 @@ class GrpcPlugin:
         return [self.breaker.site] if self.breaker.degraded else []
 
     # -- pass-throughs (vendorplugin.go:209-265) ------------------------------
-    def _call(self, service, method, req, timeout=30.0):
+    def _call(self, service: Any, method: Any, req: Any,
+              timeout: Any = 30.0) -> Any:
         if self._channel is None:
             raise RuntimeError("plugin not started")
 
-        def attempt():
+        def attempt() -> Any:
             # read the channel each attempt: _reconnect swaps it
             channel = self._channel
             if channel is None:
@@ -241,7 +243,7 @@ class GrpcPlugin:
         self._call("NetworkFunctionService", "DeleteNetworkFunction",
                    {"input": input_id, "output": output_id})
 
-    def list_network_functions(self):
+    def list_network_functions(self) -> Any:
         """Programmed (input, output) wire pairs, or None when the VSP's
         dataplane cannot enumerate them (None = unknown, NOT empty)."""
         resp = self._call("NetworkFunctionService", "ListNetworkFunctions",

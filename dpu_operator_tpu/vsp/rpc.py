@@ -15,7 +15,7 @@ import os
 import random
 import threading
 from concurrent import futures
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import grpc
 
@@ -38,10 +38,10 @@ def _de(data: bytes) -> dict:
 
 
 class _GenericHandler(grpc.GenericRpcHandler):
-    def __init__(self, methods: dict):
+    def __init__(self, methods: dict) -> None:
         self._methods = methods
 
-    def service(self, handler_call_details):
+    def service(self, handler_call_details: Any) -> Any:
         fn = self._methods.get(handler_call_details.method)
         if fn is None:
             return None
@@ -79,8 +79,8 @@ class VspServer:
         ("AdminService", "BeginHandoff"): "begin_handoff",
     }
 
-    def __init__(self, impl, socket_path: Optional[str] = None,
-                 tcp_addr: Optional[tuple] = None):
+    def __init__(self, impl: Any, socket_path: Optional[str] = None,
+                 tcp_addr: Optional[tuple] = None) -> None:
         """Bind to a unix *socket_path* (daemon↔VSP seam) or a TCP
         *(ip, port)* (the host↔tpu cross-boundary channel, the reference's
         OPI server on the VSP-returned IpPort, dpusidemanager.go:141-165)."""
@@ -100,7 +100,7 @@ class VspServer:
     #: up at 30 s; 2x leaves room for the long admin calls)
     HANDLER_DEADLINE = 60.0
 
-    def start(self):
+    def start(self) -> None:
         if self.socket_path:
             os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
             if os.path.exists(self.socket_path):
@@ -111,8 +111,8 @@ class VspServer:
             if fn is None:
                 continue
 
-            def wrap(fn=fn, svc=svc, rpc=rpc):
-                def handler(request, context):
+            def wrap(fn: Any = fn, svc: Any = svc, rpc: Any = rpc) -> Any:
+                def handler(request: dict, context: Any) -> dict:
                     # restore the caller's trace context from gRPC
                     # metadata and record the server-side span, so the
                     # VSP's work appears in the same trace tree as the
@@ -185,7 +185,7 @@ class VspServer:
             f"{_BIND_ATTEMPTS - 2} ephemeral candidates, and an "
             f"OS-assigned port (last tried {last})")
 
-    def _teardown_failed_server(self):
+    def _teardown_failed_server(self) -> None:
         server, self._server = self._server, None
         if server is not None:
             try:
@@ -194,7 +194,7 @@ class VspServer:
                 log.debug("teardown of half-started VSP server failed",
                           exc_info=True)
 
-    def stop(self, grace: float = 0.5):
+    def stop(self, grace: float = 0.5) -> None:
         if self._server:
             self._server.stop(grace).wait()
             self._server = None
@@ -206,16 +206,16 @@ class VspServer:
 class VspChannel:
     """Client-side channel with per-method callables (stub analog)."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str) -> None:
         self.target = target
         self._channel = grpc.insecure_channel(target)
         self._calls: dict[tuple, Callable] = {}
         self._lock = threading.Lock()
 
-    def close(self):
+    def close(self) -> None:
         self._channel.close()
 
-    def wait_ready(self, timeout: float = 10.0):
+    def wait_ready(self, timeout: float = 10.0) -> None:
         fut = grpc.channel_ready_future(self._channel)
         try:
             fut.result(timeout=timeout)

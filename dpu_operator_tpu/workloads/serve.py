@@ -69,7 +69,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-from ..utils import flight, metrics, tracing, watchdog
+from ..utils import flight, metrics, tracing, validate, watchdog
 from ..utils.stats import nearest_rank
 from . import kv_pool
 from .kv_pool import KvBlockPool
@@ -78,6 +78,14 @@ log = logging.getLogger(__name__)
 
 INTERACTIVE = "interactive"
 BATCH = "batch"
+
+# -- ingress bounds (the wire-taint seam: every request field is
+# clamped against these BEFORE it can size a read, a KV reservation or
+# a decode budget — hostile input 400s at the boundary) ----------------------
+MAX_BODY_BYTES = 1 << 20      # 1 MiB of request JSON is ~1.5e5 tokens
+MAX_PROMPT_LEN = 65536
+MAX_OUTPUT_LEN = 65536
+MAX_TOKEN_ID = 1 << 30        # any real vocab fits well inside this
 
 # request lifecycle
 QUEUED = "queued"
@@ -1536,34 +1544,50 @@ class DecodeService:
                     self.send_error(404, "unknown path")
                     return
                 try:
-                    length = int(self.headers.get("Content-Length") or 0)
+                    # every field rides a utils/validate sanitizer (the
+                    # wire-taint seam): sizes are clamped BEFORE they
+                    # size a read or a KV reservation, enums are
+                    # membership-checked, free-form ids are bounded —
+                    # hostile input 400s here, it never mutates
+                    # scheduler state
+                    length = validate.clamped_int(
+                        self.headers.get("Content-Length") or 0,
+                        0, MAX_BODY_BYTES, "Content-Length")
                     spec = _json.loads(
                         self.rfile.read(length) or b"{}")
                     if not isinstance(spec, dict):
                         raise ValueError("body must be a JSON object")
                     prompt = spec.get("prompt")
+                    if prompt is not None \
+                            and not isinstance(prompt, (list, tuple)):
+                        raise ValueError("prompt must be a list of "
+                                         "token ids")
                     req = Request(
-                        rid=str(spec.get("rid")
-                                or f"http-{next(outer._rid_seq)}"),
-                        prompt_len=int(spec.get("prompt_len")
-                                       or len(prompt or ())),
-                        output_len=int(spec["output_len"]),
-                        slo_class=str(spec.get("slo_class",
-                                               INTERACTIVE)),
-                        # coerce to ints NOW: a non-numeric element
-                        # must 400 here, not blow up chain_keys inside
-                        # the scheduler loop later
-                        prompt=tuple(int(t) for t in prompt)
+                        rid=validate.bounded_str(
+                            spec.get("rid")
+                            or f"http-{next(outer._rid_seq)}",
+                            max_len=128, what="rid"),
+                        prompt_len=validate.clamped_int(
+                            spec.get("prompt_len")
+                            or len(prompt or ()),
+                            1, MAX_PROMPT_LEN, "prompt_len"),
+                        output_len=validate.clamped_int(
+                            spec["output_len"], 1, MAX_OUTPUT_LEN,
+                            "output_len"),
+                        slo_class=validate.parse_choice(
+                            spec.get("slo_class", INTERACTIVE),
+                            (INTERACTIVE, BATCH), "slo_class"),
+                        # coerce to bounded ints NOW: a non-numeric or
+                        # absurd element must 400 here, not blow up
+                        # chain_keys inside the scheduler loop later
+                        prompt=tuple(
+                            validate.clamped_int(t, 0, MAX_TOKEN_ID,
+                                                 "prompt id")
+                            for t in prompt)
                         if prompt else None)
                 except (KeyError, ValueError, TypeError,
                         AttributeError) as e:
                     self.send_error(400, f"bad request: {e}")
-                    return
-                if req.prompt_len <= 0 or req.output_len <= 0 \
-                        or req.slo_class not in (INTERACTIVE, BATCH):
-                    self.send_error(
-                        400, "need positive prompt_len/output_len and "
-                             "a known slo_class")
                     return
                 if req.prompt is not None \
                         and len(req.prompt) != req.prompt_len:

@@ -1,0 +1,379 @@
+"""Hostile-input corpus at the untrusted ingresses (`make fuzz-check`).
+
+The runtime complement to opslint's wire-taint pass: every case drives
+real bytes at a real boundary — the streaming HTTP serve ingress over
+TCP, the CNI server over its unix socket, the CNI/handoff parse seams
+directly — and asserts a 400/refusal with ZERO interior state mutated
+(no scheduler admission, no dispatcher call, no file outside a state
+dir). Corpus generation is seeded; the suite is deterministic.
+"""
+
+import http.client
+import json
+import random
+import socket
+import threading
+
+import pytest
+
+from dpu_operator_tpu.cni import ChipAllocator, CniServer, NetConfCache
+from dpu_operator_tpu.cni.types import CniRequest, PodRequest
+from dpu_operator_tpu.workloads import serve
+
+SEED = 20260804
+
+NAN_BODY = '{"prompt_len": 1, "output_len": NaN}'  # json.loads accepts NaN
+
+
+# -- HTTP serve ingress -------------------------------------------------------
+
+def _scheduler():
+    cfg = serve.ServeConfig(slots=2, kv_blocks=8, kv_block_size=16,
+                            queue_limit=8)
+    return serve.Scheduler(cfg)
+
+
+def _post_raw(port, body: bytes, headers=None, timeout=10.0):
+    """POST raw bytes; returns the status code, or None when the
+    server severed the connection before consuming the body (it 400s
+    from the Content-Length clamp and closes — a large send can hit
+    the closed socket before the response is readable)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.putrequest("POST", "/v1/generate")
+        hdrs = {"Content-Type": "application/json",
+                "Content-Length": str(len(body))}
+        hdrs.update(headers or {})
+        for k, v in hdrs.items():
+            conn.putheader(k, v)
+        conn.endheaders()
+        try:
+            if body:
+                conn.send(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # refused before the body was consumed
+        try:
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status
+        except (http.client.BadStatusLine, ConnectionError, OSError):
+            return None
+    finally:
+        conn.close()
+
+
+def _wrong_typed_corpus(rng):
+    """Seeded wrong-typed/hostile specs; every one must 400."""
+    fixed = [
+        {"prompt_len": "abc", "output_len": 4},
+        {"prompt_len": 4},                              # missing output_len
+        {"prompt_len": 4, "output_len": []},
+        {"prompt_len": 4, "output_len": {"a": 1}},
+        {"prompt_len": 4, "output_len": 4, "slo_class": 5},
+        {"prompt_len": 4, "output_len": 4, "slo_class": "platinum"},
+        {"prompt_len": 4, "output_len": 4, "prompt": "not-a-list"},
+        {"prompt_len": 4, "output_len": 4, "prompt": {"0": 1}},
+        {"prompt_len": 2, "output_len": 2, "prompt": ["x", "y"]},
+        {"prompt_len": 2, "output_len": 2, "prompt": [1, -5]},
+        {"prompt_len": 2, "output_len": 2, "prompt": [1, 2 ** 40]},
+        {"prompt_len": -3, "output_len": 4},
+        {"prompt_len": 0, "output_len": 4},
+        {"prompt_len": 4, "output_len": -1},
+        {"prompt_len": 10 ** 9, "output_len": 4},       # oversize
+        {"prompt_len": 4, "output_len": 10 ** 12},
+        {"prompt_len": 4, "output_len": True},          # bool is not a size
+        {"prompt_len": 4, "output_len": 4, "rid": "x" * 4096},
+        {"prompt_len": 4, "output_len": 4, "rid": "a\nb"},
+    ]
+    types_pool = ["abc", [], {}, None, -1, 10 ** 10, 1.5e308, True]
+    for _ in range(40):
+        spec = {"prompt_len": 4, "output_len": 4}
+        field = rng.choice(["prompt_len", "output_len", "slo_class",
+                            "prompt"])
+        spec[field] = rng.choice(types_pool)
+        # drop the mutations that are in fact VALID requests: an
+        # absent/empty prompt just means "no ids supplied"
+        if field == "prompt" and spec[field] in ([], None):
+            continue
+        if field == "slo_class" and spec[field] in ("interactive",
+                                                    "batch"):
+            continue
+        fixed.append(spec)
+    return fixed
+
+
+def _assert_virgin(sched):
+    """No hostile request may have mutated scheduler state."""
+    snap = sched.snapshot()
+    assert all(not reqs for reqs in snap["queued"].values())
+    assert all(not reqs for reqs in snap["active"].values())
+    assert snap["iterations"] == 0
+    assert snap["completed"] == 0 and snap["rejected"] == 0
+    assert snap["kv"]["usedBlocks"] == 0
+    cap = snap["capacity"]
+    assert cap["freeSlots"] == cap["slots"]
+
+
+def test_http_ingress_hostile_corpus_all_400_no_state_mutation():
+    rng = random.Random(SEED)
+    sched = _scheduler()
+    service = serve.DecodeService(sched, idle_interval_s=0.01)
+    port = service.start_http()
+    try:
+        # malformed JSON / raw garbage bytes
+        for body in (b"{nope", b"\x00\xff\xfe garbage", b"[1,2",
+                     NAN_BODY.encode(),
+                     b'{"prompt_len": 1, "output_len": Infinity}',
+                     b'{"prompt_len": 1, "output_len": -Infinity}'):
+            assert _post_raw(port, body) == 400
+        # valid JSON, hostile shapes
+        for spec in _wrong_typed_corpus(rng):
+            status = _post_raw(port, json.dumps(spec).encode())
+            assert status == 400, f"accepted hostile spec {spec!r}"
+        _assert_virgin(sched)
+    finally:
+        service.stop()
+
+
+def test_http_ingress_refuses_10mb_body_without_reading_it():
+    sched = _scheduler()
+    service = serve.DecodeService(sched, idle_interval_s=0.01)
+    port = service.start_http()
+    try:
+        # a declared 10MB Content-Length must refuse BEFORE the read:
+        # send only the header and a trickle of body — a server that
+        # tried to read 10MB would hang past the client timeout
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.putrequest("POST", "/v1/generate")
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", str(10 * 1024 * 1024))
+            conn.endheaders()
+            # no body is ever sent: the 400 must come from the header
+            # clamp alone — a server that honored the length would
+            # block reading 10MB and trip the client timeout instead
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 400
+        finally:
+            conn.close()
+        # and an actually-transmitted oversized body is refused too:
+        # 400 when the response wins the race, a severed connection
+        # when the early close beats the client's 2MB send — either
+        # way the body never reached the parser
+        assert _post_raw(port, json.dumps(
+            {"prompt_len": 4, "output_len": 4,
+             "rid": "x" * (2 * 1024 * 1024)}).encode()) in (400, None)
+        _assert_virgin(sched)
+    finally:
+        service.stop()
+
+
+def test_http_ingress_still_serves_after_the_storm():
+    """Refusals must not poison the listener: a good request right
+    after the corpus completes normally."""
+    rng = random.Random(SEED + 1)
+    sched = _scheduler()
+    service = serve.DecodeService(sched, idle_interval_s=0.01)
+    service.start()
+    port = service.start_http()
+    try:
+        for spec in _wrong_typed_corpus(rng)[:10]:
+            _post_raw(port, json.dumps(spec).encode())
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"rid": "good", "prompt_len": 4,
+                                 "output_len": 2}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        conn.close()
+        assert resp.status == 200
+        assert '"done"' in body
+    finally:
+        service.stop()
+
+
+# -- CNI stdin / server seam --------------------------------------------------
+
+def _cni_env(container="abc123", ifname="net1", command="ADD"):
+    return {"CNI_COMMAND": command, "CNI_CONTAINERID": container,
+            "CNI_NETNS": "/var/run/netns/x", "CNI_IFNAME": ifname,
+            "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p"}
+
+
+def _cni_conf(device="chip-1"):
+    return {"cniVersion": "0.4.0", "name": "tpunfcni-conf",
+            "type": "tpu-cni", "mode": "chip", "deviceID": device,
+            "resourceName": "google.com/tpu"}
+
+
+@pytest.mark.parametrize("field,value", [
+    ("container", "../../../etc/cron.d/pwn"),
+    ("container", ".."),
+    ("container", "a/b"),
+    ("container", "x" * 300),
+    ("container", ".hidden"),
+    ("container", "a\x00b"),
+    ("ifname", "../../net1"),
+    ("ifname", "net1/../.."),
+])
+def test_cni_parse_refuses_traversal_ids(field, value):
+    kwargs = {field: value}
+    req = CniRequest(env=_cni_env(**kwargs), config=_cni_conf())
+    with pytest.raises(ValueError):
+        PodRequest.from_cni_request(req)
+
+
+def test_cni_parse_refuses_traversal_device_id():
+    req = CniRequest(env=_cni_env(),
+                     config=_cni_conf(device="../../dev/mem"))
+    with pytest.raises(ValueError):
+        PodRequest.from_cni_request(req)
+
+
+def test_cni_parse_accepts_real_id_shapes():
+    for device in ("chip-1", "0000:00:04.0", "google.com/tpu-3"):
+        req = CniRequest(env=_cni_env(), config=_cni_conf(device=device))
+        assert PodRequest.from_cni_request(req).device_id == device
+
+
+class _UnixConn(http.client.HTTPConnection):
+    def __init__(self, path, timeout=10.0):
+        super().__init__("localhost", timeout=timeout)
+        self._path = path
+
+    def connect(self):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(self._path)
+        self.sock = s
+
+
+def _post_cni(sock_path, body: bytes, content_length=None):
+    conn = _UnixConn(sock_path)
+    try:
+        conn.putrequest("POST", "/cni")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length",
+                       str(content_length if content_length is not None
+                           else len(body)))
+        conn.endheaders()
+        conn.send(body)
+        resp = conn.getresponse()
+        payload = resp.read()
+        return resp.status, json.loads(payload or b"{}")
+    finally:
+        conn.close()
+
+
+def test_cni_server_refuses_hostile_wire_without_dispatch(tmp_path):
+    calls = []
+
+    def add_handler(pod_req):
+        calls.append(pod_req)
+        return {"cniVersion": "0.4.0"}
+
+    srv = CniServer(str(tmp_path / "cni.sock"),
+                    add_handler=add_handler,
+                    del_handler=add_handler, timeout=5.0)
+    srv.start()
+    try:
+        path = srv.socket_path
+        # oversize Content-Length: refused before the read sizes a
+        # buffer (send only a trickle — a server that honored the
+        # header would hang)
+        status, resp = _post_cni(path, b'{"x":', content_length=10**7)
+        assert status == 500 and "Content-Length" in resp["error"]
+        # malformed JSON body
+        status, resp = _post_cni(path, b"{nope")
+        assert status == 500 and resp["error"]
+        # traversal container id: refused at parse, handler NOT called
+        hostile = {"env": _cni_env(container="../../etc"),
+                   "config": _cni_conf()}
+        status, resp = _post_cni(path, json.dumps(hostile).encode())
+        assert status == 500 and "CNI_CONTAINERID" in resp["error"]
+        assert calls == [], "hostile request reached the dispatcher"
+        # the server still dispatches a good request afterwards
+        good = {"env": _cni_env(), "config": _cni_conf()}
+        status, resp = _post_cni(path, json.dumps(good).encode())
+        assert status == 200 and not resp.get("error")
+        assert len(calls) == 1
+    finally:
+        srv.stop()
+
+
+def test_netconf_cache_empty_ids_keep_defensive_noop_paths(tmp_path):
+    """Review regression: the traversal belt must not convert the
+    legal empty-id shapes (teardown DELs carry no ifname; defensive
+    loads may carry no sandbox) into ValueErrors that escape load()'s
+    OSError-only except and wedge kubelet's DEL retry loop."""
+    cache = NetConfCache(str(tmp_path / "cache"))
+    assert cache.load("", "eth0") is None
+    cache.delete("", "")                  # no raise
+    cache.save("sbx", "", {"a": 1})       # empty ifname still caches
+    assert cache.load("sbx", "") == {"a": 1}
+
+
+def test_netconf_cache_and_allocator_refuse_traversal(tmp_path):
+    cache = NetConfCache(str(tmp_path / "cache"))
+    with pytest.raises(ValueError):
+        cache.save("../../escape", "net1", {"a": 1})
+    with pytest.raises(ValueError):
+        cache.save("sandbox", "../up", {"a": 1})
+    alloc = ChipAllocator(str(tmp_path / "alloc"))
+    with pytest.raises(ValueError):
+        alloc.allocate("..", "owner")
+    # nothing escaped the state dirs
+    assert not (tmp_path / "escape-net1.json").exists()
+    written = [p for p in tmp_path.rglob("*") if p.is_file()]
+    assert written == []
+
+
+# -- handoff bundle seam ------------------------------------------------------
+
+def test_handoff_adoption_refuses_traversal_entry_names(tmp_path):
+    from dpu_operator_tpu.daemon import handoff
+
+    state = tmp_path / "state"
+    state.mkdir()
+    report = handoff.AdoptionReport()
+    written = []
+
+    def writer(path, content):
+        written.append(path)
+        with open(path, "w") as fh:
+            fh.write(content)
+
+    entries = {"../outside.json": "pwn", "..": "pwn",
+               "good-entry.json": "{}", "a/b.json": "pwn"}
+    handoff._reconcile_state_dir(str(state), entries, "netconf",
+                                 report, writer)
+    # only the safe entry landed, inside the state dir
+    assert written == [str(state / "good-entry.json")]
+    assert sorted(p.name for p in state.iterdir()) == ["good-entry.json"]
+    assert not (tmp_path / "outside.json").exists()
+    kinds = {d["kind"] for d in report.discrepancies}
+    assert "netconf-invalid-name" in kinds
+
+
+def test_fuzz_suite_is_deterministic():
+    """The corpus itself must replay bit-identically from its seed."""
+    a = _wrong_typed_corpus(random.Random(SEED))
+    b = _wrong_typed_corpus(random.Random(SEED))
+    assert a == b
+
+
+def test_threads_are_not_leaked_by_refusals():
+    """A refused request must not leave a handler thread wedged."""
+    sched = _scheduler()
+    service = serve.DecodeService(sched, idle_interval_s=0.01)
+    port = service.start_http()
+    before = threading.active_count()
+    try:
+        for _ in range(8):
+            _post_raw(port, b"{nope")
+    finally:
+        service.stop()
+    # generous bound: daemon threads unwind asynchronously
+    assert threading.active_count() <= before + 8

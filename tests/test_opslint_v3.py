@@ -1,0 +1,493 @@
+"""opslint v3 tests: wire-taint dataflow + blocking-under-lock.
+
+Per-rule pass/fail fixtures covering source seeding (all five ingress
+families), interprocedural propagation, sanitizer discharge, guard
+recognition, pragma suppression and witness chains — plus the shared
+symbol-table satellite (one ProjectIndex build per invocation) and the
+lint-gate wall-time bound. Fixtures build Modules directly, mirroring
+test_opslint.py / test_opslint_v2.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+from dpu_operator_tpu.analysis import (ALL_CHECKERS,
+                                       BlockingUnderLockChecker,
+                                       WireTaintChecker)
+from dpu_operator_tpu.analysis.callgraph import ProjectIndex
+from dpu_operator_tpu.analysis.core import (Module, load_modules,
+                                            pragma_inventory,
+                                            run_checkers_on)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVE = "dpu_operator_tpu/workloads/serve.py"
+CNI = "dpu_operator_tpu/cni/server.py"
+RPC = "dpu_operator_tpu/vsp/rpc.py"
+CTRL = "dpu_operator_tpu/controller/some_controller.py"
+HANDOFF = "dpu_operator_tpu/daemon/handoff.py"
+
+
+def check(checker, source, relpath=SERVE):
+    module = Module("/x/" + relpath, relpath, textwrap.dedent(source))
+    return [v for v in checker.check(module)
+            if not module.suppressed(v.rule, v.line)]
+
+
+def check_many(checker, sources):
+    modules = [Module("/x/" + rel, rel, textwrap.dedent(src))
+               for rel, src in sources.items()]
+    by_rel = {m.relpath: m for m in modules}
+    return [v for v in checker.check_project(modules)
+            if not by_rel[v.path].suppressed(v.rule, v.line)]
+
+
+# -- wire-taint: source seeding, one fixture per ingress family ---------------
+
+def test_taint_seeds_http_body_and_flags_alloc_sink():
+    violations = check(WireTaintChecker(), """
+        import json
+
+        class H:
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+    """)
+    assert [v.rule for v in violations] == ["wire-taint"]
+    assert "[alloc]" in violations[0].message
+    assert "rfile.read" in violations[0].message
+
+
+def test_taint_seeds_cni_stdin_into_path_sink():
+    violations = check(WireTaintChecker(), """
+        import json, os
+
+        def handle(raw):
+            conf = json.loads(raw)
+            path = os.path.join("/var/lib/cni", conf["name"])
+            return open(path)
+    """, relpath=CNI)
+    assert violations and all(v.rule == "wire-taint"
+                              for v in violations)
+    assert any("[path]" in v.message for v in violations)
+
+
+def test_taint_seeds_grpc_request_param():
+    violations = check(WireTaintChecker(), """
+        import subprocess
+
+        def handler(request, context):
+            subprocess.run(["tool", request["arg"]])
+    """, relpath=RPC)
+    assert len(violations) == 1
+    assert "[subprocess]" in violations[0].message
+
+
+def test_taint_seeds_cr_spec_fields_into_log_format():
+    violations = check(WireTaintChecker(), """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def reconcile(cfg):
+            log.info("mode is " + cfg.spec.mode)
+    """, relpath=CTRL)
+    assert len(violations) == 1
+    assert "[logfmt]" in violations[0].message
+
+
+def test_taint_seeds_cr_spec_key_reads():
+    violations = check(WireTaintChecker(), """
+        def reconcile(obj, topology_map):
+            key = obj["spec"]["sliceTopology"]
+            return topology_map[key]
+    """, relpath=CTRL)
+    assert len(violations) == 1
+    assert "[index]" in violations[0].message
+
+
+def test_taint_seeds_handoff_bundle():
+    violations = check(WireTaintChecker(), """
+        import os
+
+        def adopt(sock, state_dir):
+            bundle, size = recv_frame(sock)
+            for name in bundle["netconfs"]:
+                os.unlink(os.path.join(state_dir, name))
+    """, relpath=HANDOFF)
+    assert violations
+    assert all("[path]" in v.message for v in violations)
+
+
+# -- wire-taint: interprocedural propagation + witness chains -----------------
+
+def test_taint_propagates_through_resolved_calls_with_witness():
+    violations = check(WireTaintChecker(), """
+        import json, os
+
+        class Cache:
+            def _path(self, sandbox_id):
+                return os.path.join("/state", sandbox_id)
+
+            def save(self, sandbox_id, data):
+                return open(self._path(sandbox_id), "w")
+
+        class Server:
+            def __init__(self):
+                self.cache = Cache()
+
+            def handle(self, raw):
+                body = json.loads(raw)
+                self.cache.save(body["sandbox"], body)
+    """, relpath=CNI)
+    assert violations
+    msg = violations[0].message
+    # the witness chain names the interprocedural route
+    assert "Server.handle" in msg and "Cache" in msg
+
+
+def test_taint_return_summary_carries_taint_back_to_caller():
+    violations = check(WireTaintChecker(), """
+        import json
+
+        def parse(raw):
+            return json.loads(raw)
+
+        def serve(raw, conn):
+            spec = parse(raw)
+            n = int(spec["n"])
+            conn.recv(n)
+    """, relpath=CNI)
+    assert len(violations) == 1
+    assert "[alloc]" in violations[0].message
+
+
+def test_taint_clean_when_callee_sanitizes():
+    assert check(WireTaintChecker(), """
+        import json
+        from ..utils.validate import clamped_int
+
+        def parse(raw):
+            spec = json.loads(raw)
+            return clamped_int(spec["n"], 0, 4096, "n")
+
+        def serve(raw, conn):
+            n = parse(raw)
+            conn.recv(n)
+    """, relpath=CNI) == []
+
+
+# -- wire-taint: sanitizer discharge is PER SINK ------------------------------
+
+def test_taint_int_discharges_path_but_not_alloc():
+    # int() result cannot traverse a path...
+    assert check(WireTaintChecker(), """
+        import json, os
+
+        def handle(raw):
+            n = int(json.loads(raw)["n"])
+            return open(os.path.join("/state", "f-%d" % n))
+    """, relpath=CNI) == []
+    # ...but it is still an unbounded allocation size
+    violations = check(WireTaintChecker(), """
+        import json
+
+        def handle(raw, conn):
+            n = int(json.loads(raw)["n"])
+            conn.recv(n)
+    """, relpath=CNI)
+    assert len(violations) == 1 and "[alloc]" in violations[0].message
+
+
+def test_taint_bounded_label_discharges_metric_label():
+    flagged = check(WireTaintChecker(), """
+        import json
+        from ..utils import metrics
+
+        def handle(raw):
+            cmd = json.loads(raw)["cmd"]
+            metrics.REQUESTS.inc(command=cmd)
+    """, relpath=CNI)
+    assert len(flagged) == 1 and "[label]" in flagged[0].message
+    assert check(WireTaintChecker(), """
+        import json
+        from ..utils import metrics
+
+        def handle(raw):
+            cmd = metrics.bounded_label(json.loads(raw)["cmd"],
+                                        {"ADD", "DEL"})
+            metrics.REQUESTS.inc(command=cmd)
+    """, relpath=CNI) == []
+
+
+def test_taint_guard_raise_discharges_bounded_kinds():
+    assert check(WireTaintChecker(), """
+        import json
+
+        def handle(raw, conn):
+            n = int(json.loads(raw)["n"])
+            if n > 65536:
+                raise ValueError("too big")
+            conn.recv(n)
+    """, relpath=CNI) == []
+
+
+def test_taint_membership_guard_discharges_everything():
+    assert check(WireTaintChecker(), """
+        import json, subprocess
+
+        def handle(raw):
+            cmd = json.loads(raw)["cmd"]
+            if cmd not in ("up", "down"):
+                raise ValueError(cmd)
+            subprocess.run(["tool", cmd])
+    """, relpath=CNI) == []
+
+
+def test_taint_comprehension_applies_element_sanitizer():
+    from dpu_operator_tpu.utils.validate import clamped_int  # noqa: F401
+    assert check(WireTaintChecker(), """
+        import json
+        from ..utils.validate import clamped_int
+
+        def handle(raw, pool):
+            ids = tuple(clamped_int(t, 0, 1024, "id")
+                        for t in json.loads(raw)["ids"])
+            pool.alloc("owner", ids[0])
+    """, relpath=CNI) == []
+
+
+def test_taint_lazy_log_args_pass_format_string_flagged():
+    # tainted data as a LAZY %s arg is fine...
+    assert check(WireTaintChecker(), """
+        import json, logging
+
+        log = logging.getLogger(__name__)
+
+        def handle(raw):
+            body = json.loads(raw)
+            log.info("got %s", body["name"])
+    """, relpath=CNI) == []
+    # ...as the format string it is log forgery
+    violations = check(WireTaintChecker(), """
+        import json, logging
+
+        log = logging.getLogger(__name__)
+
+        def handle(raw):
+            body = json.loads(raw)
+            log.info("got " + str(body["name"]))
+    """, relpath=CNI)
+    assert len(violations) == 1 and "[logfmt]" in violations[0].message
+
+
+def test_taint_pragma_suppresses():
+    src = """
+        import json
+
+        def handle(raw, conn):
+            n = int(json.loads(raw)["n"])
+            conn.recv(n)  # opslint: disable=wire-taint
+    """
+    module = Module("/x/" + CNI, CNI, textwrap.dedent(src))
+    violations = [v for v in WireTaintChecker().check(module)
+                  if not module.suppressed(v.rule, v.line)]
+    assert violations == []
+
+
+def test_taint_ignores_trusted_modules():
+    # the same flow OUTSIDE a registered ingress module is not seeded
+    assert check(WireTaintChecker(), """
+        import json
+
+        def handle(raw, conn):
+            n = int(json.loads(raw)["n"])
+            conn.recv(n)
+    """, relpath="dpu_operator_tpu/utils/innocuous.py") == []
+
+
+# -- blocking-under-lock ------------------------------------------------------
+
+BLOCK = "dpu_operator_tpu/utils/somemod.py"
+
+
+def test_blocking_flags_untimed_queue_get_under_lock():
+    violations = check(BlockingUnderLockChecker(), """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.queue = None
+
+            def drain(self):
+                with self._lock:
+                    return self.queue.get()
+    """, relpath=BLOCK)
+    assert [v.rule for v in violations] == ["blocking-under-lock"]
+    assert "queue.get" in violations[0].message
+    assert "Pump._lock" in violations[0].message
+
+
+def test_blocking_passes_timeout_bounded_variants():
+    assert check(BlockingUnderLockChecker(), """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.queue = None
+                self._evt = threading.Event()
+
+            def drain(self):
+                with self._lock:
+                    self._evt.wait(5.0)
+                    return self.queue.get(timeout=1.0)
+    """, relpath=BLOCK) == []
+
+
+def test_blocking_flags_transitively_reached_sink_with_chain():
+    violations = check(BlockingUnderLockChecker(), """
+        import threading, time
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _backoff(self):
+                time.sleep(1.0)
+
+            def tick(self):
+                with self._lock:
+                    self._backoff()
+    """, relpath=BLOCK)
+    assert len(violations) == 1
+    msg = violations[0].message
+    assert "Engine.tick" in msg and "Engine._backoff" in msg
+
+
+def test_blocking_ignores_rlock_and_short_sleeps():
+    assert check(BlockingUnderLockChecker(), """
+        import threading, time
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._plain = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(10)   # RLock: out of scope
+
+            def micro(self):
+                with self._plain:
+                    time.sleep(0.01)  # below the wedge threshold
+    """, relpath=BLOCK) == []
+
+
+def test_blocking_condition_wait_releases_its_own_lock():
+    assert check(BlockingUnderLockChecker(), """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def drain(self):
+                with self._lock:
+                    self._cond.wait()
+    """, relpath=BLOCK) == []
+
+
+def test_blocking_socket_io_under_lock_flagged_and_pragma_works():
+    src = """
+        import threading
+
+        class Client:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._sock = sock
+
+            def call(self, payload):
+                with self._lock:
+                    self._sock.sendall(payload)
+    """
+    violations = check(BlockingUnderLockChecker(), src, relpath=BLOCK)
+    assert len(violations) == 1 and "sendall" in violations[0].message
+    suppressed = src.replace(
+        "self._sock.sendall(payload)",
+        "self._sock.sendall(payload)  "
+        "# opslint: disable=blocking-under-lock")
+    module = Module("/x/" + BLOCK, BLOCK, textwrap.dedent(suppressed))
+    assert [v for v in BlockingUnderLockChecker().check(module)
+            if not module.suppressed(v.rule, v.line)] == []
+
+
+def test_blocking_local_dict_named_requests_is_not_wire():
+    assert check(BlockingUnderLockChecker(), """
+        import threading
+
+        class Tally:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fold(self, containers):
+                with self._lock:
+                    requests = {}
+                    for c in containers:
+                        requests.update(c)
+                    return requests
+    """, relpath=BLOCK) == []
+
+
+# -- satellites: shared build, wall time, inventory ---------------------------
+
+def test_full_run_builds_the_symbol_table_once():
+    """Three whole-program passes (lock rules, blocking, taint) must
+    share ONE ProjectIndex per invocation."""
+    modules = load_modules(["dpu_operator_tpu"], REPO)
+    before = ProjectIndex.builds
+    run_checkers_on([cls() for cls in ALL_CHECKERS], modules)
+    assert ProjectIndex.builds - before <= 1
+
+
+def test_lint_gate_wall_time_stays_bounded():
+    """The CI gate must not crawl as interprocedural passes stack up:
+    a full `python -m dpu_operator_tpu.analysis` run (15 rules, whole
+    tree) stays well under the bound."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 60.0, f"lint gate took {elapsed:.1f}s"
+
+
+def test_pragma_inventory_counts_per_rule():
+    module = Module("/x/" + BLOCK, BLOCK, textwrap.dedent("""
+        import time
+        x = 1  # opslint: disable=wire-taint
+        y = 2  # opslint: disable=wire-taint,blocking-under-lock
+    """))
+    inv = pragma_inventory([module])
+    assert inv == {"wire-taint": 2, "blocking-under-lock": 1}
+
+
+def test_cli_sarif_out_writes_stable_artifact(tmp_path):
+    out = tmp_path / "opslint.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis",
+         "--sarif-out", str(out)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"wire-taint", "blocking-under-lock"} <= rules
+    assert "pragmas:" in proc.stdout
