@@ -5,7 +5,7 @@ TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
         upgrade-check fault-check scale-check serve-check lint-check \
-        fuzz-check \
+        fuzz-check fleet-obs-check \
         race-check type-check bench native traffic-flow images \
         smoke-images deploy undeploy graft-check clean
 
@@ -121,6 +121,25 @@ scale-check:
 serve-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m serve \
 	  -p no:randomly -p no:cacheprovider
+
+# fleet telemetry plane gate (doc/observability.md "Fleet telemetry
+# plane"): a seeded 100-node FakeKube fleet of damped TelemetryPublishers
+# over injected clocks — all nodes publish and the informer-fed rollup
+# converges object-by-object with the apiserver; a 200-flap storm on one
+# node stays inside the damping write budget (never O(flaps)); a
+# silenced node flips TelemetryStale (CR condition + Event + exclusion
+# from advertisable totals) and back; a forced relist leaves the rollup
+# equal to apiserver state; replayed/reordered digest sequences and
+# future schemas are ignored. Plus the cross-node trace federation e2e:
+# one CNI ADD (shim -> daemon -> VSP) and one streamed serve request
+# (ingress -> scheduler) under ONE caller trace_id, stitched into a
+# single parent-linked tree by `tpuctl fleet trace` across two per-node
+# flight rings, with one unreachable daemon degrading to a partial
+# result instead of an error.
+fleet-obs-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest \
+	  tests/test_fleet_telemetry.py tests/test_fleet_trace.py \
+	  -q -m obs -p no:randomly -p no:cacheprovider
 
 # opslint (dpu_operator_tpu/analysis/): the repo's own invariants as AST
 # checkers — wire-seam, retry-discipline, exception-hygiene,

@@ -37,8 +37,15 @@ def main(argv=None):
     from .k8s.real import RealKube
     client = RealKube(args.kubeconfig or None)
 
+    # fleet telemetry plane: the aggregator rides the manager's shared
+    # informer factory (one watch stream over every TpuNodeTelemetry
+    # digest CR) and the reconciler folds its rollup into the
+    # TpuOperatorConfig FleetTelemetry condition
+    from .controller import FleetAggregator
     mgr = Manager(client)
-    mgr.add_reconciler(TpuOperatorConfigReconciler(EnvImageManager()))
+    aggregator = FleetAggregator(client, mgr.informers)
+    mgr.add_reconciler(TpuOperatorConfigReconciler(
+        EnvImageManager(), fleet_provider=aggregator.conditions))
     mgr.add_reconciler(ServiceFunctionChainClusterReconciler())
 
     # handlers FIRST — before any server, lease, or manager goes live:
@@ -69,12 +76,14 @@ def main(argv=None):
     # SubjectAccessReview (reference: cmd/main.go:66-70 filters metrics
     # with WithAuthenticationAndAuthorization; RBAC:
     # config/rbac/metrics_auth_role.yaml + metrics_reader_role.yaml)
-    from .utils.metrics import TokenReviewAuth
+    from .utils.metrics import TokenReviewAuth, set_build_info
+    set_build_info("operator")
     metrics_server = MetricsServer(
         port=args.metrics_port, ready_check=started.is_set,
         auth=TokenReviewAuth(client),
         degraded_check=watchdog.WATCHDOG.degraded_components,
-        health_check=slo.health_snapshot)
+        health_check=slo.health_snapshot,
+        debug_handlers={"/debug/fleet": aggregator.rollup})
     metrics_server.start()
 
     from .webhook import WebhookServer
@@ -98,10 +107,12 @@ def main(argv=None):
             return
 
     mgr.start()
+    aggregator.start()
     started.set()
     log.info("operator running (metrics :%d, webhook :%d)",
              metrics_server.port, webhook.port)
     done.wait()
+    aggregator.stop()
     mgr.stop()
     webhook.stop()
     metrics_server.stop()

@@ -522,12 +522,27 @@ class MetricsNamingChecker(Checker):
 #: registered `tpu_*` family must have a row there
 _METRIC_DOC_RELPATH = os.path.join("doc", "observability.md")
 
+#: emit-shaped callables whose literal reason arguments are Kubernetes
+#: Event reasons flowing through the k8s/events.py seam: the global
+#: `events.emit`, the health engine's `emit_health_event`, recorder
+#: `.emit`, and `._emit` thin wrappers (vsp_rollout). The informer's
+#: `_emit("ADDED", ...)` never matches: watch event types are
+#: ALL-CAPS and the reason grammar requires mixed case.
+_EVENT_EMIT_NAMES = {"emit", "_emit", "emit_health_event"}
+
+#: CamelCase reason grammar; single words that are Event *types* or
+#: condition statuses, not reasons, are excluded explicitly
+_EVENT_REASON_RE = re.compile(r"^[A-Z][a-z][A-Za-z0-9]{2,}$")
+_EVENT_NON_REASONS = {"Warning", "Normal", "Event", "True", "False"}
+
 
 class MetricDocParityChecker(Checker):
     name = "metric-doc-parity"
-    description = ("every registered `tpu_*` metric family must have a "
-                   "matching row in doc/observability.md — operators "
-                   "discover series through that page, not the source")
+    description = ("every registered `tpu_*` metric family AND every "
+                   "Event reason emitted through k8s/events.py must "
+                   "have a matching row in doc/observability.md — "
+                   "operators discover series and `kubectl get "
+                   "events` reasons through that page, not the source")
 
     def __init__(self) -> None:
         #: repo root -> doc text (None = no doc file, rule inert —
@@ -542,6 +557,20 @@ class MetricDocParityChecker(Checker):
         if doc is None:
             return
         for call in calls_in(module.tree):
+            # Event-reason parity: emit-shaped calls carrying a literal
+            # CamelCase reason must have a row in the Event catalog
+            last = (dotted_name(call.func) or "").split(".")[-1]
+            if last in _EVENT_EMIT_NAMES:
+                for reason in self._event_reasons(call):
+                    if not re.search(rf"`{re.escape(reason)}`", doc):
+                        yield self.violation(
+                            module, call,
+                            f"Event reason {reason!r} has no row in "
+                            "doc/observability.md's Event catalog: "
+                            "document it (backticked, with type and "
+                            "when it fires) or `kubectl get events` "
+                            "surfaces a reason operators cannot look "
+                            "up")
             # same registration shapes the metrics-naming rule matches:
             # REGISTRY.counter/gauge/... and direct ctor calls with a
             # literal name + help string
@@ -564,6 +593,28 @@ class MetricDocParityChecker(Checker):
                     "type, meaning — backticked, as `"
                     f"{metric}" "` or with its labels) or the series "
                     "is undiscoverable to operators")
+
+    @staticmethod
+    def _event_reasons(call: ast.Call) -> list:
+        """Literal Event reasons in an emit-shaped call: CamelCase
+        string constants among the positional args (covers the global
+        emit's args[0], EventRecorder.emit's args[1], wrapper shapes
+        with the reason deeper in, and both branches of a conditional
+        reason) plus an explicit ``reason=`` keyword. Messages never
+        match — they are sentences; types ("Warning"/"Normal") are
+        excluded by name."""
+        nodes = list(call.args)
+        nodes.extend(kw.value for kw in call.keywords
+                     if kw.arg == "reason")
+        out = []
+        for node in nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str) \
+                        and _EVENT_REASON_RE.match(sub.value) \
+                        and sub.value not in _EVENT_NON_REASONS:
+                    out.append(sub.value)
+        return out
 
     def _doc_text(self, module: Module) -> Optional[str]:
         """doc/observability.md's content for the repo that owns

@@ -138,6 +138,49 @@ class TpuOperatorConfig:
         )
 
 
+#: version of the TpuNodeTelemetry status digest schema; aggregators
+#: ignore digests from a future schema (and count them) instead of
+#: misreading fields that moved
+TELEMETRY_SCHEMA_VERSION = 1
+
+
+@dataclass
+class TpuNodeTelemetry:
+    """Namespaced per-node telemetry digest CR (the fleet telemetry
+    plane's publish side). One object per node daemon, named after the
+    node; the daemon publishes its judged local state — health
+    components, serve headroom, fault-engine quarantines, active SLO
+    alerts, watchdog stalls — into ``status`` on a damped cadence
+    (daemon/telemetry.py), and the operator's FleetAggregator consumes
+    every object through one shared informer
+    (controller/fleet_telemetry.py). The spec is intentionally tiny:
+    the object IS its status."""
+
+    name: str
+    namespace: str = v.NAMESPACE
+    uid: str = ""
+
+    KIND = "TpuNodeTelemetry"
+
+    def to_obj(self) -> dict:
+        md: dict = {"name": self.name, "namespace": self.namespace}
+        if self.uid:
+            md["uid"] = self.uid
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": md,
+            "spec": {"node": self.name},
+        }
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "TpuNodeTelemetry":
+        md = obj.get("metadata", {})
+        return cls(name=md.get("name", ""),
+                   namespace=md.get("namespace", v.NAMESPACE),
+                   uid=md.get("uid", ""))
+
+
 @dataclass
 class NetworkFunction:
     """One element of an SFC (reference: servicefunctionchain_types.go:27-34)."""

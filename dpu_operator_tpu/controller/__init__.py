@@ -1,7 +1,9 @@
+from .fleet_telemetry import FleetAggregator
 from .tpuoperatorconfig_controller import TpuOperatorConfigReconciler
 from .servicefunctionchain_controller import ServiceFunctionChainClusterReconciler
 
 __all__ = [
+    "FleetAggregator",
     "TpuOperatorConfigReconciler",
     "ServiceFunctionChainClusterReconciler",
 ]

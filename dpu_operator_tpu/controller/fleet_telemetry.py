@@ -1,0 +1,503 @@
+"""FleetAggregator: informer-fed cluster rollup over TpuNodeTelemetry.
+
+The aggregate side of the fleet telemetry plane. One shared informer
+(the existing watch core — one LIST + one watch stream for the whole
+fleet) feeds every node's digest into an in-memory rollup:
+
+- **capacity**: total/free/advertisable serve slots and free KV blocks
+  summed across nodes — advertisable counts only FRESH nodes, so the
+  router (ROADMAP item 2) never places against a silent replica;
+- **fleet burn rate** per SLO over the SUMMED per-node cumulative
+  counters (windowed deltas, per-node restart resets clamped to zero) —
+  the SRE-Workbook math utils/slo.py runs per process, lifted to the
+  fleet;
+- **quarantined-unit census** from the fault-engine sections;
+- **staleness judgment**: a node whose accepted digest is older than
+  ``stale_after`` flips to ``TelemetryStale`` (condition on its CR +
+  Event + exclusion from advertisable totals) and back on the next
+  accepted digest.
+
+Digests are ordered by their publisher **sequence**: a replayed or
+reordered digest at/below the last accepted sequence is ignored
+(``tpu_fleet_digests_total{outcome="rejected_sequence"}``), and a
+digest from a future schema version is ignored rather than misread.
+
+Exported as ``tpu_fleet_*`` gauges, served at ``/debug/fleet`` on the
+operator's MetricsServer, and folded into TpuOperatorConfig status
+conditions by the reconciler (``fleet_provider`` seam).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..api.types import API_VERSION, TELEMETRY_SCHEMA_VERSION, \
+    TpuNodeTelemetry
+from ..k8s.events import EventRecorder, object_reference
+from ..utils import metrics
+from ..utils import vars as v
+
+log = logging.getLogger(__name__)
+
+#: default staleness deadline: 3x the publisher's heartbeat interval —
+#: one missed heartbeat is jitter, three is a silent node
+STALE_AFTER_S = 90.0
+
+#: fleet burn-rate window over the summed counters (one window: the
+#: rollup is a signal surface, not an alerting policy — per-node
+#: multi-window alerting already runs in each process)
+BURN_WINDOW_S = 300.0
+
+
+class _NodeState:
+    """Last accepted digest + receipt bookkeeping for one node."""
+
+    __slots__ = ("digest", "sequence", "received_at", "stale",
+                 "slo_samples")
+
+    def __init__(self) -> None:
+        self.digest: dict = {}
+        self.sequence = -1
+        self.received_at = float("-inf")
+        self.stale = False
+        #: per-SLO deque of (t, bad, total) cumulative samples — the
+        #: windowed delta source for the fleet burn rate
+        self.slo_samples: dict[str, deque] = {}
+
+
+class FleetAggregator:
+    """Cluster rollup fed by the TpuNodeTelemetry shared informer."""
+
+    def __init__(self, client: Any, factory: Any, *,
+                 namespace: str = v.NAMESPACE,
+                 clock: Callable[[], float] = time.monotonic,
+                 stale_after: float = STALE_AFTER_S,
+                 burn_window: float = BURN_WINDOW_S,
+                 component: str = "tpu-operator") -> None:
+        """*factory* is an ``InformerFactory`` (typically the
+        manager's — the aggregator rides the same watch stream every
+        other consumer of the kind shares)."""
+        self.client = client
+        self.factory = factory
+        self.namespace = namespace
+        self.clock = clock
+        self.stale_after = stale_after
+        self.burn_window = burn_window
+        self._recorder = EventRecorder(client, component=component,
+                                       namespace=namespace)
+        self._lock = threading.Lock()
+        self._nodes: dict[str, _NodeState] = {}
+        self._objectives: dict[str, float] = {}
+        #: label sets exported on the last gauge pass — a kind/SLO that
+        #: drops out of the rollup must be zeroed, not left reporting
+        #: its final value forever
+        self._exported_kinds: set = set()
+        self._exported_slos: set = set()
+        #: gauge-export debounce: a full rollup recompute per watch
+        #: event would be O(nodes) work per event — O(nodes²) per
+        #: convergence wave — under the lock; the gauges are a mirror,
+        #: so they refresh at most once per interval while rollup()
+        #: itself always computes fresh on demand
+        self.export_interval = 1.0
+        self._last_export = float("-inf")
+        self._cancel: Optional[Callable[[], None]] = None
+        self._check_timer: Any = None
+        self._stopped = False
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, check_interval: float = 5.0) -> "FleetAggregator":
+        """Attach to the shared informer and start the periodic
+        staleness check. *check_interval* <= 0 disables the timer —
+        deterministic harnesses drive :meth:`check_staleness` manually
+        against injected clocks."""
+        informer = self.factory.informer_for(API_VERSION,
+                                             TpuNodeTelemetry.KIND)
+        self._cancel = informer.add_handler(self._on_event)
+        if check_interval > 0:
+            self._schedule_check(check_interval)
+        return self
+
+    def _schedule_check(self, interval: float) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+
+            def fire() -> None:
+                try:
+                    self.check_staleness()
+                except Exception:  # noqa: BLE001 — the staleness loop
+                    # must outlive one bad pass
+                    log.exception("fleet staleness check failed")
+                finally:
+                    self._schedule_check(interval)
+
+            timer = threading.Timer(interval, fire)
+            timer.daemon = True
+            timer.start()
+            self._check_timer = timer
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            timer, self._check_timer = self._check_timer, None
+        if timer is not None:
+            timer.cancel()
+        if self._cancel is not None:
+            self._cancel()
+            self._cancel = None
+
+    # -- informer feed --------------------------------------------------------
+    def _on_event(self, event: str, obj: dict) -> None:
+        if event == "DELETED":
+            name = obj.get("metadata", {}).get("name", "")
+            with self._lock:
+                self._nodes.pop(name, None)
+                self._maybe_export_locked()
+            return
+        self.ingest(obj)
+
+    def ingest(self, obj: dict) -> bool:
+        """Accept one CR snapshot; returns False when rejected
+        (replayed/reordered sequence, future schema, no status)."""
+        status = obj.get("status") or {}
+        node = str(status.get("node")
+                   or obj.get("metadata", {}).get("name", ""))
+        if not node or not status:
+            return False
+        try:
+            seq = int(status.get("sequence", -1))
+            schema = int(status.get("schemaVersion", 0))
+        except (TypeError, ValueError):
+            metrics.FLEET_DIGESTS.inc(outcome="rejected_schema")
+            return False
+        if schema > TELEMETRY_SCHEMA_VERSION:
+            # a future daemon's digest: ignoring beats misreading
+            # fields that moved between schema generations
+            metrics.FLEET_DIGESTS.inc(outcome="rejected_schema")
+            return False
+        now = self.clock()
+        with self._lock:
+            state = self._nodes.setdefault(node, _NodeState())
+            if seq <= state.sequence:
+                # replayed or reordered read: a digest the apiserver
+                # already superseded must not roll the rollup back.
+                # Only a strictly LOWER sequence counts as a replay —
+                # the same sequence re-arriving is this aggregator's
+                # own condition write echoing back through the watch
+                if seq < state.sequence:
+                    metrics.FLEET_DIGESTS.inc(
+                        outcome="rejected_sequence")
+                return False
+            state.sequence = seq
+            state.digest = dict(status)
+            state.received_at = now
+            # an accepted digest IS freshness: a stale node rejoins
+            # advertisable totals on this very event, not on the next
+            # periodic staleness pass (the documented contract)
+            revived = state.stale
+            state.stale = False
+            self._ingest_slo_locked(state, status, now)
+            metrics.FLEET_DIGESTS.inc(outcome="accepted")
+            self._maybe_export_locked()
+        if revived:
+            self._publish_staleness(node, False)
+        return True
+
+    def _ingest_slo_locked(self, state: _NodeState, status: dict,
+                           now: float) -> None:
+        counters = status.get("sloCounters") or {}
+        if not isinstance(counters, dict):
+            return
+        horizon = self.burn_window
+        for name, row in counters.items():
+            if not isinstance(row, dict):
+                continue
+            try:
+                bad = float(row.get("bad", 0.0))
+                total = float(row.get("total", 0.0))
+                objective = float(row.get("objective", 0.0))
+            except (TypeError, ValueError):
+                continue
+            slo = metrics.bounded_label(name)
+            if 0.0 < objective < 1.0:
+                self._objectives[slo] = objective
+            samples = state.slo_samples.setdefault(slo, deque())
+            samples.append((now, bad, total))
+            # keep one sample at/earlier than the horizon — the delta
+            # reference, same pruning as utils/slo.py
+            while len(samples) >= 2 and samples[1][0] <= now - horizon:
+                samples.popleft()
+
+    # -- staleness ------------------------------------------------------------
+    def check_staleness(self) -> list[str]:
+        """Judge every node against the heartbeat deadline; returns the
+        currently-stale node names. Condition writes and Events happen
+        OUTSIDE the lock (wire I/O never runs under aggregator state)."""
+        now = self.clock()
+        flipped: list[tuple[str, bool]] = []
+        with self._lock:
+            for name, state in self._nodes.items():
+                # only the stale TRANSITION is judged here; freshness
+                # returns on the accepted digest itself (ingest)
+                stale = now - state.received_at > self.stale_after
+                if stale and not state.stale:
+                    state.stale = True
+                    flipped.append((name, True))
+            if flipped:
+                self._export_locked()
+            current = [n for n, s in self._nodes.items() if s.stale]
+        for name, stale in flipped:
+            self._publish_staleness(name, stale)
+        return sorted(current)
+
+    def _publish_staleness(self, node: str, stale: bool) -> None:
+        """TelemetryStale condition on the node's CR + Event — the
+        cluster-visible judgment that a node went silent (or came
+        back). Best-effort: the rollup's own exclusion already
+        happened under the lock.
+
+        The condition shares the status subresource with the daemon's
+        digest, and neither FakeKube nor the plain client offers a
+        field-scoped patch — so the write is a read-modify-write with
+        a bounded REPAIR loop: if the post-write read shows a sequence
+        below the aggregator's latest ACCEPTED digest, this write (or
+        a raced reader) buried a newer digest, and the loop restores
+        the accepted digest + conditions. The residual window (a
+        publish the aggregator has not even seen yet) self-heals on
+        the daemon's next publish, which carries conditions forward."""
+        condition = [{
+            "type": "TelemetryStale",
+            "status": "True" if stale else "False",
+            "reason": ("HeartbeatDeadlineMissed" if stale
+                       else "HeartbeatResumed"),
+            "message": (
+                f"no telemetry digest accepted within "
+                f"{self.stale_after:g}s" if stale else
+                "telemetry digests flowing again"),
+        }]
+        try:
+            obj = None
+            for _ in range(3):
+                with self._lock:
+                    st = self._nodes.get(node)
+                    expect_seq = st.sequence if st else -1
+                    accepted = dict(st.digest) if st else None
+                obj = self.client.get(
+                    API_VERSION, TpuNodeTelemetry.KIND, node,
+                    namespace=self.namespace)
+                if obj is None:
+                    break
+                status = dict(obj.get("status") or {})
+                if accepted is not None and \
+                        int(status.get("sequence") or -1) < expect_seq:
+                    status = dict(accepted)
+                status["conditions"] = condition
+                obj["status"] = status
+                self.client.update_status(obj)
+                check = self.client.get(
+                    API_VERSION, TpuNodeTelemetry.KIND, node,
+                    namespace=self.namespace)
+                if check is not None and int(
+                        (check.get("status") or {})
+                        .get("sequence") or -1) >= expect_seq:
+                    obj = check
+                    break
+            if obj is not None:
+                involved = object_reference(obj)
+            else:
+                from ..k8s.events import node_reference
+                involved = node_reference(node)
+            if stale:
+                self._recorder.emit(
+                    involved, "TelemetryStale",
+                    f"node {node} missed its telemetry heartbeat "
+                    f"deadline ({self.stale_after:g}s); excluded from "
+                    "advertisable fleet capacity",
+                    type_="Warning", series=node)
+            else:
+                self._recorder.emit(
+                    involved, "TelemetryFresh",
+                    f"node {node} resumed publishing telemetry; "
+                    "rejoined advertisable fleet capacity",
+                    series=node)
+        except Exception:  # noqa: BLE001 — condition/Event publication
+            # is observability; the in-memory judgment already stands
+            metrics.SWALLOWED_ERRORS.inc(site="fleet.staleness")
+            log.warning("staleness publication for %s failed", node,
+                        exc_info=True)
+
+    # -- rollup ---------------------------------------------------------------
+    def rollup(self) -> dict:
+        """The cluster rollup (served at ``/debug/fleet``, rendered by
+        ``tpuctl fleet top``, folded into TpuOperatorConfig status)."""
+        now = self.clock()
+        with self._lock:
+            return self._rollup_locked(now)
+
+    def _rollup_locked(self, now: float) -> dict:
+        slots_total = slots_free = slots_adv = 0
+        free_kv = 0
+        quarantined: dict[str, int] = {}
+        alerts: list[dict] = []
+        stalls: list[dict] = []
+        per_node: dict[str, dict] = {}
+        fresh = stale = 0
+        for name, state in sorted(self._nodes.items()):
+            digest = state.digest
+            headroom = digest.get("headroom") or {}
+            adv = int(headroom.get("advertisableSlots") or 0)
+            row = {
+                "sequence": state.sequence,
+                "asOf": digest.get("asOf"),
+                "stale": state.stale,
+                "metricsAddr": str(digest.get("metricsAddr") or ""),
+                "advertisableSlots": adv,
+                "healthy": bool(
+                    (digest.get("health") or {}).get("healthy", True)),
+            }
+            per_node[name] = row
+            if state.stale:
+                stale += 1
+                continue  # a silent node contributes NOTHING to totals
+            fresh += 1
+            slots_total += int(headroom.get("slots") or 0)
+            slots_free += int(headroom.get("freeSlots") or 0)
+            slots_adv += adv
+            free_kv += int(headroom.get("freeKvBlocks") or 0)
+            faults = digest.get("faults") or {}
+            for kind, count in (faults.get("quarantined")
+                                or {}).items():
+                kind = metrics.bounded_label(kind)
+                try:
+                    quarantined[kind] = (quarantined.get(kind, 0)
+                                         + int(count))
+                except (TypeError, ValueError):
+                    continue
+            for alert in digest.get("sloAlerts") or []:
+                if isinstance(alert, dict):
+                    alerts.append({
+                        "node": name,
+                        "slo": metrics.bounded_label(
+                            alert.get("slo", "")),
+                        "severity": metrics.bounded_label(
+                            alert.get("severity", ""),
+                            allowed={"page", "ticket"})})
+            for comp in digest.get("watchdogStalls") or []:
+                stalls.append({"node": name, "component": str(comp)})
+        burn = self._fleet_burn_locked(now)
+        return {
+            "schemaVersion": TELEMETRY_SCHEMA_VERSION,
+            "nodes": {"total": fresh + stale, "fresh": fresh,
+                      "stale": stale},
+            "staleNodes": sorted(n for n, s in self._nodes.items()
+                                 if s.stale),
+            "serveSlots": {"total": slots_total, "free": slots_free,
+                           "advertisable": slots_adv},
+            "freeKvBlocks": free_kv,
+            "quarantined": quarantined,
+            "sloBurnRate": burn,
+            "sloAlerts": alerts,
+            "watchdogStalls": stalls,
+            "perNode": per_node,
+        }
+
+    def _fleet_burn_locked(self, now: float) -> dict:
+        """Burn per SLO over the summed windowed deltas: for each node
+        the delta between its newest sample and its window reference,
+        clamped at zero (a restarted daemon resets its counters — a
+        negative delta is a reset, not negative traffic)."""
+        sums: dict[str, list[float]] = {}
+        for state in self._nodes.values():
+            if state.stale:
+                continue
+            for slo, samples in state.slo_samples.items():
+                if not samples:
+                    continue
+                t_new, bad_new, total_new = samples[-1]
+                ref = samples[0]
+                for s in samples:
+                    if s[0] <= now - self.burn_window:
+                        ref = s
+                    else:
+                        break
+                d_bad = max(0.0, bad_new - ref[1])
+                d_total = max(0.0, total_new - ref[2])
+                acc = sums.setdefault(slo, [0.0, 0.0])
+                acc[0] += d_bad
+                acc[1] += d_total
+        out: dict[str, float] = {}
+        for slo, (bad, total) in sums.items():
+            objective = self._objectives.get(slo)
+            if not total or objective is None:
+                out[slo] = 0.0
+                continue
+            budget = 1.0 - objective
+            out[slo] = round((bad / total) / budget, 4) if budget \
+                else 0.0
+        return out
+
+    def _maybe_export_locked(self) -> None:
+        now = self.clock()
+        if now - self._last_export < self.export_interval:
+            return
+        self._last_export = now
+        self._export_locked()
+
+    def _export_locked(self) -> None:
+        roll = self._rollup_locked(self.clock())
+        metrics.FLEET_NODES.set(float(roll["nodes"]["fresh"]),
+                                state="fresh")
+        metrics.FLEET_NODES.set(float(roll["nodes"]["stale"]),
+                                state="stale")
+        for dim, value in roll["serveSlots"].items():
+            metrics.FLEET_SERVE_SLOTS.set(float(value), dimension=dim)
+        metrics.FLEET_FREE_KV_BLOCKS.set(float(roll["freeKvBlocks"]))
+        # a kind/SLO that vanished from the rollup (last quarantined
+        # chip recovered, a stale node's SLO dropped out) must read 0,
+        # not its final value forever
+        for kind in self._exported_kinds - set(roll["quarantined"]):
+            metrics.FLEET_QUARANTINED.set(0.0, kind=kind)
+        for kind, count in roll["quarantined"].items():
+            metrics.FLEET_QUARANTINED.set(float(count), kind=kind)
+        self._exported_kinds = set(roll["quarantined"])
+        for slo in self._exported_slos - set(roll["sloBurnRate"]):
+            metrics.FLEET_SLO_BURN.set(0.0, slo=slo)
+        for slo, burn in roll["sloBurnRate"].items():
+            metrics.FLEET_SLO_BURN.set(float(burn), slo=slo)
+        self._exported_slos = set(roll["sloBurnRate"])
+        by_sev: dict[str, int] = {"page": 0, "ticket": 0}
+        for alert in roll["sloAlerts"]:
+            sev = alert.get("severity", "")
+            if sev in by_sev:
+                by_sev[sev] += 1
+        for sev, count in by_sev.items():
+            metrics.FLEET_SLO_ALERTS.set(float(count), severity=sev)
+
+    # -- TpuOperatorConfig condition seam -------------------------------------
+    def conditions(self) -> list[dict]:
+        """``FleetTelemetry`` condition rows for the TpuOperatorConfig
+        status (the reconciler's ``fleet_provider`` seam)."""
+        roll = self.rollup()
+        nodes = roll["nodes"]
+        healthy = nodes["stale"] == 0
+        if nodes["total"] == 0:
+            message = "no telemetry publishers yet"
+        elif healthy:
+            message = (f"{nodes['fresh']} node(s) publishing; "
+                       f"{roll['serveSlots']['advertisable']} "
+                       "advertisable serve slots")
+        else:
+            message = (f"{nodes['stale']} of {nodes['total']} node(s) "
+                       "TelemetryStale: "
+                       + ", ".join(roll["staleNodes"][:8]))
+        return [{
+            "type": "FleetTelemetry",
+            "status": "True" if healthy else "False",
+            "reason": ("AllNodesPublishing" if healthy
+                       else "NodesStale"),
+            "message": message,
+        }]

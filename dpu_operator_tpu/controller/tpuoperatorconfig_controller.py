@@ -37,11 +37,15 @@ class TpuOperatorConfigReconciler:
                  path_manager: PathManager | None = None,
                  fs_detector: FilesystemModeDetector | None = None,
                  health_provider: Optional[Callable[[], dict]]
+                 = None,
+                 fleet_provider: Optional[Callable[[], list]]
                  = None) -> None:
         """*health_provider*: callable returning the health-engine
         snapshot (utils/slo.py health_snapshot shape) folded into the
         CR's Healthy/Degraded conditions each reconcile; defaults to
-        the in-process engine."""
+        the in-process engine. *fleet_provider*: callable returning
+        FleetTelemetry condition rows (FleetAggregator.conditions) —
+        None when no aggregator runs in this process."""
         self.image_manager = image_manager
         self.path_manager = path_manager or PathManager()
         self.fs_detector = fs_detector or FilesystemModeDetector()
@@ -49,6 +53,7 @@ class TpuOperatorConfigReconciler:
             from ..utils.slo import health_snapshot
             health_provider = health_snapshot
         self.health_provider = health_provider
+        self.fleet_provider = fleet_provider
         self._recorder = None
         # blue-green VSP replacement (spec.upgradeStrategy): staged,
         # gated on the same health snapshot the CR conditions fold
@@ -184,6 +189,12 @@ class TpuOperatorConfigReconciler:
                         else "ComponentsDegraded"),
              "message": message},
         ]
+        if self.fleet_provider is not None:
+            try:
+                status["conditions"].extend(self.fleet_provider())
+            except Exception:  # noqa: BLE001 — a broken rollup must
+                log.exception("fleet condition provider failed")
+                # not fail the health fold (conditions above stand)
         if healthy != was_healthy:
             from ..k8s.events import EventRecorder, object_reference
             if self._recorder is None or self._recorder.client is not client:

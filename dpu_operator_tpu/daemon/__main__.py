@@ -31,6 +31,10 @@ def main(argv: Optional[list] = None) -> None:
     # join the trace tree and the flight recorder (doc/observability.md)
     from ..utils import tracing
     tracing.install_log_context()
+    # build identity on /metrics: schema generations + opslint rule
+    # count as labels (tpu_build_info)
+    from ..utils.metrics import set_build_info
+    set_build_info("daemon")
 
     # Fail fast when an apiserver is expected (explicit kubeconfig or
     # in-cluster env): silently downgrading to standalone would disable VSP

@@ -84,6 +84,10 @@ class Daemon:
         #: 200), so operators see a walled-off VSP or a wedged loop
         #: instead of discovering it.
         self.health_server = None
+        #: fleet telemetry publisher (daemon/telemetry.py): damped
+        #: TpuNodeTelemetry status writes; started alongside the
+        #: health server when a client + node name exist
+        self.telemetry = None
         # manager teardown must run exactly once, whichever of the
         # signal handler / serve-loop exit gets there first
         self._mgr_stop_lock = threading.Lock()
@@ -217,10 +221,52 @@ class Daemon:
             except Exception:  # noqa: BLE001 — observability must not
                 log.exception("event recorder setup failed")  # kill it
 
+    def _start_telemetry(self) -> None:
+        """Damped per-node digest publisher (the fleet telemetry
+        plane's publish side): requires an apiserver client and a node
+        identity; sources resolve lazily against whatever side manager
+        is live when each digest is built."""
+        if self.client is None or not self.node_name \
+                or self.telemetry is not None:
+            return
+
+        def faults() -> Optional[dict]:
+            from ..faults.engine import QUARANTINED, RECOVERING
+            engine = getattr(self.manager, "fault_engine", None)
+            if engine is None:
+                return None
+            quarantined: dict = {}
+            for row in engine.state_table():
+                if row.get("state") in (QUARANTINED, RECOVERING):
+                    kind = str(row.get("kind", ""))
+                    quarantined[kind] = quarantined.get(kind, 0) + 1
+            return {"quarantined": quarantined,
+                    "sliceDegraded": engine.slice_degraded()}
+
+        try:
+            from .telemetry import default_publisher
+            # the digest's metricsAddr is what `tpuctl fleet trace`
+            # fans out to from ANOTHER host — it must be node-reachable,
+            # never loopback: the DaemonSet exports the pod/host IP as
+            # TPU_DAEMON_METRICS_HOST (hostNetwork daemons fall back to
+            # the kernel hostname, resolvable via cluster node DNS)
+            host = (os.environ.get("TPU_DAEMON_METRICS_HOST", "")
+                    or os.uname().nodename)
+            addr = ("%s:%d" % (host, self.health_server.port)
+                    if self.health_server is not None else "")
+            self.telemetry = default_publisher(
+                self.client, self.node_name,
+                metrics_addr=addr, faults_fn=faults)
+            self.telemetry.start()
+        except Exception:  # noqa: BLE001 — telemetry must never take
+            self.telemetry = None  # the daemon down
+            log.exception("telemetry publisher failed to start")
+
     def serve(self, block: bool = True) -> None:
         """1 Hz detect loop; returns when stopped or a manager errored."""
         self._start_health_engine()
         self._start_health_server()
+        self._start_telemetry()
         # watchdog heartbeat for this loop — only in blocking mode,
         # where the loop actually keeps running (block=False returns
         # after one pass; a registered heartbeat would read as a stall)
@@ -309,6 +355,9 @@ class Daemon:
         self._stop_manager()
         if self._serve_thread:
             self._serve_thread.join(timeout=5)
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
         if self.health_server is not None:
             self.health_server.stop()
             self.health_server = None

@@ -26,7 +26,8 @@ from ..k8s.fake import FakeKube
 from ..k8s.informer import cached_list
 from ..k8s.manager import Manager, ReconcileResult, Request
 
-__all__ = ["CountingKube", "FleetReconciler", "FleetHarness"]
+__all__ = ["CountingKube", "FleetReconciler", "FleetHarness",
+           "TelemetryFleetHarness"]
 
 
 class CountingKube:
@@ -345,3 +346,136 @@ class FleetHarness:
 
     def relists(self) -> int:
         return sum(inf.relists for inf in self.mgr.informers.informers())
+
+
+# -- fleet telemetry plane (daemon/telemetry.py + controller/fleet_telemetry.py)
+
+class _NodeSources:
+    """Mutable per-node telemetry sources a test flips to drive the
+    damping gate — the digest dimensions without the subsystems."""
+
+    def __init__(self, rng: random.Random) -> None:
+        self.slots = 24
+        self.free_slots = rng.randrange(0, 25)
+        self.free_kv = 512
+        self.backlog = 0
+        self.quarantined: dict = {}
+        self.alerts: list = []
+        self.stalls: list = []
+        self.slo: dict = {"serve-ttft": {
+            "total": float(rng.randrange(100, 1000)), "bad": 0.0,
+            "objective": 0.99}}
+        self._hseq = 0
+
+    def headroom(self) -> dict:
+        self._hseq += 1
+        adv = min(self.free_slots, self.free_kv // 16)
+        return {"sequence": self._hseq, "asOf": 0.0,
+                "slots": self.slots, "freeSlots": self.free_slots,
+                "advertisableSlots": adv,
+                "freeKvBlocks": self.free_kv,
+                "chunkBacklogTokens": self.backlog,
+                "queueDepth": {"interactive": 0, "batch": 0},
+                "prefixIndexKeys": 0}
+
+    def faults(self) -> dict:
+        return {"quarantined": dict(self.quarantined),
+                "sliceDegraded": None}
+
+
+class TelemetryFleetHarness:
+    """Seeded N-node fleet for the telemetry plane gate
+    (``make fleet-obs-check``): N TelemetryPublishers with injected
+    virtual clocks over ONE CountingKube (so the damping bound is
+    asserted against real counted apiserver writes), one shared
+    informer feeding a FleetAggregator, and the FakeKube watch-outage
+    injectors for the forced-relist parity scenario. No wall-clock
+    sleeps drive assertions: the virtual clock advances explicitly and
+    convergence waits are event-driven."""
+
+    def __init__(self, n_nodes: int = 100, seed: int = 20260803,
+                 stale_after: float = 90.0,
+                 heartbeat_interval: float = 30.0,
+                 damp_interval: float = 5.0) -> None:
+        from ..controller.fleet_telemetry import FleetAggregator
+        from ..daemon.telemetry import TelemetryPublisher
+        from ..k8s.informer import InformerFactory
+
+        self.rng = random.Random(seed)
+        self.kube = FakeKube()
+        self.client = CountingKube(self.kube)
+        self.now = 0.0
+        clock = lambda: self.now  # noqa: E731 — the injected clock
+        self.factory = InformerFactory(self.client)
+        self.aggregator = FleetAggregator(
+            self.client, self.factory, clock=clock,
+            stale_after=stale_after)
+        self.sources: list[_NodeSources] = []
+        self.publishers: list = []
+        for i in range(n_nodes):
+            src = _NodeSources(self.rng)
+            pub = TelemetryPublisher(
+                self.client, f"node-{i:04d}",
+                metrics_addr=f"127.0.0.1:{18001 + i}",
+                headroom_fn=src.headroom,
+                faults_fn=src.faults,
+                health_fn=lambda: {"healthy": True, "degraded": []},
+                counters_fn=(lambda s=src: dict(s.slo)),
+                alerts_fn=(lambda s=src: list(s.alerts)),
+                stalls_fn=(lambda s=src: list(s.stalls)),
+                clock=clock, wall=clock,
+                heartbeat_interval=heartbeat_interval,
+                damp_interval=damp_interval)
+            self.sources.append(src)
+            self.publishers.append(pub)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        """Attach the aggregator to the shared informer; staleness
+        checks stay manual (deterministic against the virtual clock)."""
+        self.aggregator.start(check_interval=0.0)
+
+    def stop(self) -> None:
+        self.aggregator.stop()
+        self.factory.stop_all()
+
+    # -- clock + cadence ------------------------------------------------------
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+    def tick_all(self) -> int:
+        return sum(1 for pub in self.publishers if pub.tick())
+
+    def status_writes(self) -> int:
+        return self.client.snapshot().get("update_status", 0)
+
+    # -- scenarios ------------------------------------------------------------
+    def storm(self, node: int = 0, flaps: int = 200,
+              dt: float = 0.1) -> None:
+        """M advertisable-slot flaps on one node, each followed by a
+        publisher tick and a small clock step — the damping-budget
+        storm (material on every flap; writes bounded by the damp
+        interval, not M)."""
+        src = self.sources[node]
+        for _ in range(flaps):
+            src.free_slots = 0 if src.free_slots else src.slots
+            self.publishers[node].tick()
+            self.advance(dt)
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Watch pipeline drained: apiserver fanout done AND every
+        informer handler queue empty (double-read with a settle gap —
+        the Manager.wait_idle discipline without a Manager)."""
+        deadline = time.monotonic() + timeout
+        inflight = getattr(self.kube, "watch_inflight", lambda: False)
+
+        def quiet() -> bool:
+            return not inflight() and not self.factory.pending()
+
+        while time.monotonic() < deadline:
+            if quiet():
+                time.sleep(0.02)
+                if quiet():
+                    return True
+            time.sleep(0.005)
+        return False

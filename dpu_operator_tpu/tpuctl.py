@@ -130,6 +130,35 @@ def _vsp_cmds(sub):
                    help="bearer token when the debug endpoints are "
                         "auth-filtered")
     p = sub.add_parser(
+        "fleet",
+        help="fleet telemetry plane: 'top' renders the operator's "
+             "cluster rollup from /debug/fleet on --operator-addr "
+             "(fresh/stale nodes, serve-slot totals, fleet SLO burn "
+             "rates, quarantined-unit census); 'trace <trace_id>' "
+             "fans out to every node's /debug/flight endpoint "
+             "(addresses from the rollup or --nodes; bounded "
+             "concurrency, per-node timeout) and stitches the "
+             "cross-node span tree — a CNI ADD's shim/daemon/VSP "
+             "spans and a serve request's ingress/scheduler spans "
+             "reassemble under one trace_id; unreachable nodes "
+             "degrade to a partial result, never an error")
+    p.add_argument("action", choices=["top", "trace"])
+    p.add_argument("trace_id", nargs="?", default="",
+                   help="trace id to stitch (trace action)")
+    p.add_argument("--operator-addr", default="127.0.0.1:18090",
+                   help="host:port of the operator's metrics server "
+                        "(serves /debug/fleet)")
+    p.add_argument("--nodes", default="",
+                   help="comma-separated host:port flight endpoints "
+                        "(overrides discovery through the rollup)")
+    p.add_argument("--fanout-timeout", type=float, default=3.0,
+                   help="per-node /debug/flight fetch timeout")
+    p.add_argument("--max-workers", type=int, default=8,
+                   help="fan-out concurrency bound")
+    p.add_argument("--token", default="",
+                   help="bearer token when the debug endpoints are "
+                        "auth-filtered")
+    p = sub.add_parser(
         "handoff",
         help="zero-downtime upgrade: 'begin' asks the daemon (over "
              "--daemon-addr) to freeze mutations and serve its live "
@@ -346,6 +375,121 @@ def render_serve_top(snapshot: dict, ledger: dict,
     return out
 
 
+def render_fleet_top(rollup: dict) -> dict:
+    """The `tpuctl fleet top` view over the operator's /debug/fleet
+    rollup: the cluster capacity/health summary an operator of N nodes
+    reads first, with the per-node table kept for drill-down."""
+    nodes = rollup.get("nodes") or {}
+    return {
+        "reachable": True,
+        "nodes": nodes,
+        "staleNodes": rollup.get("staleNodes", []),
+        "serveSlots": rollup.get("serveSlots", {}),
+        "freeKvBlocks": rollup.get("freeKvBlocks", 0),
+        "quarantined": rollup.get("quarantined", {}),
+        "sloBurnRate": rollup.get("sloBurnRate", {}),
+        "sloAlerts": rollup.get("sloAlerts", []),
+        "watchdogStalls": rollup.get("watchdogStalls", []),
+        "perNode": rollup.get("perNode", {}),
+    }
+
+
+def federate_flight(addrs: list, token: str = "",
+                    timeout: float = 3.0,
+                    max_workers: int = 8) -> tuple[dict, list]:
+    """Fetch /debug/flight from every node with BOUNDED concurrency
+    and a per-node timeout; returns (addr -> events, unreachable
+    [{addr, error}]). A node that cannot answer degrades the result to
+    partial — it never fails the whole federation."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .utils.flight import fetch
+
+    def one(addr: str):
+        try:
+            return addr, fetch(addr, timeout=timeout,
+                               token=token).get("events", []), None
+        except Exception as e:  # noqa: BLE001 — partial results by
+            # contract: one dead daemon must not hide the other N-1
+            return addr, None, f"{type(e).__name__}: {e}"
+
+    per_node: dict = {}
+    unreachable: list = []
+    if not addrs:
+        return per_node, unreachable
+    with ThreadPoolExecutor(
+            max_workers=max(1, min(max_workers, len(addrs)))) as pool:
+        for addr, events, error in pool.map(one, addrs):
+            if error is None:
+                per_node[addr] = events
+            else:
+                unreachable.append({"addr": addr, "error": error})
+    return per_node, unreachable
+
+
+def stitch_trace(trace_id: str, per_node_events: dict,
+                 unreachable: list | None = None) -> dict:
+    """Reassemble one trace's span tree from several nodes' flight
+    rings. Spans (flight entries carrying a span_id) hang below their
+    recorded parent_id regardless of which node recorded them — the
+    CNI shim → daemon → VSP hops and the ingress → scheduler hops
+    share ids, so the cross-node path reads as ONE tree. Spans whose
+    parent was never captured (evicted ring, unreachable node, or a
+    genuine root) surface as roots; non-span entries of the trace
+    (FirstToken, breaker flips, stalls) ride along as `events`."""
+    spans: dict = {}
+    extras: list = []
+    for addr in sorted(per_node_events):
+        for e in per_node_events[addr] or []:
+            if e.get("trace_id") != trace_id:
+                continue
+            sid = e.get("span_id")
+            entry = {
+                "node": addr,
+                "kind": e.get("kind", ""),
+                "name": e.get("name", ""),
+                "ts": e.get("ts"),
+                "spanId": sid,
+                "parentId": e.get("parent_id"),
+                "durationSeconds": e.get("duration_s"),
+                "attributes": e.get("attributes") or {},
+                "children": [],
+            }
+            if sid and sid not in spans:
+                spans[sid] = entry
+            elif not sid:
+                extras.append(entry)
+    roots = []
+    for entry in spans.values():
+        parent = spans.get(entry["parentId"] or "")
+        if parent is not None and parent is not entry:
+            parent["children"].append(entry)
+        else:
+            roots.append(entry)
+
+    def order(items: list) -> list:
+        items.sort(key=lambda s: (s["ts"] is None, s["ts"] or 0.0,
+                                  s["name"]))
+        for item in items:
+            order(item["children"])
+        return items
+
+    return {
+        "traceId": trace_id,
+        "found": bool(spans or extras),
+        "nodes": {addr: sum(1 for e in (events or [])
+                            if e.get("trace_id") == trace_id)
+                  for addr, events in sorted(per_node_events.items())},
+        "unreachable": list(unreachable or []),
+        "partial": bool(unreachable),
+        "spanCount": len(spans),
+        "tree": order(roots),
+        "events": sorted(extras,
+                         key=lambda s: (s["ts"] is None, s["ts"] or 0.0,
+                                        s["name"])),
+    }
+
+
 def render_faults(status: dict, flight_events: list) -> dict:
     """Fold the daemon's GetFaults answer with the flight recorder's
     fault-kind entries into the `tpuctl faults` view: the judged state
@@ -472,6 +616,36 @@ def run(args) -> dict:
             events = []
         return render_serve(snap, events, now=_time.time(),
                             window_s=args.window)
+
+    if args.cmd == "fleet":
+        from .utils.flight import fetch
+        rollup = None
+        try:
+            rollup = fetch(args.operator_addr, token=args.token,
+                           path="/debug/fleet")
+        except Exception as e:  # noqa: BLE001 — graceful: top needs
+            # the rollup; trace can still run from explicit --nodes
+            print(f"tpuctl: fleet rollup unreachable at "
+                  f"{args.operator_addr}: {e}", file=sys.stderr)
+            if args.action == "top" or not args.nodes:
+                return {"reachable": False, "error": str(e)}
+        if args.action == "top":
+            return render_fleet_top(rollup)
+        if not args.trace_id:
+            raise SystemExit("fleet trace needs a trace id: "
+                             "tpuctl fleet trace <trace_id>")
+        if args.nodes:
+            addrs = [a.strip() for a in args.nodes.split(",")
+                     if a.strip()]
+        else:
+            addrs = sorted({
+                row.get("metricsAddr", "")
+                for row in (rollup.get("perNode") or {}).values()
+                if row.get("metricsAddr")})
+        per_node, unreachable = federate_flight(
+            addrs, token=args.token, timeout=args.fanout_timeout,
+            max_workers=args.max_workers)
+        return stitch_trace(args.trace_id, per_node, unreachable)
 
     if args.cmd == "handoff" and args.action == "status":
         from .utils.flight import fetch

@@ -83,6 +83,7 @@ class FlightRecorder:
     def record(self, kind: str, name: str,
                trace_id: Optional[str] = None,
                span_id: Optional[str] = None,
+               parent_id: Optional[str] = None,
                duration_s: Optional[float] = None,
                error: str = "",
                attributes: Optional[dict] = None) -> None:
@@ -101,6 +102,11 @@ class FlightRecorder:
             event["trace_id"] = trace_id
         if span_id:
             event["span_id"] = span_id
+        if parent_id:
+            # the parent's span_id: what lets `tpuctl fleet trace`
+            # stitch flight rings from several nodes into ONE span
+            # tree without a trace sink having been configured
+            event["parent_id"] = parent_id
         if duration_s is not None:
             event["duration_s"] = duration_s
         if error:

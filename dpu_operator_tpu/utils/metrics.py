@@ -609,6 +609,56 @@ FLIGHT_DROPPED = REGISTRY.counter(
     "Flight-recorder events evicted by ring overflow, per kind — a "
     "storm that outruns the ring is visible here instead of silently "
     "overwriting history (tpuctl flight surfaces the same counts)")
+# -- fleet telemetry plane (daemon/telemetry.py + controller/fleet_telemetry.py)
+TELEMETRY_PUBLISHES = REGISTRY.counter(
+    "tpu_telemetry_publishes_total",
+    "TpuNodeTelemetry status writes by reason (change = immediate "
+    "publish on a material digest change; coalesced = a change damped "
+    "earlier published at the damp boundary; heartbeat = max-interval "
+    "keepalive; error = the write failed and stays dirty)")
+TELEMETRY_DAMPED = REGISTRY.counter(
+    "tpu_telemetry_damped_total",
+    "Material digest changes absorbed into a pending coalesced publish "
+    "instead of an immediate apiserver write (the damping that bounds "
+    "a flapping gauge to one write per damp interval)")
+FLEET_DIGESTS = REGISTRY.counter(
+    "tpu_fleet_digests_total",
+    "Per-node telemetry digests processed by the FleetAggregator, by "
+    "outcome (accepted; rejected_sequence = replayed/reordered digest "
+    "at or below the last accepted sequence; rejected_schema = digest "
+    "from an unknown future schema version)")
+FLEET_NODES = REGISTRY.gauge(
+    "tpu_fleet_nodes",
+    "Nodes known to the fleet telemetry rollup by freshness (fresh = "
+    "digest inside the staleness deadline; stale = TelemetryStale, "
+    "excluded from advertisable totals)")
+FLEET_SERVE_SLOTS = REGISTRY.gauge(
+    "tpu_fleet_serve_slots",
+    "Cluster-wide serve-slot rollup by dimension (total / free / "
+    "advertisable — advertisable sums only fresh nodes, the number the "
+    "fleet router can actually place against)")
+FLEET_FREE_KV_BLOCKS = REGISTRY.gauge(
+    "tpu_fleet_free_kv_blocks",
+    "Cluster-wide free KV-pool blocks summed over fresh nodes")
+FLEET_QUARANTINED = REGISTRY.gauge(
+    "tpu_fleet_quarantined_units",
+    "Fault-engine quarantined/recovering units across the fleet, by "
+    "kind (chip/link) — the quarantined-chip census")
+FLEET_SLO_BURN = REGISTRY.gauge(
+    "tpu_fleet_slo_burn_rate",
+    "Fleet-wide SLO burn rate per SLO, computed over the SUMMED "
+    "per-node counters from the telemetry digests (1.0 = spending the "
+    "error budget exactly)")
+FLEET_SLO_ALERTS = REGISTRY.gauge(
+    "tpu_fleet_slo_alerts",
+    "Active per-node SLO burn-rate alerts across the fleet, by "
+    "severity")
+BUILD_INFO = REGISTRY.gauge(
+    "tpu_build_info",
+    "Always-1 info-style gauge carrying build identity as labels: "
+    "component (daemon/vsp/operator), telemetry digest schema, handoff "
+    "bundle schema, and the opslint rule count — so a fleet scrape "
+    "answers which schema generation every process speaks")
 # -- static-analysis gate (opslint exception-hygiene rule) -------------------
 SWALLOWED_ERRORS = REGISTRY._add(_FlightRecordedCounter(
     "tpu_daemon_swallowed_errors_total",
@@ -616,6 +666,33 @@ SWALLOWED_ERRORS = REGISTRY._add(_FlightRecordedCounter(
     "by site — a rising rate at one site is a failing dependency that "
     "would otherwise be invisible",
     kind="swallowed_error"))
+
+
+def set_build_info(component: str) -> None:
+    """Register this process's ``tpu_build_info`` sample — called once
+    from each entrypoint (daemon, VSP, operator). Label sources are
+    imported lazily and individually guarded: build identity must
+    never take down the process it identifies."""
+    labels = {"component": component}
+    try:
+        from ..api.types import TELEMETRY_SCHEMA_VERSION
+        labels["telemetry_schema"] = str(TELEMETRY_SCHEMA_VERSION)
+    except Exception:  # noqa: BLE001 — label is informational
+        logging.getLogger(__name__).exception(
+            "build info: telemetry schema version unavailable")
+    try:
+        from ..daemon.handoff import SCHEMA_VERSION
+        labels["handoff_schema"] = str(SCHEMA_VERSION)
+    except Exception:  # noqa: BLE001 — label is informational
+        logging.getLogger(__name__).exception(
+            "build info: handoff schema version unavailable")
+    try:
+        from ..analysis import ALL_CHECKERS
+        labels["opslint_rules"] = str(len(ALL_CHECKERS))
+    except Exception:  # noqa: BLE001 — label is informational
+        logging.getLogger(__name__).exception(
+            "build info: opslint rule count unavailable")
+    BUILD_INFO.set(1.0, **labels)
 
 
 class TokenReviewAuth:
@@ -700,7 +777,9 @@ class MetricsServer:
                  degraded_check: Optional[Callable[[], list]] = None,
                  health_check: Optional[Callable[[], dict]] = None,
                  debug_handlers: Optional[
-                     dict[str, Callable[[], dict]]] = None) -> None:
+                     dict[str, Callable[[], dict]]] = None,
+                 flight_recorder: Optional[
+                     "flight.FlightRecorder"] = None) -> None:
         """*degraded_check* returns the components currently degraded
         (open circuit breakers + watchdog-stalled loops) — surfaced as
         a structured JSON breakdown in the /healthz body. Degraded is
@@ -720,6 +799,11 @@ class MetricsServer:
         self.degraded_check = degraded_check
         self.health_check = health_check
         self.debug_handlers = dict(debug_handlers or {})
+        #: the ring /debug/flight serves; default = the process-global
+        #: recorder (overridable so multi-node tests can serve one ring
+        #: per simulated node)
+        self.flight_recorder = (flight_recorder if flight_recorder
+                                is not None else flight.RECORDER)
         self._server: Optional[ThreadingHTTPServer] = None
 
     def start(self) -> None:
@@ -771,7 +855,7 @@ class MetricsServer:
                     else:
                         import json
                         body = json.dumps(
-                            flight.RECORDER.snapshot()).encode()
+                            outer.flight_recorder.snapshot()).encode()
                         ctype, code = "application/json", 200
                 elif self.path == "/debug/health":
                     denied = self._auth_denial()

@@ -247,6 +247,28 @@ class SloEvaluator:
         with self._lock:
             return {name: dict(s) for name, s in self._last.items()}
 
+    def counters(self) -> dict:
+        """Raw cumulative (total, bad) reads per SLO — what the node
+        telemetry digest publishes so the FleetAggregator can compute
+        fleet-wide burn rates over SUMMED counters instead of trying
+        to average per-node rates (sums weight nodes by traffic, the
+        only aggregation that preserves the budget math)."""
+        with self._lock:
+            slos = list(self._slos)
+        out: dict[str, dict] = {}
+        for slo in slos:
+            try:
+                out[slo.name] = {
+                    "total": float(slo.total_fn()),
+                    "bad": float(slo.bad_fn()),
+                    "objective": slo.objective,
+                }
+            except Exception:  # noqa: BLE001 — one broken source must
+                # not hide every other SLO's counters from the fleet
+                metrics.SWALLOWED_ERRORS.inc(site="slo.counters")
+                log.exception("SLO %s counter read failed", slo.name)
+        return out
+
     # -- production loop ------------------------------------------------------
     def start(self, interval: float = 10.0) -> None:
         with self._lock:

@@ -247,7 +247,9 @@ def span(name: str, /, **attributes: object) -> Iterator[SpanContext]:
         _local.ctx = prev
         duration = round(time.perf_counter() - t0, 6)
         flight.record("span", name, trace_id=ctx.trace_id,
-                      span_id=ctx.span_id, duration_s=duration,
+                      span_id=ctx.span_id,
+                      parent_id=parent.span_id if parent else None,
+                      duration_s=duration,
                       error=error,
                       attributes={k: str(v) for k, v in
                                   attributes.items()} or None)

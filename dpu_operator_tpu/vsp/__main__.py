@@ -41,6 +41,10 @@ def main(argv: Optional[list] = None) -> None:
     # gRPC metadata restored server-side (vsp/rpc.py)
     from ..utils import tracing
     tracing.install_log_context()
+    # build identity on /metrics (tpu_build_info): which schema
+    # generation this VSP speaks, for fleet-wide skew checks
+    from ..utils.metrics import set_build_info
+    set_build_info("vsp")
 
     pm = PathManager(args.root)
     sock = args.socket or pm.vendor_plugin_socket()

@@ -500,7 +500,9 @@ class Scheduler:
                  executor: Optional[Any] = None,
                  cost_model: Optional[CostModel] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 heartbeat: Optional[watchdog.Heartbeat] = None) -> None:
+                 heartbeat: Optional[watchdog.Heartbeat] = None,
+                 headroom_clock: Optional[Callable[[], float]]
+                 = None) -> None:
         self.config = config
         self.executor = executor if executor is not None else SimExecutor()
         self.cost = cost_model if cost_model is not None else CostModel()
@@ -526,6 +528,13 @@ class Scheduler:
                 "chunked prefill configured but the executor was built "
                 "without a chunk width (pass chunk_tokens)")
         self.now = 0.0 if clock is None else clock()
+        #: headroom digest freshness: a monotonic per-replica sequence
+        #: plus a wall-clock stamp (injectable for tests) so a remote
+        #: aggregator can detect a reordered or replayed read — two
+        #: digests compare by sequence, never by arrival order
+        self._headroom_seq = 0
+        self._headroom_clock: Callable[[], float] = (
+            headroom_clock if headroom_clock is not None else time.time)
         #: guards _pending (submit() may race the step loop)
         self._lock = threading.Lock()
         #: guards the scheduler's mutable state as a whole against
@@ -1398,7 +1407,14 @@ class Scheduler:
             cap = self.capacity()
             backlog = self._prefill_backlog()
             queued = {cls: len(q) for cls, q in self._queues.items()}
+            # sequence bumps under the state lock: two concurrent
+            # readers get distinct, ordered sequences, so the consumer
+            # rule "higher sequence wins" is safe
+            self._headroom_seq += 1
+            seq = self._headroom_seq
         return {
+            "sequence": seq,
+            "asOf": round(self._headroom_clock(), 6),
             "slots": self.config.slots,
             "freeSlots": cap["freeSlots"],
             "advertisableSlots": cap["advertisableSlots"],

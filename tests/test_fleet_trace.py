@@ -1,0 +1,249 @@
+"""Cross-node flight/trace federation e2e (`make fleet-obs-check`).
+
+Two simulated nodes, each with its OWN flight ring served by its own
+MetricsServer — node A runs a real CNI ADD (shim → CNI server → VSP
+gRPC over real unix sockets), node B serves a real streamed request
+through the HTTP ingress → scheduler. Both adopt the SAME caller
+traceparent, so `tpuctl fleet trace <trace_id>` must fan out to both
+/debug/flight endpoints (bounded concurrency, per-node timeout) and
+reassemble ONE parent-linked span tree spanning both nodes; killing
+one node must degrade the answer to a partial result, never an error.
+"""
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dpu_operator_tpu import tpuctl
+from dpu_operator_tpu.cni import CniServer, CniShim
+from dpu_operator_tpu.platform import TpuDetector
+from dpu_operator_tpu.utils import flight, tracing
+from dpu_operator_tpu.utils.metrics import MetricsServer
+from dpu_operator_tpu.utils.path_manager import PathManager
+from dpu_operator_tpu.vsp import GrpcPlugin, MockTpuVsp, VspServer
+from dpu_operator_tpu.workloads import serve
+
+pytestmark = pytest.mark.obs
+
+#: the client's trace: both the CNI ADD and the serve request join it,
+#: which is exactly what makes the cross-node tree a single trace_id
+TRACE = "ab" * 16
+CLIENT_SPAN = "12" * 8
+TRACEPARENT = f"00-{TRACE}-{CLIENT_SPAN}-01"
+
+
+def _env(container="fede2e0001", ifname="net1"):
+    return {
+        "CNI_COMMAND": "ADD",
+        "CNI_CONTAINERID": container,
+        "CNI_NETNS": "/var/run/netns/x",
+        "CNI_IFNAME": ifname,
+        "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=fedpod",
+    }
+
+
+def _conf():
+    return {"cniVersion": "0.4.0", "name": "tpunfcni-conf",
+            "type": "tpu-cni", "mode": "chip", "deviceID": "chip-1",
+            "resourceName": "google.com/tpu"}
+
+
+def _stream_post(port, body, traceparent):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", "/v1/generate", json.dumps(body),
+                     {"Content-Type": "application/json",
+                      "traceparent": traceparent})
+        resp = conn.getresponse()
+        raw = resp.read()
+    finally:
+        conn.close()
+    return [json.loads(line) for line in raw.split(b"\n") if line]
+
+
+def _wait_for(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, "condition never held"
+        time.sleep(0.005)
+
+
+def _tpuctl(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        tpuctl.main(argv)
+    return json.loads(buf.getvalue())
+
+
+def _tree_rows(tree):
+    for span in tree:
+        yield span
+        yield from _tree_rows(span["children"])
+
+
+@pytest.fixture
+def nodes(short_tmp, monkeypatch):
+    """Two nodes' worth of real machinery, one flight ring each."""
+    tracing.reset_for_tests()
+    ring_a = flight.FlightRecorder(2048)
+    ring_b = flight.FlightRecorder(2048)
+
+    # -- node A: VSP server + CNI server over real unix sockets
+    pm = PathManager(short_tmp)
+    vsp_sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(vsp_sock)
+    vsp_server = VspServer(MockTpuVsp(), vsp_sock)
+    vsp_server.start()
+    det = TpuDetector().detection_result(tpu_mode=True,
+                                         identifier="fed-tpu")
+    plugin = GrpcPlugin(det, path_manager=pm, init_timeout=5.0)
+    plugin.start(tpu_mode=True)
+
+    def add(pod_req):
+        plugin.create_slice_attachment(
+            {"name": f"att-{pod_req.sandbox_id[:8]}", "chip_index": 1})
+        return {"cniVersion": pod_req.netconf.cni_version, "ok": True}
+
+    cni_sock = os.path.join(short_tmp, "cni-fed.sock")
+    cni_server = CniServer(cni_sock, add_handler=add)
+    cni_server.start()
+
+    # ONE CNI ADD joins the client trace (the shim honors an exported
+    # TRACEPARENT) while the process-global ring is node A's
+    monkeypatch.setenv("TRACEPARENT", TRACEPARENT)
+    monkeypatch.setattr(flight, "RECORDER", ring_a)
+    resp = CniShim(cni_sock).invoke(_env(), json.dumps(_conf()))
+    assert not resp.error
+
+    # -- node B: the decode service's streaming HTTP ingress
+    monkeypatch.setattr(flight, "RECORDER", ring_b)
+    sched = serve.Scheduler(serve.ServeConfig(
+        slots=2, kv_blocks=32, kv_block_size=4, queue_limit=8))
+    service = serve.DecodeService(sched, idle_interval_s=0.005)
+    service.start()
+    port = service.start_http()
+    lines = _stream_post(
+        port, {"rid": "fed", "prompt_len": 4, "output_len": 3},
+        TRACEPARENT)
+    assert lines, "the streamed request produced no output"
+    _wait_for(lambda: any(e.get("name") == "Completed"
+                          for e in ring_b.events(kind="serve")))
+    service.stop()
+
+    # each node serves ITS ring on its own metrics endpoint
+    srv_a = MetricsServer(host="127.0.0.1", flight_recorder=ring_a)
+    srv_a.start()
+    srv_b = MetricsServer(host="127.0.0.1", flight_recorder=ring_b)
+    srv_b.start()
+    addr_a = f"127.0.0.1:{srv_a.port}"
+    addr_b = f"127.0.0.1:{srv_b.port}"
+
+    # the operator's rollup names each node's metrics address — the
+    # discovery path `tpuctl fleet trace` walks when --nodes is absent
+    rollup = {
+        "nodes": {"total": 2, "fresh": 2, "stale": 0},
+        "staleNodes": [],
+        "serveSlots": {"total": 26, "free": 11, "advertisable": 9},
+        "freeKvBlocks": 32, "quarantined": {}, "sloBurnRate": {},
+        "sloAlerts": [], "watchdogStalls": [],
+        "perNode": {"node-a": {"metricsAddr": addr_a},
+                    "node-b": {"metricsAddr": addr_b}},
+    }
+    srv_op = MetricsServer(host="127.0.0.1",
+                           debug_handlers={"/debug/fleet":
+                                           lambda: rollup})
+    srv_op.start()
+    try:
+        yield {"addr_a": addr_a, "addr_b": addr_b,
+               "operator": f"127.0.0.1:{srv_op.port}",
+               "srv_b": srv_b}
+    finally:
+        srv_op.stop()
+        srv_a.stop()
+        srv_b.stop()
+        cni_server.stop()
+        plugin.close()
+        vsp_server.stop()
+        tracing.reset_for_tests()
+
+
+def test_cross_node_trace_stitch_and_partial_degradation(nodes):
+    out = _tpuctl(["fleet", "trace", TRACE,
+                   "--operator-addr", nodes["operator"],
+                   "--nodes",
+                   f"{nodes['addr_a']},{nodes['addr_b']}"])
+    assert out["found"] and not out["partial"]
+    # both nodes contributed spans of the SAME trace
+    assert out["nodes"][nodes["addr_a"]] > 0
+    assert out["nodes"][nodes["addr_b"]] > 0
+    rows = list(_tree_rows(out["tree"]))
+    by_name = {r["name"]: r for r in rows}
+    # node A's CNI path: CNI server → VSP, parent-linked across the
+    # in-node process boundaries (the shim itself records only to the
+    # trace FILE — its span id still shows up as cni.add's parent)
+    assert {"cni.add", "vsp.call"} <= set(by_name)
+    assert by_name["cni.add"]["node"] == nodes["addr_a"]
+    assert by_name["vsp.call"]["parentId"] \
+        == by_name["cni.add"]["spanId"]
+    # cni.add's parent is the shim's span — never captured in any
+    # flight ring — so it surfaces as a root of the stitched tree
+    assert by_name["cni.add"]["parentId"]
+    assert by_name["cni.add"] in out["tree"]
+    # the VSP SERVER span (crossed the gRPC metadata seam) hangs below
+    # the client span
+    assert by_name["vsp.SliceService.CreateSliceAttachment"][
+        "parentId"] == by_name["vsp.call"]["spanId"]
+    # node B's serve path: the ingress span plus the scheduler's phase
+    # spans, all under the same trace_id
+    assert by_name["serve.request"]["node"] == nodes["addr_b"]
+    assert any(r["name"].startswith("serve.") and r["kind"] == "serve"
+               for r in rows)
+    # the non-span flight entries of the trace (FirstToken, Completed)
+    # ride along for context
+    extras = {e["name"] for e in out["events"]}
+    assert "FirstToken" in extras
+
+    # one node dies: the federation degrades to a PARTIAL result with
+    # the dead node named — node A's half of the story still renders
+    nodes["srv_b"].stop()
+    out = _tpuctl(["fleet", "trace", TRACE,
+                   "--operator-addr", nodes["operator"],
+                   "--nodes",
+                   f"{nodes['addr_a']},{nodes['addr_b']}"])
+    assert out["found"] and out["partial"]
+    assert [u["addr"] for u in out["unreachable"]] \
+        == [nodes["addr_b"]]
+    rows = list(_tree_rows(out["tree"]))
+    assert any(r["name"] == "cni.add" for r in rows)
+    assert not any(r["name"] == "serve.request" for r in rows)
+
+
+def test_fleet_trace_discovers_nodes_through_rollup(nodes):
+    # no --nodes: the endpoints come from the rollup's metricsAddr
+    out = _tpuctl(["fleet", "trace", TRACE,
+                   "--operator-addr", nodes["operator"]])
+    assert out["found"] and not out["partial"]
+    assert set(out["nodes"]) == {nodes["addr_a"], nodes["addr_b"]}
+    names = {r["name"] for r in _tree_rows(out["tree"])}
+    assert "cni.add" in names and "serve.request" in names
+
+
+def test_fleet_top_renders_rollup(nodes):
+    out = _tpuctl(["fleet", "top",
+                   "--operator-addr", nodes["operator"]])
+    assert out["reachable"]
+    assert out["nodes"] == {"total": 2, "fresh": 2, "stale": 0}
+    assert out["serveSlots"]["advertisable"] == 9
+    assert set(out["perNode"]) == {"node-a", "node-b"}
+
+
+def test_fleet_top_graceful_when_operator_unreachable():
+    out = _tpuctl(["fleet", "top",
+                   "--operator-addr", "127.0.0.1:1"])
+    assert out == {"reachable": False, "error": out["error"]}

@@ -268,3 +268,59 @@ def test_leases_are_namespace_scoped(rbac_clients):
                    "metadata": {"name": "x", "namespace": "kube-system"},
                    "spec": {"holderIdentity": "me"}})
     assert exc.value.response.status_code == 403
+
+
+# -- every /debug/* handler honors the token filter ---------------------------
+
+#: the debug surface the fleet plane federates over: every one of these
+#: serves operational detail equivalent to a metrics scrape, so every
+#: one must sit behind the SAME token filter
+DEBUG_PATHS = ("/debug", "/debug/flight", "/debug/health",
+               "/debug/serve", "/debug/serve/ledger",
+               "/debug/serve/headroom", "/debug/fleet")
+
+
+@pytest.fixture
+def debug_server():
+    """A MetricsServer with the full debug surface registered (the
+    serve + fleet handlers a production daemon/operator wires) behind
+    a deterministic auth callable."""
+    ms = MetricsServer(
+        host="127.0.0.1",
+        auth=lambda token: token == "good-token",
+        health_check=lambda: {"healthy": True},
+        debug_handlers={
+            "/debug/serve": lambda: {"ok": "serve"},
+            "/debug/serve/ledger": lambda: {"ok": "ledger"},
+            "/debug/serve/headroom": lambda: {"ok": "headroom"},
+            "/debug/fleet": lambda: {"ok": "fleet"},
+        })
+    ms.start()
+    yield ms
+    ms.stop()
+
+
+def test_debug_index_lists_the_whole_surface(debug_server):
+    """The parametrization below iterates DEBUG_PATHS; this guard
+    asserts that list IS the index — a newly registered handler that
+    nobody added to DEBUG_PATHS fails here instead of shipping
+    untested."""
+    resp = _get(debug_server.port, "/debug", token="good-token")
+    assert resp.status_code == 200
+    listed = set(resp.json()["debugHandlers"])
+    assert listed == set(DEBUG_PATHS) - {"/debug"}
+
+
+@pytest.mark.parametrize("path", DEBUG_PATHS)
+def test_every_debug_endpoint_honors_the_token_filter(
+        debug_server, path):
+    # unauthenticated read -> 401; wrong token -> 403; never a body
+    anon = _get(debug_server.port, path)
+    assert anon.status_code == 401, f"{path} served an anonymous read"
+    bad = _get(debug_server.port, path, token="wrong")
+    assert bad.status_code == 403, f"{path} served a bad token"
+    for denied in (anon, bad):
+        assert b"ok" not in denied.content
+    # the right token reads it
+    good = _get(debug_server.port, path, token="good-token")
+    assert good.status_code == 200, f"{path} denied a valid token"

@@ -703,3 +703,84 @@ def test_metric_doc_parity_prefix_of_documented_name_still_fires(tmp_path):
     assert _parity_module(tmp_path, """
         X = REGISTRY.gauge("tpu_serve_step", "now documented")
     """, doc="| `tpu_serve_step{dim}` | gauge | fine |\n") == []
+
+
+# -- metric-doc-parity: Event-reason catalog parity ---------------------------
+
+def test_event_doc_parity_flags_undocumented_reason(tmp_path):
+    violations = _parity_module(tmp_path, """
+        from ..k8s import events
+        def f():
+            events.emit("GhostReason", "a thing happened",
+                        type_="Warning", series="x")
+    """, doc="| `DocumentedReason` | Warning | when it fires |\n")
+    assert [v.rule for v in violations] == ["metric-doc-parity"]
+    assert "GhostReason" in violations[0].message
+    assert "Event catalog" in violations[0].message
+
+
+def test_event_doc_parity_passes_documented_reasons(tmp_path):
+    assert _parity_module(tmp_path, """
+        from ..k8s import events
+        from ..utils.watchdog import emit_health_event
+        def f(recorder, involved, healthy):
+            events.emit("DocumentedReason", "msg", series="x")
+            emit_health_event("OtherReason", "msg text", "Warning",
+                              series="y")
+            recorder.emit(involved,
+                          "FlipReasonA" if healthy else "FlipReasonB",
+                          "message", type_="Normal")
+    """, doc=("| `DocumentedReason` | Warning | row |\n"
+              "| `OtherReason` | Warning | row |\n"
+              "| `FlipReasonA` / `FlipReasonB` | Normal | row |\n")) \
+        == []
+
+
+def test_event_doc_parity_conditional_reason_needs_both_rows(tmp_path):
+    # both branches of a conditional reason are live reasons — each
+    # needs its catalog row
+    violations = _parity_module(tmp_path, """
+        def f(recorder, involved, healthy):
+            recorder.emit(involved,
+                          "FlipGood" if healthy else "FlipBad",
+                          "message")
+    """, doc="| `FlipGood` | Normal | only one documented |\n")
+    assert [v.rule for v in violations] == ["metric-doc-parity"]
+    assert "FlipBad" in violations[0].message
+
+
+def test_event_doc_parity_ignores_non_reason_shapes(tmp_path):
+    # watch event types (ALL-CAPS), Event types (Warning/Normal) and
+    # sentence messages never match the reason grammar; _emit fanout
+    # helpers with non-reason payloads stay silent
+    assert _parity_module(tmp_path, """
+        def f(self, obj, recorder, involved):
+            self._emit("ADDED", obj)
+            self._emit("DELETED", obj)
+            recorder.emit(involved, reason_var,
+                          "A sentence message with spaces",
+                          type_="Warning")
+    """, doc="nothing documented\n") == []
+
+
+def test_event_doc_parity_wrapper_emit_is_scanned(tmp_path):
+    # the vsp_rollout-style thin wrapper: reason sits deeper in the
+    # positional args, still caught
+    violations = _parity_module(tmp_path, """
+        def g(self, client, cfg_obj):
+            self._emit(client, cfg_obj, "WrappedReason",
+                       "a message about it")
+    """, doc="no rows\n")
+    assert [v.rule for v in violations] == ["metric-doc-parity"]
+    assert "WrappedReason" in violations[0].message
+
+
+def test_event_doc_parity_live_repo_catalog_is_complete():
+    # every literal reason emitted through the events seam has its
+    # Event-catalog row in doc/observability.md (the Events half of
+    # the live-repo-green assertion)
+    from dpu_operator_tpu.analysis import MetricDocParityChecker
+    from dpu_operator_tpu.analysis.core import run_checkers
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert run_checkers([MetricDocParityChecker()],
+                        ["dpu_operator_tpu"], repo) == []
