@@ -113,10 +113,19 @@ scale-check:
 # caller's traceparent; plus the shared
 # zero-spurious-ListAndWatch-deletion churn regression for both
 # capacity producers (fault gate + serve slots); plus the cost-ledger
-# reconciliation gate: every step's phase sum (prefill/decode/cow/
-# sched) must reconcile with the observed iteration time — exactly in
-# virtual time, within tolerance under a real (injected) clock with a
-# stalling executor, the stall attributed to the stalled phase.
+# reconciliation gate: every step's phase sum (prefill/decode/verify/
+# cow/sched) must reconcile with the observed iteration time — exactly
+# in virtual time, within tolerance under a real (injected) clock with
+# a stalling executor, the stall attributed to the stalled phase; plus
+# the SPECULATIVE DECODING gate (tests/test_spec.py): speculative
+# token streams identical to greedy generate() across bf16/int8/KV8
+# and k in {1,2,4} (exact greedy acceptance, corrupted-oracle forced
+# rejections, forced mid-speculation preemption), the batched verify
+# program compiles once per (cfg, cache shape, k) and never re-traces,
+# 500 speculate/reject lifecycles over CoW-shared prefixes leak zero
+# KV blocks (rollback is accounting-only, fired copies persist),
+# adaptive k degrades to plain decode under hostile acceptance, and
+# traces stay bit-deterministic with speculation on.
 # Seeded RNG, virtual clocks, no wall-clock sleeps.
 serve-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m serve \

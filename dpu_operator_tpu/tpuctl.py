@@ -261,6 +261,16 @@ def render_serve(snapshot: dict, flight_events: list,
         out["kvSharedBlocks"] = kv.get("sharedBlocks", 0)
         out["kvCowCopies"] = kv.get("cowCopies", 0)
         out["kvLogicalBlocks"] = kv.get("logicalBlocks", 0)
+    spec = snapshot.get("spec") or {}
+    if spec.get("kMax"):
+        # speculative decoding at a glance: is the drafter earning its
+        # verify cost (acceptance), and how many extra tokens is each
+        # verify iteration actually landing (mean accepted k)
+        out["specKMax"] = spec.get("kMax", 0)
+        out["specAcceptanceRate"] = spec.get("acceptanceRate", 0.0)
+        out["specMeanAcceptedK"] = spec.get("meanAcceptedK", 0.0)
+        out["specProposedTokens"] = spec.get("proposed", 0)
+        out["specAcceptedTokens"] = spec.get("accepted", 0)
     if ttfts:
         from .utils.stats import nearest_rank
         out["ttftP50Seconds"] = round(nearest_rank(ttfts, 0.50), 4)

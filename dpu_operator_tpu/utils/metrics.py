@@ -597,6 +597,26 @@ SERVE_STEP_BREAKDOWN = REGISTRY.histogram_vec(
     label="phase",
     buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
              0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+SERVE_SPEC_TOKENS = REGISTRY.counter(
+    "tpu_serve_spec_tokens_total",
+    "Speculative-decoding draft tokens by outcome (proposed = drafted "
+    "by the prompt-lookup drafter and scored by the verify pass; "
+    "accepted = matched the model's own greedy choice and were "
+    "emitted; rejected = mismatched and rolled back via the paged KV "
+    "pool)")
+SERVE_SPEC_ACCEPTANCE = REGISTRY.gauge(
+    "tpu_serve_spec_acceptance_rate",
+    "Lifetime speculative-draft acceptance rate (accepted / proposed "
+    "tokens); the adaptive-k policy's EWMA tracks the same signal and "
+    "drives k back to 0 when this collapses")
+SERVE_SPEC_VERIFY_SECONDS = REGISTRY.histogram(
+    "tpu_serve_spec_verify_seconds",
+    "Duration of each speculative verify iteration (the batched "
+    "k+1-position verify_step pass plus acceptance) — what the "
+    "calibrated cost model's verify term must track for adaptive k "
+    "to price speculation honestly",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 5.0))
 SERVE_HEADROOM = REGISTRY.gauge(
     "tpu_serve_headroom",
     "Replica headroom digest by dimension (free_slots / "

@@ -150,96 +150,25 @@ def _scale_bhqk(s: jax.Array) -> jax.Array:
     return s[..., 0].transpose(0, 2, 1)[:, :, None, :]
 
 
-def _cache_write(cache_t: jax.Array, new_t: jax.Array,
-                 pos: jax.Array) -> jax.Array:
-    """Write one step's K/V rows (B, 1, H, ...) into the cache at *pos*
-    — a shared scalar position (the fused generate scan, every row in
-    lockstep) or a per-row (B,) vector (the continuous-batching serve
-    path, where every slot sits at its own sequence position). Scalar
-    keeps the original dynamic_update_slice; vector scatters per row.
-    Both write the same values, so the two paths stay token-identical."""
-    if jnp.ndim(pos) == 1:
-        return cache_t.at[jnp.arange(new_t.shape[0]), pos].set(new_t[:, 0])
-    return jax.lax.dynamic_update_slice(
-        cache_t, new_t, (0, pos) + (0,) * (cache_t.ndim - 2))
-
-
 def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
                 tokens: jax.Array, pos: jax.Array) -> tuple:
     """One decode step: *tokens* (B,) at position *pos* -> (logits (B, V),
     updated cache). *pos* is a scalar (all rows at the same position —
     the generate scan) or a (B,) vector (per-slot positions — the serve
-    scheduler's interleaved batch)."""
+    scheduler's interleaved batch).
+
+    Decode IS verify at width 1: delegating to :func:`_verify_one`
+    keeps the decode scan, the serve decode step, and the speculative
+    verify pass one traced body, so the greedy-acceptance token
+    identity cannot rot — two hand-maintained copies of the same math
+    compile to DIFFERENT fusions whose bf16 roundings disagree just
+    enough to flip a quantized near-tie."""
     B = tokens.shape[0]
-    per_row = jnp.ndim(pos) == 1
-    if per_row:
-        pos_emb = params["pos"][pos]                       # (B, D)
-        # (B,1,1,1) against positions (1,1,1,S) -> per-row causal mask
-        pos_q = pos[:, None, None, None]
-    else:
-        pos_emb = jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
-                                               keepdims=False)
-        pos_q = pos
-    x = _embed_rows(params["embed"], tokens) + pos_emb
-    x = x.astype(cfg.dtype)[:, None, :]          # (B, 1, D)
-    positions = jnp.arange(cfg.max_seq)
-    new_cache = []
-    for lp, layer_cache in zip(params["layers"], cache):
-        h = _rmsnorm(x, lp["ln1"])
-        qkv = _mm(h, lp["wqkv"])
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t: jax.Array) -> jax.Array:
-            return t.reshape(B, 1, cfg.n_heads, cfg.d_head)
-
-        q, k, v = heads(q), heads(k), heads(v)
-        if "k_q" in layer_cache:  # KV8: int8 cache, fused dequant
-            kq, ks = _kv_quant(k)
-            vq, vs = _kv_quant(v)
-            ck = _cache_write(layer_cache["k_q"], kq, pos)
-            cks = _cache_write(layer_cache["k_s"], ks, pos)
-            cv = _cache_write(layer_cache["v_q"], vq, pos)
-            cvs = _cache_write(layer_cache["v_s"], vs, pos)
-            new_cache.append({"k_q": ck, "k_s": cks,
-                              "v_q": cv, "v_s": cvs})
-            # q . k_q on the MXU (convert fused into the cache read);
-            # the per-position k scale applies to the (B,H,1,S) scores
-            att = jnp.einsum("bqhd,bkhd->bhqk", q, ck.astype(cfg.dtype))
-            att = (att.astype(jnp.float32) * _scale_bhqk(cks)
-                   / np.sqrt(cfg.d_head))
-            att = jnp.where(positions[None, None, None, :] <= pos_q,
-                            att, -1e9)
-            att = jax.nn.softmax(att, -1)
-            # fold the v scales into the attention weights, then one
-            # int8-read einsum
-            att_v = (att * _scale_bhqk(cvs)).astype(cfg.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", att_v,
-                           cv.astype(cfg.dtype)).reshape(
-                B, 1, cfg.d_model)
-        else:
-            ck = _cache_write(layer_cache["k"], k, pos)
-            cv = _cache_write(layer_cache["v"], v, pos)
-            new_cache.append({"k": ck, "v": cv})
-
-            att = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / np.sqrt(
-                cfg.d_head)
-            att = jnp.where(positions[None, None, None, :] <= pos_q,
-                            att, -1e9)
-            att = jax.nn.softmax(att.astype(jnp.float32),
-                                 -1).astype(cfg.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", att, cv).reshape(
-                B, 1, cfg.d_model)
-        x = x + _mm(o, lp["wo"])
-        h2 = _rmsnorm(x, lp["ln2"])
-        if "moe" in lp:
-            from .moe import moe_ffn
-            out, _ = moe_ffn(lp["moe"], h2, cfg.moe_capacity_factor)
-            x = x + out
-        else:
-            x = x + _mm(jax.nn.gelu(_mm(h2, lp["w1"])), lp["w2"])
-    x = _rmsnorm(x, params["out_norm"])
-    logits = _logits(x[:, 0, :], params["embed"])
-    return logits, new_cache
+    pos_vec = pos if jnp.ndim(pos) == 1 \
+        else jnp.full((B,), pos, jnp.int32)
+    logits, new_cache = _verify_one(params, cfg, cache,
+                                    tokens[:, None], pos_vec)
+    return logits[:, 0], new_cache
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -254,6 +183,113 @@ def decode_step(params: dict, cfg: TransformerConfig, cache: list,
     scan runs the same `_decode_one` body, so the two paths cannot
     drift (asserted token-identical in tests/test_decode.py)."""
     return _decode_one(params, cfg, cache, tokens, pos)
+
+
+def _verify_one(params: dict, cfg: TransformerConfig, cache: list,
+                tokens: jax.Array, pos: jax.Array) -> tuple:
+    """Batched multi-position forward for speculative verify: *tokens*
+    (B, K1) starting at per-row base positions *pos* (B,) -> (logits
+    (B, K1, V), updated cache). Row (b, i) writes its K/V at position
+    ``pos[b] + i`` (2D scatter, out-of-range rows dropped) and attends
+    over the full cache row under a per-row causal-at-offset mask, so
+    ``logits[b, i]`` is exactly what sequential :func:`_decode_one`
+    calls would have produced for that position — the property the
+    greedy acceptance rule's token identity rests on."""
+    B, K1 = tokens.shape
+    rows = pos[:, None] + jnp.arange(K1)[None, :]       # (B, K1) abs pos
+    pos_emb = params["pos"][jnp.clip(rows, 0, cfg.max_seq - 1)]
+    x = (_embed_rows(params["embed"], tokens) + pos_emb).astype(
+        cfg.dtype)                                      # (B, K1, D)
+    positions = jnp.arange(cfg.max_seq)
+    # (B, K1, S) per-row causal mask; broadcasts over heads as
+    # (B, 1, K1, S) against the (B, H, K1, S) scores
+    mask = positions[None, None, :] <= rows[:, :, None]
+    b_idx = jnp.arange(B)[:, None]                      # (B, 1)
+
+    def put(cache_t: jax.Array, new_t: jax.Array) -> jax.Array:
+        # scatter row (b, i) at (b, pos[b] + i); a padding row past
+        # max_seq is dropped — same dead-write argument as
+        # prefill_chunk's padding: any surviving garbage sits strictly
+        # above every committed position and is overwritten before a
+        # causal mask can admit it
+        return cache_t.at[b_idx, rows].set(
+            new_t.astype(cache_t.dtype), mode="drop")
+
+    new_cache = []
+    for lp, layer_cache in zip(params["layers"], cache):
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = _mm(h, lp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t: jax.Array) -> jax.Array:
+            return t.reshape(B, K1, cfg.n_heads, cfg.d_head)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if "k_q" in layer_cache:  # KV8: int8 cache, fused dequant
+            kq, ks = _kv_quant(k)
+            vq, vs = _kv_quant(v)
+            ck, cks = put(layer_cache["k_q"], kq), put(layer_cache["k_s"],
+                                                       ks)
+            cv, cvs = put(layer_cache["v_q"], vq), put(layer_cache["v_s"],
+                                                       vs)
+            new_cache.append({"k_q": ck, "k_s": cks,
+                              "v_q": cv, "v_s": cvs})
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, ck.astype(cfg.dtype))
+            att = (att.astype(jnp.float32) * _scale_bhqk(cks)
+                   / np.sqrt(cfg.d_head))
+            att = jnp.where(mask[:, None, :, :], att, -1e9)
+            att = jax.nn.softmax(att, -1)
+            att_v = (att * _scale_bhqk(cvs)).astype(cfg.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att_v,
+                           cv.astype(cfg.dtype)).reshape(
+                B, K1, cfg.d_model)
+        else:
+            ck, cv = put(layer_cache["k"], k), put(layer_cache["v"], v)
+            new_cache.append({"k": ck, "v": cv})
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / np.sqrt(
+                cfg.d_head)
+            att = jnp.where(mask[:, None, :, :], att, -1e9)
+            att = jax.nn.softmax(att.astype(jnp.float32),
+                                 -1).astype(cfg.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att, cv).reshape(
+                B, K1, cfg.d_model)
+        x = x + _mm(o, lp["wo"])
+        h2 = _rmsnorm(x, lp["ln2"])
+        if "moe" in lp:
+            from .moe import moe_ffn
+            out, _ = moe_ffn(lp["moe"], h2, cfg.moe_capacity_factor)
+            x = x + out
+        else:
+            x = x + _mm(jax.nn.gelu(_mm(h2, lp["w1"])), lp["w2"])
+    x = _rmsnorm(x, params["out_norm"])
+    logits = _logits(x, params["embed"])                # (B, K1, V)
+    return logits, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def verify_step(params: dict, cfg: TransformerConfig, cache: list,
+                tokens: jax.Array, pos: jax.Array) -> tuple:
+    """One compiled speculative VERIFY iteration — the batched k-token
+    scorer the speculate-aware scheduler drives. *tokens* (B, K1) is
+    per row ``[last committed token, draft_1 .. draft_k]`` (K1 = k+1)
+    and *pos* (B,) is the position the last committed token's K/V lands
+    at, so ``logits[:, i]`` scores the token at position ``pos + i + 1``
+    — exactly the sequence of logits k+1 sequential :func:`decode_step`
+    calls would produce, in ONE weight sweep.
+
+    Compiled ONCE per (cfg, cache shape, K1): token values, positions
+    and per-row draft counts all ride as traced values, so adaptive k
+    (rows padding unused draft slots with repeats) never re-traces —
+    asserted via ``_cache_size`` in tests. Rows whose drafts are
+    rejected leave stale K/V above the accepted frontier; the next
+    iteration's writes land at-or-below every stale position before any
+    causal mask admits it (the same argument that makes
+    :func:`prefill_chunk` padding safe), so ROLLBACK on the dense slot
+    cache is free — the paged pool's accounting rollback
+    (:meth:`~dpu_operator_tpu.workloads.kv_pool.KvBlockPool.rollback_tokens`)
+    is the only bookkeeping. Works with bf16, int8 weights, and KV8
+    caches — the same branches :func:`_decode_one` has."""
+    return _verify_one(params, cfg, cache, tokens, pos)
 
 
 def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,
@@ -478,6 +514,16 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
                               greedy, key, kv_int8=kv_int8)
 
 
+#: effective CPU throughput for decode-shaped matmuls (toy config,
+#: d_model 64): the BENCH_r08 investigation measured ~14-19 GFLOPS
+#: achieved across B1/B8 — an order of magnitude under perf.py's
+#: generic 0.2 TFLOPS fallback, because sub-MXU-size matrices on CPU
+#: pay per-op overhead that never amortizes. Like
+#: perf._CPU_FALLBACK_HBM_GBPS this is a smoke-number constant, not a
+#: chip claim; real-TPU runs use the spec-sheet peak instead.
+_CPU_DECODE_EFFECTIVE_TFLOPS = 0.015
+
+
 def measure_decode(cfg: TransformerConfig, batch: int = 8,
                    prompt_len: int = 16, steps: int = 64,
                    iters: int = 4, best_of: int = 3,
@@ -490,9 +536,18 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
     slope methodology as perf.marginal_time; best-of for the tunnel's
     contention phases, perf.best_marginal_time).
 
-    Also reports the HBM roofline fraction: a decode step must stream
-    every weight byte (bf16) plus the batch's KV cache from HBM, so
-    ``min_ms = (2N + kv_bytes) / HBM_BW`` bounds ms/token from below."""
+    Also reports the roofline fraction against the BINDING bound: a
+    decode step must stream every weight byte plus the batch's KV cache
+    from HBM (``hbm_s = (weights + kv_bytes) / BW``) AND execute its
+    FLOPs (``compute_s = flops / rate``) — per-step time is bounded
+    from below by the LARGER of the two. On a TPU the HBM term binds at
+    serving batch sizes and ``roofline_frac == hbm_frac``; on the CPU
+    smoke backend compute scales linearly with batch while the
+    HBM-model stays near-flat, so at B8 the HBM fraction alone reads
+    degenerately low (BENCH_r08's 0.118 ``decode_hbm_frac_b8_int8kv8``
+    vs 0.606 at B1 — the bytes model neither double-counts nor hides
+    dispatch; it was simply not the binding bound). ``bound`` records
+    which term bound the reported fraction."""
     from .model import init_params
     from .perf import best_marginal_time, hbm_bandwidth_gbps
 
@@ -534,17 +589,35 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
     kv_width = (1.0 + 4.0 / cfg.d_head) if kv_int8 else 2.0
     kv_bytes = (2.0 * cfg.n_layers * cfg.max_seq * cfg.d_model
                 * kv_width * batch)
-    min_s = (weight_bytes + kv_bytes) / hbm_bandwidth_gbps() / 1e9
-    hbm_frac = min_s / per_step
+    hbm_s = (weight_bytes + kv_bytes) / hbm_bandwidth_gbps() / 1e9
+    # the compute bound: every step multiplies the batch against the
+    # active params (2 flops/MAC) plus the dense-cache attention
+    # (QK^T + PV over all max_seq positions). On TPU the spec-sheet
+    # rate applies; the CPU smoke backend runs these tiny matmuls at
+    # an EFFECTIVE rate far under the generic perf fallback — use the
+    # decode-calibrated constant so the B8 smoke fraction compares
+    # against the bound that actually binds there
+    from .perf import active_param_count, peak_tflops
+    flops = (2.0 * active_param_count(cfg) * batch
+             + 4.0 * cfg.n_layers * batch * cfg.max_seq * cfg.d_model)
+    rate = peak_tflops()
+    if rate <= 1.0:  # CPU/unknown fallback, not a real chip number
+        rate = _CPU_DECODE_EFFECTIVE_TFLOPS
+    compute_s = flops / rate / 1e12
+    min_s = max(hbm_s, compute_s)
+    bound = "hbm" if hbm_s >= compute_s else "compute"
+    hbm_frac = hbm_s / per_step
+    roofline_frac = min_s / per_step
     # sanity bound on a RECORDED value (bench callers set it from their
     # roofline cap): a fraction far past 1.0 means the slope collapsed,
     # which the warmup should have made impossible — fail loudly rather
     # than publish it. Toy/smoke callers leave it None: their chains
     # are legitimately inside the noise floor and they record nothing.
-    if max_sane_frac is not None and not 0.0 < hbm_frac \
+    if max_sane_frac is not None and not 0.0 < roofline_frac \
             <= max_sane_frac:
         raise ValueError(
-            f"degenerate decode measurement: hbm_frac={hbm_frac:.3g} "
+            f"degenerate decode measurement: roofline_frac="
+            f"{roofline_frac:.3g} "
             f"outside (0, {max_sane_frac}] (per-step {per_step:.3g}s "
             f"vs roofline {min_s:.3g}s) — slope timing collapsed "
             "despite warmup")
@@ -552,4 +625,8 @@ def measure_decode(cfg: TransformerConfig, batch: int = 8,
             "ms_per_token": per_step * 1e3,
             "tokens_per_s": batch / per_step,
             "roofline_ms_per_token": min_s * 1e3,
-            "hbm_frac": hbm_frac}
+            "hbm_ms_per_token": hbm_s * 1e3,
+            "compute_ms_per_token": compute_s * 1e3,
+            "bound": bound,
+            "hbm_frac": hbm_frac,
+            "roofline_frac": roofline_frac}

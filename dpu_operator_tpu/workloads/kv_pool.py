@@ -124,6 +124,7 @@ class KvBlockPool:
         #: lifetime counters (snapshot/bench visibility)
         self.cow_copies = 0
         self.prefix_block_hits = 0
+        self.spec_rollback_tokens = 0
         self._update_gauges_locked()
 
     # -- sizing ---------------------------------------------------------------
@@ -359,6 +360,40 @@ class KvBlockPool:
             self._used_tokens[owner] = min(int(tokens), cap)
             self._update_gauges_locked()
 
+    def rollback_tokens(self, owner: str, tokens: int) -> int:
+        """Un-write *owner*'s token accounting back to the *tokens*
+        frontier — the paged-pool half of speculative-decoding
+        rollback. The verify pass writes K/V for every drafted
+        position before acceptance is known; when drafts are rejected
+        the scheduler rolls the written-token frontier back to the
+        accepted position, so the fragmentation gauge and
+        ``set_used_tokens`` invariants see only committed rows.
+
+        Deliberately accounting-only: the owner's BLOCKS stay
+        allocated (they are its reservation — the next accepted tokens
+        rewrite the same slots), and a copy-on-write that fired while
+        writing the speculated tail into a shared block is NOT undone.
+        The physical divergent write happened, so the copied block
+        must keep serving the owner; the shared original's other
+        readers were never exposed to the speculated rows — exactly
+        the CoW semantics the non-speculative path guarantees. Raising
+        the frontier is not this method's job (``set_used_tokens``);
+        a *tokens* at or above the current frontier is a no-op.
+        Returns the number of token slots rolled back."""
+        with self._lock:
+            if owner not in self._owned:
+                raise KeyError(f"unknown owner {owner!r}")
+            if tokens < 0:
+                raise ValueError("tokens must be >= 0")
+            cur = self._used_tokens.get(owner, 0)
+            new = min(cur, int(tokens))
+            rolled = cur - new
+            if rolled:
+                self._used_tokens[owner] = new
+                self.spec_rollback_tokens += rolled
+                self._update_gauges_locked()
+            return rolled
+
     def free(self, owner: str) -> int:
         """Release every block *owner* holds (completion or preemptive
         eviction): each refcount is decremented and a block returns to
@@ -437,4 +472,5 @@ class KvBlockPool:
                 "cowCopies": self.cow_copies,
                 "prefixBlockHits": self.prefix_block_hits,
                 "prefixIndexKeys": len(self._index),
+                "specRollbackTokens": self.spec_rollback_tokens,
             }

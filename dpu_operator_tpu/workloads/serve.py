@@ -73,6 +73,7 @@ from ..utils import flight, metrics, tracing, validate, watchdog
 from ..utils.stats import nearest_rank
 from . import kv_pool
 from .kv_pool import KvBlockPool
+from .spec import AdaptiveK, NgramDrafter, greedy_accept
 
 log = logging.getLogger(__name__)
 
@@ -185,6 +186,13 @@ class CostModel:
     decode_base_s: float = 0.025
     decode_per_seq_s: float = 0.0005
     prefill_per_token_s: float = 0.0002
+    #: marginal cost of scoring ONE extra draft position for one
+    #: sequence in the batched verify pass. Verify streams the same
+    #: weights as a decode iteration (that sweep is already the base),
+    #: so the increment is small — which is the whole economics of
+    #: speculative decoding — but it is NOT free, and the adaptive-k
+    #: policy must see the real slope or it will speculate into a loss
+    spec_verify_per_token_s: float = 0.0002
 
     def decode_s(self, batch: int) -> float:
         return self.decode_base_s + self.decode_per_seq_s * batch if batch \
@@ -192,6 +200,15 @@ class CostModel:
 
     def prefill_s(self, tokens: int) -> float:
         return self.prefill_per_token_s * tokens
+
+    def verify_s(self, batch: int, k: int) -> float:
+        """Modeled cost of one speculative verify iteration scoring k
+        drafts (k+1 positions) per sequence: a decode-shaped weight
+        sweep plus the per-draft-position increment. k=0 collapses to
+        ``decode_s`` exactly — the policy's baseline comparison is
+        against the identical number."""
+        return self.decode_s(batch) \
+            + self.spec_verify_per_token_s * batch * k
 
 
 @dataclasses.dataclass(frozen=True)
@@ -225,6 +242,15 @@ class ServeConfig:
     #: (requests with a common prompt prefix map the same physical
     #: blocks; effective only with a prefix-aware executor)
     prefix_sharing: bool = False
+    #: > 0 enables SPECULATIVE DECODING with at most this many drafted
+    #: tokens per sequence per iteration: a drafter proposes, the
+    #: executor's batched verify pass scores all k+1 positions in one
+    #: iteration, and the exact greedy acceptance rule keeps token
+    #: streams identical by construction to plain decode. The actual k
+    #: each iteration is chosen adaptively from the cost model and the
+    #: observed acceptance rate (k=0 falls back to today's decode
+    #: path). 0 disables speculation entirely.
+    spec_k: int = 0
 
 
 def prefill_budget_tokens(cost_model: "CostModel", slots: int,
@@ -272,6 +298,10 @@ class SimExecutor:
     prefix_aware = True
     #: no kernel behind it, so any chunk size fits in one call
     chunk_capacity = 0
+    #: no kernel behind verify either, so any draft count fits (the
+    #: convention mirrors chunk_capacity: 0 = unbounded, None = the
+    #: executor has no verify path at all)
+    spec_width = 0
 
     def begin(self, req: Request, slot: int) -> int:
         # the CONTINUATION token: after a preemption the request
@@ -292,12 +322,51 @@ class SimExecutor:
         return {slot: self._token(req, len(req.tokens))
                 for slot, req in active}
 
+    def spec_step(self, active: list, drafts: dict) -> dict:
+        """Speculative verify: score each row's drafts against the
+        true token stream and apply the EXACT greedy acceptance rule —
+        the same :func:`~dpu_operator_tpu.workloads.spec.greedy_accept`
+        the JAX executor uses, so scheduler-level speculation tests
+        exercise the real acceptance/rollback arithmetic without a
+        model in the loop. Returns ``{slot: [emitted tokens]}`` (always
+        at least one token per row: the correction/bonus)."""
+        out = {}
+        for slot, req in active:
+            d = drafts.get(slot, [])
+            base = len(req.tokens)
+            truth = [self._token(req, base + i)
+                     for i in range(len(d) + 1)]
+            _, emitted = greedy_accept(d, truth)
+            out[slot] = emitted
+        return out
+
     @staticmethod
     def _token(req: Request, n: int) -> int:
         acc = 0
         for ch in req.rid:
             acc = (acc * 131 + ord(ch)) % 50_021
         return (acc + 7919 * n) % 50_021
+
+
+class PeriodicSimExecutor(SimExecutor):
+    """Synthetic stream whose tokens CYCLE with a fixed period — the
+    drafter-friendly traffic shape (templated prompts, code loops,
+    verbatim retrieval spans repeat their own recent history). After
+    one full period the prompt-lookup drafter's trailing n-gram always
+    has an earlier occurrence, so acceptance approaches 1.0 — the
+    workload the BENCH spec-decode record speculates on, with the SAME
+    arrivals run un-speculated as the baseline."""
+
+    def __init__(self, period: int = 4) -> None:
+        if period < 1:
+            raise ValueError("period must be >= 1")
+        self.period = period
+
+    def _token(self, req: Request, n: int) -> int:  # type: ignore[override]
+        acc = 0
+        for ch in req.rid:
+            acc = (acc * 131 + ord(ch)) % 50_021
+        return (acc + 7919 * (n % self.period)) % 50_021
 
 
 class JaxSlotExecutor:
@@ -319,7 +388,7 @@ class JaxSlotExecutor:
     prefix_aware = False
 
     def __init__(self, params: dict, cfg: Any, slots: int,
-                 chunk_tokens: int = 0) -> None:
+                 chunk_tokens: int = 0, spec_k: int = 0) -> None:
         import numpy as np
 
         from .decode import init_kv_cache
@@ -333,6 +402,13 @@ class JaxSlotExecutor:
         #: None = chunking unavailable (a chunked Scheduler refuses the
         #: pairing at construction instead of failing every request)
         self.chunk_capacity = int(chunk_tokens) if chunk_tokens else None
+        #: fixed verify width (max drafts + 1) for decode.verify_step —
+        #: same ONE-compiled-program discipline as the chunk kernel:
+        #: shorter proposals pad with repeats of the committed token
+        #: (dead writes past the frontier, same safety argument as
+        #: decode_step's inactive slots). None = no verify path; a
+        #: speculating Scheduler refuses the pairing at construction
+        self.spec_width = int(spec_k) + 1 if spec_k else None
         self.cache = init_kv_cache(cfg, slots)
         self.pos = np.zeros(slots, dtype=np.int32)
         self.last = np.zeros(slots, dtype=np.int32)
@@ -422,9 +498,55 @@ class JaxSlotExecutor:
             out[slot] = tok
         return out
 
+    def spec_step(self, active: list, drafts: dict) -> dict:
+        """One speculative iteration through the jitted batched verify
+        kernel: rows carry ``[last committed, d_1..d_k]`` padded to the
+        fixed ``spec_width`` with repeats of the committed token, ONE
+        forward pass scores every position, and the exact greedy rule
+        accepts. Rows whose drafts are all rejected still emit the
+        correction token — a verify iteration never does worse than a
+        decode iteration, it only writes some dead K/V past the
+        frontier (overwritten before any causal mask admits it, the
+        same argument decode_step's inactive slots rest on). Returns
+        ``{slot: [emitted tokens]}``."""
+        import jax.numpy as jnp
+        import numpy as np
 
-#: the ledger's phase keys, in render order
-LEDGER_PHASES = ("prefill", "decode", "cow", "sched")
+        from .decode import verify_step
+
+        if not self.spec_width:
+            raise ValueError("JaxSlotExecutor needs spec_k > 0 for "
+                             "speculative decoding")
+        width = self.spec_width
+        tokens = np.tile(np.asarray(self.last, np.int32)[:, None],
+                         (1, width))
+        n_drafted = {}
+        for slot, req in active:
+            d = [int(t) for t in drafts.get(slot, ())][:width - 1]
+            n_drafted[slot] = len(d)
+            for i, t in enumerate(d):
+                tokens[slot, 1 + i] = t
+        pos = jnp.asarray(np.clip(self.pos, 0, self.cfg.max_seq - 1))
+        logits, self.cache = verify_step(self.params, self.cfg,
+                                         self.cache,
+                                         jnp.asarray(tokens), pos)
+        picked = np.asarray(jnp.argmax(logits, axis=-1))
+        out = {}
+        for slot, req in active:
+            k = n_drafted[slot]
+            row_drafts = [int(tokens[slot, 1 + i]) for i in range(k)]
+            argmaxes = [int(picked[slot, i]) for i in range(k + 1)]
+            _, emitted = greedy_accept(row_drafts, argmaxes)
+            self.last[slot] = emitted[-1]
+            self.pos[slot] += len(emitted)
+            out[slot] = emitted
+        return out
+
+
+#: the ledger's phase keys, in render order (``verify`` is the
+#: speculative verify iteration — decode's replacement on iterations
+#: where the scheduler chose k > 0)
+LEDGER_PHASES = ("prefill", "decode", "verify", "cow", "sched")
 
 
 class StepLedger:
@@ -502,7 +624,8 @@ class Scheduler:
                  clock: Optional[Callable[[], float]] = None,
                  heartbeat: Optional[watchdog.Heartbeat] = None,
                  headroom_clock: Optional[Callable[[], float]]
-                 = None) -> None:
+                 = None,
+                 drafter: Optional[Any] = None) -> None:
         self.config = config
         self.executor = executor if executor is not None else SimExecutor()
         self.cost = cost_model if cost_model is not None else CostModel()
@@ -527,6 +650,32 @@ class Scheduler:
             raise ValueError(
                 "chunked prefill configured but the executor was built "
                 "without a chunk width (pass chunk_tokens)")
+        #: speculative decoding: spec_k > 0 needs an executor with a
+        #: verify path wide enough for spec_k drafts — refused at
+        #: construction (the chunk-width precedent), not one
+        #: executor_error per request
+        self._spec_on = config.spec_k > 0
+        if self._spec_on:
+            width = getattr(self.executor, "spec_width", None)
+            if width is None:
+                raise ValueError(
+                    "speculative decoding configured but the executor "
+                    "has no verify path (pass spec_k to "
+                    "JaxSlotExecutor)")
+            if width and width < config.spec_k + 1:
+                raise ValueError(
+                    f"executor verify width {width} cannot score "
+                    f"{config.spec_k} drafts (needs spec_k + 1 "
+                    "positions)")
+        #: the drafter seam (pluggable so a draft MODEL can slot in);
+        #: the adaptive-k policy owns the acceptance EWMA and the
+        #: lifetime proposed/accepted accounting
+        self._drafter = drafter if drafter is not None \
+            else NgramDrafter()
+        self._spec = AdaptiveK(k_max=config.spec_k)
+        #: (iteration, row) verify events — mean accepted k divides
+        #: accepted_total by this
+        self.spec_rows_total = 0
         self.now = 0.0 if clock is None else clock()
         #: headroom digest freshness: a monotonic per-replica sequence
         #: plus a wall-clock stamp (injectable for tests) so a remote
@@ -697,7 +846,13 @@ class Scheduler:
         active = sorted((slot, req) for slot, req in self._active.items()
                         if req.state == RUNNING
                         and len(req.tokens) < req.output_len)
-        if active:
+        drafts = self._propose_locked(active) if (active
+                                                  and self._spec_on) \
+            else None
+        if active and drafts:
+            self._spec_pass_locked(it, active, drafts, phases,
+                                   iter_start, real)
+        elif active:
             seg = self._mark()
             self._ledger_phase = "decode"
             self._advance_locked(self.cost.decode_s(len(active)))
@@ -796,6 +951,101 @@ class Scheduler:
         modeled cost; virtual time is advanced by _advance_locked instead."""
         if self._clock is not None:
             self.now = self._clock()
+
+    # -- speculative decoding -------------------------------------------------
+    def _propose_locked(self, active: list) -> Optional[dict]:
+        """The speculate-vs-decode decision plus per-row drafting.
+        The adaptive-k policy prices this iteration from the calibrated
+        cost model and the observed acceptance EWMA; k=0 (or no row
+        producing a draft) returns None and the iteration takes the
+        plain decode path — speculation can only ever be additive."""
+        k = self._spec.choose(self.cost, len(active))
+        if k <= 0:
+            return None
+        drafts: dict = {}
+        for slot, req in active:
+            # never draft past the request's remaining output: a row
+            # emits up to drafts+1 tokens, and overshooting output_len
+            # would both break stream identity with the plain run and
+            # write past the KV reservation
+            remaining = req.output_len - len(req.tokens)
+            if remaining <= 1:
+                continue
+            ids = list(req.prompt or ()) + list(req.tokens)
+            d = self._drafter.propose(ids, min(k, remaining - 1))
+            if d:
+                drafts[slot] = [int(t) for t in d]
+        return drafts or None
+
+    def _spec_pass_locked(self, it: int, active: list, drafts: dict,
+                          phases: dict, iter_start: float,
+                          real: bool) -> None:
+        """One speculative iteration: the executor's batched verify
+        scores every row's drafts in ONE pass, the exact greedy rule
+        accepts, and each row's accepted+1 tokens commit. KV
+        accounting writes every speculated position at verify time (so
+        CoW against shared blocks fires when the divergent write
+        actually happens) and ROLLS BACK past the accepted frontier on
+        rejection — accounting-only: blocks stay allocated (still
+        reserved for this request's future tokens) and fired copies
+        persist (the physical divergence happened)."""
+        k_iter = max(len(d) for d in drafts.values())
+        seg = self._mark()
+        self._ledger_phase = "verify"
+        self._advance_locked(self.cost.verify_s(len(active), k_iter))
+        emitted = self.executor.spec_step(active, drafts)
+        self._tick_locked()
+        if real:
+            phases["verify"] += self._mark() - seg
+        metrics.SERVE_SPEC_VERIFY_SECONDS.observe(self._mark() - seg)
+        metrics.SERVE_ITL_SECONDS.observe(
+            self.now - iter_start,
+            exemplar=({"trace_id": active[0][1].trace_id}
+                      if active[0][1].trace_id else None))
+        seg = self._mark()
+        self._ledger_phase = "cow"
+        for slot, req in active:
+            toks = emitted[slot]
+            proposed = len(drafts.get(slot, ()))
+            accepted = len(toks) - 1
+            base = req.prompt_len + len(req.tokens)
+            if self._share:
+                for i in range(proposed + 1):
+                    wrote = self.pool.write_token(req.rid, base + i)
+                    if wrote is None:
+                        self.trace.append(("cow_uncopied", it, req.rid))
+                    elif wrote:
+                        self._phase_span_locked(req, "serve.cow",
+                                                self.now, self.now,
+                                                pos=base + i)
+                # the frontier covers every row verify WROTE (drafts
+                # included) — rejection below rolls it back to just
+                # the committed rows
+                self.pool.set_used_tokens(req.rid, base + proposed + 1)
+            req.tokens.extend(toks)
+            req.decode_iters += 1
+            used = req.prompt_len + len(req.tokens)
+            if self._share and accepted < proposed:
+                self.pool.rollback_tokens(req.rid, used)
+            self.pool.set_used_tokens(req.rid, used)
+            for tok in toks:
+                metrics.SERVE_TOKENS.inc(phase="decode")
+                self._notify(req, "token", tok)
+            if proposed:
+                self._spec.observe(proposed, accepted)
+                self.spec_rows_total += 1
+                metrics.SERVE_SPEC_TOKENS.inc(proposed,
+                                              outcome="proposed")
+                metrics.SERVE_SPEC_TOKENS.inc(accepted,
+                                              outcome="accepted")
+                metrics.SERVE_SPEC_TOKENS.inc(proposed - accepted,
+                                              outcome="rejected")
+                self.trace.append(("spec", it, req.rid, proposed,
+                                   accepted))
+        metrics.SERVE_SPEC_ACCEPTANCE.set(self._spec.acceptance_rate())
+        if real:
+            phases["cow"] += self._mark() - seg
+        self.trace.append(("decode", it, len(active)))
 
     # -- request-lifecycle tracing --------------------------------------------
     def _ensure_trace_locked(self, req: Request) -> None:
@@ -900,9 +1150,13 @@ class Scheduler:
                       trace_id=req.trace_id, attributes={
                           "rid": req.rid, "class": req.slo_class,
                           "reason": reason})
+        # the reason rides the Event message as a machine-readable
+        # prefix: the fleet router sheds differently on queue_full
+        # (transient saturation — retry elsewhere soon) vs kv_too_large
+        # (this request can NEVER fit this replica's pool)
         watchdog.emit_health_event(
-            "ServeAdmissionRejected", message, "Warning",
-            series=f"serve-admission/{req.slo_class}")
+            "ServeAdmissionRejected", f"[{reason}] {message}",
+            "Warning", series=f"serve-admission/{req.slo_class}")
         self._notify(req, "rejected", reason)
 
     def _admit_locked(self, it: int) -> list:
@@ -1459,6 +1713,20 @@ class Scheduler:
             },
             "recentTtftS": [round(t, 6)
                             for t in self._recent_ttft[-16:]],
+            "spec": {
+                "kMax": self.config.spec_k,
+                "proposed": self._spec.proposed_total,
+                "accepted": self._spec.accepted_total,
+                "rejected": (self._spec.proposed_total
+                             - self._spec.accepted_total),
+                "acceptanceRate": round(self._spec.acceptance_rate(),
+                                        4),
+                "ewmaRate": round(self._spec.rate, 4),
+                "meanAcceptedK": round(
+                    self._spec.accepted_total
+                    / max(self.spec_rows_total, 1), 4),
+                "verifyRows": self.spec_rows_total,
+            },
         }
 
 
@@ -1761,11 +2029,18 @@ def open_loop_arrivals(seed: int, rate_rps: float, horizon_s: float,
 
 
 def run_open_loop(config: ServeConfig, cost_model: CostModel,
-                  arrivals: list, max_steps: int = 200_000) -> dict:
+                  arrivals: list, max_steps: int = 200_000,
+                  executor_factory: Optional[Callable[[], Any]]
+                  = None) -> dict:
     """Run one seeded open-loop experiment to drain; report the serving
     metrics the BENCH series records. Aggregate tokens/s is total
-    generated tokens over the busy makespan (virtual time)."""
-    sched = Scheduler(config, executor=SimExecutor(),
+    generated tokens over the busy makespan (virtual time).
+    *executor_factory* swaps the executor (each run needs a FRESH one —
+    executors carry per-slot state); default SimExecutor."""
+    sched = Scheduler(config,
+                      executor=(executor_factory()
+                                if executor_factory is not None
+                                else SimExecutor()),
                       cost_model=cost_model)
     sched.submit_all(arrivals)
     occupancies: list[float] = []
@@ -1794,6 +2069,7 @@ def run_open_loop(config: ServeConfig, cost_model: CostModel,
         "tokens_per_s": round(tokens / makespan, 2) if makespan else 0.0,
         "ttft_p50_s": round(nearest_rank(ttfts, 0.50), 4),
         "ttft_p99_s": round(nearest_rank(ttfts, 0.99), 4),
+        "itl_p50_s": round(nearest_rank(itls, 0.50), 4),
         "itl_p99_s": round(nearest_rank(itls, 0.99), 4),
         "kv_occupancy_mean": round(
             sum(occupancies) / len(occupancies), 4) if occupancies
@@ -1807,6 +2083,14 @@ def run_open_loop(config: ServeConfig, cost_model: CostModel,
         "prefill_chunks": sched.prefill_chunks_total,
         "prefill_tokens_discarded": sched.prefill_tokens_discarded,
         "trace_events": len(sched.trace),
+        "spec_proposed": sched._spec.proposed_total,
+        "spec_accepted": sched._spec.accepted_total,
+        "spec_acceptance_rate": round(sched._spec.acceptance_rate(),
+                                      4),
+        "spec_mean_accepted_k": round(
+            sched._spec.accepted_total / max(sched.spec_rows_total, 1),
+            4),
+        "spec_kv_rollback_tokens": sched.pool.spec_rollback_tokens,
     }
 
 
@@ -1886,6 +2170,61 @@ def bench_prefix_sharing(seed: int = 0,
     }
 
 
+def bench_spec_decoding(seed: int = 0, offered_load: float = 0.6,
+                        horizon_s: float = 40.0, spec_k: int = 4,
+                        period: int = 4,
+                        cost_model: Optional[CostModel] = None,
+                        config: Optional[ServeConfig] = None) -> dict:
+    """The BENCH record's speculative-decoding evidence: the SAME
+    seeded open-loop arrivals through the SAME drafter-friendly
+    executor (:class:`PeriodicSimExecutor` — tokens cycle, so prompt
+    lookup drafts well, the workload speculation targets) with
+    speculation on vs off. The on-run must show the acceptance
+    machinery actually firing (acceptance rate, mean accepted k) and
+    an ITL p50 improvement vs the off-run — the non-speculative
+    SAME-RUN baseline the acceptance criteria name — with zero KV
+    blocks leaked on both sides."""
+    cm = cost_model or CostModel()
+    base = config or ServeConfig()
+    prompt_mean = (16 + 128) / 2.0
+    output_mean = (8 + 128) / 2.0
+    per_request_s = (cm.prefill_s(prompt_mean)
+                     + output_mean * cm.decode_s(base.slots)
+                     / base.slots)
+    rate = offered_load / per_request_s
+    arrivals = open_loop_arrivals(seed, rate, horizon_s,
+                                  id_prefix="S")
+    on = run_open_loop(
+        dataclasses.replace(base, spec_k=spec_k), cm,
+        [r.fresh_copy() for r in arrivals],
+        executor_factory=lambda: PeriodicSimExecutor(period))
+    off = run_open_loop(
+        base, cm, [r.fresh_copy() for r in arrivals],
+        executor_factory=lambda: PeriodicSimExecutor(period))
+    return {
+        "offered_load": offered_load,
+        "offered_rps": round(rate, 3),
+        "spec_k": spec_k,
+        "period": period,
+        "with_speculation": on,
+        "baseline": off,
+        "acceptance_rate": on["spec_acceptance_rate"],
+        "mean_accepted_k": on["spec_mean_accepted_k"],
+        "itl_p50_s_spec": on["itl_p50_s"],
+        "itl_p50_s_baseline": off["itl_p50_s"],
+        "itl_p50_delta_s": round(off["itl_p50_s"] - on["itl_p50_s"],
+                                 4),
+        "itl_p50_speedup": round(
+            off["itl_p50_s"] / on["itl_p50_s"], 3)
+        if on["itl_p50_s"] else 0.0,
+        "tokens_per_s_speedup": round(
+            on["tokens_per_s"] / off["tokens_per_s"], 3)
+        if off["tokens_per_s"] else 0.0,
+        "kv_blocks_leaked": (on["kv_blocks_leaked"]
+                             + off["kv_blocks_leaked"]),
+    }
+
+
 def compare_batching(config: ServeConfig, cost_model: CostModel,
                      arrivals: list) -> dict:
     """Continuous vs static batching on the SAME seeded arrivals: the
@@ -1949,9 +2288,27 @@ def calibrate_cost_model(cfg: Optional[Any] = None, slots: int = 8,
     d1, dn = one_decode(1), one_decode(slots)
     per_seq = max((dn - d1) / max(slots - 1, 1), 1e-6)
     base = max(d1 - per_seq, 1e-6)
+
+    # verify cost: the batched k+1-position verify pass at full batch
+    # vs the plain decode iteration it replaces — the marginal slope
+    # per (sequence, draft position) is what the adaptive-k policy
+    # prices speculation with
+    from .decode import verify_step
+    spec_k = 4
+
+    def one_verify() -> float:
+        cache = init_kv_cache(cfg, slots)
+        toks = jnp.zeros((slots, spec_k + 1), jnp.int32)
+        pos = jnp.full((slots,), prompt_len, jnp.int32)
+        return timed(lambda: jax.block_until_ready(
+            verify_step(params, cfg, cache, toks, pos)[0]))
+
+    verify_per_token = max(
+        (one_verify() - dn) / (slots * spec_k), 1e-7)
     return CostModel(decode_base_s=base, decode_per_seq_s=per_seq,
                      prefill_per_token_s=max(
-                         prefill_s / prompt_len, 1e-7))
+                         prefill_s / prompt_len, 1e-7),
+                     spec_verify_per_token_s=verify_per_token)
 
 
 def bench_serving(seed: int = 0, loads: tuple = (0.5, 0.8, 1.1),

@@ -1,0 +1,171 @@
+"""Speculative decoding: drafter, exact greedy acceptance, adaptive k.
+
+Standard acceptance-sampling speculative decoding (Leviathan et al.'s
+draft-then-verify) specialized to the greedy serving path: a cheap
+DRAFTER proposes k tokens per sequence, the jitted batched verify
+kernel (:func:`~dpu_operator_tpu.workloads.decode.verify_step`) scores
+all k+1 positions in ONE iteration, and the exact acceptance rule
+keeps the emitted stream IDENTICAL BY CONSTRUCTION to running
+``generate()`` token by token — speculation can only change how many
+tokens an iteration emits, never which tokens.
+
+The default drafter is prompt-lookup / n-gram (Saxena-style): match
+the context's own suffix against its history and propose the
+continuation — no second model, no extra weights streamed, and the
+workloads it wins on (templated prompts, code, retrieval contexts with
+verbatim spans) are exactly the serving mixes the scheduler sees. The
+:class:`Drafter` seam is pluggable so a small draft MODEL can slot in
+later without touching the scheduler.
+
+Everything here is pure Python over token ids — deterministic, no JAX
+— so the scheduler's seeded virtual-clock runs stay bit-identical with
+speculation on (the serve-check determinism gate covers it).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+
+class Drafter(Protocol):
+    """The drafter seam: propose up to *k* continuation tokens for a
+    request whose context (prompt + generated tokens so far) is *ids*.
+    Proposals are best-effort — returning fewer than k (or none) is
+    normal and simply shrinks that row's speculation this iteration."""
+
+    def propose(self, ids: Sequence[int], k: int) -> list: ...
+
+
+class NgramDrafter:
+    """Prompt-lookup drafting: find the most recent earlier occurrence
+    of the context's trailing n-gram inside the context itself and
+    propose the tokens that followed it. Longest n-gram first (a
+    3-token match is far more predictive than a 1-token one), most
+    recent occurrence wins (locality: loops and templated spans repeat
+    near themselves). O(len(context) * max_ngram) per call, no state —
+    safe to share across requests."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1) -> None:
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError("need 1 <= min_ngram <= max_ngram")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, ids: Sequence[int], k: int) -> list:
+        ids = list(ids)
+        n = len(ids)
+        if k <= 0 or n < self.min_ngram + 1:
+            return []
+        for ng in range(min(self.max_ngram, n - 1),
+                        self.min_ngram - 1, -1):
+            pattern = ids[n - ng:]
+            # scan right-to-left over earlier occurrences: most recent
+            # match first (start < n - ng so the continuation is real)
+            for start in range(n - ng - 1, -1, -1):
+                if ids[start:start + ng] == pattern:
+                    cont = ids[start + ng:start + ng + k]
+                    if cont:
+                        return cont
+                    break  # suffix-adjacent match has no continuation
+        return []
+
+
+def greedy_accept(drafts: Sequence[int],
+                  argmaxes: Sequence[int]) -> tuple:
+    """The EXACT greedy acceptance rule. *drafts* is the k proposed
+    tokens; *argmaxes* is the verify pass's per-position argmax —
+    ``argmaxes[i]`` is the token greedy decoding WOULD emit after
+    position i's context, so ``len(argmaxes) == len(drafts) + 1``.
+
+    Accept drafts left to right while ``drafts[i] == argmaxes[i]``
+    (each accepted draft is literally the token the model would have
+    picked, so the stream cannot diverge); on the first mismatch emit
+    the model's own token instead (the CORRECTION), and when every
+    draft survives emit ``argmaxes[k]`` (the BONUS — the verify pass
+    already scored the position after the last draft). Returns
+    ``(accepted, emitted)``: the number of drafts accepted and the
+    ``accepted + 1`` tokens to append. With k=0 this degrades to plain
+    greedy decode (emit ``argmaxes[0]``)."""
+    if len(argmaxes) != len(drafts) + 1:
+        raise ValueError(
+            f"need {len(drafts) + 1} argmax positions for "
+            f"{len(drafts)} drafts, got {len(argmaxes)}")
+    accepted = 0
+    emitted: list[int] = []
+    for d, true_tok in zip(drafts, argmaxes):
+        if int(d) != int(true_tok):
+            break
+        emitted.append(int(d))
+        accepted += 1
+    emitted.append(int(argmaxes[accepted]))
+    return accepted, emitted
+
+
+class AdaptiveK:
+    """Per-iteration draft-length policy: an EWMA estimate of the
+    per-draft acceptance rate feeds the calibrated cost model, and the
+    chosen k maximizes EXPECTED tokens per modeled second.
+
+    With per-draft acceptance rate a, k drafts are expected to yield
+    ``1 + sum_{i=1..k} a^i`` tokens (geometric acceptance plus the
+    always-emitted correction/bonus) at modeled cost
+    ``cost.verify_s(batch, k)``; k=0 is plain decode at
+    ``cost.decode_s(batch)``. Low acceptance or a verify cost that
+    outgrows its expected yield both drive the choice back to k=0 —
+    speculation degrades to today's decode path instead of taxing it.
+    Pure float arithmetic over deterministic inputs, so seeded runs
+    replay bit-identically."""
+
+    def __init__(self, k_max: int, init_rate: float = 0.5,
+                 ewma: float = 0.3) -> None:
+        if k_max < 0:
+            raise ValueError("k_max must be >= 0")
+        self.k_max = k_max
+        self.rate = min(max(init_rate, 0.0), 1.0)
+        self.ewma = ewma
+        #: lifetime accounting (snapshot / metrics visibility)
+        self.proposed_total = 0
+        self.accepted_total = 0
+
+    def observe(self, proposed: int, accepted: int) -> None:
+        """Fold one iteration's draft outcome into the EWMA."""
+        if proposed <= 0:
+            return
+        self.proposed_total += proposed
+        self.accepted_total += accepted
+        obs = accepted / proposed
+        self.rate += self.ewma * (obs - self.rate)
+
+    def acceptance_rate(self) -> float:
+        """Lifetime acceptance (accepted / proposed), 0.0 before any
+        proposal — the ``tpu_serve_spec_acceptance_rate`` gauge and
+        ``tpuctl serve status`` read this."""
+        if not self.proposed_total:
+            return 0.0
+        return self.accepted_total / self.proposed_total
+
+    def expected_tokens(self, k: int) -> float:
+        """Expected emitted tokens for k drafts at the current rate."""
+        a = self.rate
+        total, p = 1.0, 1.0
+        for _ in range(k):
+            p *= a
+            total += p
+        return total
+
+    def choose(self, cost: object, batch: int) -> int:
+        """The k in [0, k_max] maximizing expected tokens/second under
+        *cost* (a CostModel with ``decode_s`` and ``verify_s``). Plain
+        decode (k=0) is the baseline any speculation must BEAT — ties
+        go to the smaller k, so a cost model that prices verify at
+        decode parity never speculates on hope alone."""
+        if self.k_max <= 0 or batch <= 0:
+            return 0
+        best_k = 0
+        best = 1.0 / max(cost.decode_s(batch), 1e-12)
+        for k in range(1, self.k_max + 1):
+            rate = (self.expected_tokens(k)
+                    / max(cost.verify_s(batch, k), 1e-12))
+            if rate > best:
+                best, best_k = rate, k
+        return best_k

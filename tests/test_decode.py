@@ -365,8 +365,13 @@ def test_measure_decode_kv_int8_byte_model():
            * (1.0 + 4.0 / cfg.d_head))
     from dpu_operator_tpu.workloads.perf import hbm_bandwidth_gbps
     delta_ms = (kv16 - kv8) / hbm_bandwidth_gbps() / 1e9 * 1e3
-    got = r16["roofline_ms_per_token"] - r8["roofline_ms_per_token"]
+    # the byte model lives in the HBM leg of the dual roofline; the
+    # combined roofline is max(hbm, compute) and this toy config is
+    # compute-bound on CPU, so the kv-width delta shows up there only
+    got = r16["hbm_ms_per_token"] - r8["hbm_ms_per_token"]
     assert got == pytest.approx(delta_ms, rel=1e-6)
+    assert r16["roofline_ms_per_token"] >= r16["hbm_ms_per_token"]
+    assert r8["roofline_ms_per_token"] >= r8["hbm_ms_per_token"]
 
 
 # -- chunked prefill (the schedulable-prefill kernel entry) -------------------

@@ -1,0 +1,594 @@
+"""Speculative-decoding gate (rides `make serve-check`).
+
+The spec-decode contract, asserted end to end: the exact greedy
+acceptance rule keeps speculative token streams IDENTICAL BY
+CONSTRUCTION to `generate()` (across bf16 / int8 weights / KV8 cache
+and across k), the jitted batched verify program compiles ONCE per
+(cfg, cache shape, k) and never re-traces, rejected speculation rolls
+the paged pool's written frontier back without leaking a block or
+undoing a fired copy-on-write, the scheduler's speculate-vs-decode
+choice degrades to plain decode under hostile acceptance, and seeded
+runs with speculation on replay bit-identical traces. Everything is
+virtual-clock / seeded — opslint's chaos-determinism rule covers the
+serve marker.
+"""
+
+import pytest
+
+from dpu_operator_tpu.workloads import serve
+from dpu_operator_tpu.workloads.kv_pool import KvBlockPool, chain_keys
+from dpu_operator_tpu.workloads.spec import (AdaptiveK, NgramDrafter,
+                                             greedy_accept)
+
+pytestmark = pytest.mark.serve
+
+SEED = 20260806
+
+
+# -- exact greedy acceptance rule ---------------------------------------------
+
+
+def test_greedy_accept_full_acceptance_emits_bonus():
+    accepted, emitted = greedy_accept([5, 6, 7], [5, 6, 7, 9])
+    assert accepted == 3
+    assert emitted == [5, 6, 7, 9]  # all drafts + the bonus argmax
+
+
+def test_greedy_accept_first_mismatch_emits_correction():
+    accepted, emitted = greedy_accept([5, 6, 7], [5, 8, 7, 9])
+    assert accepted == 1
+    # the correction is the model's OWN choice at the mismatch — the
+    # stream cannot diverge from plain greedy decode
+    assert emitted == [5, 8]
+
+
+def test_greedy_accept_zero_drafts_is_plain_decode():
+    accepted, emitted = greedy_accept([], [42])
+    assert accepted == 0
+    assert emitted == [42]
+
+
+def test_greedy_accept_rejects_length_mismatch():
+    with pytest.raises(ValueError):
+        greedy_accept([1, 2], [1, 2])  # needs k+1 argmax positions
+
+
+def test_greedy_accept_always_emits_accepted_plus_one():
+    for drafts, argmaxes in (([1, 2, 3, 4], [1, 2, 3, 4, 5]),
+                             ([1, 2, 3, 4], [9, 9, 9, 9, 9])):
+        accepted, emitted = greedy_accept(drafts, argmaxes)
+        assert 1 <= len(emitted) == accepted + 1 <= len(drafts) + 1
+
+
+# -- prompt-lookup drafter ----------------------------------------------------
+
+
+def test_ngram_drafter_proposes_continuation_of_suffix_match():
+    d = NgramDrafter(max_ngram=3)
+    #      0   1   2   3   4   5   6   7
+    ids = [10, 11, 12, 13, 99, 11, 12, 13]
+    # trailing 3-gram [11,12,13] matched at positions 1..3; the
+    # proposal is what followed it there
+    assert d.propose(ids, 2) == [99, 11]
+
+
+def test_ngram_drafter_prefers_most_recent_occurrence():
+    d = NgramDrafter(max_ngram=1)
+    ids = [7, 1, 7, 2, 7]
+    # trailing 1-gram [7] occurs at 0 and 2; the most recent (2) wins
+    assert d.propose(ids, 1) == [2]
+
+
+def test_ngram_drafter_longest_ngram_wins():
+    d = NgramDrafter(max_ngram=2, min_ngram=1)
+    ids = [5, 6, 9, 3, 5, 6]
+    # the 2-gram [5,6] (continuation 9) beats any 1-gram match — the
+    # longer, more predictive context must be preferred
+    assert d.propose(ids, 1) == [9]
+
+
+def test_ngram_drafter_no_match_returns_empty():
+    d = NgramDrafter()
+    assert d.propose([1, 2, 3, 4], 4) == []
+    assert d.propose([], 4) == []
+    assert d.propose([1], 4) == []
+    assert d.propose([1, 2, 3], 0) == []
+
+
+def test_ngram_drafter_clamps_to_k():
+    d = NgramDrafter(max_ngram=1)
+    ids = [4, 8, 9, 10, 11, 4]
+    assert d.propose(ids, 2) == [8, 9]
+    assert d.propose(ids, 10) == [8, 9, 10, 11, 4]
+
+
+# -- adaptive-k policy --------------------------------------------------------
+
+
+def test_adaptive_k_expected_tokens_is_geometric():
+    ak = AdaptiveK(k_max=4, init_rate=0.5)
+    assert ak.expected_tokens(0) == pytest.approx(1.0)
+    assert ak.expected_tokens(2) == pytest.approx(1 + 0.5 + 0.25)
+
+
+def test_adaptive_k_chooses_zero_under_collapsed_acceptance():
+    ak = AdaptiveK(k_max=4, init_rate=0.9)
+    for _ in range(50):
+        ak.observe(4, 0)  # every draft rejected
+    assert ak.rate < 0.01
+    assert ak.choose(serve.CostModel(), batch=8) == 0
+
+
+def test_adaptive_k_speculates_under_high_acceptance():
+    ak = AdaptiveK(k_max=4, init_rate=0.5)
+    for _ in range(50):
+        ak.observe(4, 4)
+    assert ak.choose(serve.CostModel(), batch=8) == 4
+    assert ak.acceptance_rate() == pytest.approx(1.0)
+
+
+def test_adaptive_k_ties_break_to_smaller_k():
+    # at rate 0 every k nets exactly one token per iteration, and
+    # verify is never cheaper than decode — the tie must resolve to
+    # NOT speculating
+    ak = AdaptiveK(k_max=4, init_rate=0.0)
+    assert ak.choose(serve.CostModel(), batch=8) == 0
+
+
+def test_cost_model_verify_collapses_to_decode_at_k0():
+    cm = serve.CostModel()
+    assert cm.verify_s(8, 0) == pytest.approx(cm.decode_s(8))
+    assert cm.verify_s(8, 4) > cm.decode_s(8)
+
+
+# -- paged-pool rollback ------------------------------------------------------
+
+
+def test_pool_rollback_unwrites_past_frontier():
+    pool = KvBlockPool(num_blocks=4, block_size=4)
+    pool.alloc("a", 3)
+    pool.set_used_tokens("a", 9)
+    rolled = pool.rollback_tokens("a", 6)
+    assert rolled == 3
+    assert pool.spec_rollback_tokens == 3
+    # blocks stay allocated — rollback is accounting-only (they are
+    # the request's reservation; accepted tokens rewrite the slots)
+    assert pool.free_blocks() == 1
+    assert pool.snapshot()["specRollbackTokens"] == 3
+    pool.free("a")
+    assert pool.outstanding() == 0
+
+
+def test_pool_rollback_never_extends_and_guards_inputs():
+    pool = KvBlockPool(num_blocks=4, block_size=4)
+    pool.alloc("a", 2)
+    pool.set_used_tokens("a", 3)
+    assert pool.rollback_tokens("a", 8) == 0  # raising is not its job
+    with pytest.raises(KeyError):
+        pool.rollback_tokens("ghost", 0)
+    with pytest.raises(ValueError):
+        pool.rollback_tokens("a", -1)
+
+
+def test_pool_rollback_preserves_cow_copy_in_shared_block():
+    """A speculative write into a shared block fires copy-on-write;
+    rejecting the speculation rolls the frontier back but CANNOT undo
+    the copy — the physical divergent write happened. The shared
+    original must keep serving its other reader."""
+    pool = KvBlockPool(num_blocks=8, block_size=4, sharing=True)
+    prompt = tuple(range(8))  # 2 full blocks
+    keys = chain_keys(prompt, 4)
+    pool.alloc("a", 3)  # prompt + 1 generation block
+    for i in range(8):
+        pool.write_token("a", i)
+    pool.register_prefix("a", keys, 8)
+    mapped = pool.map_prefix("b", keys)
+    assert mapped == 2
+    pool.alloc("b", 3 - mapped)
+    before = pool.cow_copies
+    assert pool.write_token("b", 8) is False  # own block: no copy
+    pool.set_used_tokens("b", 9)
+    assert pool.rollback_tokens("b", 8) == 1
+    # speculate INTO the shared covered region: must copy, and the
+    # copy persists across the rollback that rejects the speculation
+    assert pool.write_token("b", 7) is True
+    assert pool.cow_copies == before + 1
+    pool.rollback_tokens("b", 7)
+    assert pool.cow_copies == before + 1  # rollback undoes no copy
+    pool.free("a")
+    pool.free("b")
+    assert pool.outstanding() == 0
+
+
+# -- jitted verify kernel: token identity + no-retrace ------------------------
+
+
+def _tiny_model():
+    import jax
+
+    from dpu_operator_tpu.workloads.model import (TransformerConfig,
+                                                  init_params)
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_seq=64)
+    return cfg, init_params(jax.random.key(0), cfg)
+
+
+def _spec_generate(params, cfg, prompt, out_len, k, ref, corrupt,
+                   kv_int8=False):
+    """Drive the jitted verify kernel with an oracle drafter (drafts
+    copied from the reference stream, optionally corrupting the last
+    draft to force mid-speculation rejections) and the exact greedy
+    rule. Verify width is FIXED at k+1 (short proposals pad with
+    repeats of the committed token); returns the emitted stream."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dpu_operator_tpu.workloads import decode as D
+
+    cache, logits = D.prefill(params, cfg,
+                              jnp.asarray([list(prompt)], jnp.int32),
+                              kv_int8=kv_int8)
+    toks = [int(jnp.argmax(logits[0]))]
+    pos = len(prompt)
+    while len(toks) < out_len:
+        kk = min(k, out_len - len(toks) - 1)
+        drafts = list(ref[len(toks):len(toks) + kk])
+        if corrupt and drafts:
+            drafts[-1] = (drafts[-1] + 1) % cfg.vocab
+        row = [toks[-1]] + drafts + [toks[-1]] * (k - len(drafts))
+        logits, cache = D.verify_step(
+            params, cfg, cache, jnp.asarray([row], jnp.int32),
+            jnp.asarray([pos], jnp.int32))
+        arg = np.asarray(jnp.argmax(logits, axis=-1))[0]
+        _, emitted = greedy_accept(
+            drafts, [int(arg[i]) for i in range(len(drafts) + 1)])
+        toks.extend(emitted)
+        pos += len(emitted)
+    return toks[:out_len]
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8", "kv8"])
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_verify_step_streams_identical_to_generate(mode, k):
+    """The tentpole identity: speculative decoding through the jitted
+    verify kernel emits EXACTLY the greedy generate() stream — across
+    weight/cache quantization and draft lengths, with rejections
+    forced every iteration (corrupted oracle drafts)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dpu_operator_tpu.workloads import decode as D
+
+    cfg, params = _tiny_model()
+    kv_int8 = mode == "kv8"
+    if mode == "int8":
+        params = D.quantize_decode_params(params)
+    prompt = [3, 7, 11, 5, 2]
+    out_len = 12
+    ref = D.generate(params, cfg, jnp.asarray([prompt], jnp.int32),
+                     out_len, kv_int8=kv_int8)
+    ref = [int(t) for t in np.asarray(ref)[0]]
+    got = _spec_generate(params, cfg, prompt, out_len, k, ref,
+                         corrupt=True, kv_int8=kv_int8)
+    assert got == ref
+    got_clean = _spec_generate(params, cfg, prompt, out_len, k, ref,
+                               corrupt=False, kv_int8=kv_int8)
+    assert got_clean == ref
+
+
+def test_verify_step_never_retraces():
+    """ONE compiled program per (cfg, cache shape, k): re-running the
+    same shapes with different token values, positions and per-row
+    draft counts must not grow the jit cache."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dpu_operator_tpu.workloads import decode as D
+
+    cfg, params = _tiny_model()
+    prompt = [3, 7, 11, 5]
+    ref = D.generate(params, cfg, jnp.asarray([prompt], jnp.int32), 10)
+    ref = [int(t) for t in np.asarray(ref)[0]]
+    _spec_generate(params, cfg, prompt, 10, 3, ref, corrupt=True)
+    size = D.verify_step._cache_size()
+    assert size >= 1
+    _spec_generate(params, cfg, prompt, 10, 3, ref, corrupt=False)
+    assert D.verify_step._cache_size() == size
+
+
+# -- scheduler + JAX executor: identity through preemption --------------------
+
+
+class _OracleDrafter:
+    """Drafts copied from per-request reference streams (prompt-keyed),
+    corrupting the final draft when it can — deterministic forced
+    mid-speculation rejections on the REAL verify path."""
+
+    def __init__(self, refs: dict, prompts: dict,
+                 corrupt: bool = True) -> None:
+        self.refs = refs
+        self.prompts = prompts
+        self.corrupt = corrupt
+
+    def propose(self, ids, k):
+        ids = list(ids)
+        for rid, p in self.prompts.items():
+            if len(ids) >= len(p) and tuple(ids[:len(p)]) == p:
+                done = len(ids) - len(p)
+                d = list(self.refs[rid][done:done + k])
+                if self.corrupt and len(d) >= 2:
+                    d[-1] = (d[-1] + 1) % 64
+                return d
+        return []
+
+
+def _jax_refs(cfg, params, prompts: dict, out_len: int) -> dict:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dpu_operator_tpu.workloads import decode as D
+
+    refs = {}
+    for rid, p in prompts.items():
+        r = D.generate(params, cfg, jnp.asarray([list(p)], jnp.int32),
+                       out_len)
+        refs[rid] = [int(t) for t in np.asarray(r)[0]]
+    return refs
+
+
+def test_scheduler_spec_streams_match_generate_through_preemption():
+    """The full serving path with speculation on — including a forced
+    preemption that evicts a batch request MID-SPECULATION (its KV
+    recomputed on re-admission) — must emit streams identical to the
+    fused generate() per request in isolation."""
+    cfg, params = _tiny_model()
+    prompts = {"b1": (3, 7, 11, 5), "b2": (9, 2, 4, 1),
+               "hot": (1, 1, 2, 3, 5)}
+    out_len = 10
+    refs = _jax_refs(cfg, params, prompts, out_len)
+    ex = serve.JaxSlotExecutor(params, cfg, slots=2, spec_k=3)
+    # both slots full when the interactive request lands: it MUST
+    # preempt a batch request while that request's speculation is in
+    # flight (arrival 2 ms ≈ one decode iteration of virtual time)
+    config = serve.ServeConfig(slots=2, kv_blocks=4, kv_block_size=16,
+                               spec_k=3, preemption=True)
+    sched = serve.Scheduler(
+        config, executor=ex,
+        drafter=_OracleDrafter(refs, prompts, corrupt=True))
+    sched.submit(serve.Request(rid="b1", prompt_len=4,
+                               output_len=out_len, prompt=prompts["b1"],
+                               slo_class=serve.BATCH, arrival_s=0.0))
+    sched.submit(serve.Request(rid="b2", prompt_len=4,
+                               output_len=out_len, prompt=prompts["b2"],
+                               slo_class=serve.BATCH, arrival_s=0.0))
+    sched.submit(serve.Request(rid="hot", prompt_len=5,
+                               output_len=out_len,
+                               prompt=prompts["hot"],
+                               slo_class=serve.INTERACTIVE,
+                               arrival_s=0.002))
+    sched.run()
+    assert len(sched.completed) == 3
+    assert {r.rid: r.tokens for r in sched.completed} == refs
+    assert any(t[0] == "preempt" for t in sched.trace)
+    assert any(t[0] == "spec" for t in sched.trace)
+    assert sched.pool.outstanding() == 0
+
+
+def test_scheduler_spec_construction_guards():
+    cfg, params = _tiny_model()
+    # an executor without a verify path refuses the speculating config
+    ex = serve.JaxSlotExecutor(params, cfg, slots=2)
+    with pytest.raises(ValueError, match="verify"):
+        serve.Scheduler(serve.ServeConfig(slots=2, spec_k=2),
+                        executor=ex)
+    # as does a verify width narrower than spec_k + 1
+    ex2 = serve.JaxSlotExecutor(params, cfg, slots=2, spec_k=1)
+    with pytest.raises(ValueError, match="width"):
+        serve.Scheduler(serve.ServeConfig(slots=2, spec_k=3),
+                        executor=ex2)
+
+
+# -- scheduler semantics over the sim executor --------------------------------
+
+
+def _spec_config(**kw) -> serve.ServeConfig:
+    base = dict(slots=4, kv_blocks=64, kv_block_size=16,
+                queue_limit=256, spec_k=4)
+    base.update(kw)
+    return serve.ServeConfig(**base)
+
+
+class _WrongDrafter:
+    """Always proposes tokens the sim stream will reject — the
+    deterministic hostile workload that must drive adaptive k to 0."""
+
+    def propose(self, ids, k):
+        return [1] * k  # sim tokens are (hash + 7919 n) mod 50021
+
+
+class _FlakyDrafter:
+    """Prompt-lookup drafts with every second proposal's tail
+    corrupted — deterministic partial acceptance, so rejection and
+    rollback exercise on an otherwise drafter-friendly stream."""
+
+    def __init__(self) -> None:
+        self.inner = NgramDrafter()
+        self.calls = 0
+
+    def propose(self, ids, k):
+        d = self.inner.propose(ids, k)
+        self.calls += 1
+        if d and self.calls % 2 == 0:
+            d[-1] = (d[-1] + 1) % 50_021
+        return d
+
+
+def test_spec_run_matches_plain_run_token_for_token():
+    """Stream identity at the SCHEDULER level: the same seeded
+    arrivals through the periodic (drafter-friendly) executor with
+    speculation on vs off must complete with identical per-request
+    token streams — speculation changes pacing, never content."""
+    arrivals = serve.open_loop_arrivals(SEED, 8.0, 10.0)
+    on = serve.Scheduler(_spec_config(),
+                         executor=serve.PeriodicSimExecutor(4))
+    on.submit_all([r.fresh_copy() for r in arrivals])
+    on.run()
+    off = serve.Scheduler(_spec_config(spec_k=0),
+                          executor=serve.PeriodicSimExecutor(4))
+    off.submit_all([r.fresh_copy() for r in arrivals])
+    off.run()
+    tok_on = {r.rid: r.tokens for r in on.completed}
+    tok_off = {r.rid: r.tokens for r in off.completed}
+    assert tok_on == tok_off
+    assert len(tok_on) == len(arrivals)
+    snap = on.snapshot()["spec"]
+    assert snap["proposed"] > 0
+    assert snap["acceptanceRate"] > 0.8  # periodic streams draft well
+    assert on.pool.outstanding() == 0
+
+
+def test_spec_traces_are_bit_deterministic():
+    """The determinism artifact with speculation ON: two runs over the
+    same seed produce bit-identical traces, including the
+    (spec, iteration, rid, proposed, accepted) tuples."""
+    def run():
+        sched = serve.Scheduler(
+            _spec_config(prefix_sharing=True, prefill_chunk_tokens=32),
+            executor=serve.PeriodicSimExecutor(4))
+        sched.submit_all(serve.open_loop_arrivals(SEED, 10.0, 12.0))
+        sched.run()
+        return sched.trace
+    t1, t2 = run(), run()
+    assert t1 == t2
+    assert any(t[0] == "spec" for t in t1)
+
+
+def test_spec_degrades_to_plain_decode_under_hostile_acceptance():
+    """Every proposal rejected: the acceptance EWMA collapses and
+    adaptive k must drive speculation to ZERO — the k=0 degradation
+    the tentpole requires — while streams stay correct."""
+    sched = serve.Scheduler(_spec_config(),
+                            executor=serve.SimExecutor(),
+                            drafter=_WrongDrafter())
+    sched.submit_all(serve.open_loop_arrivals(SEED, 6.0, 15.0))
+    sched.run()
+    spec_events = [t for t in sched.trace if t[0] == "spec"]
+    assert spec_events  # it probed while the EWMA was warm...
+    assert max(t[1] for t in spec_events) < sched.iterations  # ...then quit
+    assert sched._spec.rate < 0.05
+    assert sched._spec.choose(sched.cost, 4) == 0
+    ex = serve.SimExecutor()
+    for r in sched.completed:
+        assert r.tokens == [ex._token(r, n)
+                            for n in range(r.output_len)]
+    assert sched.pool.outstanding() == 0
+
+
+def test_spec_rollback_with_cow_shared_blocks_leaks_nothing():
+    """Speculation over SHARED prefixes: speculative writes land in
+    shared tail blocks (CoW fires at verify time), every second
+    proposal rejects (flaky drafter), and after 500 speculate/reject
+    lifecycles the pool drains to exactly zero — the leak gate with
+    speculation on."""
+    config = _spec_config(slots=8, kv_blocks=128, prefix_sharing=True)
+    sched = serve.Scheduler(config,
+                            executor=serve.PeriodicSimExecutor(4),
+                            drafter=_FlakyDrafter())
+    arrivals = serve.prefix_heavy_arrivals(SEED, 40.0, 16.0,
+                                           n_prefixes=3,
+                                           prefix_len=33)
+    assert len(arrivals) >= 500
+    sched.submit_all(arrivals[:500])
+    sched.run()
+    assert sched.completed_total + sched.rejected_total == 500
+    assert sched.completed_total >= 450
+    assert sched.pool.outstanding() == 0
+    snap = sched.snapshot()["spec"]
+    assert snap["proposed"] > 0
+    assert snap["rejected"] > 0
+    assert sched.pool.spec_rollback_tokens > 0
+    assert sched.ledger.reconcile()["ok"]
+
+
+def test_spec_verify_phase_lands_in_ledger():
+    sched = serve.Scheduler(_spec_config(),
+                            executor=serve.PeriodicSimExecutor(4))
+    sched.submit_all(serve.open_loop_arrivals(SEED, 6.0, 6.0))
+    sched.run()
+    assert set(serve.LEDGER_PHASES) == {"prefill", "decode", "verify",
+                                        "cow", "sched"}
+    verify_s = sum(e["phases"]["verify"]
+                   for e in sched.ledger.entries())
+    assert verify_s > 0.0
+    assert sched.ledger.reconcile()["ok"]
+
+
+def test_spec_improves_itl_on_drafter_friendly_mix():
+    """The perf claim in miniature: same arrivals, same virtual cost
+    model — the speculative run's median inter-token latency beats the
+    plain run's, with zero blocks leaked in either."""
+    arrivals = serve.open_loop_arrivals(SEED, 8.0, 10.0)
+    on = serve.run_open_loop(
+        _spec_config(), serve.CostModel(),
+        [r.fresh_copy() for r in arrivals],
+        executor_factory=lambda: serve.PeriodicSimExecutor(4))
+    off = serve.run_open_loop(
+        _spec_config(spec_k=0), serve.CostModel(),
+        [r.fresh_copy() for r in arrivals],
+        executor_factory=lambda: serve.PeriodicSimExecutor(4))
+    assert on["completed"] == off["completed"]
+    assert on["itl_p50_s"] < off["itl_p50_s"]
+    assert on["spec_acceptance_rate"] > 0.8
+    assert on["spec_mean_accepted_k"] > 1.0
+    assert on["kv_blocks_leaked"] == off["kv_blocks_leaked"] == 0
+
+
+def test_bench_spec_decoding_record_shape():
+    r = serve.bench_spec_decoding(seed=SEED, horizon_s=8.0)
+    assert r["kv_blocks_leaked"] == 0
+    assert r["acceptance_rate"] > 0.8
+    assert r["itl_p50_delta_s"] > 0
+    assert r["itl_p50_speedup"] > 1.0
+    assert r["with_speculation"]["completed"] == \
+        r["baseline"]["completed"]
+    # the compressed evidence reaches the BENCH payload (full on/off
+    # sub-records are deliberately dropped at the payload boundary)
+    import bench
+    payload = bench.build_payload({"serve": {"spec_decode": r}}, {})
+    sd = payload["serve"]["spec_decode"]
+    assert sd["acceptance_rate"] == r["acceptance_rate"]
+    assert sd["kv_blocks_leaked"] == 0
+    assert "with_speculation" not in sd
+    assert payload["serve_spec_itl_speedup"] == r["itl_p50_speedup"]
+
+
+# -- admission-rejection reason visibility (fleet-router seam) ----------------
+
+
+def test_reject_event_message_carries_machine_readable_reason(
+        monkeypatch):
+    captured = []
+    monkeypatch.setattr(
+        serve.watchdog, "emit_health_event",
+        lambda reason, message, type_, series="": captured.append(
+            (reason, message)))
+    config = _spec_config(spec_k=0, queue_limit=1, kv_blocks=4)
+    sched = serve.Scheduler(config)
+    # kv_too_large: can never fit the 64-token pool
+    sched.submit(serve.Request(rid="big", prompt_len=60,
+                               output_len=60, arrival_s=0.0))
+    # queue_full: limit 1, later batch arrivals shed at the edge
+    for rid in ("q1", "q2", "q3"):
+        sched.submit(serve.Request(rid=rid, prompt_len=30,
+                                   output_len=20,
+                                   slo_class=serve.BATCH,
+                                   arrival_s=0.0))
+    sched.step()
+    assert all(reason == "ServeAdmissionRejected"
+               for reason, _ in captured)
+    msgs = [m for _, m in captured]
+    assert any(m.startswith("[kv_too_large] ") for m in msgs)
+    assert any(m.startswith("[queue_full] ") for m in msgs)
+    # the trace tuple carries the same machine-readable reason
+    reasons = {t[4] for t in sched.trace if t[0] == "reject"}
+    assert {"kv_too_large", "queue_full"} <= reasons
