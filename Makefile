@@ -4,7 +4,8 @@ REGISTRY ?= localhost:5000
 TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
-        upgrade-check fault-check scale-check serve-check lint-check \
+        upgrade-check fault-check scale-check serve-check \
+        serve-chaos-check lint-check \
         fuzz-check fleet-obs-check \
         race-check type-check bench native traffic-flow images \
         smoke-images deploy undeploy graft-check clean
@@ -129,6 +130,17 @@ scale-check:
 # Seeded RNG, virtual clocks, no wall-clock sleeps.
 serve-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m serve \
+	  -p no:randomly -p no:cacheprovider
+
+# serving-path fault engine gate (doc/architecture.md "Serving failure
+# modes"): seeded ChaosExecutor storms through the real Scheduler —
+# the interactive serve-ttft SLO holds while the degradation ladder
+# sheds batch traffic, a poisoned request is excised within its retry
+# budget, zero KV blocks leak across 500 fault/retry/rebuild
+# lifecycles, storm traces replay bit-identically, and FAULT_r02.json
+# records serve-path MTTR alongside the hardware series
+serve-chaos-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m serve_chaos \
 	  -p no:randomly -p no:cacheprovider
 
 # fleet telemetry plane gate (doc/observability.md "Fleet telemetry

@@ -397,6 +397,24 @@ class RetryDisciplineChecker(Checker):
                 f"{sorted(sleeps)[0]}() and no deadline check — use "
                 "utils.resilience.RetryPolicy (bounded attempts + "
                 "deadline budget) or bound the loop on time.monotonic()")
+        # ad-hoc backoff: sleeping a hand-rolled exponential
+        # (`sleep(base * 2 ** attempt)`) re-implements — without the
+        # jitter, the cap, or the deadline — what RetryPolicy.backoff
+        # already owns; one backoff curve per codebase
+        for call in (c for c in ast.walk(module.tree)
+                     if isinstance(c, ast.Call)):
+            name = dotted_name(call.func) or ""
+            if not (name == "time.sleep" or name.endswith(".sleep")):
+                continue
+            if any(isinstance(sub, ast.BinOp)
+                   and isinstance(sub.op, ast.Pow)
+                   for arg in call.args for sub in ast.walk(arg)):
+                yield self.violation(
+                    module, call,
+                    f"ad-hoc exponential backoff: {name}() sleeps a "
+                    "hand-computed power — use utils.resilience."
+                    "RetryPolicy.backoff() (seeded jitter, cap, "
+                    "deadline) instead of re-deriving the curve")
 
 
 # -- exception-hygiene --------------------------------------------------------
@@ -652,7 +670,8 @@ _ALLOWED_RANDOM = {"random.Random"}  # seedable constructor — the idiom
 #: arrival process must never silently use unseeded entropy) share the
 #: invariant
 _DETERMINISTIC_MARKS = ("pytest.mark.chaos", "pytest.mark.fault",
-                        "pytest.mark.serve")
+                        "pytest.mark.serve",
+                        "pytest.mark.serve_chaos")
 
 
 def _is_deterministic_mark(target: Any) -> bool:

@@ -28,6 +28,7 @@ from ..k8s.manager import Manager
 from ..utils import metrics, tracing
 from ..utils import vars as v
 from ..utils.path_manager import PathManager
+from ..utils.resilience import RetryPolicy
 from ..vsp.rpc import VspChannel
 from . import handoff as handoff_mod
 from .device_handler import TpuDeviceHandler
@@ -172,6 +173,12 @@ class HostSideManager:
     def _tpu_daemon_call_traced(self, method: str, req: dict) -> dict:
         ip, port = self._tpu_daemon_addr
         last: Optional[Exception] = None
+        # RetryPolicy owns the backoff curve (full jitter, capped at
+        # the old curve's 16x ceiling); built per call so tests that
+        # reassign dial_backoff/dial_retries keep working
+        policy = RetryPolicy(max_attempts=self.dial_retries,
+                             base=self.dial_backoff,
+                             cap=self.dial_backoff * 16)
         for attempt in range(self.dial_retries):
             channel = VspChannel(f"{ip}:{port}")
             try:
@@ -183,7 +190,7 @@ class HostSideManager:
                         f"{e.details()}") from e
                 last = e
                 if attempt < self.dial_retries - 1:
-                    time.sleep(self.dial_backoff * (2 ** min(attempt, 4)))
+                    time.sleep(policy.backoff(attempt))
             finally:
                 channel.close()
         raise ConnectionError(

@@ -2,9 +2,11 @@
 
 from .chaos import (  # noqa: F401
     ChaosChannel,
+    ChaosExecutor,
     ChaosKube,
     ChaosVsp,
     ChipDead,
+    ExecutorOom,
     Fail,
     FailAfter,
     FaultPlan,
@@ -13,5 +15,8 @@ from .chaos import (  # noqa: F401
     Latency,
     LinkFlap,
     Ok,
+    Oom,
+    PoisonedRid,
+    Stall,
     truncate_file,
 )

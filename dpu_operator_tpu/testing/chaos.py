@@ -28,6 +28,15 @@ Fault vocabulary:
   blind POST retries unsafe (k8s/pool.py's response-phase rule).
 - :class:`Latency` — sleep, then run: a slow dependency for deadline/
   timeout budgets.
+- :class:`Stall` — Latency on an INJECTED clock (no wall sleep): an
+  executor hang past a watchdog deadline, bit-reproducible.
+- :class:`Oom` — raise :class:`ExecutorOom` before the operation: an
+  allocation-time failure whose cure is freeing blocks (the serve
+  retry-with-rebuild path).
+
+:class:`ChaosExecutor` applies the same vocabulary to the serving
+decode path (begin/prefill_chunk/step/spec_step), plus per-rid
+poisoning (:class:`PoisonedRid`).
 """
 
 from __future__ import annotations
@@ -88,6 +97,46 @@ class Latency(Fault):
     def apply(self, op, args, kwargs):
         self.sleep(self.seconds)
         return op(*args, **kwargs)
+
+
+class Stall(Latency):
+    """A stall on an INJECTED clock: *advance* (e.g. a test Clock's
+    ``advance``) moves virtual time past a watchdog deadline, then the
+    operation runs — the executor "hung" for *seconds* without a single
+    wall-clock sleep, so stall storms replay bit-identically."""
+
+    def __init__(self, seconds: float,
+                 advance: Callable[[float], None], times: int = 1):
+        super().__init__(seconds, times=times, sleep=advance)
+
+
+class ExecutorOom(MemoryError):
+    """Allocation-time OOM from an executor (HBM/page exhaustion while
+    materializing a step): transient from the scheduler's point of
+    view — the retry-with-rebuild path frees the victim's blocks,
+    which is exactly what an OOM needs."""
+
+
+class Oom(Fault):
+    """Fail *times* calls with :class:`ExecutorOom` before the
+    operation runs (the allocation never succeeded)."""
+
+    def __init__(self, times: int = 1):
+        self.times = times
+
+    def apply(self, op, args, kwargs):
+        raise ExecutorOom("chaos: executor allocation OOM")
+
+
+class PoisonedRid(RuntimeError):
+    """Deterministic per-request fault: raised by :class:`ChaosExecutor`
+    for every executor call that touches the configured rid. Carries
+    ``rid`` so the scheduler can attribute a batched-step failure to
+    the actual victim instead of guessing."""
+
+    def __init__(self, rid: str):
+        super().__init__(f"chaos: poisoned request {rid}")
+        self.rid = rid
 
 
 class FaultPlan:
@@ -274,6 +323,67 @@ class ChaosVsp:
                 return self.plan.run(__name, __attr, *a, **kw)
             return chaotic
         return attr
+
+
+class ChaosExecutor:
+    """Serve-executor wrapper: scripted faults on the DECODE path.
+
+    Wraps :class:`workloads.serve.SimExecutor` / ``JaxSlotExecutor``
+    (anything with the executor surface) and injects faults keyed by
+    method name — ``begin`` / ``prefill_chunk`` / ``step`` /
+    ``spec_step`` — through the same :class:`FaultPlan` vocabulary the
+    wire wrappers use: :class:`Fail` (step raise), :class:`Stall`
+    (past a watchdog deadline, on an injected clock), :class:`Oom`
+    (allocation-time), plus seeded ``plan.flaky`` storms. A rid passed
+    to :meth:`poison` deterministically fails EVERY call whose request
+    set contains it (:class:`PoisonedRid`, carrying the rid) — the
+    one-bad-request case the scheduler's excision budget exists for.
+
+    Executor capability attributes (``prefix_aware``,
+    ``chunk_capacity``, ``spec_width``) pass through, so a wrapped
+    executor schedules exactly like the bare one between faults, and
+    everything is driven by the plan's seed — storms replay
+    bit-identically with zero wall-clock sleeps.
+    """
+
+    _METHODS = ("begin", "prefill_chunk", "step", "spec_step")
+
+    def __init__(self, inner, plan: Optional[FaultPlan] = None,
+                 seed: int = 0):
+        self.inner = inner
+        self.plan = plan or FaultPlan(seed)
+        self._poisoned: set[str] = set()
+
+    def poison(self, *rids: str) -> "ChaosExecutor":
+        self._poisoned.update(rids)
+        return self
+
+    def __getattr__(self, name):
+        # capability attributes and anything non-faulted pass through
+        return getattr(self.inner, name)
+
+    def _check_poison(self, rids) -> None:
+        for rid in rids:
+            if rid in self._poisoned:
+                raise PoisonedRid(rid)
+
+    def begin(self, req, slot):
+        self._check_poison((req.rid,))
+        return self.plan.run("begin", self.inner.begin, req, slot)
+
+    def prefill_chunk(self, req, slot, offset, n):
+        self._check_poison((req.rid,))
+        return self.plan.run("prefill_chunk", self.inner.prefill_chunk,
+                             req, slot, offset, n)
+
+    def step(self, active):
+        self._check_poison(r.rid for _, r in active)
+        return self.plan.run("step", self.inner.step, active)
+
+    def spec_step(self, active, drafts):
+        self._check_poison(r.rid for _, r in active)
+        return self.plan.run("spec_step", self.inner.spec_step,
+                             active, drafts)
 
 
 # -- hardware fault scripts (faults/engine.py chaos gate) ---------------------

@@ -505,7 +505,8 @@ FAULT_RECOVERY_SECONDS = REGISTRY.histogram(
 SERVE_REQUESTS = REGISTRY.counter(
     "tpu_serve_requests_total",
     "Serve requests by SLO class and outcome (completed / rejected = "
-    "admission queue full / failed)")
+    "shed at admission / cancelled / failed = lost after admission / "
+    "poisoned = failed past the retry budget / deadline_exceeded)")
 SERVE_TOKENS = REGISTRY.counter(
     "tpu_serve_tokens_total",
     "Tokens produced by the decode service, by phase (prefill = first "
@@ -621,9 +622,32 @@ SERVE_HEADROOM = REGISTRY.gauge(
     "tpu_serve_headroom",
     "Replica headroom digest by dimension (free_slots / "
     "advertisable_slots / free_kv_blocks / chunk_backlog_tokens / "
-    "prefix_index_keys / slo_alerts_firing / fault_gate_capacity) — "
-    "the deterministic record the prefix/load-aware router scores "
-    "replicas by; served at /debug/serve/headroom")
+    "prefix_index_keys / degraded_rung / slo_alerts_firing / "
+    "fault_gate_capacity) — the deterministic record the prefix/"
+    "load-aware router scores replicas by; served at "
+    "/debug/serve/headroom")
+SERVE_EXECUTOR_FAULTS = REGISTRY.counter(
+    "tpu_serve_executor_faults_total",
+    "Executor exceptions caught by the serving-path fault engine, by "
+    "phase (prefill / decode / verify) — each one cost the batch an "
+    "iteration and routed exactly one victim through retry or "
+    "fail-fast")
+SERVE_RETRIES = REGISTRY.counter(
+    "tpu_serve_retries_total",
+    "Retry-with-rebuild lifecycles scheduled after a transient "
+    "executor fault, by phase: the victim's KV blocks are freed, its "
+    "generated tokens kept, and it re-prefills on readmission after "
+    "RetryPolicy's backoff")
+SERVE_POISONED = REGISTRY.counter(
+    "tpu_serve_poisoned_requests_total",
+    "Requests classified poisoned — the same rid failed the executor "
+    "past its retry budget — and excised so one bad request can never "
+    "crash-loop the step")
+SERVE_DEGRADED_RUNG = REGISTRY.gauge(
+    "tpu_serve_degraded_rung",
+    "Current graceful-degradation ladder rung (0 healthy / 1 "
+    "shed_batch / 2 no_spec / 3 shrink_slots / 4 interactive_only); "
+    "rung changes also emit ServeDegraded / ServeRecovered Events")
 FLIGHT_DROPPED = REGISTRY.counter(
     "tpu_flight_dropped_total",
     "Flight-recorder events evicted by ring overflow, per kind — a "

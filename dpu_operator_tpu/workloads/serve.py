@@ -65,13 +65,16 @@ import dataclasses
 import heapq
 import itertools
 import logging
+import random
+import re
 import threading
 import time
 from typing import Any, Callable, Optional
 
 from ..utils import flight, metrics, tracing, validate, watchdog
+from ..utils.resilience import RetryPolicy
 from ..utils.stats import nearest_rank
-from . import kv_pool
+from . import degrade, kv_pool
 from .kv_pool import KvBlockPool
 from .spec import AdaptiveK, NgramDrafter, greedy_accept
 
@@ -88,12 +91,46 @@ MAX_PROMPT_LEN = 65536
 MAX_OUTPUT_LEN = 65536
 MAX_TOKEN_ID = 1 << 30        # any real vocab fits well inside this
 
+#: per-request deadline header: a relative millisecond budget from
+#: arrival ("finish within this or don't bother"), parsed with the
+#: traceparent parser's discipline — hostile input yields None (no
+#: deadline), never an exception and never a partial parse
+DEADLINE_HEADER = "x-tpu-deadline-ms"
+MAX_DEADLINE_MS = 86_400_000  # 24 h: anything longer is no deadline
+_DEADLINE_RE = re.compile(r"^[0-9]{1,8}$")
+#: extra stream wait past a request's deadline budget, so the
+#: scheduler's own deadline_exceeded terminal record reaches the wire
+#: before the ingress gives up on the queue
+STREAM_DEADLINE_GRACE_S = 0.5
+
+
+def parse_deadline_ms(value: object) -> Optional[int]:
+    """Strict parse of the ``x-tpu-deadline-ms`` header. Digits only
+    (no sign, no decimal point, no whitespace, no exponent — so NaN,
+    negatives and header-splitting control bytes all fall out of the
+    character class), bounded width, bounded magnitude. Anything else
+    returns None and the request simply carries no deadline — the
+    same fail-open-without-trust shape as
+    :func:`utils.tracing.extract_traceparent`."""
+    if not isinstance(value, str):
+        return None
+    if not _DEADLINE_RE.match(value):
+        return None
+    ms = int(value)
+    if ms < 1 or ms > MAX_DEADLINE_MS:
+        return None
+    return ms
+
 # request lifecycle
 QUEUED = "queued"
 PREFILLING = "prefilling"
 RUNNING = "running"
 DONE = "done"
 REJECTED = "rejected"
+#: terminal state for requests that were ADMITTED and then could not
+#: be served (executor failure, poisoned classification, deadline) —
+#: distinct from REJECTED so admission-shed accounting stays honest
+FAILED = "failed"
 
 
 @dataclasses.dataclass
@@ -149,18 +186,32 @@ class Request:
     queued_since_s: Optional[float] = None
     decode_since_s: Optional[float] = None
     decode_iters: int = 0
+    #: optional deadline: the ingress stamps a relative budget (parsed
+    #: from ``x-tpu-deadline-ms``); ingest resolves it to an absolute
+    #: scheduler-clock instant. Enforced at admission (reject what
+    #: cannot finish in time), at chunk-queue re-entry, and mid-stream.
+    deadline_budget_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    #: retry-with-rebuild bookkeeping: transient executor failures
+    #: survived so far, the virtual-clock instant before which the
+    #: request must NOT be re-admitted (RetryPolicy-owned backoff),
+    #: and when the last fault hit (serve-path MTTR measures from it)
+    retries: int = 0
+    retry_at: float = 0.0
+    last_fault_s: Optional[float] = None
 
     def fresh_copy(self) -> "Request":
-        """Spec-only copy (id, lengths, class, arrival, prompt):
-        re-running the same arrivals through a second scheduler must
-        not inherit the first run's tokens/state — dataclasses.replace
-        would share the mutable runtime fields. The stream callback is
-        deliberately NOT carried: comparison reruns must not re-fire a
-        live client's stream."""
+        """Spec-only copy (id, lengths, class, arrival, prompt,
+        deadline): re-running the same arrivals through a second
+        scheduler must not inherit the first run's tokens/state —
+        dataclasses.replace would share the mutable runtime fields.
+        The stream callback is deliberately NOT carried: comparison
+        reruns must not re-fire a live client's stream."""
         return Request(rid=self.rid, prompt_len=self.prompt_len,
                        output_len=self.output_len,
                        slo_class=self.slo_class,
-                       arrival_s=self.arrival_s, prompt=self.prompt)
+                       arrival_s=self.arrival_s, prompt=self.prompt,
+                       deadline_budget_s=self.deadline_budget_s)
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -251,6 +302,16 @@ class ServeConfig:
     #: observed acceptance rate (k=0 falls back to today's decode
     #: path). 0 disables speculation entirely.
     spec_k: int = 0
+    #: transient executor failures a request may survive via the
+    #: retry-with-rebuild path (blocks freed, tokens kept, re-prefill
+    #: on readmission) before it is classified POISONED and excised.
+    #: 0 turns every executor failure terminal (the legacy behavior).
+    retry_budget: int = 2
+    #: RetryPolicy backoff shape for re-admission after a transient
+    #: failure (virtual-clock gated: the request is held out of
+    #: admission until the backoff expires — no sleeps anywhere)
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_cap_s: float = 1.0
 
 
 def prefill_budget_tokens(cost_model: "CostModel", slots: int,
@@ -712,12 +773,38 @@ class Scheduler:
         self._free_slots: list[int] = list(range(config.slots))
         self.completed: list[Request] = []
         self.rejected: list[Request] = []
+        #: admitted-then-unservable requests (executor failure,
+        #: poisoned, deadline) — NOT in ``rejected``: conflating them
+        #: would make admission-shed accounting lie
+        self.failed: list[Request] = []
         self.completed_total = 0
         self.rejected_total = 0
+        self.failed_total = 0
+        self.poisoned_total = 0
+        self.deadline_exceeded_total = 0
+        self.retries_total = 0
         self.iterations = 0
         self.preemptions = 0
         self.prefill_chunks_total = 0
         self.prefill_tokens_discarded = 0
+        #: retry-with-rebuild: RetryPolicy OWNS the backoff curve (the
+        #: retry-discipline invariant); seeded rng so the jitter — and
+        #: therefore every re-admission order — replays bit-identically
+        self._retry_policy = RetryPolicy(
+            max_attempts=config.retry_budget + 1,
+            base=config.retry_backoff_base_s,
+            cap=config.retry_backoff_cap_s,
+            rng=random.Random(0x5E17E))
+        #: graceful-degradation ladder: fed one signal per iteration
+        #: (executor fault this step OR a firing serve-SLO burn alert
+        #: via ``slo_alert_fn``); transitions published below
+        self.ladder = degrade.DegradationLadder()
+        self.slo_alert_fn: Optional[Callable[[], bool]] = None
+        self._fault_this_step = False
+        #: (rid, seconds) fault-to-recovery samples: last transient
+        #: fault to the victim's completion — the serve-path MTTR
+        #: series FAULT_r02.json records
+        self.retry_recoveries: list[tuple[str, float]] = []
         #: when set, trace/completed/rejected are trimmed to the last N
         #: entries after each step — a long-lived DecodeService must not
         #: grow without bound; the test harness leaves it None and reads
@@ -790,6 +877,23 @@ class Scheduler:
                 # service loop waits instead of busy-spinning
                 self._update_gauges()
                 return False
+        elif (self._clock is None and not self._active
+                and not self._prefilling
+                and self._head() is None):
+            # every queued request is GATED — retry backoff or the
+            # ladder's interactive-only rung — with nothing running:
+            # modeled time must still move or the backoffs and
+            # hold-downs would never expire. Jump to the nearest
+            # wake-up (earliest retry_at / next arrival), or by one
+            # decode quantum when there is none.
+            targets = [r.retry_at for q in self._queues.values()
+                       for r in q if r.retry_at > self.now]
+            nxt = self._next_arrival()
+            if nxt is not None and nxt > self.now:
+                targets.append(nxt)
+            self.now = min(targets) if targets \
+                else self.now + self.cost.decode_base_s
+            self._ingest_locked()
         self.iterations += 1
         it = self.iterations
         # per-iteration cost ledger: real-clock runs measure each
@@ -830,9 +934,10 @@ class Scheduler:
                     req.prefill_target - req.prefill_start))
                 try:
                     tok = self.executor.begin(req, req.slot)
-                except Exception as e:  # noqa: BLE001 — fail the one
-                    # request the executor chokes on, not the service
-                    self._fail_request_locked(it, req, e)
+                except Exception as e:  # noqa: BLE001 — one request's
+                    # fault, never the service's: transient failures
+                    # retry-with-rebuild, contract breaches fail fast
+                    self._executor_fault_locked(it, req, e, "prefill")
                     continue
                 req.prefilled = req.prefill_target
                 self._phase_span_locked(
@@ -856,7 +961,13 @@ class Scheduler:
             seg = self._mark()
             self._ledger_phase = "decode"
             self._advance_locked(self.cost.decode_s(len(active)))
-            toks = self.executor.step(active)
+            try:
+                toks = self.executor.step(active)
+            except Exception as e:  # noqa: BLE001 — a batched-step
+                # blowup costs ONE victim a retry/rebuild round trip
+                # (or its budget), never the whole batch or the service
+                toks = None
+                self._step_fault_locked(it, "decode", active, e)
             self._tick_locked()
             if real:
                 phases["decode"] += self._mark() - seg
@@ -870,7 +981,7 @@ class Scheduler:
                           if active[0][1].trace_id else None))
             seg = self._mark()
             self._ledger_phase = "cow"
-            for slot, req in active:
+            for slot, req in (active if toks is not None else ()):
                 # write accounting only matters under sharing (CoW /
                 # unpublish); skipping it otherwise keeps one mutex
                 # round-trip per slot off the no-sharing hot path
@@ -899,17 +1010,25 @@ class Scheduler:
                 self._notify(req, "token", toks[slot])
             if real:
                 phases["cow"] += self._mark() - seg
-            self.trace.append(("decode", it, len(active)))
+            if toks is not None:
+                self.trace.append(("decode", it, len(active)))
         seg = self._mark()
         self._ledger_phase = "sched"
         for slot in sorted(self._active):
             req = self._active[slot]
             if len(req.tokens) >= req.output_len:
                 self._complete_locked(it, slot, req)
+            elif req.deadline_s is not None and self.now > req.deadline_s:
+                # mid-stream deadline: completion above wins the race
+                # by construction (a request with all tokens done is
+                # completed, never expired)
+                self._deadline_exceed_locked(it, req)
+        self._degrade_pass_locked(it)
         if self.history_limit is not None:
             del self.trace[:-self.history_limit]
             del self.completed[:-self.history_limit]
             del self.rejected[:-self.history_limit]
+            del self.failed[:-self.history_limit]
         self._update_gauges()
         if real:
             phases["sched"] += self._mark() - seg
@@ -959,6 +1078,10 @@ class Scheduler:
         cost model and the observed acceptance EWMA; k=0 (or no row
         producing a draft) returns None and the iteration takes the
         plain decode path — speculation can only ever be additive."""
+        if self.ladder.rung >= degrade.RUNG_NO_SPEC:
+            # degradation ladder: no verify amplification against a
+            # faulting executor — k clamps to 0 until recovery
+            return None
         k = self._spec.choose(self.cost, len(active))
         if k <= 0:
             return None
@@ -993,7 +1116,15 @@ class Scheduler:
         seg = self._mark()
         self._ledger_phase = "verify"
         self._advance_locked(self.cost.verify_s(len(active), k_iter))
-        emitted = self.executor.spec_step(active, drafts)
+        try:
+            emitted = self.executor.spec_step(active, drafts)
+        except Exception as e:  # noqa: BLE001 — same one-victim rule
+            # as the decode pass: retry/rebuild, never a batch loss
+            self._step_fault_locked(it, "verify", active, e)
+            self._tick_locked()
+            if real:
+                phases["verify"] += self._mark() - seg
+            return
         self._tick_locked()
         if real:
             phases["verify"] += self._mark() - seg
@@ -1121,6 +1252,19 @@ class Scheduler:
                              f"whole pool holds "
                              f"{self.pool.num_blocks * self.pool.block_size}")
                 continue
+            if req.deadline_budget_s is not None \
+                    and req.deadline_s is None:
+                # resolve the ingress's relative budget to an absolute
+                # scheduler-clock deadline at arrival
+                req.deadline_s = req.arrival_s + req.deadline_budget_s
+            if req.slo_class == BATCH \
+                    and self.ladder.rung >= degrade.RUNG_SHED_BATCH:
+                self._reject_locked(req, "degraded_shed",
+                             f"serving degraded to rung "
+                             f"{self.ladder.rung} "
+                             f"({self.ladder.rung_name}); batch-class "
+                             "admissions shed until recovery")
+                continue
             queue = self._queues[req.slo_class]
             if len(queue) >= self.config.queue_limit:
                 self._reject_locked(req, "queue_full",
@@ -1173,6 +1317,14 @@ class Scheduler:
             req = self._head()
             if req is None:
                 break
+            if req.deadline_s is not None \
+                    and self._eta_s(req) > req.deadline_s:
+                # admission-time enforcement: the modeled MINIMUM
+                # finish (uncontended prefill + per-token decode)
+                # already misses the deadline — admitting would burn
+                # slot/KV/decode budget on an answer nobody will read
+                self._deadline_exceed_locked(it, req)
+                continue
             blocks = self.pool.blocks_for_tokens(req.total_tokens())
             keys: list = []
             if self._share and req.prompt:
@@ -1215,7 +1367,7 @@ class Scheduler:
                                     "re-admission; divergence proceeds "
                                     "uncopied", req.rid)
                         break
-            self._queues[req.slo_class].pop(0)
+            self._queues[req.slo_class].remove(req)
             slot = self._free_slots.pop(0)
             req.slot = slot
             req.state = RUNNING
@@ -1261,6 +1413,12 @@ class Scheduler:
                   if r.slo_class == INTERACTIVE]
                  + [r for r in self._prefilling if r.slo_class == BATCH])
         for req in order:
+            if req.deadline_s is not None and self.now > req.deadline_s:
+                # chunk-queue re-entry enforcement: spending budget on
+                # a request that can no longer finish starves requests
+                # that still could
+                self._deadline_exceed_locked(it, req)
+                continue
             while budget > 0:
                 remaining = req.prefill_target - req.prefilled
                 if remaining <= 0:
@@ -1274,8 +1432,9 @@ class Scheduler:
                 except Exception as e:  # noqa: BLE001 — a request the
                     # executor cannot serve (no prompt ids, over
                     # max_seq) fails ALONE; left queued it would
-                    # re-raise every iteration and wedge the service
-                    self._fail_request_locked(it, req, e)
+                    # re-raise every iteration and wedge the service.
+                    # Transient faults go the retry-with-rebuild way.
+                    self._executor_fault_locked(it, req, e, "prefill")
                     break
                 self._phase_span_locked(req, "serve.prefill_chunk",
                                         chunk_start, self._mark(),
@@ -1441,17 +1600,19 @@ class Scheduler:
     def _fail_request_locked(self, it: int, req: Request,
                       exc: Exception) -> None:
         """Excise a request the executor cannot serve: free its slot
-        and blocks, record it as failed, tell its stream. One bad spec
-        must cost one stream, never the scheduler."""
+        and blocks, record it as FAILED — a distinct outcome from an
+        admission rejection, on the wire and in the metrics, because
+        this request WAS admitted and then lost — and tell its stream.
+        One bad spec must cost one stream, never the scheduler."""
         log.warning("executor failed for %s (failing the request): %s",
                     req.rid, exc)
         metrics.SWALLOWED_ERRORS.inc(site="serve.executor")
         self._close_open_phase_locked(req, "failed")
         self._release_locked(req)
-        req.state = REJECTED
+        req.state = FAILED
         req.reject_reason = "executor_error"
-        self.rejected.append(req)
-        self.rejected_total += 1
+        self.failed.append(req)
+        self.failed_total += 1
         self.trace.append(("fail", it, req.rid))
         metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
                                    outcome="failed")
@@ -1459,7 +1620,203 @@ class Scheduler:
                       attributes={
                           "rid": req.rid,
                           "error": f"{type(exc).__name__}: {exc}"})
-        self._notify(req, "rejected", "executor_error")
+        self._notify(req, "failed", "executor_error")
+
+    # -- serving-path fault engine --------------------------------------------
+    def _eta_s(self, req: Request) -> float:
+        """Modeled MINIMUM finish time for *req* admitted now: its
+        remaining prefill plus one uncontended decode iteration per
+        remaining token. Real service is slower (batching, chunk
+        budget), so a deadline this misses is certainly missed."""
+        prefill_tokens = max(
+            0, req.prompt_len + len(req.tokens) - req.prefilled)
+        remaining = max(0, req.output_len - len(req.tokens))
+        return (self.now + self.cost.prefill_s(prefill_tokens)
+                + remaining * self.cost.decode_s(1))
+
+    def _step_fault_locked(self, it: int, phase: str, active: list,
+                           exc: Exception) -> None:
+        """A batched executor pass blew up: attribute it to ONE victim
+        — the rid the exception names (the ChaosExecutor poison
+        contract, ``exc.rid``) when it is in the batch, else the
+        latest-admitted request (least progress, cheapest rebuild) —
+        and route the victim through retry-with-rebuild. The rest of
+        the batch loses one iteration, nothing else."""
+        self._fault_this_step = True
+        metrics.SERVE_EXECUTOR_FAULTS.inc(phase=phase)
+        rid = getattr(exc, "rid", None)
+        victim = next((r for _, r in active if r.rid == rid), None)
+        if victim is None:
+            victim = max((r for _, r in active),
+                         key=lambda r: ((r.admitted_s or 0.0), r.rid))
+        self.trace.append(("step_fault", it, phase, victim.rid,
+                           type(exc).__name__))
+        self._retry_request_locked(it, victim, exc, phase)
+
+    def _executor_fault_locked(self, it: int, req: Request,
+                               exc: Exception, phase: str) -> None:
+        """Classify a single-request executor failure: a contract
+        breach (ValueError/TypeError — bad spec, missing prompt ids)
+        can never succeed on retry and fails fast; anything else is
+        presumed transient and goes through retry-with-rebuild."""
+        self._fault_this_step = True
+        metrics.SERVE_EXECUTOR_FAULTS.inc(phase=phase)
+        if isinstance(exc, (ValueError, TypeError)):
+            self._fail_request_locked(it, req, exc)
+        else:
+            self._retry_request_locked(it, req, exc, phase)
+
+    def _retry_request_locked(self, it: int, req: Request,
+                              exc: Exception, phase: str) -> None:
+        """Retry-with-rebuild: the transiently-failed victim takes the
+        recomputable-eviction path a preemption uses — blocks freed,
+        generated tokens KEPT, re-prefill on readmission — and
+        requeues at the front of its class, gated by RetryPolicy's
+        backoff on the virtual clock (no sleeps anywhere). A request
+        that exhausts its budget is classified POISONED and excised:
+        one bad request can never crash-loop the step."""
+        req.retries += 1
+        req.last_fault_s = self.now
+        if req.retries > self.config.retry_budget:
+            self._poison_request_locked(it, req, exc)
+            return
+        log.warning("executor %s fault for %s (retry %d/%d, "
+                    "rebuilding): %s", phase, req.rid, req.retries,
+                    self.config.retry_budget, exc)
+        self.pool.free(req.rid)
+        if req.slot is not None:
+            self._active.pop(req.slot, None)
+            self._free_slots.append(req.slot)
+            self._free_slots.sort()
+            req.slot = None
+        discarded = 0
+        if req in self._prefilling:
+            self._prefilling.remove(req)
+            discarded = max(0, req.prefilled - req.prefill_start)
+            if discarded:
+                self.prefill_tokens_discarded += discarded
+                metrics.SERVE_PREFILL_CHUNK_TOKENS.inc(
+                    discarded, outcome="discarded")
+        elif req.decode_since_s is not None:
+            self._phase_span_locked(
+                req, "serve.decode", req.decode_since_s, self.now,
+                iterations=req.decode_iters, tokens=len(req.tokens),
+                outcome="retried")
+        req.decode_since_s = None
+        req.decode_iters = 0
+        req.queued_since_s = self.now
+        req.prefilled = 0
+        req.state = QUEUED
+        # RetryPolicy owns the backoff curve (seeded jitter): the
+        # request is HELD OUT of admission until retry_at, instead of
+        # anything anywhere sleeping
+        req.retry_at = self.now \
+            + self._retry_policy.backoff(req.retries - 1)
+        self._queues[req.slo_class].insert(0, req)
+        self.retries_total += 1
+        self.trace.append(("retry", it, req.rid, req.retries))
+        metrics.SERVE_RETRIES.inc(phase=phase)
+        flight.record("serve", "RetryScheduled",
+                      trace_id=req.trace_id, attributes={
+                          "rid": req.rid, "attempt": str(req.retries),
+                          "phase": phase,
+                          "tokens_kept": str(len(req.tokens)),
+                          "error": f"{type(exc).__name__}: {exc}"})
+
+    def _poison_request_locked(self, it: int, req: Request,
+                               exc: Exception) -> None:
+        """Excise a request that failed past its retry budget — the
+        same rid failing every time it meets the executor is a
+        poisoned REQUEST, not a sick executor — with a distinct
+        ``poisoned`` outcome, fast: slot and blocks freed now, stream
+        told now."""
+        log.warning("request %s poisoned after %d retries (excising): "
+                    "%s", req.rid, req.retries - 1, exc)
+        self._close_open_phase_locked(req, "poisoned")
+        self._release_locked(req)
+        req.state = FAILED
+        req.reject_reason = "poisoned"
+        self.failed.append(req)
+        self.failed_total += 1
+        self.poisoned_total += 1
+        self.trace.append(("poison", it, req.rid, req.retries - 1))
+        metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
+                                   outcome="poisoned")
+        metrics.SERVE_POISONED.inc()
+        flight.record("serve", "Poisoned", trace_id=req.trace_id,
+                      attributes={
+                          "rid": req.rid,
+                          "retries": str(req.retries - 1),
+                          "error": f"{type(exc).__name__}: {exc}"})
+        watchdog.emit_health_event(
+            "ServeRequestPoisoned",
+            f"request {req.rid} failed the executor on every attempt "
+            f"({req.retries - 1} rebuilds); excised so it cannot "
+            "crash-loop the step", "Warning", series="serve-poison")
+        self._notify(req, "failed", "poisoned")
+
+    def _deadline_exceed_locked(self, it: int, req: Request) -> None:
+        """A deadline-bearing request that can no longer finish in
+        time: cancel it wherever it is (queued, prefilling, active),
+        free everything, and close the stream with a distinct
+        ``deadline_exceeded`` terminal record."""
+        q = self._queues[req.slo_class]
+        if req in q:
+            q.remove(req)
+        self._close_open_phase_locked(req, "deadline_exceeded")
+        self._release_locked(req)
+        req.state = FAILED
+        req.reject_reason = "deadline_exceeded"
+        self.failed.append(req)
+        self.failed_total += 1
+        self.deadline_exceeded_total += 1
+        self.trace.append(("deadline", it, req.rid, len(req.tokens)))
+        metrics.SERVE_REQUESTS.inc(slo_class=req.slo_class,
+                                   outcome="deadline_exceeded")
+        flight.record("serve", "DeadlineExceeded",
+                      trace_id=req.trace_id, attributes={
+                          "rid": req.rid,
+                          "tokens_done": str(len(req.tokens))})
+        self._notify(req, "deadline_exceeded", len(req.tokens))
+
+    def _degrade_pass_locked(self, it: int) -> None:
+        """Feed the graceful-degradation ladder this iteration's
+        signal (an executor fault happened, or a serve-SLO burn alert
+        is firing) and publish any committed rung change: gauge,
+        Event, flight entry, trace tuple. The ladder itself is pure —
+        all emission happens here, under the state lock."""
+        bad = self._fault_this_step
+        self._fault_this_step = False
+        if not bad and self.slo_alert_fn is not None:
+            try:
+                bad = bool(self.slo_alert_fn())
+            except Exception:  # noqa: BLE001 — a broken alert probe
+                # must degrade observability, not the step loop
+                log.warning("serve slo_alert_fn failed", exc_info=True)
+                metrics.SWALLOWED_ERRORS.inc(site="serve.slo_alert")
+        change = self.ladder.observe(self.now, bad)
+        metrics.SERVE_DEGRADED_RUNG.set(float(self.ladder.rung))
+        if change is None:
+            return
+        self.trace.append(("rung", it, change.old, change.new))
+        names = degrade.RUNGS
+        if change.new > change.old:
+            flight.record("serve", "Degraded", attributes={
+                "from": names[change.old], "to": names[change.new]})
+            watchdog.emit_health_event(
+                "ServeDegraded",
+                f"serving degraded {names[change.old]} -> "
+                f"{names[change.new]} (rung {change.new}) under "
+                "sustained executor faults or serve-SLO burn",
+                "Warning", series="serve-degrade")
+        else:
+            flight.record("serve", "Recovered", attributes={
+                "from": names[change.old], "to": names[change.new]})
+            watchdog.emit_health_event(
+                "ServeRecovered",
+                f"serving recovered {names[change.old]} -> "
+                f"{names[change.new]} (rung {change.new})",
+                "Normal", series="serve-degrade")
 
     def _notify(self, req: Request, event: str, value: object) -> None:
         """Fire the request's stream callback (the HTTP ingress seam);
@@ -1474,9 +1831,19 @@ class Scheduler:
             req.stream = None
 
     def _head(self) -> Optional[Request]:
+        """First ADMITTABLE request in class order — interactive
+        before batch, FIFO within a class — skipping requests held
+        back by a retry backoff (``retry_at`` in the future) and the
+        whole batch queue on the ladder's interactive-only rung.
+        Gated is not gone: skipped requests stay queued for a later
+        pass."""
         for cls in (INTERACTIVE, BATCH):
-            if self._queues[cls]:
-                return self._queues[cls][0]
+            if cls == BATCH and self.ladder.rung \
+                    >= degrade.RUNG_INTERACTIVE_ONLY:
+                continue
+            for r in self._queues[cls]:
+                if r.retry_at <= self.now:
+                    return r
         return None
 
     def _can_preempt_for_head(self) -> bool:
@@ -1563,6 +1930,11 @@ class Scheduler:
         self._release_locked(req)
         req.state = DONE
         req.finish_s = self.now
+        if req.retries and req.last_fault_s is not None:
+            # serve-path MTTR sample: first fault to full completion
+            # through however many rebuilds it took (FAULT_r02.json)
+            self.retry_recoveries.append(
+                (req.rid, self.now - req.last_fault_s))
         self.completed.append(req)
         self.completed_total += 1
         self.trace.append(("complete", it, req.rid, len(req.tokens)))
@@ -1626,6 +1998,8 @@ class Scheduler:
         metrics.SERVE_HEADROOM.set(
             float(self.pool.prefix_index_keys() if self._share else 0),
             dimension="prefix_index_keys")
+        metrics.SERVE_HEADROOM.set(float(self.ladder.rung),
+                                   dimension="degraded_rung")
 
     # -- operator seams -------------------------------------------------------
     def _advertisable(self, free_slots: int, free_blocks: int) -> int:
@@ -1633,7 +2007,15 @@ class Scheduler:
         enough free KV blocks for a typical request (an unfeedable
         slot would admit-then-starve)."""
         typical = self.pool.blocks_for_tokens(self.config.typical_tokens)
-        return min(free_slots, free_blocks // max(typical, 1))
+        slots = min(free_slots, free_blocks // max(typical, 1))
+        # degradation ladder: stop selling capacity the replica may not
+        # be able to serve — a faulting executor keeps what it already
+        # holds but shrinks its ask on the device plugin
+        if self.ladder.rung >= degrade.RUNG_INTERACTIVE_ONLY:
+            return 0
+        if self.ladder.rung >= degrade.RUNG_SHRINK_SLOTS:
+            return min(slots, max(1, self.config.slots // 4))
+        return slots
 
     def capacity(self) -> dict:
         """What the device plugin advertises: slots that could take a
@@ -1676,6 +2058,7 @@ class Scheduler:
             "chunkBacklogTokens": backlog,
             "queueDepth": queued,
             "prefixIndexKeys": self.pool.prefix_index_keys(),
+            "degradedRung": self.ladder.rung,
         }
 
     def snapshot(self) -> dict:
@@ -1702,7 +2085,12 @@ class Scheduler:
             "capacity": self.capacity(),
             "completed": self.completed_total,
             "rejected": self.rejected_total,
+            "failed": self.failed_total,
+            "poisoned": self.poisoned_total,
+            "deadlineExceeded": self.deadline_exceeded_total,
+            "retries": self.retries_total,
             "preemptions": self.preemptions,
+            "degraded": self.ladder.snapshot(self.now),
             "prefill": {
                 "chunkTokensPerIteration":
                     self.config.prefill_chunk_tokens,
@@ -1763,6 +2151,17 @@ class DecodeService:
         self._http = None
         self._http_thread: Optional[threading.Thread] = None
         self._rid_seq = itertools.count()
+        if scheduler.slo_alert_fn is None:
+            # the degradation ladder's second signal: a firing
+            # serve-SLO burn alert degrades just like executor faults
+            scheduler.slo_alert_fn = self._serve_alert_firing
+
+    def _serve_alert_firing(self) -> bool:
+        from ..utils import slo as _slo
+        ev = self.evaluator if self.evaluator is not None \
+            else _slo.EVALUATOR
+        return any(name.startswith("serve-")
+                   for name, _ in ev.active_alerts())
 
     def debug_handlers(self) -> dict:
         return {"/debug/serve": self.scheduler.snapshot,
@@ -1879,6 +2278,13 @@ class DecodeService:
                         400, "prompt_len disagrees with the prompt "
                              "ids' length")
                     return
+                # optional caller deadline, traceparent-parser
+                # discipline: a hostile or malformed header yields
+                # None (no deadline) — fail open WITHOUT trusting
+                deadline_ms = parse_deadline_ms(
+                    self.headers.get(DEADLINE_HEADER))
+                if deadline_ms is not None:
+                    req.deadline_budget_s = deadline_ms / 1000.0
                 ctx = tracing.extract_traceparent(
                     self.headers.get("traceparent"))
                 events: _queue.Queue = _queue.Queue()
@@ -1903,11 +2309,21 @@ class DecodeService:
                     self.end_headers()
                     first = True
                     finished = False
+                    # a deadline-bearing request's stream gives up
+                    # when the deadline can no longer be met (plus a
+                    # grace window for the scheduler's own terminal
+                    # record to arrive) instead of holding the
+                    # connection for the full configured cap
+                    timeout_s = outer.stream_timeout_s
+                    if req.deadline_budget_s is not None:
+                        timeout_s = min(
+                            timeout_s, req.deadline_budget_s
+                            + STREAM_DEADLINE_GRACE_S)
                     try:
                         while True:
                             try:
                                 ev, val = events.get(
-                                    timeout=outer.stream_timeout_s)
+                                    timeout=timeout_s)
                             except _queue.Empty:
                                 self._write_chunk(
                                     {"error": "stream timeout"})
@@ -1923,6 +2339,19 @@ class DecodeService:
                             elif ev == "done":
                                 self._write_chunk({"done": True,
                                                    "tokens": val})
+                                finished = True
+                                break
+                            elif ev == "failed":
+                                # admitted-then-lost is NOT a
+                                # rejection: the wire record says so
+                                self._write_chunk(
+                                    {"error": f"failed: {val}"})
+                                finished = True
+                                break
+                            elif ev == "deadline_exceeded":
+                                self._write_chunk(
+                                    {"error": "deadline exceeded",
+                                     "tokens": val})
                                 finished = True
                                 break
                             else:

@@ -184,6 +184,29 @@ def test_retry_discipline_allows_deadline_bounded_loop():
     assert check(RetryDisciplineChecker(), src) == []
 
 
+def test_retry_discipline_flags_ad_hoc_exponential_backoff():
+    """The serving-path retry loop's discipline: RetryPolicy owns the
+    backoff curve — a hand-computed `sleep(base * 2 ** attempt)`
+    re-derives it without the jitter, cap, or deadline."""
+    violations = check(RetryDisciplineChecker(), """
+        import time
+        def redial(attempt):
+            time.sleep(0.05 * 2 ** attempt)
+    """)
+    assert [v.rule for v in violations] == ["retry-discipline"]
+    assert "RetryPolicy.backoff" in violations[0].message
+
+
+def test_retry_discipline_allows_policy_owned_backoff_sleep():
+    # sleeping a RetryPolicy-computed value (no power expression at
+    # the call site) is exactly the sanctioned shape
+    assert check(RetryDisciplineChecker(), """
+        import time
+        def redial(policy, attempt):
+            time.sleep(policy.backoff(attempt))
+    """) == []
+
+
 def test_retry_discipline_allows_resilience_module_and_plain_loops():
     src = "import time\nwhile True:\n    time.sleep(1)\n"
     assert check(RetryDisciplineChecker(), src,
@@ -331,6 +354,33 @@ def test_chaos_determinism_fault_module_mark_seeded_rng_ok():
     """
     assert check(ChaosDeterminismChecker(), src,
                  relpath="tests/test_fault_y.py") == []
+
+
+def test_chaos_determinism_covers_serve_chaos_marked_tests():
+    """The serving-path fault storms (`make serve-chaos-check`) promise
+    bit-identical traces across runs — the mark joins the invariant
+    (and needs its own tuple entry: endswith-matching means
+    `serve_chaos` does NOT match `serve`)."""
+    violations = check(ChaosDeterminismChecker(), """
+        import pytest, random
+        @pytest.mark.serve_chaos
+        def test_storm():
+            jitter = random.random()
+    """, relpath="tests/test_serve_chaos_x.py")
+    assert [v.rule for v in violations] == ["chaos-determinism"]
+
+
+def test_chaos_determinism_serve_chaos_module_mark_seeded_rng_ok():
+    src = """
+        import pytest, random
+        pytestmark = pytest.mark.serve_chaos
+        SEED = 0x5E17E
+        def test_storm():
+            rng = random.Random(SEED)
+            assert rng.random() < 1.0
+    """
+    assert check(ChaosDeterminismChecker(), src,
+                 relpath="tests/test_serve_chaos_y.py") == []
 
 
 # -- lock-discipline ----------------------------------------------------------

@@ -1265,7 +1265,7 @@ def test_poison_request_is_excised_not_retried():
         steps = sched.run(max_steps=10_000)
         assert steps < 10_000, "poison request wedged the scheduler"
         assert [r.rid for r in sched.completed] == ["good"]
-        (poison,) = sched.rejected
+        (poison,) = sched.failed
         assert poison.reject_reason == "executor_error"
         assert any(ev[0] == "fail" for ev in sched.trace)
         assert sched.pool.outstanding() == 0
@@ -1373,7 +1373,7 @@ def test_contract_breaching_final_chunk_fails_request_not_leaks():
     steps = sched.run(max_steps=10_000)
     assert steps < 10_000
     assert [r.rid for r in sched.completed] == ["good"]
-    (liar,) = sched.rejected
+    (liar,) = sched.failed
     assert liar.reject_reason == "executor_error"
     assert sched.pool.outstanding() == 0
     assert not sched._active

@@ -1,0 +1,724 @@
+"""Serving-path fault engine gate (`make serve-chaos-check`).
+
+The contracts under test, end to end: a scripted ChaosExecutor fault
+storm (Fail / Oom / poisoned-rid, all seeded, virtual-clock) must cost
+exactly its victims — transient step failures take the
+retry-with-rebuild path (blocks freed, tokens kept, re-prefill on
+readmission) and the recovered stream is bit-identical to an unfaulted
+run; a request that exhausts its retry budget is classified POISONED
+and excised with a distinct outcome; ingress deadlines are enforced at
+admission, at chunk-queue re-entry, and mid-stream (completion wins
+the race by construction); and under a sustained storm the
+graceful-degradation ladder sheds batch traffic while the interactive
+serve-ttft SLO holds, then recovers through hysteresis. Zero KV-block
+leaks across 500+ fault/retry/rebuild lifecycles, traces bit-identical
+across two runs of the same seed, and the serve-path MTTR series lands
+in FAULT_r02.json.
+
+Injected clocks and seeded RNGs only — opslint's chaos-determinism
+rule covers the serve_chaos marker, so a wall-clock or unseeded-
+entropy call here fails lint before it can flake.
+"""
+
+import json
+import math
+import os
+import random
+import time
+
+import pytest
+
+from dpu_operator_tpu.testing import chaos
+from dpu_operator_tpu.utils import metrics, slo
+from dpu_operator_tpu.workloads import degrade, serve
+
+pytestmark = pytest.mark.serve_chaos
+
+SEED = 20260806
+
+
+def _config(**kw) -> serve.ServeConfig:
+    base = dict(slots=4, kv_blocks=64, kv_block_size=16,
+                queue_limit=256, ttft_bound_s=1.0)
+    base.update(kw)
+    return serve.ServeConfig(**base)
+
+
+def _expected_tokens(req: serve.Request) -> list:
+    """The SimExecutor stream is a pure function of (rid, position) —
+    the oracle every rebuilt request must still match exactly."""
+    return [serve.SimExecutor._token(req, i)
+            for i in range(req.output_len)]
+
+
+def _p99(xs: list) -> float:
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, max(0, math.ceil(0.99 * len(xs)) - 1))]
+
+
+# -- retry-with-rebuild -------------------------------------------------------
+
+
+def test_transient_step_fault_retries_and_stream_survives_bitwise():
+    """One scripted decode-step failure (connection reset — transient)
+    must cost its victim ONE retry/rebuild round trip: blocks freed,
+    generated tokens kept, re-prefill on readmission — and every
+    completed stream, including the victim's, is identical to an
+    unfaulted run of the same arrivals."""
+    faults_before = metrics.SERVE_EXECUTOR_FAULTS.value(phase="decode")
+    retries_before = metrics.SERVE_RETRIES.value(phase="decode")
+    plan = chaos.FaultPlan(seed=SEED)
+    plan.script("step", chaos.Ok(times=3), chaos.Fail())
+    ex = chaos.ChaosExecutor(serve.SimExecutor(), plan=plan)
+    sched = serve.Scheduler(_config(), executor=ex)
+    reqs = [serve.Request(rid="a", prompt_len=8, output_len=12,
+                          slo_class=serve.INTERACTIVE, arrival_s=0.0),
+            serve.Request(rid="b", prompt_len=8, output_len=12,
+                          slo_class=serve.BATCH, arrival_s=0.0)]
+    sched.submit_all(reqs)
+    assert sched.run(max_steps=10_000) < 10_000
+    assert sched.completed_total == 2 and not sched.failed
+    assert sched.retries_total == 1
+    faults = [t for t in sched.trace if t[0] == "step_fault"]
+    assert faults == [("step_fault", faults[0][1], "decode",
+                       faults[0][3], "ConnectionResetError")]
+    victim_rid = faults[0][3]
+    retries = [t for t in sched.trace if t[0] == "retry"]
+    assert retries == [("retry", faults[0][1], victim_rid, 1)]
+    # the rebuilt stream equals the pure-function oracle — retry kept
+    # the tokens and re-prefill continued the exact same stream
+    for req in sched.completed:
+        assert req.tokens == _expected_tokens(req)
+    victim = next(r for r in sched.completed if r.rid == victim_rid)
+    assert victim.retries == 1
+    # serve-path MTTR: fault-to-recovery was sampled for the victim
+    assert [rid for rid, _ in sched.retry_recoveries] == [victim_rid]
+    assert sched.retry_recoveries[0][1] > 0.0
+    assert sched.pool.outstanding() == 0
+    assert metrics.SERVE_EXECUTOR_FAULTS.value(phase="decode") \
+        == faults_before + 1
+    assert metrics.SERVE_RETRIES.value(phase="decode") \
+        == retries_before + 1
+
+
+def test_allocation_oom_is_transient_and_takes_the_retry_path():
+    """An allocation-time ExecutorOom frees the victim's blocks via
+    the SAME rebuild path — which is exactly what an OOM needs — and
+    the request still completes."""
+    plan = chaos.FaultPlan(seed=SEED)
+    plan.script("step", chaos.Ok(times=2), chaos.Oom())
+    ex = chaos.ChaosExecutor(serve.SimExecutor(), plan=plan)
+    sched = serve.Scheduler(_config(), executor=ex)
+    sched.submit(serve.Request(rid="oomed", prompt_len=8, output_len=10,
+                               arrival_s=0.0))
+    assert sched.run(max_steps=10_000) < 10_000
+    assert sched.completed_total == 1 and not sched.failed
+    assert sched.retries_total == 1
+    (fault,) = [t for t in sched.trace if t[0] == "step_fault"]
+    assert fault[4] == "ExecutorOom"
+    assert sched.completed[0].tokens \
+        == _expected_tokens(sched.completed[0])
+    assert sched.pool.outstanding() == 0
+
+
+class Clock:
+    """Injected wall clock (the test_faults idiom): Stall faults call
+    ``advance`` so a 2 s executor hang costs zero wall seconds and
+    replays bit-identically."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def test_stall_past_the_deadline_on_an_injected_clock_is_excised():
+    """A Stall moves the INJECTED clock past the request's deadline
+    while the step 'hangs' — the sweep excises the victim with its
+    partial tokens the moment the stalled iteration lands, with zero
+    wall-clock sleeps anywhere."""
+    clock = Clock()
+    plan = chaos.FaultPlan(seed=SEED)
+    plan.script("step", chaos.Ok(times=2),
+                chaos.Stall(2.0, clock.advance))
+    ex = chaos.ChaosExecutor(serve.SimExecutor(), plan=plan)
+    sched = serve.Scheduler(_config(), executor=ex, clock=clock)
+    sched.submit(serve.Request(rid="hung", prompt_len=8, output_len=40,
+                               arrival_s=0.0, deadline_budget_s=1.5))
+    assert sched.run(max_steps=10_000) < 10_000
+    (hung,) = sched.failed
+    assert hung.rid == "hung"
+    assert hung.reject_reason == "deadline_exceeded"
+    assert 0 < len(hung.tokens) < hung.output_len
+    assert sched.deadline_exceeded_total == 1
+    assert sched.pool.outstanding() == 0
+    assert clock.t == pytest.approx(2.0)  # the stall moved ALL time
+
+
+def test_poisoned_rid_is_excised_within_budget(kube):
+    """A rid that deterministically fails EVERY executor call it
+    appears in must burn exactly its retry budget and then be excised
+    with the distinct ``poisoned`` outcome — one bad request costs one
+    stream plus budget, never the scheduler — while an innocent
+    request sharing the batch completes untouched."""
+    from dpu_operator_tpu.k8s import events
+
+    poisoned_before = metrics.SERVE_POISONED.value()
+    outcome_before = metrics.SERVE_REQUESTS.value(
+        slo_class=serve.INTERACTIVE, outcome="poisoned")
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("tpu-vm-0"))
+    try:
+        ex = chaos.ChaosExecutor(serve.SimExecutor()).poison("bad")
+        cfg = _config()
+        sched = serve.Scheduler(cfg, executor=ex)
+        seen: list = []
+        sched.submit(serve.Request(rid="good", prompt_len=8,
+                                   output_len=8, arrival_s=0.0))
+        sched.submit(serve.Request(
+            rid="bad", prompt_len=8, output_len=8,
+            slo_class=serve.INTERACTIVE, arrival_s=0.0,
+            stream=lambda ev, val: seen.append((ev, val))))
+        assert sched.run(max_steps=10_000) < 10_000
+        events.flush()
+    finally:
+        events.reset()
+    (good,) = sched.completed
+    assert good.rid == "good" and good.tokens == _expected_tokens(good)
+    (bad,) = sched.failed
+    assert bad.rid == "bad" and bad.state == serve.FAILED
+    assert bad.reject_reason == "poisoned"
+    assert bad not in sched.rejected  # failed is NOT a rejection
+    # excised within budget: exactly retry_budget rebuilds, then poison
+    assert [t for t in sched.trace if t[0] == "retry"] \
+        == [("retry", t[1], "bad", i + 1)
+            for i, t in enumerate(
+                t for t in sched.trace if t[0] == "retry")]
+    assert len([t for t in sched.trace if t[0] == "retry"]) \
+        == cfg.retry_budget
+    (poison,) = [t for t in sched.trace if t[0] == "poison"]
+    assert poison[2] == "bad" and poison[3] == cfg.retry_budget
+    assert sched.poisoned_total == 1 and sched.failed_total == 1
+    assert sched.pool.outstanding() == 0
+    # the stream saw the distinct terminal record, exactly once
+    assert seen[-1] == ("failed", "poisoned")
+    assert [e for e in seen if e[0] != "token"] \
+        == [("failed", "poisoned")]
+    assert metrics.SERVE_POISONED.value() == poisoned_before + 1
+    assert metrics.SERVE_REQUESTS.value(
+        slo_class=serve.INTERACTIVE, outcome="poisoned") \
+        == outcome_before + 1
+    reasons = {e["reason"] for e in kube.list("v1", "Event")}
+    assert "ServeRequestPoisoned" in reasons
+
+
+def test_batched_step_fault_attributes_the_actual_victim():
+    """A PoisonedRid raised out of a BATCHED step carries the rid —
+    the scheduler must bill the actual victim, not the latest-admitted
+    guess, and the innocent batchmate completes its full stream."""
+    ex = chaos.ChaosExecutor(serve.SimExecutor())
+    sched = serve.Scheduler(_config(), executor=ex)
+    sched.submit(serve.Request(rid="v", prompt_len=8, output_len=20,
+                               slo_class=serve.INTERACTIVE,
+                               arrival_s=0.0))
+    sched.submit(serve.Request(rid="w", prompt_len=8, output_len=20,
+                               arrival_s=0.0))
+    # let both admit and decode a little, THEN poison the earlier-
+    # admitted one: latest-admitted attribution alone would pick "w"
+    for _ in range(4):
+        sched.step()
+    ex.poison("v")
+    assert sched.run(max_steps=10_000) < 10_000
+    faults = [t for t in sched.trace if t[0] == "step_fault"]
+    assert faults and all(t[3] == "v" and t[4] == "PoisonedRid"
+                          for t in faults)
+    (bad,) = sched.failed
+    assert bad.rid == "v" and bad.reject_reason == "poisoned"
+    (w,) = sched.completed
+    assert w.rid == "w" and w.tokens == _expected_tokens(w)
+    assert sched.pool.outstanding() == 0
+
+
+# -- the seeded storm: ladder, SLO, determinism -------------------------------
+
+
+def _storm_run() -> serve.Scheduler:
+    """One seeded fault storm: two scripted 2-iteration Fail bursts
+    against a mixed open-loop arrival stream — enough consecutive bad
+    signals to walk the ladder down twice (the second burst doubles
+    the hold-down: same flap window), then a clean tail long enough to
+    recover through hysteresis."""
+    plan = chaos.FaultPlan(seed=SEED)
+    plan.script("step",
+                chaos.Ok(times=40), chaos.Fail(times=2),
+                chaos.Ok(times=30), chaos.Fail(times=2))
+    ex = chaos.ChaosExecutor(serve.SimExecutor(), plan=plan)
+    sched = serve.Scheduler(_config(slots=4, kv_blocks=96,
+                                    queue_limit=512), executor=ex)
+    sched.submit_all(serve.open_loop_arrivals(
+        SEED, rate_rps=6.0, horizon_s=8.0, prompt_lens=(8, 32),
+        output_lens=(8, 32), interactive_frac=0.5))
+    assert sched.run(max_steps=100_000) < 100_000
+    return sched
+
+
+def test_storm_sheds_batch_holds_interactive_slo_and_recovers(kube):
+    """The gate's core claim: under a sustained executor-fault storm
+    the ladder escalates (shedding batch admissions), the interactive
+    serve-ttft SLO HOLDS through the degraded window, and once the
+    faults stop the ladder recovers to healthy through hold-down +
+    consecutive-good hysteresis — all of it published (Events, trace
+    tuples, gauge) and leak-free."""
+    from dpu_operator_tpu.k8s import events
+
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("tpu-vm-0"))
+    try:
+        sched = _storm_run()
+        events.flush()
+    finally:
+        events.reset()
+    # the storm actually fired and the ladder walked both directions
+    assert len(sched.executor.plan.injected) == 4
+    assert sched.ladder.escalations >= 2
+    assert sched.ladder.holddown_doublings >= 1
+    assert sched.ladder.rung == degrade.RUNG_HEALTHY  # recovered
+    rungs = [t for t in sched.trace if t[0] == "rung"]
+    assert any(t[3] > t[2] for t in rungs)  # escalation committed
+    assert any(t[3] < t[2] for t in rungs)  # recovery committed
+    assert rungs[-1][3] == degrade.RUNG_HEALTHY
+    # batch was shed at admission while degraded — with the distinct
+    # reason, not folded into queue_full
+    shed = [r for r in sched.rejected
+            if r.reject_reason == "degraded_shed"]
+    assert shed and all(r.slo_class == serve.BATCH for r in shed)
+    # the interactive serve-ttft SLO held through the storm
+    ttfts = [r.ttft_s for r in sched.completed
+             if r.slo_class == serve.INTERACTIVE]
+    assert ttfts and _p99(ttfts) <= slo.SERVE_TTFT_SLOW_SECONDS
+    # every completed stream — victims included — matches the oracle
+    for req in sched.completed:
+        assert req.tokens == _expected_tokens(req)
+    assert sched.retries_total >= 1
+    assert sched.pool.outstanding() == 0
+    reasons = {e["reason"] for e in kube.list("v1", "Event")}
+    assert {"ServeDegraded", "ServeRecovered"} <= reasons
+    assert sched.snapshot()["degraded"]["rung"] == 0
+
+
+def test_storm_traces_are_bit_identical_across_runs():
+    """Two runs of the same storm seed must produce byte-identical
+    traces and identical terminal accounting — the determinism
+    artifact serve-chaos-check exists to defend. Chaos (FaultPlan
+    order + seeded flaky RNG), retry jitter (seeded RetryPolicy), the
+    ladder (pure state machine on the virtual clock) and the executor
+    (pure token function) all replay exactly."""
+    a, b = _storm_run(), _storm_run()
+    assert a.trace == b.trace
+    assert json.dumps(a.trace) == json.dumps(b.trace)
+    assert [r.rid for r in a.completed] == [r.rid for r in b.completed]
+    assert [(r.rid, r.reject_reason) for r in a.failed] \
+        == [(r.rid, r.reject_reason) for r in b.failed]
+    assert [(r.rid, r.reject_reason) for r in a.rejected] \
+        == [(r.rid, r.reject_reason) for r in b.rejected]
+    assert a.retry_recoveries == b.retry_recoveries
+    assert a.ladder.snapshot(a.now) == b.ladder.snapshot(b.now)
+
+
+# -- 500 fault/retry/rebuild lifecycles: the leak gate + FAULT_r02 ------------
+
+
+def test_kv_never_leaks_across_500_fault_lifecycles_and_mttr_lands():
+    """520 seeded request lifecycles through a flaky executor (seeded
+    3% step-fault storm plus two poisoned rids): every request ends
+    terminally (completed, poisoned, or shed), every rebuilt stream
+    matches the oracle, and the pool returns to EXACTLY zero
+    outstanding blocks. The serve-path MTTR series — last transient
+    fault to the victim's completion — lands in FAULT_r02.json."""
+    plan = chaos.FaultPlan(seed=SEED)
+    plan.flaky("step", 0.03, n=8000)
+    plan.flaky("begin", 0.01, n=1000)
+    ex = chaos.ChaosExecutor(serve.SimExecutor(), plan=plan)
+    ex.poison("life100", "life300")
+    cfg = _config(slots=6, kv_blocks=96, queue_limit=1000)
+    sched = serve.Scheduler(cfg, executor=ex)
+    rng = random.Random(SEED)
+    t = 0.0
+    for i in range(520):
+        t += rng.expovariate(8.0)
+        sched.submit(serve.Request(
+            rid=f"life{i}", prompt_len=rng.randint(4, 64),
+            output_len=rng.randint(1, 48),
+            slo_class=serve.INTERACTIVE if rng.random() < 0.4
+            else serve.BATCH,
+            arrival_s=t))
+    assert sched.run(max_steps=500_000) < 500_000
+    # every lifecycle ended terminally, none vanished: completed,
+    # excised (poisoned), or shed by the degraded ladder — a 3% step-
+    # fault storm keeps the ladder escalated for real stretches, and
+    # batch admissions shed there are clean terminal lifecycles too
+    assert (sched.completed_total + sched.failed_total
+            + sched.rejected_total) == 520
+    assert sched.completed_total >= 300
+    assert all(r.reject_reason == "degraded_shed"
+               for r in sched.rejected)
+    assert sched.ladder.escalations >= 1
+    # the storm actually exercised the retry path, hard
+    assert sched.retries_total >= 20
+    assert len(plan.injected) >= 20
+    assert sched.retry_recoveries, "no serve-path MTTR was sampled"
+    # both poisoned rids were excised with the distinct outcome; no
+    # other classification leaked in (resets are transient by contract)
+    failed = {r.rid: r.reject_reason for r in sched.failed}
+    assert failed.get("life100") == "poisoned"
+    assert failed.get("life300") == "poisoned"
+    assert set(failed.values()) == {"poisoned"}
+    # rebuilt streams are exact — retry kept tokens, re-prefill
+    # continued the same pure-function stream
+    retried_done = [r for r in sched.completed if r.retries]
+    assert retried_done, "no retried request completed"
+    for req in sched.completed:
+        assert len(req.tokens) == req.output_len
+        assert req.tokens == _expected_tokens(req)
+    # THE leak gate: zero outstanding blocks, every slot back
+    assert sched.pool.outstanding() == 0
+    assert len(sched._free_slots) == cfg.slots
+    assert not sched._prefilling
+
+    mttrs = sorted(s for _, s in sched.retry_recoveries)
+    artifact = {
+        "schema": 1,
+        "seed": SEED,
+        "lifecycles": 520,
+        "completed": sched.completed_total,
+        "failed": sched.failed_total,
+        "poisoned": sched.poisoned_total,
+        "rejected": sched.rejected_total,
+        "retries": sched.retries_total,
+        "faults_injected": len(plan.injected),
+        "retry_budget": cfg.retry_budget,
+        "kv_blocks_outstanding": sched.pool.outstanding(),
+        "ladder": {
+            "escalations": sched.ladder.escalations,
+            "holddown_doublings": sched.ladder.holddown_doublings,
+            "final_rung": sched.ladder.rung,
+        },
+        "mttr_s": {
+            "count": len(mttrs),
+            "mean": round(sum(mttrs) / len(mttrs), 3),
+            "p50": round(mttrs[len(mttrs) // 2], 3),
+            "max": round(max(mttrs), 3),
+        },
+    }
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo_root, "FAULT_r02.json"), "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# -- degradation-ladder hysteresis (pure state machine) -----------------------
+
+
+def test_ladder_escalates_only_on_consecutive_bads():
+    lad = degrade.DegradationLadder()
+    assert lad.observe(0.0, True) is None          # 1 bad: not yet
+    assert lad.observe(0.1, False) is None         # reset
+    assert lad.observe(0.2, True) is None
+    change = lad.observe(0.3, True)                # 2 consecutive
+    assert change == degrade.RungChange(0, 1, "degraded")
+    assert lad.rung == degrade.RUNG_SHED_BATCH
+    assert lad.escalations == 1
+
+
+def test_ladder_ignores_goods_during_hold_down_then_recovers():
+    lad = degrade.DegradationLadder()
+    lad.observe(0.0, True)
+    lad.observe(0.1, True)                          # rung 1, hold 2 s
+    assert lad.rung == 1 and lad.hold_remaining_s(0.1) == 2.0
+    # a full recover_after run of goods INSIDE the hold-down: ignored
+    for i in range(6):
+        assert lad.observe(0.2 + i * 0.1, False) is None
+    assert lad.rung == 1
+    # after expiry, goods count — and it takes recover_after of them
+    now = 2.5
+    for i in range(3):
+        assert lad.observe(now + i * 0.1, False) is None
+    change = lad.observe(now + 0.4, False)
+    assert change == degrade.RungChange(1, 0, "recovered")
+    assert lad.rung == degrade.RUNG_HEALTHY
+
+
+def test_ladder_reescalation_in_flap_window_doubles_hold_down():
+    lad = degrade.DegradationLadder()
+    lad.observe(0.0, True)
+    lad.observe(0.1, True)                          # episode 1: hold 2
+    lad.observe(1.0, True)
+    lad.observe(1.1, True)                          # episode 2: hold 4
+    assert lad.rung == 2
+    assert lad.holddown_doublings == 1
+    assert lad.hold_remaining_s(1.1) == pytest.approx(4.0)
+    # outside the flap window the hold-down RESETS to base
+    lad.observe(100.0, True)
+    lad.observe(100.1, True)
+    assert lad.hold_remaining_s(100.1) == 2.0
+
+
+def test_ladder_hold_down_is_capped_and_top_rung_is_terminal():
+    pol = degrade.LadderPolicy(hold_down_base_s=2.0,
+                               hold_down_max_s=8.0)
+    lad = degrade.DegradationLadder(pol)
+    t = 0.0
+    for _ in range(10):                             # flap storm
+        lad.observe(t, True)
+        change = lad.observe(t + 0.1, True)
+        t += 1.0
+        if lad.rung == degrade.RUNG_INTERACTIVE_ONLY:
+            break
+    assert lad.rung == degrade.RUNG_INTERACTIVE_ONLY
+    # more bads at the top rung: no further escalation, ever
+    for _ in range(5):
+        assert lad.observe(t, True) is None
+        t += 0.1
+    assert lad.rung == degrade.RUNG_INTERACTIVE_ONLY
+    # the doubling is bounded by the cap
+    assert lad._hold_s <= pol.hold_down_max_s
+    snap = lad.snapshot(t)
+    assert snap["name"] == "interactive_only"
+    assert set(snap) == {"rung", "name", "escalations",
+                         "holddownDoublings", "holdRemainingS"}
+
+
+# -- hostile deadline-header parsing ------------------------------------------
+
+#: the traceparent-parser table discipline: every hostile shape a
+#: header can take, and what the strict grammar must do with it
+HOSTILE_DEADLINES = [
+    (None, None),                 # absent header
+    (123, None),                  # non-string (already-parsed object)
+    (b"100", None),               # bytes, not str
+    ("", None),                   # empty
+    ("-5", None),                 # negative
+    ("+5", None),                 # explicit sign
+    ("NaN", None),                # not a number at all
+    ("1e3", None),                # scientific notation
+    ("1.5", None),                # fractional
+    (" 100", None),               # leading whitespace
+    ("100 ", None),               # trailing whitespace
+    ("0", None),                  # below the floor (zero budget)
+    ("86400001", None),           # above the 24 h ceiling
+    ("999999999", None),          # absurd magnitude (9 digits)
+    ("100\r\nX-Evil: 1", None),   # header-splitting attempt
+    ("0x64", None),               # hex
+    ("1", 1),                     # floor
+    ("1500", 1500),               # a normal budget
+    ("86400000", 86_400_000),     # ceiling, inclusive
+]
+
+
+@pytest.mark.parametrize("value,expected", HOSTILE_DEADLINES)
+def test_parse_deadline_ms_hostile_table(value, expected):
+    """Strict-grammar discipline (the traceparent-parser precedent):
+    anything that is not 1-8 ASCII digits inside [1 ms, 24 h] yields
+    None — fail OPEN (no deadline) without ever trusting the bytes."""
+    assert serve.parse_deadline_ms(value) == expected
+
+
+# -- deadline enforcement: admission, chunk re-entry, mid-stream --------------
+
+
+def test_deadline_rejected_at_admission_when_eta_cannot_fit():
+    """A deadline the modeled MINIMUM service time already misses is
+    excised at admission — zero tokens, zero wasted decode work."""
+    sched = serve.Scheduler(_config())
+    seen: list = []
+    sched.submit(serve.Request(
+        rid="late", prompt_len=8, output_len=400, arrival_s=0.0,
+        deadline_budget_s=0.05,  # ~400 decode iterations cannot fit
+        stream=lambda ev, val: seen.append((ev, val))))
+    assert sched.run(max_steps=10_000) < 10_000
+    (late,) = sched.failed
+    assert late.reject_reason == "deadline_exceeded"
+    assert late.tokens == [] and late.first_token_s is None
+    assert sched.deadline_exceeded_total == 1
+    assert [t for t in sched.trace if t[0] == "deadline"] \
+        == [("deadline", 1, "late", 0)]
+    assert seen == [("deadline_exceeded", 0)]
+    assert sched.pool.outstanding() == 0
+
+
+def test_deadline_enforced_at_chunk_queue_reentry():
+    """A chunked-prefill request whose deadline expires while it still
+    sits in the chunk queue is excised THERE — partially prefilled,
+    zero tokens — instead of burning the remaining chunk budget on a
+    corpse."""
+    cfg = _config(slots=4, kv_blocks=96, queue_limit=64,
+                  prefill_chunk_tokens=16)
+    sched = serve.Scheduler(cfg)
+    # two small interactive requests keep decode advancing the clock
+    # while the victim's 256-token prompt crawls through the budget
+    for i in range(2):
+        sched.submit(serve.Request(rid=f"i{i}", prompt_len=8,
+                                   output_len=40,
+                                   slo_class=serve.INTERACTIVE,
+                                   arrival_s=0.0))
+    sched.submit(serve.Request(rid="crawl", prompt_len=256,
+                               output_len=4, arrival_s=0.0,
+                               deadline_budget_s=0.2))
+    assert sched.run(max_steps=10_000) < 10_000
+    (crawl,) = sched.failed
+    assert crawl.rid == "crawl"
+    assert crawl.reject_reason == "deadline_exceeded"
+    assert crawl.prefilled > 0      # it WAS making chunk progress
+    assert crawl.tokens == []       # but never reached decode
+    assert len(sched.completed) == 2
+    assert sched.pool.outstanding() == 0
+
+
+def test_deadline_enforced_mid_stream_with_partial_tokens():
+    """A deadline that admission's uncontended ETA accepts but batched
+    service misses is enforced MID-STREAM: the victim keeps its
+    partial tokens on the wire (the terminal record says how many) and
+    everything it held is freed."""
+    contended = serve.CostModel(decode_base_s=0.02,
+                                decode_per_seq_s=0.01)
+    sched = serve.Scheduler(_config(), cost_model=contended)
+    seen: list = []
+    for i in range(3):
+        sched.submit(serve.Request(rid=f"bg{i}", prompt_len=8,
+                                   output_len=30, arrival_s=0.0))
+    # uncontended ETA ~ 30 * decode_s(1) = 0.9 s; 4-deep batched
+    # service ~ 30 * decode_s(4) = 1.8 s: admitted, then overtaken
+    sched.submit(serve.Request(
+        rid="victim", prompt_len=8, output_len=30, arrival_s=0.0,
+        slo_class=serve.INTERACTIVE, deadline_budget_s=1.2,
+        stream=lambda ev, val: seen.append((ev, val))))
+    assert sched.run(max_steps=10_000) < 10_000
+    (victim,) = sched.failed
+    assert victim.rid == "victim"
+    assert victim.reject_reason == "deadline_exceeded"
+    assert 0 < len(victim.tokens) < victim.output_len
+    assert seen[-1] == ("deadline_exceeded", len(victim.tokens))
+    assert len(sched.completed) == 3
+    assert sched.pool.outstanding() == 0
+
+
+def test_completion_wins_the_deadline_race_and_excision_is_idempotent():
+    """Two halves of the race discipline: a request whose deadline
+    falls INSIDE its final iteration completes (the sweep checks
+    completion first — a request with all its tokens is never
+    expired); and after a genuine excision, cancel() on the same rid
+    is a no-op returning False — no double release."""
+    contended = serve.CostModel(decode_base_s=0.02,
+                                decode_per_seq_s=0.01)
+    mk = [serve.Request(rid=f"r{i}", prompt_len=8, output_len=16,
+                        arrival_s=0.0) for i in range(4)]
+    base = serve.Scheduler(_config(), cost_model=contended)
+    base.submit_all([r.fresh_copy() for r in mk])
+    assert base.run(max_steps=10_000) < 10_000
+    finish = next(r for r in base.completed if r.rid == "r1").finish_s
+    # rerun with r1's deadline strictly BEFORE its finish instant but
+    # after the previous iteration — inside the final iteration window
+    # (4-deep contention keeps admission's uncontended ETA well below
+    # the deadline, so the request IS admitted and the race is real)
+    race = serve.Scheduler(_config(), cost_model=contended)
+    reqs = [r.fresh_copy() for r in mk]
+    reqs[1].deadline_budget_s = finish - 0.005
+    race.submit_all(reqs)
+    assert race.run(max_steps=10_000) < 10_000
+    b = next(r for r in race.completed if r.rid == "r1")
+    assert b.finish_s > b.deadline_s      # the race was real
+    assert race.deadline_exceeded_total == 0 and not race.failed
+    # -- idempotence: excise by deadline, then try to cancel the corpse
+    late = serve.Scheduler(_config())
+    late.submit(serve.Request(rid="gone", prompt_len=8, output_len=400,
+                              arrival_s=0.0, deadline_budget_s=0.05))
+    assert late.run(max_steps=10_000) < 10_000
+    assert late.failed[0].reject_reason == "deadline_exceeded"
+    released_once = late.pool.outstanding()
+    assert released_once == 0
+    assert late.cancel("gone") is False   # already terminal: no-op
+    assert late.pool.outstanding() == 0
+    assert late.failed_total == 1 and late.rejected_total == 0
+
+
+# -- the wire: per-request stream timeout + distinct failed record ------------
+
+
+def _read_stream(port: int, body: dict, headers: dict = None) -> list:
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", "/v1/generate", json.dumps(body), hdrs)
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        buf = b""
+        while True:
+            piece = resp.read(64)
+            if not piece:
+                break
+            buf += piece
+        return [json.loads(ln) for ln in buf.decode().splitlines()
+                if ln.strip()]
+    finally:
+        conn.close()
+
+
+def test_stream_timeout_is_deadline_derived_not_hardwired():
+    """Satellite regression: the stream-timeout cap used to be a
+    hardwired 30 s. With a caller deadline it must derive from the
+    request's budget (plus the grace window) — a wedged scheduler
+    releases the connection right after the deadline, not half a
+    minute later."""
+    sched = serve.Scheduler(_config())
+    service = serve.DecodeService(sched)          # default 30 s cap
+    port = service.start_http()                   # NO step loop: wedged
+    try:
+        t0 = time.monotonic()
+        lines = _read_stream(port, {"prompt_len": 8, "output_len": 4},
+                             headers={"x-tpu-deadline-ms": "200"})
+        elapsed = time.monotonic() - t0
+    finally:
+        service.stop()
+    assert lines == [{"error": "stream timeout"}]
+    # 0.2 s budget + 0.5 s grace, generous sandbox slack — but
+    # nowhere NEAR the 30 s cap the old hardwired timeout would hold
+    assert elapsed < 10.0
+    assert service.stream_timeout_s == 30.0       # cap still intact
+
+
+def test_failed_after_admission_is_distinct_on_the_wire():
+    """Satellite (b) end-to-end: a contract-breach executor failure on
+    an ADMITTED request reaches the client as ``failed: ...`` — never
+    as a rejection — and lands in the failed outcome counter."""
+    failed_before = metrics.SERVE_REQUESTS.value(
+        slo_class=serve.INTERACTIVE, outcome="failed")
+    plan = chaos.FaultPlan(seed=SEED)
+    plan.script("begin", chaos.Fail(
+        exc=lambda: ValueError("chaos: bad spec")))
+    ex = chaos.ChaosExecutor(serve.SimExecutor(), plan=plan)
+    sched = serve.Scheduler(_config(), executor=ex)
+    service = serve.DecodeService(sched, idle_interval_s=0.01)
+    service.start()
+    port = service.start_http()
+    try:
+        lines = _read_stream(port, {"rid": "doomed", "prompt_len": 8,
+                                    "output_len": 4,
+                                    "slo_class": "interactive"})
+    finally:
+        service.stop()
+    assert lines == [{"error": "failed: executor_error"}]
+    (doomed,) = [r for r in sched.failed if r.rid == "doomed"]
+    assert doomed.state == serve.FAILED
+    assert doomed.reject_reason == "executor_error"
+    assert doomed not in sched.rejected
+    assert metrics.SERVE_REQUESTS.value(
+        slo_class=serve.INTERACTIVE, outcome="failed") \
+        == failed_before + 1
+    assert sched.pool.outstanding() == 0
