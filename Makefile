@@ -204,16 +204,20 @@ fuzz-check:
 	  -q -p no:randomly -p no:cacheprovider
 
 # mypy strict over utils/ ici/ k8s/ workloads/ controller/ cni/
-# daemon/ vsp/ faults/ analysis/ ([tool.mypy] in pyproject.toml). The
-# CI image does not ship mypy; the target degrades to a no-op there
-# rather than failing the whole gate on a missing dev tool
+# daemon/ vsp/ faults/ analysis/ ops/ platform/ render/ webhook/
+# deviceplugin/ api/ ([tool.mypy] in pyproject.toml). The CI image
+# does not ship mypy; the target degrades to a no-op there rather
+# than failing the whole gate on a missing dev tool
 type-check:
 	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
 	  $(PYTHON) -m mypy dpu_operator_tpu/utils dpu_operator_tpu/ici \
 	    dpu_operator_tpu/k8s dpu_operator_tpu/workloads \
 	    dpu_operator_tpu/controller dpu_operator_tpu/cni \
 	    dpu_operator_tpu/daemon dpu_operator_tpu/vsp \
-	    dpu_operator_tpu/faults dpu_operator_tpu/analysis; \
+	    dpu_operator_tpu/faults dpu_operator_tpu/analysis \
+	    dpu_operator_tpu/ops dpu_operator_tpu/platform \
+	    dpu_operator_tpu/render dpu_operator_tpu/webhook \
+	    dpu_operator_tpu/deviceplugin dpu_operator_tpu/api; \
 	else \
 	  echo "type-check: mypy not installed; skipping (pip install mypy)"; \
 	fi

@@ -19,6 +19,10 @@ from .core import Baseline, Checker, Module, Violation, run_checkers
 from .lifecycle import ResourceLifecycleChecker
 from .lockcheck import LockDisciplineChecker, LockOrderGraphChecker
 from .taint import WireTaintChecker
+from .traceability import (DonationDisciplineChecker,
+                           DtypeDisciplineChecker,
+                           HostSyncDisciplineChecker,
+                           RetraceHazardChecker)
 
 ALL_CHECKERS = (
     WireSeamChecker,
@@ -36,6 +40,10 @@ ALL_CHECKERS = (
     ResourceLifecycleChecker,
     WireTaintChecker,
     BlockingUnderLockChecker,
+    RetraceHazardChecker,
+    HostSyncDisciplineChecker,
+    DonationDisciplineChecker,
+    DtypeDisciplineChecker,
 )
 
 __all__ = [
@@ -47,5 +55,7 @@ __all__ = [
     "MetricsNamingChecker", "ChaosDeterminismChecker",
     "LockDisciplineChecker", "LockOrderGraphChecker",
     "ResourceLifecycleChecker", "WireTaintChecker",
-    "BlockingUnderLockChecker",
+    "BlockingUnderLockChecker", "RetraceHazardChecker",
+    "HostSyncDisciplineChecker", "DonationDisciplineChecker",
+    "DtypeDisciplineChecker",
 ]

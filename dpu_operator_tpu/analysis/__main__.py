@@ -21,12 +21,13 @@ import os
 import sys
 
 from . import ALL_CHECKERS
-from .core import (Baseline, load_modules, pragma_inventory,
-                   run_checkers_on)
+from .core import (Baseline, FileCache, analysis_stamp, load_modules,
+                   pragma_inventory, run_checkers_on)
 from typing import Any, Optional
 
 DEFAULT_ROOTS = ("dpu_operator_tpu", "tests")
 DEFAULT_BASELINE = "opslint-baseline.json"
+DEFAULT_CACHE = ".opslint-cache.json"
 
 
 def _split_key(key: str) -> tuple:
@@ -63,19 +64,37 @@ def _emit_json(new: list, baselined: list, stale: list,
     }, indent=2, sort_keys=True))
 
 
+def _location(path: Any, line: Any, message: Any = None) -> Any:
+    out = {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path},
+            "region": {"startLine": line},
+        },
+    }
+    if message is not None:
+        out["message"] = {"text": message}
+    return out
+
+
 def _sarif_doc(new: list, baselined: list, checkers: list) -> dict:
     def result(v: Any, baselined_flag: Any) -> Any:
         out = {
             "ruleId": v.rule,
             "level": "warning",
             "message": {"text": v.message},
-            "locations": [{
-                "physicalLocation": {
-                    "artifactLocation": {"uri": v.path},
-                    "region": {"startLine": v.line},
-                },
-            }],
+            "locations": [_location(v.path, v.line)],
         }
+        if v.chain:
+            # interprocedural witness (lock-order, blocking-under-
+            # lock, host-sync-discipline): the call chain that carried
+            # the context to the finding, entry point first, finding
+            # last — what makes the artifact debuggable without
+            # re-running the fixpoint
+            out["codeFlows"] = [{"threadFlows": [{"locations": [
+                *({"location": _location(p, li, f"via {label}")}
+                  for p, li, label in v.chain),
+                {"location": _location(v.path, v.line, v.message)},
+            ]}]}]
         if baselined_flag:
             out["suppressions"] = [{"kind": "external",
                                     "justification":
@@ -137,6 +156,17 @@ def main(argv: Optional[list] = None) -> int:
                              "PATH (independent of --format): the "
                              "stable CI artifact diff-annotators "
                              "consume")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="replay single-file rule findings for "
+                             "content-unchanged modules from the "
+                             "per-file hash cache (whole-program "
+                             "passes still run on the full index); "
+                             "findings are byte-identical to a cold "
+                             "run")
+    parser.add_argument("--cache", default=None, metavar="PATH",
+                        help=f"cache file for --changed-only "
+                             f"(default: {DEFAULT_CACHE} at the repo "
+                             f"root, gitignored)")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -168,7 +198,15 @@ def main(argv: Optional[list] = None) -> int:
     roots = args.paths or [r for r in DEFAULT_ROOTS
                            if os.path.exists(os.path.join(repo_root, r))]
     modules = load_modules(roots, repo_root)
-    violations = run_checkers_on(checkers, modules)
+    cache = None
+    if args.changed_only:
+        cache_path = args.cache or os.path.join(repo_root,
+                                                DEFAULT_CACHE)
+        cache = FileCache(cache_path,
+                          analysis_stamp(c.name for c in checkers))
+    violations = run_checkers_on(checkers, modules, cache=cache)
+    if cache is not None:
+        cache.write()
 
     baseline_path = args.baseline or os.path.join(repo_root,
                                                   DEFAULT_BASELINE)
@@ -217,6 +255,9 @@ def main(argv: Optional[list] = None) -> int:
               f"(total {sum(inventory.values())})")
     else:
         print("pragmas: none")
+    if cache is not None:
+        print(f"cache: {cache.hits} unchanged, {cache.misses} "
+              f"re-scanned")
     if args.sarif_out:
         print(f"sarif: wrote {args.sarif_out}")
     print(f"opslint: {len(new)} new, {len(baselined)} baselined, "

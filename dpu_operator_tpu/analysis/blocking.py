@@ -32,7 +32,7 @@ from __future__ import annotations
 
 from typing import Iterable, Iterator
 
-from .callgraph import build_flow
+from .callgraph import build_flow, frame_locations
 from .core import Checker, Module, Violation
 
 
@@ -56,6 +56,7 @@ class BlockingUnderLockChecker(Checker):
         if not in_scope:
             return
         flow = build_flow(in_scope)
+        locs = frame_locations(flow.index)
         witnesses = sorted(flow.blocking.values(),
                            key=lambda w: (w.relpath, w.lineno, w.what))
         for w in witnesses:
@@ -67,4 +68,6 @@ class BlockingUnderLockChecker(Checker):
                 f"{w.chain}) — every thread wanting the lock wedges "
                 "behind this call: move the blocking work outside the "
                 "held region, bound it with a timeout, or hand it to "
-                "a worker")
+                "a worker",
+                chain=tuple((*locs[q], q) for q in w.frames
+                            if q in locs))

@@ -498,12 +498,27 @@ def build_flow(modules: list) -> "LockFlow":
     return flow
 
 
+def frame_locations(index: "ProjectIndex") -> dict:
+    """qualname -> (relpath, def lineno) over every indexed function:
+    how the interprocedural rules turn a witness's qualname chain back
+    into source locations for SARIF ``codeFlows``. Qualnames can
+    collide (same basename + class + name in two packages); collisions
+    keep the first definition — a witness chain is a debugging aid,
+    not an identity, so an approximate frame beats a dropped one."""
+    out: dict = {}
+    for func in index.all_functions():
+        out.setdefault(func.qualname,
+                       (func.module.relpath, func.node.lineno))
+    return out
+
+
 @dataclasses.dataclass
 class EdgeWitness:
     relpath: str
     lineno: int
     holder: str  # qualname of the function where the edge was observed
     chain: str   # call chain that carried the held lock to this frame
+    frames: tuple = ()  # the same chain as qualnames, for SARIF codeFlows
 
 
 @dataclasses.dataclass
@@ -516,6 +531,7 @@ class BlockingWitness:
     chain: str    # call chain that carried the held lock to this frame
     what: str     # human description of the blocking call
     locks: tuple  # sorted node ids of the non-reentrant locks held
+    frames: tuple = ()  # the same chain as qualnames, for SARIF codeFlows
 
 
 #: time.sleep below this is a deliberate micro-backoff, not a wedge
@@ -846,7 +862,8 @@ class LockFlow:
         if key not in self.blocking:
             self.blocking[key] = BlockingWitness(
                 func.module.relpath, getattr(call, "lineno", 1),
-                func.qualname, " -> ".join(chain[-4:]), what, wedged)
+                func.qualname, " -> ".join(chain[-4:]), what, wedged,
+                tuple(chain[-4:]))
 
     def _record_callsite(self, target: FuncInfo, caller: FuncInfo,
                          held: frozenset) -> None:
@@ -881,4 +898,5 @@ class LockFlow:
                     func.module.relpath,
                     getattr(node, "lineno", 1),
                     func.qualname,
-                    " -> ".join(chain[-4:]))
+                    " -> ".join(chain[-4:]),
+                    tuple(chain[-4:]))

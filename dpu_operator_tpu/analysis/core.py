@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -39,6 +40,12 @@ class Violation:
     path: str  # repo-relative, forward slashes
     line: int
     message: str
+    #: interprocedural witness chain for SARIF codeFlows:
+    #: ((relpath, lineno, label), ...) from entry point to the frame
+    #: holding the finding. Excluded from identity — the baseline key
+    #: and equality stay line/chain-free so witness churn never
+    #: invalidates entries.
+    chain: tuple = dataclasses.field(default=(), compare=False)
 
     def key(self) -> str:
         """Baseline identity: line-number-free so edits above a
@@ -227,6 +234,79 @@ def load_modules(roots: Iterable[str], repo_root: str) -> list:
     return modules
 
 
+class FileCache:
+    """Per-file content-hash cache for SINGLE-FILE rule findings
+    (``--changed-only``): an unchanged module's per-file findings are
+    replayed from disk instead of re-walking its AST, while
+    whole-program passes always see the full module list (their
+    evidence is cross-module, so skipping them on "unchanged" files
+    would be wrong, not just stale).
+
+    Safety: entries key on the module's source hash — a pragma edit
+    changes the source, so replayed findings are always
+    post-suppression-correct — and the whole cache is stamped with the
+    rule set + the analysis package's own source digest, so editing a
+    checker invalidates everything. The file lives untracked at the
+    repo root (gitignored, like ``opslint.sarif``)."""
+
+    VERSION = 1
+
+    def __init__(self, path: str, stamp: str) -> None:
+        self.path = path
+        self.stamp = stamp
+        self.files: dict = {}
+        self.hits = 0
+        self.misses = 0
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+            if data.get("version") == self.VERSION \
+                    and data.get("stamp") == stamp:
+                self.files = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    @staticmethod
+    def source_hash(module: Module) -> str:
+        return hashlib.sha256(module.source.encode()).hexdigest()
+
+    def lookup(self, module: Module) -> Optional[list]:
+        entry = self.files.get(module.relpath)
+        if entry is None or entry.get("sha") != self.source_hash(module):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return [Violation(rule, module.relpath, line, message)
+                for rule, line, message in entry.get("findings", [])]
+
+    def store(self, module: Module, violations: list) -> None:
+        self.files[module.relpath] = {
+            "sha": self.source_hash(module),
+            "findings": [[v.rule, v.line, v.message]
+                         for v in violations],
+        }
+
+    def write(self) -> None:
+        data = {"version": self.VERSION, "stamp": self.stamp,
+                "files": self.files}
+        with open(self.path, "w") as fh:
+            json.dump(data, fh, sort_keys=True)
+            fh.write("\n")
+
+
+def analysis_stamp(rule_names: Iterable[str]) -> str:
+    """Cache stamp: the rule set plus a digest of the analysis
+    package's own sources — editing any checker invalidates every
+    cached finding."""
+    h = hashlib.sha256(",".join(sorted(rule_names)).encode())
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(pkg)):
+        if fn.endswith(".py"):
+            with open(os.path.join(pkg, fn), "rb") as fh:
+                h.update(fh.read())
+    return h.hexdigest()
+
+
 def pragma_inventory(modules: Iterable[Module]) -> dict:
     """rule -> total pragma mentions across the PRODUCTION *modules*
     (the visible suppression ratchet). Test files are excluded: the
@@ -241,33 +321,46 @@ def pragma_inventory(modules: Iterable[Module]) -> dict:
     return out
 
 
-def run_checkers_on(checkers: Iterable[Checker],
-                    modules: list) -> list:
+def run_checkers_on(checkers: Iterable[Checker], modules: list,
+                    cache: Optional[FileCache] = None) -> list:
     """All non-suppressed violations, ordered by (path, line, rule).
 
     Checkers exposing ``check_project(modules)`` are whole-program
-    passes (the interprocedural v2/v3 rules): they receive every
+    passes (the interprocedural v2/v3/v4 rules): they receive every
     loaded module at once instead of one ``check(module)`` call per
     file, so cross-module evidence (call-site lock-held-ness, the
-    lock-order graph, taint flows) is complete. Pragma suppression
-    still applies per line of the file each violation lands in."""
+    lock-order graph, taint flows, the JAX trace model) is complete.
+    Pragma suppression still applies per line of the file each
+    violation lands in.
+
+    With *cache* (``--changed-only``), single-file rules replay an
+    unchanged module's findings from the content-hash cache; the
+    whole-program passes run unconditionally — the final sort makes
+    cached and uncached runs byte-identical in output."""
     by_relpath = {m.relpath: m for m in modules}
     violations = []
 
     def _keep(module: Optional[Module], v: Violation) -> bool:
         return module is None or not module.suppressed(v.rule, v.line)
 
+    per_file = []
     for checker in checkers:
         project = getattr(checker, "check_project", None)
-        if project is not None:
-            for v in project(modules):
-                if _keep(by_relpath.get(v.path), v):
-                    violations.append(v)
+        if project is None:
+            per_file.append(checker)
             continue
-        for module in modules:
-            for v in checker.check(module):
-                if _keep(module, v):
-                    violations.append(v)
+        for v in project(modules):
+            if _keep(by_relpath.get(v.path), v):
+                violations.append(v)
+    for module in modules:
+        found = cache.lookup(module) if cache is not None else None
+        if found is None:
+            found = [v for checker in per_file
+                     for v in checker.check(module)
+                     if _keep(module, v)]
+            if cache is not None:
+                cache.store(module, found)
+        violations.extend(found)
     return sorted(violations, key=lambda v: (v.path, v.line, v.rule))
 
 

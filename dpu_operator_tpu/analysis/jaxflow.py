@@ -1,0 +1,614 @@
+"""opslint JAX trace model: jit roots, traced/static partition, syncs.
+
+The serving kernels' performance contract is enforced at runtime by
+per-test ``_cache_size`` no-retrace assertions and the virtual-clock
+serve gates — but only for the exact shapes those tests drive. This
+module is the static complement (doc/static-analysis.md "JAX trace
+model"): it discovers every ``jax.jit`` root in the scanned tree,
+infers each root's traced-vs-static argument partition from the
+decorator/wrapper AST, and propagates tracedness interprocedurally
+over :mod:`.callgraph`'s shared :class:`ProjectIndex` so the four
+trace-discipline rules in :mod:`.traceability` share one model build
+per lint run.
+
+Jit roots come in the repo's two shapes:
+
+- decorator form — ``@jax.jit``, ``@jax.jit(...)``, and
+  ``@partial(jax.jit, static_argnames=..., donate_argnums=...)``
+  (``functools.partial`` spelled either way);
+- wrapper form — ``jax.jit(fn, ...)`` applied to a function defined in
+  an enclosing frame (the ``jstep = jax.jit(step, donate_argnums=...)``
+  factory idiom in model.py/pipeline.py/collectives.py), resolved
+  lexically innermost-out so two factories defining a same-named
+  nested fn never cross-wire.
+
+Deliberate scope cuts (conservative in both directions — unresolved
+means unflagged, never fabricated):
+
+- ``static_argnames``/``static_argnums``/``donate_argnums`` are read
+  only from literal strings/ints/tuples; computed specs make the root
+  fully traced and undonated (so donation-discipline still fires — a
+  computed donation spec is itself worth a justified pragma);
+- tracedness propagates through calls the index resolves; a call it
+  cannot resolve is a propagation frontier, not a finding;
+- shape/dtype/structure queries (``x.shape``, ``jnp.ndim(x)``,
+  ``len(x)``, ``isinstance``, ``"k_q" in layer_cache``) do NOT make an
+  expression value-dependent: under trace they are Python-static, and
+  treating them as traced would flag every legal shape-polymorphic
+  branch in the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterable, Iterator, Optional
+
+from .callgraph import FuncInfo, ProjectIndex, build_index
+from .core import Module, dotted_name, walk_in_frame
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+#: calls whose RESULT is trace-static even on traced operands: shape,
+#: rank, structure and type queries (the legal branch predicates)
+_STATIC_QUERY_CALLS = {"len", "isinstance", "type", "jnp.ndim",
+                       "jnp.shape", "jnp.size", "np.ndim", "np.shape",
+                       "jax.numpy.ndim", "jax.numpy.shape"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+#: array constructors whose first argument is a shape — a per-call
+#: varying dimension here defeats compiled-once-per-shape
+SHAPE_CTORS = {"jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty",
+               "jnp.arange", "np.zeros", "np.ones", "np.full",
+               "np.empty", "jax.numpy.zeros", "jax.numpy.ones"}
+
+#: traced-param names that ARE the threaded-buffer contract in this
+#: repo: decode/verify/prefill thread `cache`, the train steps thread
+#: `params`+`opt_state`. `params` is deliberately absent — inference
+#: kernels reuse weights across calls, so donating them is a bug, not
+#: a discipline.
+BUFFER_PARAM_NAMES = {"cache", "state", "opt_state", "opt", "carry"}
+
+_AMBIGUOUS_JIT = object()
+
+
+def _const_strs(node: ast.AST) -> tuple:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _const_ints(node: ast.AST) -> tuple:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """One jit root: the wrapped function plus its compile spec."""
+
+    func: FuncInfo
+    static_names: frozenset
+    static_nums: frozenset
+    donate_nums: frozenset
+    donate_names: frozenset
+    spec_line: int
+
+    @property
+    def param_names(self) -> tuple:
+        a = self.func.node.args
+        return tuple(p.arg for p in (a.posonlyargs + a.args))
+
+    def is_static(self, name: str) -> bool:
+        if name in self.static_names:
+            return True
+        try:
+            return self.param_names.index(name) in self.static_nums
+        except ValueError:
+            return False
+
+    def is_donated(self, name: str) -> bool:
+        if name in self.donate_names:
+            return True
+        try:
+            return self.param_names.index(name) in self.donate_nums
+        except ValueError:
+            return False
+
+    def traced_params(self) -> frozenset:
+        return frozenset(n for n in self.param_names
+                         if not self.is_static(n))
+
+    def param_for_arg(self, call: ast.Call) -> Iterator[tuple]:
+        """(param name, arg expr) pairs a call site binds, skipping
+        *args/**kwargs shapes the mapping cannot see through."""
+        if any(isinstance(a, ast.Starred) for a in call.args):
+            return
+        names = self.param_names
+        for i, arg in enumerate(call.args):
+            if i < len(names):
+                yield names[i], arg
+        for kw in call.keywords:
+            if kw.arg is not None:
+                yield kw.arg, kw.value
+
+
+def value_dependent_names(node: ast.AST,
+                          static_calls: frozenset = frozenset()) -> set:
+    """Names whose runtime VALUE *node* depends on. Shape/rank/dtype/
+    structure queries are excluded — they are Python-static under
+    trace — as are string-constant membership tests on pytree dicts
+    (``"k_q" in layer_cache`` asks about structure, not values) and
+    calls in *static_calls* (the tree's own structure-predicate
+    helpers, auto-detected by :class:`TraceFlow`)."""
+    out: set = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func) or ""
+            if name in _STATIC_QUERY_CALLS or name in static_calls:
+                return
+            for sub in list(n.args) + [kw.value for kw in n.keywords]:
+                visit(sub)
+            visit(n.func)
+            return
+        if isinstance(n, ast.Compare) \
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in n.ops) \
+                and isinstance(n.left, ast.Constant) \
+                and isinstance(n.left.value, str):
+            return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+# -- host-sync sink classification --------------------------------------------
+
+_SYNC_DOTTED = {"jax.device_get", "jax.block_until_ready"}
+_HOST_ARRAY_CTORS = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array"}
+_DEVICE_PREFIXES = ("jnp.", "jax.")
+
+
+def _device_valued(node: ast.AST) -> bool:
+    """Syntactic evidence the expression holds a device value: it
+    contains a ``jnp.``/``jax.`` call. A bare variable of array type
+    is invisible to this — conservative, so ``int()`` over host-side
+    bookkeeping never fires."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func) or ""
+            if name.startswith(_DEVICE_PREFIXES):
+                return True
+    return False
+
+
+def host_sync_call(call: ast.Call) -> Optional[str]:
+    """The device-round-trip shape *call* is, or None. ``np.asarray``/
+    coercions only count with syntactic device-value evidence in the
+    argument; ``device_get``/``block_until_ready`` always count."""
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr == "item" and not call.args \
+                and not call.keywords:
+            return ".item()"
+        if call.func.attr == "block_until_ready":
+            return ".block_until_ready()"
+    name = dotted_name(call.func)
+    if name in _SYNC_DOTTED:
+        return f"{name}()"
+    if name in _HOST_ARRAY_CTORS and call.args \
+            and _device_valued(call.args[0]):
+        return f"{name}() on a device value"
+    if name in ("float", "int", "bool") and len(call.args) == 1 \
+            and _device_valued(call.args[0]):
+        return f"{name}() on a device value"
+    return None
+
+
+# -- model --------------------------------------------------------------------
+
+class TraceModel:
+    """Jit roots of one scanned module set, resolvable by def node,
+    by (module, name) and — for the cross-module ``from .decode
+    import decode_step`` call sites the index cannot resolve — by
+    globally-unique bare name."""
+
+    def __init__(self, index: ProjectIndex, modules: list) -> None:
+        self.index = index
+        #: id(FunctionDef node) -> JitInfo
+        self.by_node: dict = {}
+        #: bare name -> JitInfo | _AMBIGUOUS_JIT
+        self.by_name: dict = {}
+        self._funcinfo_by_node = {id(f.node): f
+                                  for f in index.all_functions()}
+        for module in modules:
+            self._discover_module(module)
+
+    def roots(self) -> Iterable[JitInfo]:
+        return self.by_node.values()
+
+    def jit_target(self, call: ast.Call, caller: FuncInfo,
+                   local_types: dict) -> Optional[JitInfo]:
+        """The JitInfo *call* invokes, or None: index resolution
+        first, then unique-bare-name match (jit kernels' names are
+        unique across the tree; an ambiguous name matches nothing).
+        Every root's bare name is in ``by_name``, so a miss there
+        short-circuits the (expensive) index resolution."""
+        name = (dotted_name(call.func) or "").rsplit(".", 1)[-1]
+        info = self.by_name.get(name)
+        if info is None:
+            return None
+        target = self.index.resolve_call(call, caller, local_types)
+        if target is not None:
+            return self.by_node.get(id(target.node))
+        return info if isinstance(info, JitInfo) else None
+
+    # -- discovery ------------------------------------------------------------
+    def _register(self, node: ast.AST, spec: ast.Call,
+                  spec_line: int) -> None:
+        func = self._funcinfo_by_node.get(id(node))
+        if func is None or id(node) in self.by_node:
+            return
+        static_names: tuple = ()
+        static_nums: tuple = ()
+        donate_nums: tuple = ()
+        donate_names: tuple = ()
+        for kw in spec.keywords:
+            if kw.arg == "static_argnames":
+                static_names = _const_strs(kw.value)
+            elif kw.arg == "static_argnums":
+                static_nums = _const_ints(kw.value)
+            elif kw.arg == "donate_argnums":
+                donate_nums = _const_ints(kw.value)
+            elif kw.arg == "donate_argnames":
+                donate_names = _const_strs(kw.value)
+        info = JitInfo(func, frozenset(static_names),
+                       frozenset(static_nums), frozenset(donate_nums),
+                       frozenset(donate_names), spec_line)
+        self.by_node[id(node)] = info
+        prior = self.by_name.get(func.name)
+        self.by_name[func.name] = _AMBIGUOUS_JIT if prior is not None \
+            else info
+
+    def _discover_module(self, module: Module) -> None:
+        # decorator form: every def the index knows, including nested
+        for func in self.index.all_functions():
+            if func.module is not module:
+                continue
+            for dec in func.node.decorator_list:
+                spec = self._jit_spec(dec)
+                if spec is not None:
+                    self._register(func.node, spec,
+                                   getattr(dec, "lineno", 1))
+        # wrapper form: jax.jit(fn, ...) with fn defined in an
+        # enclosing frame, resolved lexically innermost-out
+        self._scan_frame(module.tree.body, ({},))
+
+    def _jit_spec(self, dec: ast.AST) -> Optional[ast.Call]:
+        """The Call carrying static/donate keywords if *dec* is a jit
+        decorator, else None. Bare ``@jax.jit`` yields an empty Call."""
+        if dotted_name(dec) in _JIT_NAMES:
+            return ast.Call(func=dec, args=[], keywords=[])
+        if not isinstance(dec, ast.Call):
+            return None
+        name = dotted_name(dec.func)
+        if name in _JIT_NAMES:
+            return dec
+        if name in _PARTIAL_NAMES and dec.args \
+                and dotted_name(dec.args[0]) in _JIT_NAMES:
+            return dec
+        return None
+
+    def _scan_frame(self, body: list, scopes: tuple) -> None:
+        local_defs: dict = {}
+        frames: list = []
+
+        def collect(stmts: list) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    local_defs[stmt.name] = stmt
+                    frames.append(stmt)
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    collect(stmt.body)
+                    continue
+                for sub in walk_in_frame(stmt):
+                    if isinstance(sub, ast.Call) \
+                            and dotted_name(sub.func) in _JIT_NAMES \
+                            and sub.args \
+                            and isinstance(sub.args[0], ast.Name):
+                        self._resolve_wrap(sub, scopes + (local_defs,))
+
+        collect(body)
+        for frame in frames:
+            self._scan_frame(frame.body, scopes + (local_defs,))
+
+    def _resolve_wrap(self, call: ast.Call, scopes: tuple) -> None:
+        name = call.args[0].id  # type: ignore[attr-defined]
+        for scope in reversed(scopes):
+            node = scope.get(name)
+            if node is not None:
+                self._register(node, call, getattr(call, "lineno", 1))
+                return
+
+
+_MODEL_CACHE: dict = {}
+
+
+def lint_scope(modules: list) -> list:
+    """The module subset every whole-program trace pass runs on — the
+    SAME filter :mod:`.blocking`/:mod:`.lockcheck` use, so the
+    single-slot :func:`~.callgraph.build_index` cache stays hot and a
+    full lint run still builds one symbol table."""
+    return [m for m in modules if not m.is_test
+            and m.relpath.startswith("dpu_operator_tpu/")]
+
+
+def build_trace_model(modules: list) -> TraceModel:
+    """Single-slot cache keyed on module object identities, exactly
+    like callgraph's ``_FLOW_CACHE``: the four trace rules share one
+    model per lint run."""
+    key = tuple(id(m) for m in modules)
+    slot = _MODEL_CACHE.get("slot")
+    if slot is not None and slot[0] == key:
+        model: TraceModel = slot[2]
+        return model
+    index = build_index(modules)
+    model = TraceModel(index, modules)
+    _MODEL_CACHE["slot"] = (key, list(modules), model)
+    return model
+
+
+# -- interprocedural engines --------------------------------------------------
+
+_MAX_DEPTH = 16
+
+#: the serving hot path's entry points: the scheduler's public step
+#: (everything `_step_locked` fans into rides self-call resolution)
+#: and the slot-executor protocol the scheduler drives through a
+#: duck-typed attribute the index cannot type
+HOT_PATH_ENTRIES = (
+    (re.compile(r"Scheduler$"), frozenset({"step"})),
+    (re.compile(r"Executor$"),
+     frozenset({"begin", "step", "spec_step", "prefill_chunk"})),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncWitness:
+    relpath: str
+    lineno: int
+    qualname: str
+    what: str
+    #: ((relpath, lineno, qualname), ...) — entry point first
+    chain: tuple
+
+
+class HotPathSyncFlow:
+    """LockFlow-style worklist over the callgraph: every host-sync
+    shaped call reachable from a hot-path entry point, each with the
+    witness chain that reached it (first chain wins, like
+    ``LockFlow.blocking``)."""
+
+    def __init__(self, index: ProjectIndex) -> None:
+        self.index = index
+        #: id(call node) -> SyncWitness
+        self.syncs: dict = {}
+        self._seen: set = set()
+        worklist = [(f, ()) for f in index.all_functions()
+                    if self._is_entry(f)]
+        while worklist:
+            func, chain = worklist.pop()
+            if id(func.node) in self._seen or len(chain) >= _MAX_DEPTH:
+                continue
+            self._seen.add(id(func.node))
+            worklist.extend(self._walk(func, chain))
+
+    def _is_entry(self, func: FuncInfo) -> bool:
+        if func.class_name is None:
+            return False
+        return any(pat.search(func.class_name) and func.name in names
+                   for pat, names in HOT_PATH_ENTRIES)
+
+    def _link(self, func: FuncInfo) -> tuple:
+        return (func.module.relpath,
+                getattr(func.node, "lineno", 1), func.qualname)
+
+    def _walk(self, func: FuncInfo, chain: tuple) -> list:
+        chain = chain + (self._link(func),)
+        local_types = _local_types(self.index, func)
+        out = []
+        for sub in walk_in_frame(func.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            target = self.index.resolve_call(sub, func, local_types)
+            if target is not None:
+                out.append((target, chain))
+                continue
+            what = host_sync_call(sub)
+            if what is not None and id(sub) not in self.syncs:
+                self.syncs[id(sub)] = SyncWitness(
+                    func.module.relpath, getattr(sub, "lineno", 1),
+                    func.qualname, what, chain)
+        return out
+
+
+def _local_types(index: ProjectIndex, func: FuncInfo) -> dict:
+    """name -> class for frame locals assigned from known ctors —
+    LockFlow's resolution context, shared by the trace engines."""
+    out: dict = dict(func.closure_types)
+    for node in walk_in_frame(func.node):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            ctor = (dotted_name(node.value.func) or "").split(".")[-1]
+            if index.class_of(ctor) is None:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = ctor
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class TracedPredicate:
+    relpath: str
+    lineno: int
+    qualname: str
+    name: str  # the traced value the Python branch tests
+    root: str  # qualname of the jit root whose trace reaches it
+
+
+class TraceFlow:
+    """Propagates the traced-param partition from every jit root
+    through resolved calls, collecting Python ``if``/``while``/
+    ternary predicates that test a traced VALUE — the branches that
+    raise ``TracerBoolConversionError`` at trace time, or worse,
+    silently retrace per value when the predicate is concretized."""
+
+    def __init__(self, index: ProjectIndex, model: TraceModel) -> None:
+        self.index = index
+        self.model = model
+        self.predicates: list = []
+        self._memo: set = set()
+        self._static_calls = _structure_predicates(index)
+        self._types_memo: dict = {}
+        worklist = [(info.func, info.traced_params(),
+                     info.func.qualname)
+                    for info in model.roots()]
+        while worklist:
+            func, traced, root = worklist.pop()
+            key = (id(func.node), frozenset(traced))
+            if key in self._memo or not traced:
+                continue
+            self._memo.add(key)
+            worklist.extend(self._walk(func, frozenset(traced), root))
+
+    def _walk(self, func: FuncInfo, traced: frozenset,
+              root: str) -> list:
+        local_types = self._types_memo.get(id(func.node))
+        if local_types is None:
+            local_types = _local_types(self.index, func)
+            self._types_memo[id(func.node)] = local_types
+        sc = self._static_calls
+        live = set(traced)
+        out = []
+        for node in _frame_statements(func.node):
+            if isinstance(node, ast.Assign):
+                if value_dependent_names(node.value, sc) & live:
+                    for target in node.targets:
+                        for t in ast.walk(target):
+                            if isinstance(t, ast.Name):
+                                live.add(t.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                # iterating a traced pytree: static unroll, but the
+                # per-iteration element IS a traced value
+                if value_dependent_names(node.iter, sc) & live:
+                    for t in ast.walk(node.target):
+                        if isinstance(t, ast.Name):
+                            live.add(t.id)
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and value_dependent_names(node.value, sc) & live:
+                live.add(node.target.id)
+            tests = _branch_tests(node)
+            for test in tests:
+                hit = sorted(value_dependent_names(test, sc) & live)
+                if hit:
+                    self.predicates.append(TracedPredicate(
+                        func.module.relpath,
+                        getattr(test, "lineno", 1), func.qualname,
+                        hit[0], root))
+            for call in _calls_shallow(node):
+                target = self.index.resolve_call(call, func,
+                                                 local_types)
+                if target is None:
+                    continue
+                callee_traced = _propagate(call, target, live, sc)
+                if callee_traced:
+                    out.append((target, callee_traced, root))
+        return out
+
+
+def _frame_statements(node: ast.AST) -> Iterator[ast.AST]:
+    """Frame-deep statement walk in source order (assignment-before-
+    use tracedness needs order; ``walk_in_frame`` is a stack)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+        yield from _frame_statements(child)
+
+
+def _branch_tests(node: ast.AST) -> list:
+    if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+        return [node.test]
+    return []
+
+
+def _calls_shallow(node: ast.AST) -> Iterator[ast.Call]:
+    if isinstance(node, ast.Call):
+        yield node
+
+
+def _propagate(call: ast.Call, target: FuncInfo, live: set,
+               static_calls: frozenset) -> frozenset:
+    """Callee params that receive traced values at *call*."""
+    args = target.node.args
+    names = tuple(p.arg for p in (args.posonlyargs + args.args))
+    out = set()
+    if any(isinstance(a, ast.Starred) for a in call.args):
+        return frozenset()
+    for i, arg in enumerate(call.args):
+        if i < len(names) \
+                and value_dependent_names(arg, static_calls) & live:
+            out.add(names[i])
+    for kw in call.keywords:
+        if kw.arg in names \
+                and value_dependent_names(kw.value, static_calls) \
+                & live:
+            out.add(kw.arg)
+    return frozenset(out)
+
+
+def _structure_predicates(index: ProjectIndex) -> frozenset:
+    """Bare names of single-return helpers whose body has NO value
+    dependence — `isinstance`/key-membership predicates like decode's
+    ``_is_q(w)``. Branching on their result asks about pytree
+    STRUCTURE, which is static under trace, so the trace engine treats
+    calls to them like ``len``/``isinstance``. Name-collision risk is
+    accepted: a same-named helper that is NOT structure-pure would be
+    excluded, which only ever suppresses findings."""
+    out = set()
+    for func in index.all_functions():
+        body = [s for s in func.node.body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))]
+        if len(body) == 1 and isinstance(body[0], ast.Return) \
+                and body[0].value is not None \
+                and not value_dependent_names(body[0].value):
+            out.add(func.name)
+    return frozenset(out)

@@ -553,8 +553,6 @@ class ResourceLifecycleChecker(Checker):
             return
         if not module.relpath.startswith("dpu_operator_tpu/"):
             return
-        if module.relpath.startswith("dpu_operator_tpu/analysis/"):
-            return  # the rule tables name the very calls they match
         for node in ast.walk(module.tree):
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):

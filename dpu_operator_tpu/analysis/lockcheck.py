@@ -38,7 +38,7 @@ from __future__ import annotations
 import ast
 from typing import Iterable, Iterator, Optional
 
-from .callgraph import build_flow
+from .callgraph import build_flow, frame_locations
 from .core import Checker, Module, Violation, dotted_name
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
@@ -297,6 +297,7 @@ class LockOrderGraphChecker(Checker):
         if not in_scope:
             return
         flow = build_flow(in_scope)
+        locs = frame_locations(flow.index)
         for cycle in flow.find_cycles():
             edges = list(zip(cycle, cycle[1:] + (cycle[0],)))
             witnesses = [(edge, flow.edges.get(edge))
@@ -306,15 +307,19 @@ class LockOrderGraphChecker(Checker):
             if anchor is None:  # pragma: no cover — defensive
                 continue
             parts = []
+            frames: list = []
             for (a, b), w in witnesses:
                 if w is None:
                     continue
                 parts.append(f"{a} held while acquiring {b} "
                              f"(in {w.holder}, via {w.chain})")
+                frames.extend(q for q in w.frames
+                              if q in locs and q not in frames)
             rendered = " -> ".join(cycle + (cycle[0],))
             yield Violation(
                 self.name, anchor.relpath, anchor.lineno,
                 f"lock-order cycle {rendered}: " + "; ".join(parts)
                 + " — impose one global acquisition order (release "
                 "before calling across, or hoist the second acquire "
-                "out of the held region)")
+                "out of the held region)",
+                chain=tuple((*locs[q], q) for q in frames))

@@ -1,0 +1,307 @@
+"""opslint v4: the four JAX trace-discipline rules.
+
+The serving tree's performance contract has three legs the runtime
+gates only spot-check: one compiled program per (config, cache shape)
+(`_cache_size` no-retrace asserts), exactly one device round-trip per
+scheduler iteration (the virtual-clock latency gates), and no silent
+precision/HBM regressions on the quantized paths. These rules are the
+static side of that contract, riding :mod:`.jaxflow`'s shared trace
+model (doc/static-analysis.md "JAX trace model"):
+
+- ``retrace-hazard`` — Python branches on traced values inside jit
+  roots, unhashable values in static positions, and per-call-varying
+  shape constructors at jit call sites;
+- ``host-sync-discipline`` — ``.item()``/coercions/``np.asarray``/
+  ``device_get``/``block_until_ready`` reachable from the scheduler's
+  ``step()``/executor hot path; the ONE intended commit sync per
+  iteration carries a justified pragma, everything else is a hidden
+  round-trip (the serving-latency analog of blocking-under-lock);
+- ``donation-discipline`` — jit roots threading a cache/state buffer
+  (the ``(cache, x) -> (cache, y)`` shape) must declare
+  ``donate_argnums`` for it, or HBM double-buffers the KV cache;
+- ``dtype-discipline`` — no float64 and no dtype-less float-literal
+  arrays in workloads kernels; quantized-operand ``dot_general``
+  must state ``preferred_element_type``.
+
+Scope cuts (documented per rule below, all conservative): einsum
+accumulation dtypes are not statically knowable and are NOT checked —
+the KV8 dequant einsums satisfy the rule through their explicit
+``.astype`` casts; ``float()``/``int()`` only count as syncs with
+syntactic device-value evidence; donation keys on the repo's
+buffer-param naming contract (:data:`~.jaxflow.BUFFER_PARAM_NAMES`).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from .callgraph import FuncInfo, build_index
+from .core import (Checker, Module, Violation, dotted_name,
+                   walk_in_frame)
+from .jaxflow import (BUFFER_PARAM_NAMES, SHAPE_CTORS, HotPathSyncFlow,
+                      JitInfo, TraceFlow, build_trace_model,
+                      lint_scope, _local_types)
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+_QUANT_NAME = re.compile(r"(^[a-z]q$|_q\d*$|q8$)")
+_DTYPE_SCOPE = ("dpu_operator_tpu/workloads/", "dpu_operator_tpu/ops/")
+
+_ARRAY_LITERAL_CTORS = {"jnp.array", "jnp.asarray", "jax.numpy.array",
+                        "jax.numpy.asarray"}
+
+
+def _buffer_params(info: JitInfo) -> list:
+    return [name for name in info.param_names
+            if not info.is_static(name)
+            and (name in BUFFER_PARAM_NAMES or name.endswith("_cache"))]
+
+
+class DonationDisciplineChecker(Checker):
+    name = "donation-discipline"
+    description = ("jitted kernels threading a cache/state buffer in "
+                   "and out must declare donate_argnums for it so the "
+                   "runtime reuses the HBM instead of double-buffering")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        yield from self.check_project([module])
+
+    def check_project(self, modules: list) -> Iterator[Violation]:
+        in_scope = lint_scope(modules)
+        if not in_scope:
+            return
+        model = build_trace_model(in_scope)
+        for info in sorted(model.roots(),
+                           key=lambda i: (i.func.module.relpath,
+                                          i.spec_line)):
+            for name in _buffer_params(info):
+                if info.is_donated(name):
+                    continue
+                idx = info.param_names.index(name)
+                yield Violation(
+                    self.name, info.func.module.relpath,
+                    info.spec_line,
+                    f"jit root `{info.func.qualname}` threads buffer "
+                    f"param `{name}` (arg {idx}) without donating it: "
+                    f"declare donate_argnums=({idx},) so the old "
+                    f"buffer's HBM is reused, or pragma with a "
+                    f"justification")
+
+
+class HostSyncDisciplineChecker(Checker):
+    name = "host-sync-discipline"
+    description = ("no device round-trip (.item(), float()/int() "
+                   "coercion, np.asarray, device_get, "
+                   "block_until_ready) on the scheduler/executor hot "
+                   "path beyond the pragma-justified commit sync")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        yield from self.check_project([module])
+
+    def check_project(self, modules: list) -> Iterator[Violation]:
+        in_scope = lint_scope(modules)
+        if not in_scope:
+            return
+        flow = HotPathSyncFlow(build_index(in_scope))
+        for witness in sorted(flow.syncs.values(),
+                              key=lambda w: (w.relpath, w.lineno,
+                                             w.what)):
+            names = [q for _p, _l, q in witness.chain]
+            via = " -> ".join(names[-4:])
+            yield Violation(
+                self.name, witness.relpath, witness.lineno,
+                f"{witness.what} in `{witness.qualname}` is a device "
+                f"round-trip on the serving hot path (via {via}): "
+                f"batch it into the per-iteration commit sync or "
+                f"pragma with a justification",
+                chain=witness.chain)
+
+
+class RetraceHazardChecker(Checker):
+    name = "retrace-hazard"
+    description = ("jit call sites and bodies must respect the "
+                   "compiled-once contract: no Python branches on "
+                   "traced values, no unhashable statics, no "
+                   "per-call-varying shapes")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        yield from self.check_project([module])
+
+    def check_project(self, modules: list) -> Iterator[Violation]:
+        in_scope = lint_scope(modules)
+        if not in_scope:
+            return
+        model = build_trace_model(in_scope)
+        flow = TraceFlow(model.index, model)
+        seen: set = set()
+        for pred in flow.predicates:
+            key = (pred.relpath, pred.lineno, pred.name)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Violation(
+                self.name, pred.relpath, pred.lineno,
+                f"Python branch on traced value `{pred.name}` in "
+                f"`{pred.qualname}` (traced from jit root "
+                f"`{pred.root}`): concretizing a tracer either fails "
+                f"or retraces per value — use lax.cond/lax.select or "
+                f"make it static")
+        for func in model.index.all_functions():
+            local_types: Optional[dict] = None
+            for call in walk_in_frame(func.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                bare = (dotted_name(call.func) or "").rsplit(".", 1)[-1]
+                if bare not in model.by_name:
+                    continue  # cheap pre-filter before resolution
+                if local_types is None:
+                    local_types = _local_types(model.index, func)
+                info = model.jit_target(call, func, local_types)
+                if info is None:
+                    continue
+                yield from self._check_site(func, call, info)
+
+    def _check_site(self, func: FuncInfo, call: ast.Call,
+                    info: JitInfo) -> Iterator[Violation]:
+        relpath = func.module.relpath
+        for name, arg in info.param_for_arg(call):
+            if info.is_static(name):
+                if isinstance(arg, _UNHASHABLE):
+                    yield Violation(
+                        self.name, relpath,
+                        getattr(arg, "lineno", 1),
+                        f"call to jit root `{info.func.qualname}` "
+                        f"passes an unhashable "
+                        f"{type(arg).__name__.lower()} in static "
+                        f"position `{name}`: statics key the compile "
+                        f"cache and must be hashable")
+                continue
+            reason = self._varying_shape(func, arg)
+            if reason is not None:
+                yield Violation(
+                    self.name, relpath, getattr(arg, "lineno", 1),
+                    f"call to jit root `{info.func.qualname}` builds "
+                    f"traced arg `{name}` with a per-call-varying "
+                    f"shape ({reason}): every distinct shape compiles "
+                    f"a new program — pad to a fixed capacity")
+
+    def _varying_shape(self, func: FuncInfo,
+                       arg: ast.AST) -> Optional[str]:
+        """`jnp.zeros((n, ...))`-style ctor whose shape depends on a
+        frame-varying Python value: a `len(...)` call, a caller
+        parameter, or a loop variable. Attribute-derived dims
+        (`self.chunk_capacity`, `cfg.d_model`) are fixed-capacity by
+        the repo's config conventions and pass."""
+        if not isinstance(arg, ast.Call) \
+                or dotted_name(arg.func) not in SHAPE_CTORS \
+                or not arg.args:
+            return None
+        shape = arg.args[0]
+        for sub in ast.walk(shape):
+            if isinstance(sub, ast.Call) \
+                    and dotted_name(sub.func) == "len":
+                return "len(...) in the shape"
+        node = func.node
+        params = {a.arg for a in (node.args.posonlyargs
+                                  + node.args.args
+                                  + node.args.kwonlyargs)}
+        loop_vars = set()
+        for sub in walk_in_frame(node):
+            if isinstance(sub, (ast.For, ast.AsyncFor)):
+                for t in ast.walk(sub.target):
+                    if isinstance(t, ast.Name):
+                        loop_vars.add(t.id)
+        for sub in _bare_names(shape):
+            if sub.id in loop_vars:
+                return f"loop variable `{sub.id}` in the shape"
+            if sub.id in params and sub.id not in ("self", "cls"):
+                return f"caller parameter `{sub.id}` in the shape"
+        return None
+
+
+def _bare_names(node: ast.AST) -> Iterator[ast.Name]:
+    """Names used as values, NOT as the base of an attribute chain:
+    ``cfg.d_model`` and ``self.chunk_capacity`` are fixed-capacity
+    config dims by the repo's conventions, so only the bare ``n`` in
+    ``jnp.zeros((n, d))`` counts as per-call-varying."""
+    if isinstance(node, ast.Attribute):
+        return
+    if isinstance(node, ast.Name):
+        yield node
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _bare_names(child)
+
+
+class DtypeDisciplineChecker(Checker):
+    name = "dtype-discipline"
+    description = ("workloads kernels: no float64, no dtype-less "
+                   "float-literal arrays, and quantized-operand "
+                   "dot_general must state preferred_element_type")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        if module.is_test \
+                or not module.relpath.startswith(_DTYPE_SCOPE):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr == "float64":
+                yield self.violation(
+                    module, node,
+                    "float64 in a workloads kernel: doubles halve "
+                    "MXU throughput and double HBM — use the config "
+                    "dtype (bf16/f32)")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name in _ARRAY_LITERAL_CTORS:
+                yield from self._check_array_literal(module, node)
+            if name.endswith("dot_general"):
+                yield from self._check_dot_general(module, node)
+
+    def _check_array_literal(self, module: Module,
+                             call: ast.Call) -> Iterator[Violation]:
+        has_dtype = len(call.args) >= 2 \
+            or any(kw.arg == "dtype" for kw in call.keywords)
+        if has_dtype or not call.args:
+            return
+        for sub in ast.walk(call.args[0]):
+            if isinstance(sub, ast.Constant) \
+                    and isinstance(sub.value, float):
+                yield self.violation(
+                    module, call,
+                    "dtype-less array from a Python float literal: "
+                    "weak-type promotion decides the dtype at the "
+                    "use site — state it explicitly")
+                return
+
+    def _check_dot_general(self, module: Module,
+                           call: ast.Call) -> Iterator[Violation]:
+        if any(kw.arg == "preferred_element_type"
+               for kw in call.keywords):
+            return
+        for operand in call.args[:2]:
+            if self._quantized(operand):
+                yield self.violation(
+                    module, call,
+                    "dot_general over a quantized operand without "
+                    "preferred_element_type: the accumulator dtype "
+                    "is left to the backend and int8-path wins rot "
+                    "silently")
+                return
+
+    def _quantized(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and _QUANT_NAME.search(sub.id):
+                return True
+            if isinstance(sub, ast.Subscript) \
+                    and isinstance(sub.slice, ast.Constant) \
+                    and isinstance(sub.slice.value, str) \
+                    and (sub.slice.value == "q"
+                         or sub.slice.value.endswith("_q")):
+                return True
+        return False

@@ -16,7 +16,7 @@ import os
 import threading
 import time
 from concurrent import futures
-from typing import Optional
+from typing import Any, Optional
 
 import grpc
 
@@ -27,10 +27,10 @@ log = logging.getLogger(__name__)
 
 
 class _RegistrationHandler(grpc.GenericRpcHandler):
-    def __init__(self, kubelet: "FakeKubelet"):
+    def __init__(self, kubelet: "FakeKubelet") -> None:
         self.kubelet = kubelet
 
-    def service(self, hcd):
+    def service(self, hcd: Any) -> Optional[grpc.RpcMethodHandler]:
         if hcd.method == "/v1beta1.Registration/Register":
             return grpc.unary_unary_rpc_method_handler(
                 self.kubelet._register,
@@ -40,8 +40,8 @@ class _RegistrationHandler(grpc.GenericRpcHandler):
 
 
 class FakeKubelet:
-    def __init__(self, path_manager: PathManager, node_agent=None,
-                 node_name: str = ""):
+    def __init__(self, path_manager: PathManager, node_agent: Any = None,
+                 node_name: str = "") -> None:
         """*node_agent* (FakeNodeAgent) + *node_name*: where allocatable
         updates land; optional for pure wire-level tests."""
         self.path_manager = path_manager
@@ -62,7 +62,7 @@ class FakeKubelet:
         self._watch_calls: list = []
         self._gen = 0
 
-    def start(self):
+    def start(self) -> None:
         sock = self.path_manager.kubelet_socket()
         os.makedirs(os.path.dirname(sock), exist_ok=True)
         if os.path.exists(sock):
@@ -72,7 +72,7 @@ class FakeKubelet:
         self._server.add_insecure_port(f"unix://{sock}")
         self._server.start()
 
-    def stop(self):
+    def stop(self) -> None:
         self._stop.set()
         self._cancel_watches()
         if self._server:
@@ -85,7 +85,7 @@ class FakeKubelet:
                 channel.close()
             self._alloc_channels.clear()
 
-    def _cancel_watches(self):
+    def _cancel_watches(self) -> None:
         with self._lock:
             calls, self._watch_calls = self._watch_calls, []
         for call in calls:
@@ -94,7 +94,7 @@ class FakeKubelet:
             except Exception:  # opslint: disable=exception-hygiene
                 pass  # test double: the watch already finished
 
-    def restart(self, wipe_plugin_sockets: bool = True):
+    def restart(self, wipe_plugin_sockets: bool = True) -> None:
         """Simulate a kubelet restart: connections drop, the plugin
         registry is forgotten, the plugins dir is wiped (real kubelet
         clears *.sock on startup), and a fresh Registration server binds
@@ -128,7 +128,8 @@ class FakeKubelet:
         self.start()
 
     # -- Registration service -------------------------------------------------
-    def _register(self, request: pb.RegisterRequest, context):
+    def _register(self, request: pb.RegisterRequest,
+                  context: Any) -> pb.Empty:
         with self._lock:
             self.registrations.append(request)
         endpoint = os.path.join(self.path_manager.kubelet_plugin_dir(),
@@ -141,7 +142,7 @@ class FakeKubelet:
         return pb.Empty()
 
     # -- kubelet-side ListAndWatch consumption -------------------------------
-    def _watch_plugin(self, resource: str, endpoint: str):
+    def _watch_plugin(self, resource: str, endpoint: str) -> None:
         with self._lock:
             gen = self._gen
         channel = grpc.insecure_channel(f"unix://{endpoint}")
@@ -174,7 +175,7 @@ class FakeKubelet:
     # -- test helpers ---------------------------------------------------------
     def wait_for_devices(self, resource: str, count: int,
                          timeout: float = 10.0) -> bool:
-        def ok():
+        def ok() -> bool:
             devs = self.device_lists.get(resource)
             return devs is not None and len(devs) == count
 
@@ -213,7 +214,8 @@ class FakeKubelet:
         return resp
 
     def allocate_preferred(self, resource: str, size: int,
-                           must_include: tuple = (), timeout: float = 10.0):
+                           must_include: tuple = (),
+                           timeout: float = 10.0) -> tuple:
         """The real-kubelet admission flow when the plugin advertises
         GetPreferredAllocation: offer the currently-allocatable (healthy,
         not already handed out) device set, let the PLUGIN pick, then
@@ -242,7 +244,7 @@ class FakeKubelet:
                 f"{len(available)} available {resource} devices")
         return self.allocate(resource, ids, timeout=timeout), ids
 
-    def release(self, resource: str, device_ids: list):
+    def release(self, resource: str, device_ids: list) -> None:
         """Pod teardown: return devices to the allocatable pool."""
         with self._lock:
             self.allocated.get(resource, set()).difference_update(device_ids)

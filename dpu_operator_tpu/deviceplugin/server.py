@@ -21,7 +21,7 @@ import os
 import threading
 import time
 from concurrent import futures
-from typing import Optional
+from typing import Any, Callable, Iterator, Optional
 
 import grpc
 
@@ -53,12 +53,12 @@ def _preferred_chips(available: list, must_include: list, size: int,
         # the response — never truncate them away (ADVICE r1).
         return must
 
-    def coords(dev_id):
+    def coords(dev_id: str) -> Optional[tuple]:
         info = devices.get(dev_id) or {}
         c = info.get("coords") or []
         return tuple(c) if c else None
 
-    def dist(a, b):
+    def dist(a: str, b: str) -> int:
         ca, cb = coords(a), coords(b)
         if ca is None or cb is None or len(ca) != len(cb):
             return 1  # unknown topology: everything equidistant
@@ -87,7 +87,8 @@ def _preferred_chips(available: list, must_include: list, size: int,
 
 
 def preferred_ici_ports(available: list, must_include: list, size: int,
-                        devices: dict, recent_chips=()) -> list:
+                        devices: dict,
+                        recent_chips: tuple = ()) -> list:
     """GetPreferredAllocation for the ici-port resource: align the pod's
     port allocation with its chip allocation (VERDICT r3 #3 — nothing
     previously coordinated the two, so a real kubelet handed out ports in
@@ -112,7 +113,7 @@ def preferred_ici_ports(available: list, must_include: list, size: int,
     if len(must) >= size:
         return must
 
-    def chip_of(dev_id):
+    def chip_of(dev_id: str) -> Optional[int]:
         return (devices.get(dev_id) or {}).get("chip")
 
     chosen = list(must)
@@ -137,15 +138,15 @@ def preferred_ici_ports(available: list, must_include: list, size: int,
     return chosen
 
 
-def _ser(msg) -> bytes:
+def _ser(msg: Any) -> bytes:
     return msg.SerializeToString()
 
 
 class _PluginHandler(grpc.GenericRpcHandler):
-    def __init__(self, plugin: "DevicePlugin"):
+    def __init__(self, plugin: "DevicePlugin") -> None:
         self.plugin = plugin
 
-    def service(self, hcd):
+    def service(self, hcd: Any) -> Optional[grpc.RpcMethodHandler]:
         m = hcd.method
         if m == "/v1beta1.DevicePlugin/GetDevicePluginOptions":
             return grpc.unary_unary_rpc_method_handler(
@@ -184,11 +185,13 @@ class DevicePlugin:
     handler, the ICI-port resource a topology-derived one.
     """
 
-    def __init__(self, device_handler, resource: str = v.TPU_RESOURCE_NAME,
+    def __init__(self, device_handler: Any,
+                 resource: str = v.TPU_RESOURCE_NAME,
                  path_manager: Optional[PathManager] = None,
                  libtpu_path: str = "", poll_interval: float = POLL_INTERVAL,
-                 preferred_fn=None, allocation_listener=None,
-                 extra_env_provider=None):
+                 preferred_fn: Optional[Callable] = None,
+                 allocation_listener: Optional[Callable] = None,
+                 extra_env_provider: Optional[Callable] = None) -> None:
         self.device_handler = device_handler
         self.resource = resource
         self.path_manager = path_manager or PathManager()
@@ -244,7 +247,7 @@ class DevicePlugin:
     def socket_path(self) -> str:
         return self.path_manager.device_plugin_socket(self.resource)
 
-    def start(self):
+    def start(self) -> None:
         # under _lifecycle_lock: a SIGTERM stop() racing the initial
         # start() must not strand a freshly-built server the stop path
         # already ran past (the kubelet-watch restart path re-enters via
@@ -252,7 +255,7 @@ class DevicePlugin:
         with self._lifecycle_lock:
             self._start_locked()
 
-    def _start_locked(self):
+    def _start_locked(self) -> None:
         os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
@@ -298,7 +301,7 @@ class DevicePlugin:
         the next 5 s poll."""
         self._poke.set()
 
-    def stop(self):
+    def stop(self) -> None:
         self._stop.set()
         self._poke.set()
         with self._refresh_cond:
@@ -317,7 +320,7 @@ class DevicePlugin:
             self._kubelet_watch_thread.join(timeout=3)
             self._kubelet_watch_thread = None
 
-    def _unbind_server_locked(self):
+    def _unbind_server_locked(self) -> None:
         """Stop the gRPC server WITHOUT deleting a successor's socket.
 
         grpc-core unlinks the bound *path* when the server stops — even
@@ -358,7 +361,7 @@ class DevicePlugin:
                               self.socket_path)
 
     # -- kubelet-restart resilience -------------------------------------------
-    def enable_kubelet_watch(self, interval: float = 1.0):
+    def enable_kubelet_watch(self, interval: float = 1.0) -> None:
         """Re-register when kubelet.sock is recreated (kubelet restart).
 
         A restarting kubelet forgets its plugin registry and wipes the
@@ -377,7 +380,7 @@ class DevicePlugin:
             daemon=True, name=f"kubelet-watch-{self.resource}")
         self._kubelet_watch_thread.start()
 
-    def _kubelet_sock_id(self):
+    def _kubelet_sock_id(self) -> Optional[tuple]:
         try:
             st = os.stat(self.path_manager.kubelet_socket())
             # ctime too: tmpfs happily reuses a just-freed inode number,
@@ -386,7 +389,7 @@ class DevicePlugin:
         except OSError:
             return None
 
-    def _kubelet_watch_loop(self, interval: float):
+    def _kubelet_watch_loop(self, interval: float) -> None:
         from ..utils import watchdog
         heartbeat = watchdog.register(
             f"deviceplugin.kubelet-watch.{self.resource}",
@@ -396,7 +399,8 @@ class DevicePlugin:
         finally:
             heartbeat.close()
 
-    def _kubelet_watch_passes(self, interval: float, heartbeat):
+    def _kubelet_watch_passes(self, interval: float,
+                              heartbeat: Any) -> None:
         last = self._kubelet_sock_id()
         while not self._stop.wait(interval):
             heartbeat.beat()
@@ -424,7 +428,7 @@ class DevicePlugin:
             metrics.KUBELET_REREGISTRATIONS.inc(resource=self.resource)
             last = cur
 
-    def _restart_server(self):
+    def _restart_server(self) -> None:
         with self._lifecycle_lock:
             if self._stop.is_set():
                 return  # shutdown won the race: stay down
@@ -432,7 +436,7 @@ class DevicePlugin:
             self._start_locked()
 
     # -- registration (deviceplugin.go:229-262) -------------------------------
-    def register_with_kubelet(self, timeout: float = 10.0):
+    def register_with_kubelet(self, timeout: float = 10.0) -> None:
         """Dial kubelet.sock and Register. The reference works around
         kubelet's WithBlock self-dial (:166-204) by serving before
         registering — same order here (call start() first)."""
@@ -519,7 +523,8 @@ class DevicePlugin:
             out.append(dev)
         return pb.ListAndWatchResponse(devices=out)
 
-    def _list_and_watch(self, request, context):
+    def _list_and_watch(self, request: Any,
+                        context: Any) -> Iterator[pb.ListAndWatchResponse]:
         """Stream device lists; send only on change (deviceplugin.go:92-111)."""
         last = None
         with self._refresh_cond:
@@ -546,7 +551,9 @@ class DevicePlugin:
                 self._active_streams -= 1
                 self._refresh_cond.notify_all()
 
-    def _get_preferred_allocation(self, request, context):
+    def _get_preferred_allocation(
+            self, request: Any,
+            context: Any) -> pb.PreferredAllocationResponse:
         """Topology-aware chip selection: prefer ICI-adjacent chips so the
         workload's collectives stay on short torus paths — the scheduling
         half of the slice-shape story (SURVEY.md §5). Greedy nearest-
@@ -566,7 +573,8 @@ class DevicePlugin:
                 pb.ContainerPreferredAllocationResponse(deviceIDs=picked))
         return pb.PreferredAllocationResponse(container_responses=responses)
 
-    def _allocate(self, request: "pb.AllocateRequest", context):
+    def _allocate(self, request: "pb.AllocateRequest",
+                  context: Any) -> pb.AllocateResponse:
         """Validate cached health, then wire devices into the container:
         device specs for /dev/accel*, a libtpu mount, and topology env
         (Allocate parity: deviceplugin.go:114-142; env NF-DEV analog)."""

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -31,8 +32,8 @@ _NEG_INF = -1e30
 _LOG2E = math.log2(math.e)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_k: int, causal: bool,
-            sm_scale: float):
+def _kernel(q_ref: Any, k_ref: Any, v_ref: Any, o_ref: Any, *refs: Any,
+            block_k: int, causal: bool, sm_scale: float) -> None:
     # q_ref: (block_q, d); k_ref/v_ref: (S, d); o_ref: (block_q, d)
     block_q, d = q_ref.shape
     s = k_ref.shape[0]
@@ -48,7 +49,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_k: int, causal: bool,
     acc = jnp.zeros((block_q, d), jnp.float32)
     scale2 = sm_scale * _LOG2E  # exp2-domain softmax (see _LOG2E)
 
-    def body(ki, carry):
+    def body(ki: jax.Array, carry: tuple) -> tuple:
         m, l, acc = carry
         k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
         v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
@@ -87,8 +88,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *refs, block_k: int, causal: bool,
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_k: int = 512, interpret: bool | None = None):
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 512,
+                    block_k: int = 512,
+                    interpret: bool | None = None) -> jax.Array:
     """(B, S, H, D) attention via the Pallas kernel.
 
     Default blocks 512x512: measured best on v5e across
@@ -110,7 +113,7 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
                          f"({block_q}, {block_k})")
     sm_scale = 1.0 / np.sqrt(d)
 
-    def reshaped(t):
+    def reshaped(t: jax.Array) -> jax.Array:
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     qr, kr, vr = reshaped(q), reshaped(k), reshaped(v)
@@ -133,7 +136,10 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
 
 # -- training path: custom-VJP flash attention ------------------------------
 
-def _fwd_with_lse(qr, kr, vr, causal, block_q, block_k, sm_scale, interpret):
+def _fwd_with_lse(qr: jax.Array, kr: jax.Array, vr: jax.Array,
+                  causal: bool, block_q: int, block_k: int,
+                  sm_scale: float,
+                  interpret: bool) -> tuple[jax.Array, jax.Array]:
     bh, s, d = qr.shape
     kernel = functools.partial(_kernel, block_k=block_k, causal=causal,
                                sm_scale=sm_scale)
@@ -160,8 +166,9 @@ def _fwd_with_lse(qr, kr, vr, causal, block_q, block_k, sm_scale, interpret):
     )(qr, kr, vr)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_k: int, causal: bool, sm_scale: float):
+def _bwd_dq_kernel(q_ref: Any, k_ref: Any, v_ref: Any, do_ref: Any,
+                   lse_ref: Any, delta_ref: Any, dq_ref: Any, *,
+                   block_k: int, causal: bool, sm_scale: float) -> None:
     """dQ for one Q block: walk KV blocks, recompute P from lse, accumulate
     dq += dS @ K with dS = P * (dO V^T - delta) * sm_scale."""
     block_q, d = q_ref.shape
@@ -176,7 +183,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
     dq = jnp.zeros((block_q, d), jnp.float32)
 
-    def body(ki, dq):
+    def body(ki: jax.Array, dq: jax.Array) -> jax.Array:
         k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
         v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
         scores = jnp.dot(q, k_blk.T,
@@ -200,9 +207,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q: int, causal: bool,
-                    sm_scale: float):
+def _bwd_dkv_kernel(q_ref: Any, k_ref: Any, v_ref: Any, do_ref: Any,
+                    lse_ref: Any, delta_ref: Any, dk_ref: Any,
+                    dv_ref: Any, *, block_q: int, causal: bool,
+                    sm_scale: float) -> None:
     """dK/dV for one KV block: walk Q blocks (from the causal diagonal),
     dv += P^T dO, dk += dS^T Q."""
     block_k, d = k_ref.shape
@@ -215,7 +223,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv = jnp.zeros((block_k, d), jnp.float32)
     scale2 = sm_scale * _LOG2E  # exp2-domain P recompute (see _LOG2E)
 
-    def body(qi, carry):
+    def body(qi: jax.Array, carry: tuple) -> tuple:
         dk, dv = carry
         q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
         do_blk = do_ref[pl.ds(qi * block_q, block_q), :]
@@ -255,9 +263,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention_vjp(q, k, v, causal: bool = True, block_q: int = 512,
+def flash_attention_vjp(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True, block_q: int = 512,
                         block_k: int = 512,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None) -> jax.Array:
     """Differentiable flash attention: same forward as
     :func:`flash_attention`, with a Pallas backward that recomputes P from
     the saved logsumexp (no (S, S) matrix in HBM either direction)."""
@@ -265,7 +274,9 @@ def flash_attention_vjp(q, k, v, causal: bool = True, block_q: int = 512,
                            block_k=block_k, interpret=interpret)
 
 
-def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _vjp_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+             block_q: int, block_k: int,
+             interpret: bool | None) -> tuple[jax.Array, tuple]:
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, h, d = q.shape
@@ -276,7 +287,7 @@ def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
                          f"({block_q}, {block_k})")
     sm_scale = 1.0 / np.sqrt(d)
 
-    def reshaped(t):
+    def reshaped(t: jax.Array) -> jax.Array:
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     qr, kr, vr = reshaped(q), reshaped(k), reshaped(v)
@@ -286,7 +297,9 @@ def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), res
 
 
-def _vjp_bwd(causal, block_q, block_k, _interpret, res, g):
+def _vjp_bwd(causal: bool, block_q: int, block_k: int,
+             _interpret: bool | None, res: tuple,
+             g: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
     qr, kr, vr, out, lse, (b, s, h, d), interpret = res
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -336,7 +349,7 @@ def _vjp_bwd(causal, block_q, block_k, _interpret, res, g):
         interpret=interpret,
     )(qr, kr, vr, do, lse, delta)
 
-    def unshaped(t):
+    def unshaped(t: jax.Array) -> jax.Array:
         return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
     return unshaped(dq), unshaped(dk), unshaped(dv)

@@ -10,13 +10,15 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _kernel(x_ref, scale_ref, o_ref, *, eps: float):
+def _kernel(x_ref: Any, scale_ref: Any, o_ref: Any, *,
+            eps: float) -> None:
     x = x_ref[:].astype(jnp.float32)
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     o_ref[:] = (x * jax.lax.rsqrt(var + eps)
@@ -25,8 +27,9 @@ def _kernel(x_ref, scale_ref, o_ref, *, eps: float):
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "eps",
                                              "interpret"))
-def fused_rmsnorm(x, scale, block_rows: int = 256, eps: float = 1e-6,
-                  interpret: bool | None = None):
+def fused_rmsnorm(x: jax.Array, scale: jax.Array, block_rows: int = 256,
+                  eps: float = 1e-6,
+                  interpret: bool | None = None) -> jax.Array:
     """RMSNorm over the last dim of x (..., D) with per-channel scale (D,)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -48,10 +48,10 @@ class HardwarePlatform:
 
     is_fake = False
 
-    def __init__(self, root: str = "/"):
+    def __init__(self, root: str = "/") -> None:
         self.root = root
 
-    def _sys(self, *p) -> str:
+    def _sys(self, *p: str) -> str:
         return os.path.join(self.root, "sys", *p)
 
     def pci_devices(self) -> list[PciDevice]:
@@ -62,7 +62,7 @@ class HardwarePlatform:
         for addr in sorted(os.listdir(base)):
             dev = os.path.join(base, addr)
 
-            def read(name, default=""):
+            def read(name: str, default: str = "") -> str:
                 try:
                     with open(os.path.join(dev, name)) as f:
                         return f.read().strip()
@@ -162,7 +162,7 @@ class FakePlatform:
     def __init__(self, product: str = "", pci: Optional[list] = None,
                  netdevs: Optional[list] = None,
                  accel: Optional[list] = None,
-                 accelerator_type: str = ""):
+                 accelerator_type: str = "") -> None:
         self._lock = threading.Lock()
         self._product = product
         self._pci = list(pci or [])
@@ -171,46 +171,46 @@ class FakePlatform:
         self._accel_type = accelerator_type
         self._dead: set[str] = set()
 
-    def pci_devices(self):
+    def pci_devices(self) -> list[PciDevice]:
         with self._lock:
             return list(self._pci)
 
-    def net_devs(self):
+    def net_devs(self) -> list[str]:
         with self._lock:
             return list(self._netdevs)
 
-    def product_name(self):
+    def product_name(self) -> str:
         with self._lock:
             return self._product
 
-    def accel_devices(self):
+    def accel_devices(self) -> list[str]:
         with self._lock:
             return list(self._accel)
 
-    def accelerator_type(self):
+    def accelerator_type(self) -> str:
         with self._lock:
             return self._accel_type
 
-    def read_device_serial(self, address):
+    def read_device_serial(self, address: str) -> str:
         with self._lock:
             for dev in self._pci:
                 if dev.address == address:
                     return dev.serial
         return ""
 
-    def device_alive(self, address):
+    def device_alive(self, address: str) -> bool:
         with self._lock:
             return address not in self._dead
 
     # test mutators
-    def set_accel_devices(self, devs):
+    def set_accel_devices(self, devs: list[str]) -> None:
         with self._lock:
             self._accel = list(devs)
 
-    def set_pci_devices(self, devs):
+    def set_pci_devices(self, devs: list[PciDevice]) -> None:
         with self._lock:
             self._pci = list(devs)
 
-    def set_device_alive(self, address, alive: bool):
+    def set_device_alive(self, address: str, alive: bool) -> None:
         with self._lock:
             (self._dead.discard if alive else self._dead.add)(address)

@@ -17,7 +17,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
-from .platform import Platform
+from .platform import PciDevice, Platform
 
 #: Google PCI vendor id (pci-ids: 1ae0 Google, Inc.).
 GOOGLE_VENDOR_ID = "1ae0"
@@ -46,7 +46,8 @@ class VendorDetector(Protocol):
     name: str
 
     def is_tpu_platform(self, platform: Platform) -> bool: ...
-    def is_tpu_device(self, platform: Platform, dev) -> Optional[str]:
+    def is_tpu_device(self, platform: Platform,
+                      dev: PciDevice) -> Optional[str]:
         """Return a stable identifier if *dev* is this vendor's accelerator
         endpoint, else None."""
         ...
@@ -67,7 +68,8 @@ class TpuDetector:
             return True
         return len(platform.accel_devices()) > 0
 
-    def is_tpu_device(self, platform: Platform, dev) -> Optional[str]:
+    def is_tpu_device(self, platform: Platform,
+                      dev: PciDevice) -> Optional[str]:
         if dev.vendor_id != GOOGLE_VENDOR_ID:
             return None
         if dev.device_id not in TPU_DEVICE_IDS:
@@ -78,7 +80,8 @@ class TpuDetector:
         # (netsec-accelerator.go:72-75)
         return dev.serial or dev.address
 
-    def detection_result(self, tpu_mode: bool, identifier: str):
+    def detection_result(self, tpu_mode: bool,
+                         identifier: str) -> DetectionResult:
         return DetectionResult(
             tpu_mode=tpu_mode,
             vendor=self.name,
@@ -93,19 +96,21 @@ class FakeVendorDetector:
     daemon_test.go:47 faking 'IPU Adapter E2100-CCQDA2'."""
 
     def __init__(self, product_substr: str = "tpu-sim",
-                 name: str = "fake-tpu"):
+                 name: str = "fake-tpu") -> None:
         self.name = name
         self.product_substr = product_substr
 
     def is_tpu_platform(self, platform: Platform) -> bool:
         return self.product_substr in platform.product_name()
 
-    def is_tpu_device(self, platform: Platform, dev) -> Optional[str]:
+    def is_tpu_device(self, platform: Platform,
+                      dev: PciDevice) -> Optional[str]:
         if dev.product_name and self.product_substr in dev.product_name:
             return dev.address
         return None
 
-    def detection_result(self, tpu_mode: bool, identifier: str):
+    def detection_result(self, tpu_mode: bool,
+                         identifier: str) -> DetectionResult:
         return DetectionResult(
             tpu_mode=tpu_mode,
             vendor=self.name,
@@ -118,7 +123,7 @@ class FakeVendorDetector:
 class DetectorManager:
     """Ordered detection across vendors (vendordetector.go:48-135)."""
 
-    def __init__(self, detectors: Optional[list] = None):
+    def __init__(self, detectors: Optional[list] = None) -> None:
         self.detectors = detectors if detectors is not None else [TpuDetector()]
 
     def detect(self, platform: Platform) -> Optional[DetectionResult]:

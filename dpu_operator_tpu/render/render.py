@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import os
 import re
-from typing import Optional
+from typing import Any, Optional
 
 import yaml
 
@@ -28,7 +28,7 @@ class RenderError(Exception):
 
 
 def render_template(text: str, data: dict) -> str:
-    def sub(m):
+    def sub(m: re.Match) -> str:
         key = m.group(1)
         if key not in data:
             raise RenderError(f"template references unknown variable {key!r}")
@@ -53,7 +53,7 @@ def render_dir(bindata_dir: str, data: dict) -> list[dict]:
     return objs
 
 
-def apply_all_from_bindata(client, bindata_dir: str, data: dict,
+def apply_all_from_bindata(client: Any, bindata_dir: str, data: dict,
                            owner: Optional[dict] = None) -> list[dict]:
     """ApplyAllFromBinData analog (render.go:98): render, set owner refs,
     apply each object; FakeKube/RealKube ``apply`` is create-or-merge so

@@ -6,11 +6,12 @@ import argparse
 import logging
 import signal
 import threading
+from typing import Optional
 
 from .server import WebhookServer
 
 
-def main(argv=None):
+def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser("tpu-network-resources-injector")
     parser.add_argument("--bind", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8443)

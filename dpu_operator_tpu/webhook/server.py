@@ -16,7 +16,7 @@ import os
 import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from ..api.webhook import (ValidationError,
                            validate_service_function_chain,
@@ -30,9 +30,9 @@ CONTROL_SWITCHES_CONFIGMAP = "nri-control-switches"
 
 
 class WebhookServer:
-    def __init__(self, client=None, host: str = "127.0.0.1", port: int = 0,
-                 certfile: str = "", keyfile: str = "",
-                 switch_poll_interval: float = 30.0):
+    def __init__(self, client: Any = None, host: str = "127.0.0.1",
+                 port: int = 0, certfile: str = "", keyfile: str = "",
+                 switch_poll_interval: float = 30.0) -> None:
         """*client*: kube client for NAD lookups + control switches; when
         None, injection uses an empty NAD set (mutations become no-ops)."""
         self.client = client
@@ -96,7 +96,7 @@ class WebhookServer:
         return _response(uid, allowed=True)
 
     # -- control switches (:229-240) ------------------------------------------
-    def refresh_switches(self):
+    def refresh_switches(self) -> None:
         if self.client is None:
             return
         cm = self.client.get("v1", "ConfigMap", CONTROL_SWITCHES_CONFIGMAP,
@@ -113,7 +113,7 @@ class WebhookServer:
                         CONTROL_SWITCHES_CONFIGMAP)
 
     # -- TLS hot-reload (fsnotify analog, :186-228) ---------------------------
-    def _maybe_reload_certs(self):
+    def _maybe_reload_certs(self) -> None:
         if not (self.certfile and self._ssl_context):
             return
         try:
@@ -127,22 +127,22 @@ class WebhookServer:
             log.info("reloaded webhook serving certs")
 
     # -- server ---------------------------------------------------------------
-    def start(self):
+    def start(self) -> None:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def log_message(self, fmt, *args):
+            def log_message(self, fmt: str, *args: Any) -> None:
                 log.debug("webhook: " + fmt, *args)
 
-            def do_GET(self):
+            def do_GET(self) -> None:
                 if self.path == "/healthz":
                     self._reply(200, {"ok": True})
                 else:
                     self._reply(404, {"error": "not found"})
 
-            def do_POST(self):
+            def do_POST(self) -> None:
                 routes: dict[str, Callable[[dict], dict]] = {
                     "/mutate": outer.review_mutate,
                     "/validate": outer.review_validate,
@@ -159,7 +159,7 @@ class WebhookServer:
                     log.exception("admission review failed")
                     self._reply(500, {"error": str(e)})
 
-            def _reply(self, code, obj):
+            def _reply(self, code: int, obj: dict) -> None:
                 data = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -187,12 +187,12 @@ class WebhookServer:
         log.info("webhook server on %s:%d (tls=%s)", self.host, self.port,
                  bool(self.certfile))
 
-    def _poll_switches_loop(self):
+    def _poll_switches_loop(self) -> None:
         while not self._stop.wait(self.switch_poll_interval):
             self.refresh_switches()
             self._maybe_reload_certs()
 
-    def stop(self):
+    def stop(self) -> None:
         self._stop.set()
         if self._server:
             self._server.shutdown()

@@ -171,7 +171,7 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
     return logits[:, 0], new_cache
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def decode_step(params: dict, cfg: TransformerConfig, cache: list,
                 tokens: jax.Array, pos: jax.Array) -> tuple:
     """One compiled decode iteration — the reusable half of the
@@ -181,7 +181,15 @@ def decode_step(params: dict, cfg: TransformerConfig, cache: list,
     continuous-batching loop calls this every iteration with varying
     token/position VALUES and never re-traces. The fused generate()
     scan runs the same `_decode_one` body, so the two paths cannot
-    drift (asserted token-identical in tests/test_decode.py)."""
+    drift (asserted token-identical in tests/test_decode.py).
+
+    The *cache* operand is DONATED (with verify_step/prefill_chunk —
+    opslint's donation-discipline rule): the KV cache dominates HBM at
+    serving batch sizes, and without donation every step materializes
+    old and new cache side by side. Donation-capable backends consume
+    the passed buffer, so callers must rebind from the return — the
+    slot executor's `self.cache` reassignment shape; callers that need
+    the old cache afterwards must pass a copy."""
     return _decode_one(params, cfg, cache, tokens, pos)
 
 
@@ -266,7 +274,7 @@ def _verify_one(params: dict, cfg: TransformerConfig, cache: list,
     return logits, new_cache
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def verify_step(params: dict, cfg: TransformerConfig, cache: list,
                 tokens: jax.Array, pos: jax.Array) -> tuple:
     """One compiled speculative VERIFY iteration — the batched k-token
@@ -351,7 +359,7 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     return new_cache, last_logits
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def prefill_chunk(params: dict, cfg: TransformerConfig, cache: list,
                   slot: jax.Array, tokens: jax.Array, offset: jax.Array,
                   n_valid: jax.Array) -> tuple:

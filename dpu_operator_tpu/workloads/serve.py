@@ -491,7 +491,9 @@ class JaxSlotExecutor:
         for layer, one in zip(self.cache, cache1):
             for key in layer:
                 layer[key] = layer[key].at[slot].set(one[key][0])
-        tok = int(jnp.argmax(logits[0]))
+        # the admission commit sync: ONE round-trip per begin(), the
+        # first token must reach the host to enter the ledger
+        tok = int(jnp.argmax(logits[0]))  # opslint: disable=host-sync-discipline
         self.pos[slot] = len(ids)
         self.last[slot] = tok
         return tok
@@ -534,7 +536,9 @@ class JaxSlotExecutor:
         self.pos[slot] = offset + n
         if offset + n < len(ids):
             return None
-        tok = int(jnp.argmax(logits))
+        # final-chunk commit sync: only the LAST chunk pays a
+        # round-trip — intermediate chunks return None untouched
+        tok = int(jnp.argmax(logits))  # opslint: disable=host-sync-discipline
         self.last[slot] = tok
         return tok
 
@@ -550,7 +554,10 @@ class JaxSlotExecutor:
         pos = jnp.asarray(np.clip(self.pos, 0, self.cfg.max_seq - 1))
         logits, self.cache = decode_step(self.params, self.cfg,
                                          self.cache, tokens, pos)
-        picked = np.asarray(jnp.argmax(logits, axis=-1))
+        # THE per-iteration commit sync: argmax on device, one batched
+        # D2H for all slots — the single round-trip the latency model
+        # budgets per decode iteration
+        picked = np.asarray(jnp.argmax(logits, axis=-1))  # opslint: disable=host-sync-discipline
         out = {}
         for slot, req in active:
             tok = int(picked[slot])
@@ -591,7 +598,9 @@ class JaxSlotExecutor:
         logits, self.cache = verify_step(self.params, self.cfg,
                                          self.cache,
                                          jnp.asarray(tokens), pos)
-        picked = np.asarray(jnp.argmax(logits, axis=-1))
+        # the spec-pass commit sync: one batched D2H carries all k+1
+        # verify argmaxes for every slot — acceptance runs on the host
+        picked = np.asarray(jnp.argmax(logits, axis=-1))  # opslint: disable=host-sync-discipline
         out = {}
         for slot, req in active:
             k = n_drafted[slot]

@@ -317,8 +317,11 @@ def test_decode_step_scalar_and_vector_pos_agree(setup):
     prompt = jax.random.randint(jax.random.key(22), (2, 7), 0, cfg.vocab)
     cache, logits = prefill(params, cfg, prompt)
     tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # decode_step donates its cache: the first call consumes `cache`
+    # on donation-capable backends, so the second gets its own copy
+    cache2 = jax.tree_util.tree_map(jnp.copy, cache)
     scalar_logits, scalar_cache = decode_step(params, cfg, cache, tok, 7)
-    vec_logits, vec_cache = decode_step(params, cfg, cache, tok,
+    vec_logits, vec_cache = decode_step(params, cfg, cache2, tok,
                                         jnp.full((2,), 7, jnp.int32))
     np.testing.assert_allclose(np.asarray(scalar_logits),
                                np.asarray(vec_logits),
@@ -490,13 +493,16 @@ def test_prefill_chunk_does_not_retrace_across_fills(setup):
     from dpu_operator_tpu.workloads.decode import prefill_chunk
 
     cfg, params = setup
-    cache = init_kv_cache(cfg, 2)
+    state = {"cache": init_kv_cache(cfg, 2)}
     chunk = np.arange(8, dtype=np.int32) % cfg.vocab
 
     def call(slot, off, n):
-        return prefill_chunk(params, cfg, cache, jnp.int32(slot),
-                             jnp.asarray(chunk), jnp.int32(off),
-                             jnp.int32(n))
+        # prefill_chunk donates its cache: rebind from the return,
+        # exactly as the serve executor does
+        state["cache"], logits = prefill_chunk(
+            params, cfg, state["cache"], jnp.int32(slot),
+            jnp.asarray(chunk), jnp.int32(off), jnp.int32(n))
+        return logits
 
     call(0, 0, 8)
     before = prefill_chunk._cache_size()

@@ -1,0 +1,534 @@
+"""opslint v4 tests: the JAX trace-discipline pass.
+
+Per-rule pass/fail fixtures for retrace-hazard, host-sync-discipline,
+donation-discipline and dtype-discipline, plus the PR's satellites:
+the live-tree donation regression (the three decode kernels must keep
+their donate_argnums), SARIF codeFlows for interprocedural witnesses,
+the ``--changed-only`` content-hash cache (byte-identical + strictly
+faster), and the tightened 19-rule wall-time bound. Fixtures build
+Modules directly, mirroring test_opslint_v3.py.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+from dpu_operator_tpu.analysis import (ALL_CHECKERS,
+                                       BlockingUnderLockChecker,
+                                       DonationDisciplineChecker,
+                                       DtypeDisciplineChecker,
+                                       HostSyncDisciplineChecker,
+                                       RetraceHazardChecker)
+from dpu_operator_tpu.analysis.__main__ import _sarif_doc
+from dpu_operator_tpu.analysis.core import (FileCache, Module,
+                                            analysis_stamp,
+                                            load_modules,
+                                            pragma_inventory,
+                                            run_checkers_on)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DECODE = "dpu_operator_tpu/workloads/decode.py"
+SERVE = "dpu_operator_tpu/workloads/serve.py"
+OPS = "dpu_operator_tpu/ops/quant.py"
+
+
+def check_many(checker, sources):
+    modules = [Module("/x/" + rel, rel, textwrap.dedent(src))
+               for rel, src in sources.items()]
+    by_rel = {m.relpath: m for m in modules}
+    project = getattr(checker, "check_project", None)
+    found = project(modules) if project is not None \
+        else (v for m in modules for v in checker.check(m))
+    return [v for v in found
+            if not by_rel[v.path].suppressed(v.rule, v.line)]
+
+
+def check(checker, source, relpath=DECODE):
+    return check_many(checker, {relpath: source})
+
+
+# -- donation-discipline ------------------------------------------------------
+
+def test_donation_flags_undonated_cache_param():
+    violations = check(DonationDisciplineChecker(), """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def decode(params, cfg, cache, x):
+            return cache, x
+    """)
+    assert [v.rule for v in violations] == ["donation-discipline"]
+    assert "`cache` (arg 2)" in violations[0].message
+    assert "donate_argnums=(2,)" in violations[0].message
+
+
+def test_donation_passes_with_donate_argnums():
+    violations = check(DonationDisciplineChecker(), """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",),
+                 donate_argnums=(2,))
+        def decode(params, cfg, cache, x):
+            return cache, x
+    """)
+    assert violations == []
+
+
+def test_donation_sees_wrapper_form_jit():
+    violations = check(DonationDisciplineChecker(), """
+        import jax
+
+        def make_step():
+            def step(opt_state, grads):
+                return opt_state, grads
+            return jax.jit(step)
+    """)
+    assert [v.rule for v in violations] == ["donation-discipline"]
+    assert "`opt_state`" in violations[0].message
+
+    clean = check(DonationDisciplineChecker(), """
+        import jax
+
+        def make_step():
+            def step(opt_state, grads):
+                return opt_state, grads
+            return jax.jit(step, donate_argnums=(0,))
+    """)
+    assert clean == []
+
+
+def test_donation_ignores_params_and_static_buffers():
+    """Weights are reused across calls (donating them is a bug) and a
+    static `cache` name is not a device buffer."""
+    violations = check(DonationDisciplineChecker(), """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cache",))
+        def f(params, cache, x):
+            return x
+    """)
+    assert violations == []
+
+
+def test_live_decode_kernels_declare_donation():
+    """Regression for the PR's audit fix: the three cache-threading
+    decode kernels keep their donate_argnums — dropping one silently
+    doubles KV-cache HBM."""
+    with open(os.path.join(REPO, DECODE)) as fh:
+        tree = ast.parse(fh.read())
+    donating = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            for sub in ast.walk(dec):
+                if isinstance(sub, ast.keyword) \
+                        and sub.arg == "donate_argnums":
+                    donating.add(node.name)
+    assert {"decode_step", "verify_step",
+            "prefill_chunk"} <= donating
+
+
+# -- host-sync-discipline -----------------------------------------------------
+
+HOT = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    class BatchScheduler:
+        def step(self):
+            return self._drain()
+
+        def _drain(self):
+            logits = jnp.ones((4,))
+            return logits.item(){pragma}
+"""
+
+
+def test_host_sync_flags_item_reachable_from_scheduler_step():
+    violations = check(HostSyncDisciplineChecker(),
+                       HOT.format(pragma=""), relpath=SERVE)
+    assert [v.rule for v in violations] == ["host-sync-discipline"]
+    assert ".item()" in violations[0].message
+    assert "BatchScheduler.step" in violations[0].message
+    # the witness chain is structured: entry point first
+    assert violations[0].chain
+    assert violations[0].chain[0][2].endswith("BatchScheduler.step")
+
+
+def test_host_sync_pragma_suppresses():
+    violations = check(
+        HostSyncDisciplineChecker(),
+        HOT.format(pragma="  # opslint: disable=host-sync-discipline"),
+        relpath=SERVE)
+    assert violations == []
+
+
+def test_host_sync_ignores_off_path_and_host_values():
+    violations = check(HostSyncDisciplineChecker(), """
+        import jax.numpy as jnp
+
+        class Helper:
+            def probe(self):
+                return jnp.ones(()).item()
+
+        class BatchScheduler:
+            def step(self, row):
+                return int(row["count"])
+    """, relpath=SERVE)
+    assert violations == []
+
+
+def test_host_sync_flags_coercion_on_device_value_in_executor():
+    violations = check(HostSyncDisciplineChecker(), """
+        import jax.numpy as jnp
+        import numpy as np
+
+        class SlotExecutor:
+            def begin(self, logits):
+                return np.asarray(jnp.argmax(logits))
+    """, relpath=SERVE)
+    assert len(violations) == 1
+    assert "np.asarray" in violations[0].message
+
+
+# -- retrace-hazard -----------------------------------------------------------
+
+def test_retrace_flags_python_branch_on_traced_value():
+    violations = check(RetraceHazardChecker(), """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def decode(x, cfg):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert [v.rule for v in violations] == ["retrace-hazard"]
+    assert "`x`" in violations[0].message
+    assert "decode" in violations[0].message
+
+
+def test_retrace_shape_and_structure_queries_are_static():
+    violations = check(RetraceHazardChecker(), """
+        import jax
+        from functools import partial
+
+        def _is_q(w):
+            return isinstance(w, dict) and "q" in w
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def decode(x, w, cfg):
+            if x.shape[0] > 4:
+                x = x[:4]
+            if _is_q(w):
+                x = x * w["scale"]
+            if "k_q" in w:
+                x = x + 1
+            return x
+    """)
+    assert violations == []
+
+
+def test_retrace_propagates_tracedness_through_helpers():
+    violations = check(RetraceHazardChecker(), """
+        import jax
+        from functools import partial
+
+        def _inner(y):
+            if y > 0:
+                return y
+            return -y
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def decode(x, cfg):
+            return _inner(x * 2)
+    """)
+    assert len(violations) == 1
+    assert "`y`" in violations[0].message
+    assert "_inner" in violations[0].message
+
+
+def test_retrace_flags_unhashable_static_at_call_site():
+    violations = check(RetraceHazardChecker(), """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def decode(params, cfg, x):
+            return x
+
+        def run(params, x):
+            return decode(params, [1, 2, 3], x)
+    """)
+    assert len(violations) == 1
+    assert "unhashable list" in violations[0].message
+    assert "`cfg`" in violations[0].message
+
+
+def test_retrace_flags_per_call_varying_shape_at_call_site():
+    violations = check(RetraceHazardChecker(), """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def decode(params, cfg, x):
+            return x
+
+        def run(params, cfg, n):
+            return decode(params, cfg, jnp.zeros((n, 4)))
+    """)
+    assert len(violations) == 1
+    assert "caller parameter `n`" in violations[0].message
+
+
+def test_retrace_fixed_capacity_shapes_pass():
+    violations = check(RetraceHazardChecker(), """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def decode(params, cfg, x):
+            return x
+
+        def run(params, cfg):
+            return decode(params, cfg,
+                          jnp.zeros((cfg.chunk_capacity, cfg.d_model)))
+    """)
+    assert violations == []
+
+
+def test_retrace_flags_len_shape_at_call_site():
+    violations = check(RetraceHazardChecker(), """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def decode(params, cfg, x):
+            return x
+
+        def run(params, cfg, batch):
+            return decode(params, cfg,
+                          jnp.zeros((len(batch), 4)))
+    """)
+    assert len(violations) == 1
+    assert "len(...)" in violations[0].message
+
+
+# -- dtype-discipline ---------------------------------------------------------
+
+def test_dtype_flags_float64_in_workloads():
+    violations = check(DtypeDisciplineChecker(), """
+        import jax.numpy as jnp
+
+        def kernel(x):
+            return x.astype(jnp.float64)
+    """)
+    assert [v.rule for v in violations] == ["dtype-discipline"]
+    assert "float64" in violations[0].message
+
+
+def test_dtype_flags_dtypeless_float_literal_array():
+    violations = check(DtypeDisciplineChecker(), """
+        import jax.numpy as jnp
+
+        SCALES = jnp.array([1.0, 0.5])
+    """)
+    assert len(violations) == 1
+    assert "dtype-less" in violations[0].message
+
+    clean = check(DtypeDisciplineChecker(), """
+        import jax.numpy as jnp
+
+        SCALES = jnp.array([1.0, 0.5], dtype=jnp.float32)
+        IDS = jnp.array([1, 2])
+    """)
+    assert clean == []
+
+
+def test_dtype_quantized_dot_general_needs_preferred_element_type():
+    violations = check(DtypeDisciplineChecker(), """
+        from jax import lax
+
+        def matmul(wq, x, dims):
+            return lax.dot_general(wq, x, dims)
+    """, relpath=OPS)
+    assert len(violations) == 1
+    assert "preferred_element_type" in violations[0].message
+
+    clean = check(DtypeDisciplineChecker(), """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def matmul(wq, x, dims):
+            return lax.dot_general(
+                wq, x, dims, preferred_element_type=jnp.float32)
+
+        def plain(w, x, dims):
+            return lax.dot_general(w, x, dims)
+    """, relpath=OPS)
+    assert clean == []
+
+
+def test_dtype_rule_scoped_to_kernel_dirs():
+    violations = check(DtypeDisciplineChecker(), """
+        import numpy as np
+
+        THRESH = np.float64(1.5)
+    """, relpath="dpu_operator_tpu/telemetry/rollup.py")
+    assert violations == []
+
+
+# -- SARIF codeFlows ----------------------------------------------------------
+
+def test_sarif_emits_code_flows_for_witness_chains():
+    violations = check_many(BlockingUnderLockChecker(), {SERVE: """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.queue = None
+
+            def tick(self):
+                with self._lock:
+                    self._drain()
+
+            def _drain(self):
+                return self.queue.get()
+    """})
+    assert len(violations) == 1
+    assert violations[0].chain, "witness chain must be structured"
+    doc = _sarif_doc(violations, [], [BlockingUnderLockChecker()])
+    results = doc["runs"][0]["results"]
+    flows = results[0]["codeFlows"]
+    locations = flows[0]["threadFlows"][0]["locations"]
+    # every chain frame plus the finding itself, entry first
+    assert len(locations) == len(violations[0].chain) + 1
+    assert locations[0]["location"]["message"]["text"].startswith("via ")
+    last = locations[-1]["location"]
+    assert last["physicalLocation"]["region"]["startLine"] \
+        == violations[0].line
+
+
+def test_sarif_code_flows_cover_host_sync_findings():
+    violations = check(HostSyncDisciplineChecker(),
+                       HOT.format(pragma=""), relpath=SERVE)
+    doc = _sarif_doc(violations, [], [HostSyncDisciplineChecker()])
+    assert "codeFlows" in doc["runs"][0]["results"][0]
+
+
+def test_sarif_results_without_chain_have_no_code_flows():
+    violations = check(DtypeDisciplineChecker(), """
+        import jax.numpy as jnp
+        X = jnp.array([1.0])
+    """)
+    doc = _sarif_doc(violations, [], [DtypeDisciplineChecker()])
+    assert "codeFlows" not in doc["runs"][0]["results"][0]
+
+
+# -- --changed-only cache -----------------------------------------------------
+
+def _run_lint(cache_path):
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis",
+         "--changed-only", "--cache", str(cache_path),
+         "--format", "json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout, elapsed
+
+
+def test_changed_only_is_byte_identical_and_faster(tmp_path):
+    cache = tmp_path / "opslint-cache.json"
+    cold_out, cold_s = _run_lint(cache)
+    assert cache.exists(), "first run must persist the cache"
+    warm_out, warm_s = _run_lint(cache)
+    assert warm_out == cold_out, "cached findings must be identical"
+    assert warm_s < cold_s, (
+        f"cached re-run must be strictly faster: "
+        f"warm {warm_s:.2f}s vs cold {cold_s:.2f}s")
+
+
+def test_file_cache_replays_only_unchanged_files(tmp_path):
+    src_a = textwrap.dedent("""
+        import jax.numpy as jnp
+        X = jnp.array([1.0])
+    """)
+    src_b = "Y = 2\n"
+    stamp = analysis_stamp(["dtype-discipline"])
+    path = tmp_path / "c.json"
+
+    cache = FileCache(str(path), stamp)
+    mods = [Module("/x/" + DECODE, DECODE, src_a),
+            Module("/x/" + SERVE, SERVE, src_b)]
+    first = run_checkers_on([DtypeDisciplineChecker()], mods,
+                            cache=cache)
+    assert cache.misses == 2 and cache.hits == 0
+    assert [v.rule for v in first] == ["dtype-discipline"]
+    cache.write()
+
+    # one file edited: only that one is re-scanned, findings replay
+    cache2 = FileCache(str(path), stamp)
+    mods2 = [Module("/x/" + DECODE, DECODE, src_a),
+             Module("/x/" + SERVE, SERVE, src_b + "Z = 3\n")]
+    second = run_checkers_on([DtypeDisciplineChecker()], mods2,
+                             cache=cache2)
+    assert cache2.hits == 1 and cache2.misses == 1
+    assert [(v.path, v.line, v.rule, v.message) for v in second] \
+        == [(v.path, v.line, v.rule, v.message) for v in first]
+
+
+def test_file_cache_invalidated_by_rule_set_change(tmp_path):
+    path = tmp_path / "c.json"
+    cache = FileCache(str(path), analysis_stamp(["a"]))
+    cache.store(Module("/x/" + SERVE, SERVE, "X = 1\n"), [])
+    cache.write()
+    reloaded = FileCache(str(path), analysis_stamp(["a", "b"]))
+    assert reloaded.files == {}, "stamp change must drop every entry"
+
+
+# -- lint gate: 19 rules, bounded wall time, inventory ------------------------
+
+def test_lint_gate_19_rules_under_8_seconds():
+    """The tightened bound the v4 pass must respect: the whole-tree
+    gate (19 rules, ONE index build, four trace rules sharing one
+    model) stays interactive."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "(19 rules)" in proc.stdout
+    assert elapsed < 8.0, f"lint gate took {elapsed:.1f}s"
+
+
+def test_v4_rules_registered_and_live_tree_green():
+    names = {cls.name for cls in ALL_CHECKERS}
+    assert {"retrace-hazard", "host-sync-discipline",
+            "donation-discipline", "dtype-discipline"} <= names
+    assert len(ALL_CHECKERS) == 19
+
+
+def test_live_tree_pragma_inventory_has_commit_syncs():
+    """The executor's per-iteration commit syncs are the justified
+    exceptions host-sync-discipline is defined around: they must stay
+    visible in the pragma inventory, not silently absorbed."""
+    modules = load_modules(["dpu_operator_tpu"], REPO)
+    inventory = pragma_inventory(modules)
+    assert inventory.get("host-sync-discipline", 0) >= 1
