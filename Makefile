@@ -5,8 +5,8 @@ TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
         upgrade-check fault-check scale-check serve-check \
-        serve-chaos-check lint-check \
-        fuzz-check fleet-obs-check \
+        serve-chaos-check profile-check lint-check \
+        fuzz-check fleet-obs-check bench-trend \
         race-check type-check bench native traffic-flow images \
         smoke-images deploy undeploy graft-check clean
 
@@ -143,6 +143,20 @@ serve-chaos-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m serve_chaos \
 	  -p no:randomly -p no:cacheprovider
 
+# runtime performance plane gate (doc/observability.md "Runtime
+# performance plane"): the sampling profiler's folded output is
+# byte-deterministic under an injected trigger/frame source and its
+# self-metered overhead stays under 2% on a busy scheduler loop; the
+# jit compile watch bills compile wall time into the step ledger's
+# `compile` phase with reconciliation still exact; and the seeded
+# retrace e2e — a deliberately shape-unstable executor must produce
+# EXACTLY the expected RetraceDetected Event, kind=compile flight
+# entries and a nonzero compile ledger phase, while the steady-state
+# run produces zero retrace signals. Injected clocks, no wall sleeps.
+profile-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m profile \
+	  -p no:randomly -p no:cacheprovider
+
 # fleet telemetry plane gate (doc/observability.md "Fleet telemetry
 # plane"): a seeded 100-node FakeKube fleet of damped TelemetryPublishers
 # over injected clocks — all nodes publish and the informer-fed rollup
@@ -231,6 +245,11 @@ native:
 
 bench: native
 	$(PYTHON) bench.py
+
+# per-metric trajectory over the checked-in BENCH_r*.json rounds with
+# direction-aware noise-band regression flags (tools/bench_trend.py)
+bench-trend:
+	$(PYTHON) tools/bench_trend.py
 
 # wait out a TPU-tunnel outage, then run the bench the moment it answers
 bench-when-up: native

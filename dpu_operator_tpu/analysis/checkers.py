@@ -666,12 +666,14 @@ _ALLOWED_RANDOM = {"random.Random"}  # seedable constructor — the idiom
 
 #: markers whose tests promise bit-identical replay from a seed: the
 #: scripted-fault matrix (chaos), the hardware fault-domain storms
-#: (fault) and the serve scheduler harness (serve — its open-loop
-#: arrival process must never silently use unseeded entropy) share the
-#: invariant
+#: (fault), the serve scheduler harness (serve — its open-loop
+#: arrival process must never silently use unseeded entropy) and the
+#: runtime performance plane gate (profile — folded profiler output
+#: is asserted byte-for-byte) share the invariant
 _DETERMINISTIC_MARKS = ("pytest.mark.chaos", "pytest.mark.fault",
                         "pytest.mark.serve",
-                        "pytest.mark.serve_chaos")
+                        "pytest.mark.serve_chaos",
+                        "pytest.mark.profile")
 
 
 def _is_deterministic_mark(target: Any) -> bool:

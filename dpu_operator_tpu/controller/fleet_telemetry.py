@@ -97,6 +97,7 @@ class FleetAggregator:
         #: its final value forever
         self._exported_kinds: set = set()
         self._exported_slos: set = set()
+        self._exported_rungs: set = set()
         #: gauge-export debounce: a full rollup recompute per watch
         #: event would be O(nodes) work per event — O(nodes²) per
         #: convergence wave — under the lock; the gauges are a mirror,
@@ -346,9 +347,15 @@ class FleetAggregator:
         stalls: list[dict] = []
         per_node: dict[str, dict] = {}
         fresh = stale = 0
+        rungs: dict[str, int] = {}
+        acc_rates: list[float] = []
+        jax_compiles = jax_retraces = 0
+        retrace_nodes: list[str] = []
         for name, state in sorted(self._nodes.items()):
             digest = state.digest
             headroom = digest.get("headroom") or {}
+            serving = digest.get("serving") or {}
+            perf = digest.get("perf") or {}
             adv = int(headroom.get("advertisableSlots") or 0)
             row = {
                 "sequence": state.sequence,
@@ -358,12 +365,33 @@ class FleetAggregator:
                 "advertisableSlots": adv,
                 "healthy": bool(
                     (digest.get("health") or {}).get("healthy", True)),
+                "degradedRung": str(
+                    serving.get("degradedRungName") or ""),
+                "jaxRetraces": int(perf.get("jaxRetraces") or 0),
             }
             per_node[name] = row
             if state.stale:
                 stale += 1
                 continue  # a silent node contributes NOTHING to totals
             fresh += 1
+            if serving.get("degradedRungName"):
+                rung = metrics.bounded_label(
+                    str(serving["degradedRungName"]))
+                rungs[rung] = rungs.get(rung, 0) + 1
+            try:
+                rate = serving.get("specAcceptanceRate")
+                if rate is not None:
+                    acc_rates.append(float(rate))
+            except (TypeError, ValueError):
+                pass
+            try:
+                jax_compiles += int(perf.get("jaxCompiles") or 0)
+                node_retraces = int(perf.get("jaxRetraces") or 0)
+            except (TypeError, ValueError):
+                node_retraces = 0
+            jax_retraces += node_retraces
+            if node_retraces:
+                retrace_nodes.append(name)
             slots_total += int(headroom.get("slots") or 0)
             slots_free += int(headroom.get("freeSlots") or 0)
             slots_adv += adv
@@ -402,6 +430,17 @@ class FleetAggregator:
             "sloBurnRate": burn,
             "sloAlerts": alerts,
             "watchdogStalls": stalls,
+            "serving": {
+                "degradedRungs": rungs,
+                "specAcceptanceRate": round(
+                    sum(acc_rates) / len(acc_rates), 4)
+                if acc_rates else 0.0,
+            },
+            "perf": {
+                "jaxCompiles": jax_compiles,
+                "jaxRetraces": jax_retraces,
+                "retraceNodes": sorted(retrace_nodes),
+            },
             "perNode": per_node,
         }
 
@@ -476,6 +515,20 @@ class FleetAggregator:
                 by_sev[sev] += 1
         for sev, count in by_sev.items():
             metrics.FLEET_SLO_ALERTS.set(float(count), severity=sev)
+        serving = roll["serving"]
+        perf = roll["perf"]
+        metrics.FLEET_JAX_COMPILES.set(float(perf["jaxCompiles"]))
+        metrics.FLEET_JAX_RETRACES.set(float(perf["jaxRetraces"]))
+        metrics.FLEET_SPEC_ACCEPTANCE.set(
+            float(serving["specAcceptanceRate"]))
+        # same zero-on-vanish discipline as kinds/SLOs: a rung every
+        # node climbed out of must read 0, not its last census
+        degraded = serving["degradedRungs"]
+        for rung in self._exported_rungs - set(degraded):
+            metrics.FLEET_DEGRADED_NODES.set(0.0, rung=rung)
+        for rung, count in degraded.items():
+            metrics.FLEET_DEGRADED_NODES.set(float(count), rung=rung)
+        self._exported_rungs = set(degraded)
 
     # -- TpuOperatorConfig condition seam -------------------------------------
     def conditions(self) -> list[dict]:

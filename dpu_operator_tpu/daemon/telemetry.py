@@ -63,6 +63,14 @@ DEFAULT_DEADBANDS: dict[str, float] = {
     # material
     "total": float("inf"),
     "bad": float("inf"),
+    # perf dims: sample/compile counters grow continuously and ride the
+    # heartbeat; retraces are material on ANY change (each one is an
+    # incident signal), so no band. Acceptance rate and self fractions
+    # are noisy ratios — damp small drifts
+    "samples": float("inf"),
+    "jaxCompiles": float("inf"),
+    "overheadRatio": float("inf"),
+    "specAcceptanceRate": 0.05,
 }
 
 
@@ -102,6 +110,9 @@ class TelemetryPublisher:
     - *counters_fn* — SloEvaluator.counters() per-SLO cumulative reads
     - *alerts_fn* — SloEvaluator.active_alerts() pairs
     - *stalls_fn* — watchdog degraded component names
+    - *serving_fn* — Scheduler.serving_summary() (degradation rung,
+      speculative acceptance rate)
+    - *perf_fn* — profiler top sites + jaxwatch compile/retrace counts
     """
 
     def __init__(self, client: Any, node_name: str, *,
@@ -115,6 +126,10 @@ class TelemetryPublisher:
                  counters_fn: Optional[Callable[[], dict]] = None,
                  alerts_fn: Optional[Callable[[], list]] = None,
                  stalls_fn: Optional[Callable[[], list]] = None,
+                 serving_fn: Optional[Callable[[], Optional[dict]]]
+                 = None,
+                 perf_fn: Optional[Callable[[], Optional[dict]]]
+                 = None,
                  clock: Callable[[], float] = time.monotonic,
                  wall: Callable[[], float] = time.time,
                  heartbeat_interval: float = HEARTBEAT_INTERVAL_S,
@@ -132,6 +147,8 @@ class TelemetryPublisher:
         self.counters_fn = counters_fn
         self.alerts_fn = alerts_fn
         self.stalls_fn = stalls_fn
+        self.serving_fn = serving_fn
+        self.perf_fn = perf_fn
         self.clock = clock
         self.wall = wall
         self.heartbeat_interval = heartbeat_interval
@@ -169,7 +186,9 @@ class TelemetryPublisher:
         for key, fn in (("headroom", self.headroom_fn),
                         ("faults", self.faults_fn),
                         ("health", self.health_fn),
-                        ("sloCounters", self.counters_fn)):
+                        ("sloCounters", self.counters_fn),
+                        ("serving", self.serving_fn),
+                        ("perf", self.perf_fn)):
             if fn is None:
                 continue
             try:
@@ -350,12 +369,27 @@ def default_publisher(client: Any, node_name: str, *,
                           Callable[[], Optional[dict]]] = None,
                       faults_fn: Optional[
                           Callable[[], Optional[dict]]] = None,
+                      serving_fn: Optional[
+                          Callable[[], Optional[dict]]] = None,
                       ) -> TelemetryPublisher:
     """Production wiring over the process-global health engine: the
     watchdog's degraded components, the global SLO evaluator's alerts
-    and counters, and health_snapshot — plus whatever headroom/fault
-    sources THIS process hosts."""
-    from ..utils import slo
+    and counters, and health_snapshot — plus whatever headroom/fault/
+    serving sources THIS process hosts. The perf source is always
+    wired: the sampling profiler and jaxwatch are process globals."""
+    from ..utils import profiler, slo
+    from ..workloads import jaxwatch
+
+    def perf() -> dict:
+        jax = jaxwatch.counters()
+        snap = profiler.PROFILER.snapshot()
+        return {
+            "topSites": profiler.PROFILER.top_sites(3),
+            "samples": snap["samples"],
+            "overheadRatio": snap["overheadRatio"],
+            "jaxCompiles": jax["compiles"],
+            "jaxRetraces": jax["retraces"],
+        }
 
     def health() -> dict:
         snap = slo.health_snapshot()
@@ -378,4 +412,6 @@ def default_publisher(client: Any, node_name: str, *,
         counters_fn=slo.EVALUATOR.counters,
         alerts_fn=lambda: list(slo.EVALUATOR.active_alerts()),
         stalls_fn=watchdog.WATCHDOG.degraded_components,
+        serving_fn=serving_fn,
+        perf_fn=perf,
     )

@@ -116,18 +116,37 @@ def _vsp_cmds(sub):
              "trace_id) from the flight ring; 'top' renders the last N "
              "iterations of the cost ledger (/debug/serve/ledger: "
              "slots, chunk backlog, per-phase breakdown, preemption/"
-             "CoW rates, reconciliation verdict); graceful when the "
+             "CoW rates, reconciliation verdict); 'why <rid>' joins "
+             "one request's phase timeline with the ledger window, "
+             "the degradation rung and its retry/preempt/deadline "
+             "history into a one-line bottleneck verdict (queue-bound "
+             "/ prefill-bound / preempt-thrash / cow-stall / "
+             "retrace-coincident / deadline); graceful when the "
              "endpoint is unreachable (the service may simply not be "
              "running on this node)")
-    p.add_argument("action", choices=["status", "trace", "top"])
+    p.add_argument("action", choices=["status", "trace", "top", "why"])
     p.add_argument("rid", nargs="?", default="",
-                   help="request id (trace action)")
+                   help="request id (trace and why actions)")
     p.add_argument("--window", type=float, default=60.0,
                    help="TTFT percentile look-back window in seconds")
     p.add_argument("--last", type=int, default=10,
                    help="iterations of ledger history to render (top)")
     p.add_argument("--token", default="",
                    help="bearer token when the debug endpoints are "
+                        "auth-filtered")
+    p = sub.add_parser(
+        "profile",
+        help="runtime performance plane: render the sampling "
+             "profiler's /debug/profile snapshot from --metrics-addr "
+             "(per-thread self/total hot sites, self-metered overhead, "
+             "jit compile/retrace counters); --folded emits the raw "
+             "collapsed-stack lines instead (flamegraph.pl / "
+             "speedscope input)")
+    p.add_argument("--folded", action="store_true",
+                   help="emit collapsed-stack flamegraph lines instead "
+                        "of the summary")
+    p.add_argument("--token", default="",
+                   help="bearer token when /debug/profile is "
                         "auth-filtered")
     p = sub.add_parser(
         "fleet",
@@ -385,6 +404,152 @@ def render_serve_top(snapshot: dict, ledger: dict,
     return out
 
 
+#: terminal flight-entry names a request can end with (render_serve_why
+#: reads them all; render_serve_trace's completed/cancelled subset is
+#: unchanged for compatibility)
+_WHY_TERMINALS = ("Completed", "Cancelled", "ExecutorFailed",
+                  "AdmissionRejected", "DeadlineExceeded", "Poisoned")
+
+
+def render_serve_why(flight_events: list, rid: str,
+                     ledger: dict | None = None,
+                     snapshot: dict | None = None) -> dict:
+    """The slow-request attribution verdict: join one rid's phase
+    timeline (flight ring), the step-ledger window, the degradation
+    rung and the retry/preempt/deadline history into ONE line saying
+    where the time went — queue-bound / prefill-bound / preempt-thrash
+    / cow-stall / retrace-coincident / deadline. Pure over already-
+    fetched payloads, so the verdict table is testable offline."""
+    by_phase: dict = {}
+    starts: list = []
+    ends: list = []
+    retries = preempts = 0
+    ttft_s = None
+    terminal = None
+    retrace_compiles = 0
+    for e in flight_events:
+        attrs = e.get("attributes") or {}
+        if e.get("kind") == "compile":
+            if attrs.get("retrace") == "true":
+                retrace_compiles += 1
+            continue
+        if e.get("kind") != "serve" or attrs.get("rid") != rid:
+            continue
+        name = e.get("name", "")
+        if name.startswith("serve."):
+            phase = name[len("serve."):]
+            dur = float(e.get("duration_s") or 0.0)
+            by_phase[phase] = by_phase.get(phase, 0.0) + dur
+            try:
+                start = float(attrs.get("start_s", ""))
+            except ValueError:
+                continue
+            starts.append(start)
+            ends.append(start + dur)
+        elif name == "RetryScheduled":
+            retries += 1
+        elif name == "Preempted":
+            preempts += 1
+        elif name == "FirstToken":
+            try:
+                ttft_s = float(attrs.get("ttft_s", ""))
+            except ValueError:
+                pass
+        elif name in _WHY_TERMINALS:
+            terminal = name
+    if not by_phase and terminal is None:
+        return {"rid": rid, "found": False, "verdict": "unknown",
+                "line": f"{rid}: no flight records (ring evicted, or "
+                        "not this node's request)"}
+    lifetime = max(sum(by_phase.values()),
+                   (max(ends) - min(starts)) if starts else 0.0, 1e-9)
+
+    def share(*phases: str) -> float:
+        return sum(by_phase.get(p, 0.0) for p in phases) / lifetime
+
+    compile_ledger_s = 0.0
+    for entry in (ledger or {}).get("entries") or []:
+        compile_ledger_s += (entry.get("phases") or {}).get(
+            "compile", 0.0)
+    degraded = (snapshot or {}).get("degraded") or {}
+    rung_name = degraded.get("name") or degraded.get("rung")
+    # verdict ladder, most specific cause first: a hard terminal, then
+    # scheduler-inflicted churn, then an overlapping retrace, then
+    # plain phase dominance
+    if terminal == "DeadlineExceeded":
+        verdict = "deadline"
+    elif terminal in ("Poisoned", "ExecutorFailed") or retries >= 2:
+        verdict = "executor-faults"
+    elif preempts >= 2 or (preempts and share("preempted") > 0.3):
+        verdict = "preempt-thrash"
+    elif retrace_compiles and compile_ledger_s > 0.0:
+        verdict = "retrace-coincident"
+    elif share("cow") > 0.25:
+        verdict = "cow-stall"
+    elif share("queued", "preempted") > 0.5:
+        verdict = "queue-bound"
+    elif share("prefill", "prefill_chunk") > share("decode"):
+        verdict = "prefill-bound"
+    else:
+        verdict = "decode-bound"
+    breakdown = " · ".join(
+        f"{phase} {share(phase) * 100:.0f}%"
+        for phase, _ in sorted(by_phase.items(),
+                               key=lambda kv: (-kv[1], kv[0])))
+    extras = [f"retries {retries}", f"preempts {preempts}"]
+    if retrace_compiles:
+        extras.append(f"retraces seen {retrace_compiles} "
+                      f"(ledger compile {compile_ledger_s:.3f}s)")
+    if rung_name not in (None, "", "healthy", 0):
+        extras.append(f"rung {rung_name}")
+    if ttft_s is not None:
+        extras.append(f"ttft {ttft_s:.3f}s")
+    if terminal:
+        extras.append(terminal)
+    line = (f"{rid}: {verdict} — {breakdown or 'no phase spans'} of "
+            f"{lifetime:.3f}s; " + ", ".join(extras))
+    return {
+        "rid": rid,
+        "found": True,
+        "verdict": verdict,
+        "line": line,
+        "phaseSeconds": {k: round(v, 6)
+                         for k, v in sorted(by_phase.items())},
+        "lifetimeSeconds": round(lifetime, 6),
+        "retries": retries,
+        "preemptions": preempts,
+        "terminal": terminal,
+        "ttftSeconds": ttft_s,
+        "retraceCompiles": retrace_compiles,
+        "compileLedgerSeconds": round(compile_ledger_s, 6),
+        "degradedRung": rung_name,
+    }
+
+
+def render_profile(snapshot: dict, folded: bool = False) -> dict:
+    """The `tpuctl profile` view over /debug/profile: with *folded*,
+    just the collapsed-stack lines (pipe ``.folded`` straight into
+    flamegraph.pl); otherwise the summary an operator reads first —
+    overhead self-metering, per-thread top self sites, and the jit
+    compile/retrace counters."""
+    if folded:
+        return {"format": "folded",
+                "folded": snapshot.get("folded", "")}
+    threads = {}
+    for name, rows in (snapshot.get("threads") or {}).items():
+        threads[name] = rows[:5]
+    return {
+        "reachable": True,
+        "running": snapshot.get("running"),
+        "samples": snapshot.get("samples", 0),
+        "dropped": snapshot.get("dropped", 0),
+        "overheadRatio": snapshot.get("overheadRatio", 0.0),
+        "trackedSites": snapshot.get("trackedSites", 0),
+        "threads": threads,
+        "jax": snapshot.get("jax") or {},
+    }
+
+
 def render_fleet_top(rollup: dict) -> dict:
     """The `tpuctl fleet top` view over the operator's /debug/fleet
     rollup: the cluster capacity/health summary an operator of N nodes
@@ -400,6 +565,8 @@ def render_fleet_top(rollup: dict) -> dict:
         "sloBurnRate": rollup.get("sloBurnRate", {}),
         "sloAlerts": rollup.get("sloAlerts", []),
         "watchdogStalls": rollup.get("watchdogStalls", []),
+        "serving": rollup.get("serving", {}),
+        "perf": rollup.get("perf", {}),
         "perNode": rollup.get("perNode", {}),
     }
 
@@ -589,6 +756,44 @@ def run(args) -> dict:
                   f"{args.metrics_addr}: {e}", file=sys.stderr)
             return {"reachable": False, "error": str(e)}
         return render_serve_trace(snap.get("events", []), args.rid)
+
+    if args.cmd == "serve" and args.action == "why":
+        from .utils.flight import fetch
+        if not args.rid:
+            raise SystemExit("serve why needs a request id: "
+                             "tpuctl serve why <rid>")
+        try:
+            events = fetch(args.metrics_addr,
+                           token=args.token).get("events", [])
+        except Exception as e:  # noqa: BLE001 — graceful, like status
+            print(f"tpuctl: flight recorder unavailable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+            return {"reachable": False, "error": str(e)}
+        ledger = snap = None
+        try:
+            ledger = fetch(args.metrics_addr, token=args.token,
+                           path="/debug/serve/ledger")
+            snap = fetch(args.metrics_addr, token=args.token,
+                         path="/debug/serve")
+        except Exception as e:  # noqa: BLE001 — the ledger/rung
+            # context sharpens the verdict but the timeline alone
+            # still renders one
+            print(f"tpuctl: serve ledger unavailable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+        return render_serve_why(events, args.rid, ledger=ledger,
+                                snapshot=snap)
+
+    if args.cmd == "profile":
+        from .utils.flight import fetch
+        try:
+            snap = fetch(args.metrics_addr, token=args.token,
+                         path="/debug/profile")
+        except Exception as e:  # noqa: BLE001 — graceful: the
+            # profiler endpoint may simply not be served on this node
+            print(f"tpuctl: profile endpoint unreachable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+            return {"reachable": False, "error": str(e)}
+        return render_profile(snap, folded=args.folded)
 
     if args.cmd == "serve" and args.action == "top":
         from .utils.flight import fetch

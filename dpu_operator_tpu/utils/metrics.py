@@ -653,6 +653,45 @@ FLIGHT_DROPPED = REGISTRY.counter(
     "Flight-recorder events evicted by ring overflow, per kind — a "
     "storm that outruns the ring is visible here instead of silently "
     "overwriting history (tpuctl flight surfaces the same counts)")
+# -- runtime performance plane (utils/profiler.py + workloads/jaxwatch.py) ---
+PROFILE_SAMPLES = REGISTRY.counter(
+    "tpu_profile_samples_total",
+    "Sampling-profiler stack walks taken (one per cadence tick, each "
+    "walking every live thread's current frame); served in aggregate "
+    "at /debug/profile and by tpuctl profile")
+PROFILE_DROPPED = REGISTRY.counter(
+    "tpu_profile_dropped_total",
+    "Profiler samples not aggregated because a bounded table (folded "
+    "stacks or per-thread site rows) was already full — the profiler "
+    "trades tail completeness for a hard memory bound")
+PROFILE_OVERHEAD = REGISTRY.gauge(
+    "tpu_profile_overhead_ratio",
+    "Self-metered profiler overhead: time spent walking/aggregating "
+    "frames divided by elapsed run time (the profile gate asserts "
+    "this stays under 0.02 on a busy scheduler loop)")
+PROFILE_TRACKED_SITES = REGISTRY.gauge(
+    "tpu_profile_tracked_sites",
+    "Distinct (thread, code site) rows currently held in the "
+    "profiler's bounded self/total tables")
+JAX_COMPILES = REGISTRY.counter(
+    "tpu_jax_compiles_total",
+    "JAX jit compilations observed on the watched serving entries "
+    "(decode_step / verify_step / prefill_chunk / generate), by fn — "
+    "each one also lands a kind=compile flight entry carrying the "
+    "abstract shape signature that triggered it")
+JAX_RETRACES = REGISTRY.counter(
+    "tpu_jax_retraces_total",
+    "Compilations of an already-warmed jitted fn (the runtime retrace "
+    "sentinel, armed once serving reaches steady state), by fn — each "
+    "one fires a RetraceDetected Warning Event and bills the step "
+    "ledger's compile phase instead of silently inflating decode")
+JAX_COMPILE_SECONDS = REGISTRY.histogram_vec(
+    "tpu_jax_compile_seconds",
+    "Wall time of each observed jit compilation (the duration of the "
+    "call in which the fn's trace-cache grew), by fn",
+    label="fn",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0))
 # -- fleet telemetry plane (daemon/telemetry.py + controller/fleet_telemetry.py)
 TELEMETRY_PUBLISHES = REGISTRY.counter(
     "tpu_telemetry_publishes_total",
@@ -697,6 +736,24 @@ FLEET_SLO_ALERTS = REGISTRY.gauge(
     "tpu_fleet_slo_alerts",
     "Active per-node SLO burn-rate alerts across the fleet, by "
     "severity")
+FLEET_JAX_COMPILES = REGISTRY.gauge(
+    "tpu_fleet_jax_compiles",
+    "Lifetime jit compilations summed over fresh nodes' telemetry "
+    "digests — the fleet half of tpu_jax_compiles_total")
+FLEET_JAX_RETRACES = REGISTRY.gauge(
+    "tpu_fleet_jax_retraces",
+    "Lifetime retrace-sentinel firings summed over fresh nodes — a "
+    "fleet-wide retrace storm after a bad rollout is this gauge "
+    "climbing on /debug/fleet")
+FLEET_DEGRADED_NODES = REGISTRY.gauge(
+    "tpu_fleet_degraded_nodes",
+    "Fresh nodes per graceful-degradation ladder rung (healthy / "
+    "shed_batch / no_spec / shrink_slots / interactive_only) — the "
+    "ladder census that was previously invisible off-node")
+FLEET_SPEC_ACCEPTANCE = REGISTRY.gauge(
+    "tpu_fleet_spec_acceptance_rate",
+    "Mean speculative-draft acceptance rate over fresh nodes "
+    "reporting one (0 when no fresh node serves speculatively)")
 BUILD_INFO = REGISTRY.gauge(
     "tpu_build_info",
     "Always-1 info-style gauge carrying build identity as labels: "

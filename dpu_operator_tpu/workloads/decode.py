@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import jaxwatch
 from .model import TransformerConfig, _rmsnorm
 
 
@@ -171,6 +172,7 @@ def _decode_one(params: dict, cfg: TransformerConfig, cache: list,
     return logits[:, 0], new_cache
 
 
+@jaxwatch.watched("decode_step")
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def decode_step(params: dict, cfg: TransformerConfig, cache: list,
                 tokens: jax.Array, pos: jax.Array) -> tuple:
@@ -274,6 +276,7 @@ def _verify_one(params: dict, cfg: TransformerConfig, cache: list,
     return logits, new_cache
 
 
+@jaxwatch.watched("verify_step")
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def verify_step(params: dict, cfg: TransformerConfig, cache: list,
                 tokens: jax.Array, pos: jax.Array) -> tuple:
@@ -359,6 +362,7 @@ def prefill(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     return new_cache, last_logits
 
 
+@jaxwatch.watched("prefill_chunk")
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def prefill_chunk(params: dict, cfg: TransformerConfig, cache: list,
                   slot: jax.Array, tokens: jax.Array, offset: jax.Array,
@@ -465,6 +469,7 @@ def prefill_chunk(params: dict, cfg: TransformerConfig, cache: list,
     return new_cache, logits
 
 
+@jaxwatch.watched("generate")
 @partial(jax.jit, static_argnames=("cfg", "steps", "top_k", "greedy",
                                    "kv_int8"))
 def _generate_compiled(params: dict, cfg: TransformerConfig,

@@ -1,0 +1,250 @@
+"""JAX compile/retrace telemetry — the runtime complement to opslint's
+static retrace-hazard rule.
+
+Every jitted serving entry (``decode_step``, ``verify_step``,
+``prefill_chunk``, the generate scan) is wrapped in a
+:class:`CompiledFnWatch` at its definition site, so every caller —
+the slot executor, the bench harness, tests — is instrumented without
+touching call sites. Detection is cache-delta based: a call across
+which the jitted fn's trace-cache size (``_cache_size()``) grew WAS a
+compilation, and that call's wall time (on the injectable compile-watch
+clock) is the compile cost. Each one is recorded three ways:
+
+- ``tpu_jax_compiles_total{fn}`` + the ``tpu_jax_compile_seconds``
+  histogram,
+- a ``kind=compile`` flight entry carrying the abstract shape
+  signature (dtypes/shapes of array leaves, reprs of static scalars)
+  that triggered the trace,
+- pending *compile seconds* the serve scheduler drains once per
+  iteration and re-bills from the absorbing phase into the ledger's
+  ``compile`` phase — so a recompile shows up in the step breakdown
+  instead of silently inflating decode.
+
+The retrace SENTINEL layers on top: once a fn is *warm* — it has
+served at least one cache-hit call (steady state proven), or
+:meth:`CompiledFnWatch.mark_warm` was called — any further compile is
+a retrace: ``tpu_jax_retraces_total{fn}`` plus a ``RetraceDetected``
+Warning Event. The sentinel must additionally be :func:`arm`-ed
+(done by the serving shell at startup): warmup sweeps like
+``measure_decode`` legitimately compile the same fn for several chain
+lengths, and a disarmed watch records those as plain compiles, never
+as regressions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils import flight, metrics, watchdog
+
+#: abstract-signature leaves rendered before truncation (a paged KV
+#: cache alone has dozens; the signature is a discriminator, not a dump)
+_SIG_MAX_LEAVES = 12
+
+_LOCK = threading.Lock()
+_CLOCK: Callable[[], float] = time.perf_counter
+_ARMED = False
+_PENDING_COMPILE_S = 0.0
+
+#: every watch by name, in registration order — the /debug/profile
+#: ``jax`` section and the telemetry digest read these
+WATCHES: Dict[str, "CompiledFnWatch"] = {}
+
+
+def set_clock(clock: Optional[Callable[[], float]]) -> None:
+    """Inject the compile-watch clock (None restores perf_counter).
+    The seeded e2e shares one scripted clock between the scheduler and
+    this module so ledger reconciliation stays exact."""
+    global _CLOCK
+    _CLOCK = clock if clock is not None else time.perf_counter
+
+
+def arm(enabled: bool = True) -> None:
+    """Arm (or disarm) the retrace sentinel process-wide. Compile
+    accounting is always on; only the retrace *verdict* (counter,
+    Event) is gated, so warmup sweeps can't page anyone."""
+    global _ARMED
+    _ARMED = enabled
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def drain_compile_seconds() -> float:
+    """Return and zero the compile seconds accumulated since the last
+    drain — the scheduler calls this once per iteration to re-bill
+    measured compile time into the ledger's ``compile`` phase."""
+    global _PENDING_COMPILE_S
+    with _LOCK:
+        seconds, _PENDING_COMPILE_S = _PENDING_COMPILE_S, 0.0
+    return seconds
+
+
+def counters() -> dict:
+    """Aggregate compile/retrace accounting across all watches (the
+    /debug/profile ``jax`` section and the telemetry perf digest)."""
+    per_fn = {name: {"compiles": w.compiles, "retraces": w.retraces,
+                     "warmed": w.warmed}
+              for name, w in sorted(WATCHES.items())}
+    return {"armed": _ARMED,
+            "compiles": sum(w.compiles for w in WATCHES.values()),
+            "retraces": sum(w.retraces for w in WATCHES.values()),
+            "perFn": per_fn}
+
+
+def reset(clock: Optional[Callable[[], float]] = None) -> None:
+    """Test seam: disarm the sentinel, clear warm state and per-watch
+    counts, drop pending ledger seconds, and (re)inject the clock."""
+    global _PENDING_COMPILE_S
+    arm(False)
+    set_clock(clock)
+    with _LOCK:
+        _PENDING_COMPILE_S = 0.0
+    for w in WATCHES.values():
+        w._reset()
+
+
+def _describe(x: object) -> Optional[str]:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        dims = ",".join(str(d) for d in tuple(shape))
+        return f"{dtype}[{dims}]"
+    if isinstance(x, (bool, int, float, str)):
+        return f"{type(x).__name__}:{x!r}"  # static args retrigger
+        # traces exactly like shapes do — they belong in the signature
+    return None
+
+
+def abstract_signature(args: tuple, kwargs: dict) -> str:
+    """Compact abstract signature of a call: array leaves as
+    ``dtype[dims]``, static scalars by repr, containers walked
+    depth-first, truncated at ``_SIG_MAX_LEAVES`` leaves."""
+    parts: List[str] = []
+    more = 0
+
+    def visit(x: object) -> None:
+        nonlocal more
+        if len(parts) >= _SIG_MAX_LEAVES:
+            more += 1
+            return
+        described = _describe(x)
+        if described is not None:
+            parts.append(described)
+        elif isinstance(x, dict):
+            for key in sorted(x, key=str):
+                visit(x[key])
+        elif isinstance(x, (list, tuple)):
+            for item in x:
+                visit(item)
+        elif x is None:
+            parts.append("None")
+        else:
+            parts.append(type(x).__name__)
+
+    for a in args:
+        visit(a)
+    for key in sorted(kwargs):
+        visit(kwargs[key])
+    suffix = f",+{more}" if more else ""
+    return f"({', '.join(parts)}{suffix})"
+
+
+class CompiledFnWatch:
+    """Transparent wrapper around one jitted entry point. Attribute
+    access proxies to the wrapped fn (tests poke ``_cache_size`` and
+    jit internals directly), so the wrap is invisible to callers."""
+
+    def __init__(self, name: str, fn: Callable[..., Any]) -> None:
+        self.name = name
+        self.fn = fn
+        self.compiles = 0
+        self.retraces = 0
+        self.warmed = False
+
+    def _cache_size(self) -> int:
+        probe = getattr(self.fn, "_cache_size", None)
+        if probe is None:
+            return -1
+        try:
+            return int(probe())
+        except Exception:  # noqa: BLE001 — a jit-internals change
+            # must degrade telemetry, never the serving call
+            metrics.SWALLOWED_ERRORS.inc(site="jaxwatch.cache_size")
+            return -1
+
+    def mark_warm(self) -> None:
+        """Declare steady state explicitly (the serving shell after
+        its warmup pass); also set implicitly by the first cache-hit
+        call, which proves the working shape set is established."""
+        self.warmed = True
+
+    def _reset(self) -> None:
+        self.compiles = 0
+        self.retraces = 0
+        self.warmed = False
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        before = self._cache_size()
+        t0 = _CLOCK()
+        out = self.fn(*args, **kwargs)
+        seconds = max(0.0, _CLOCK() - t0)
+        after = self._cache_size()
+        if 0 <= before < after:
+            self._on_compile(seconds, args, kwargs)
+        elif after == before and after > 0:
+            self.warmed = True
+        return out
+
+    def __getattr__(self, item: str) -> Any:
+        fn = self.__dict__.get("fn")
+        if fn is None:
+            raise AttributeError(item)
+        return getattr(fn, item)
+
+    def _on_compile(self, seconds: float, args: tuple,
+                    kwargs: dict) -> None:
+        retrace = _ARMED and self.warmed
+        self.compiles += 1
+        signature = abstract_signature(args, kwargs)
+        metrics.JAX_COMPILES.inc(fn=self.name)
+        metrics.JAX_COMPILE_SECONDS.observe(self.name, seconds)
+        global _PENDING_COMPILE_S
+        with _LOCK:
+            _PENDING_COMPILE_S += seconds
+        flight.record("compile", self.name,
+                      duration_s=round(seconds, 6),
+                      attributes={"fn": self.name,
+                                  "signature": signature,
+                                  "retrace": "true" if retrace
+                                  else "false"})
+        if retrace:
+            self.retraces += 1
+            metrics.JAX_RETRACES.inc(fn=self.name)
+            watchdog.emit_health_event(
+                "RetraceDetected",
+                f"jitted fn {self.name} recompiled after steady state "
+                f"(compile #{self.compiles}, {seconds:.3f}s, "
+                f"signature {signature}) — input shape or static-arg "
+                "churn is inflating step time",
+                "Warning", series=self.name)
+
+
+def watch(name: str, fn: Callable[..., Any]) -> CompiledFnWatch:
+    """Wrap *fn* and register the watch under *name* (latest wins —
+    re-importing a module re-registers its watches)."""
+    w = CompiledFnWatch(name, fn)
+    WATCHES[name] = w
+    return w
+
+
+def watched(name: str) -> Callable[[Callable[..., Any]],
+                                   CompiledFnWatch]:
+    """Decorator form of :func:`watch` — stacks directly on top of
+    ``@partial(jax.jit, ...)`` at the definition site."""
+    def deco(fn: Callable[..., Any]) -> CompiledFnWatch:
+        return watch(name, fn)
+    return deco

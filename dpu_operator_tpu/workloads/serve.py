@@ -74,7 +74,7 @@ from typing import Any, Callable, Optional
 from ..utils import flight, metrics, tracing, validate, watchdog
 from ..utils.resilience import RetryPolicy
 from ..utils.stats import nearest_rank
-from . import degrade, kv_pool
+from . import degrade, jaxwatch, kv_pool
 from .kv_pool import KvBlockPool
 from .spec import AdaptiveK, NgramDrafter, greedy_accept
 
@@ -615,8 +615,12 @@ class JaxSlotExecutor:
 
 #: the ledger's phase keys, in render order (``verify`` is the
 #: speculative verify iteration — decode's replacement on iterations
-#: where the scheduler chose k > 0)
-LEDGER_PHASES = ("prefill", "decode", "verify", "cow", "sched")
+#: where the scheduler chose k > 0; ``compile`` is jit compile wall
+#: time the compile watch measured inside this iteration's executor
+#: calls, re-billed OUT of the absorbing phase so a retrace shows up
+#: in the breakdown instead of silently inflating decode)
+LEDGER_PHASES = ("prefill", "decode", "verify", "cow", "sched",
+                 "compile")
 
 
 class StepLedger:
@@ -1041,6 +1045,23 @@ class Scheduler:
         self._update_gauges()
         if real:
             phases["sched"] += self._mark() - seg
+        # jit compile time the compile watch measured inside this
+        # iteration's executor calls was absorbed by whichever phase
+        # segment surrounded the call — re-bill it into the explicit
+        # `compile` phase (clamped to what those phases actually hold,
+        # so reconcile() stays exact). Virtual-clock runs drain too
+        # (the pending pot must not leak into a later measured run)
+        # but only measuring runs re-bill: modeled totals never
+        # included the compile wall time.
+        compile_s = jaxwatch.drain_compile_seconds()
+        if real and compile_s > 0.0:
+            for donor in ("decode", "verify", "prefill", "sched"):
+                if compile_s <= 0.0:
+                    break
+                shift = min(compile_s, phases[donor])
+                phases[donor] -= shift
+                phases["compile"] += shift
+                compile_s -= shift
         self._ledger_phase = None
         self._ledger_phases = None
         self.ledger.record({
@@ -2070,6 +2091,20 @@ class Scheduler:
             "degradedRung": self.ladder.rung,
         }
 
+    def serving_summary(self) -> dict:
+        """Damped-digest serving dims for the telemetry publisher: the
+        graceful-degradation rung and the speculative acceptance rate
+        — material-on-change off-node visibility for the ladder, which
+        was previously only observable on the node itself."""
+        with self._state_lock:
+            return {
+                "degradedRung": self.ladder.rung,
+                "degradedRungName": degrade.RUNGS[self.ladder.rung],
+                "specKMax": self.config.spec_k,
+                "specAcceptanceRate": round(
+                    self._spec.acceptance_rate(), 4),
+            }
+
     def snapshot(self) -> dict:
         """JSON snapshot for ``/debug/serve`` and ``tpuctl serve``.
         Taken under the state lock: the HTTP thread must never iterate
@@ -2173,9 +2208,11 @@ class DecodeService:
                    for name, _ in ev.active_alerts())
 
     def debug_handlers(self) -> dict:
+        from ..utils import profiler as _profiler
         return {"/debug/serve": self.scheduler.snapshot,
                 "/debug/serve/ledger": self.scheduler.ledger.snapshot,
-                "/debug/serve/headroom": self.headroom}
+                "/debug/serve/headroom": self.headroom,
+                "/debug/profile": _profiler.debug_handler}
 
     def headroom(self) -> dict:
         """The full replica headroom digest: the scheduler's snapshot
@@ -2400,6 +2437,13 @@ class DecodeService:
             # a long-lived service must not grow trace/completed/
             # rejected without bound (snapshot totals stay monotone)
             self.scheduler.history_limit = 4096
+        # the runtime performance plane rides the serving shell: the
+        # sampling profiler covers every component thread, and the
+        # retrace sentinel arms here — compiles before serving starts
+        # are warmup, compiles after steady state are regressions
+        from ..utils import profiler as _profiler
+        _profiler.PROFILER.start()
+        jaxwatch.arm()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="serve-scheduler")
         self._thread.start()

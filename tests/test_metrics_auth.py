@@ -277,7 +277,8 @@ def test_leases_are_namespace_scoped(rbac_clients):
 #: one must sit behind the SAME token filter
 DEBUG_PATHS = ("/debug", "/debug/flight", "/debug/health",
                "/debug/serve", "/debug/serve/ledger",
-               "/debug/serve/headroom", "/debug/fleet")
+               "/debug/serve/headroom", "/debug/fleet",
+               "/debug/profile")
 
 
 @pytest.fixture
@@ -294,6 +295,7 @@ def debug_server():
             "/debug/serve/ledger": lambda: {"ok": "ledger"},
             "/debug/serve/headroom": lambda: {"ok": "headroom"},
             "/debug/fleet": lambda: {"ok": "fleet"},
+            "/debug/profile": lambda: {"ok": "profile"},
         })
     ms.start()
     yield ms

@@ -1,0 +1,579 @@
+"""Runtime performance plane gate (`make profile-check`).
+
+Three layers under one marker:
+
+1. the sampling profiler (utils/profiler.py) — folded output
+   byte-deterministic under injected frame/clock/trigger sources,
+   self/total semantics, bounded-table drop accounting, and the
+   self-metered overhead bound (< 2%) on a genuinely busy scheduler
+   loop;
+2. the jit compile watch (workloads/jaxwatch.py) — cache-delta compile
+   detection, the warm-then-armed retrace sentinel (counter + Warning
+   Event + kind=compile flight entries), and ledger re-billing of
+   compile wall time with reconciliation still exact, including the
+   seeded shape-unstable-executor e2e;
+3. the attribution/fleet layer — `tpuctl serve why` verdicts,
+   `tpuctl profile` rendering, the telemetry digest's damped
+   serving/perf dims, the FleetAggregator rollup + gauges, and the
+   bench trend tool.
+
+Everything runs on injected clocks — no wall-clock sleep drives an
+assertion (the busy-loop overhead test measures real perf_counter
+time, which is the quantity under test, not a synchronization sleep).
+"""
+
+import importlib.util
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from dpu_operator_tpu import tpuctl
+from dpu_operator_tpu.api.types import TELEMETRY_SCHEMA_VERSION
+from dpu_operator_tpu.controller.fleet_telemetry import FleetAggregator
+from dpu_operator_tpu.daemon.telemetry import TelemetryPublisher
+from dpu_operator_tpu.k8s import FakeKube, events
+from dpu_operator_tpu.utils import flight, metrics, profiler
+from dpu_operator_tpu.workloads import jaxwatch, serve
+
+pytestmark = pytest.mark.profile
+
+
+@pytest.fixture(autouse=True)
+def _clean_jaxwatch():
+    """Every test leaves the compile watch disarmed, on the real
+    clock, with zeroed counters and no pending ledger seconds."""
+    yield
+    jaxwatch.reset()
+
+
+class Clock:
+    """Injected clock (the chaos-harness idiom): advance() moves time
+    explicitly, so compile costs replay bit-identically."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- profiler: fabricated frame chains ----------------------------------------
+
+
+class FakeCode:
+    def __init__(self, filename, name):
+        self.co_filename = filename
+        self.co_name = name
+
+
+class FakeFrame:
+    def __init__(self, filename, funcname, back=None):
+        self.f_code = FakeCode(filename, funcname)
+        self.f_back = back
+
+
+def chain(*sites):
+    """Build a frame chain from root-first (file, fn) pairs; returns
+    the LEAF frame (what sys._current_frames yields)."""
+    frame = None
+    for filename, funcname in sites:
+        frame = FakeFrame(filename, funcname, frame)
+    return frame
+
+
+def _profiler(frames, names, **kw):
+    clock = Clock()
+    p = profiler.SamplingProfiler(
+        clock=clock, frames_fn=lambda: frames,
+        threads_fn=lambda: names, **kw)
+    return p, clock
+
+
+def test_folded_output_is_byte_deterministic():
+    frames = {
+        1: chain(("/a/sched.py", "run"), ("/a/sched.py", "step"),
+                 ("/a/pool.py", "alloc")),
+        2: chain(("/b/informer.py", "loop"), ("/b/informer.py", "poll")),
+    }
+    names = {1: "decode-service", 2: "informer"}
+
+    def run():
+        p, _ = _profiler(frames, names)
+        for _ in range(5):
+            assert p.sample_once() == 2
+        return p.folded()
+
+    a, b = run(), run()
+    assert a == b
+    assert a == ("decode-service;sched.py:run;sched.py:step;"
+                 "pool.py:alloc 5\n"
+                 "informer;informer.py:loop;informer.py:poll 5")
+
+
+def test_self_total_semantics_and_recursion_counted_once():
+    # recursive chain: step appears twice, but its TOTAL must count
+    # once per sample; only the leaf (alloc) earns SELF
+    frames = {7: chain(("/a/s.py", "step"), ("/a/s.py", "retry"),
+                       ("/a/s.py", "step"), ("/a/p.py", "alloc"))}
+    p, _ = _profiler(frames, {7: "worker"})
+    for _ in range(4):
+        p.sample_once()
+    rows = {r["site"]: r for r in p.snapshot()["threads"]["worker"]}
+    assert rows["p.py:alloc"]["self"] == 4
+    assert rows["p.py:alloc"]["total"] == 4
+    assert rows["s.py:step"]["self"] == 0
+    assert rows["s.py:step"]["total"] == 4  # not 8
+
+
+def test_bounded_tables_drop_instead_of_growing():
+    p, _ = _profiler({}, {}, max_stacks=2, max_sites=2)
+    dropped_before = metrics.PROFILE_DROPPED.total()
+    for i in range(4):
+        p.frames_fn = lambda i=i: {
+            1: chain(("/x.py", f"fn{i}"), ("/x.py", f"leaf{i}"))}
+        p.sample_once()
+    snap = p.snapshot()
+    assert len(snap["folded"].splitlines()) == 2
+    assert len(snap["threads"]["thread-1"]) == 2
+    assert snap["dropped"] > 0
+    assert metrics.PROFILE_DROPPED.total() > dropped_before
+
+
+def test_sampler_excludes_its_own_thread_and_never_raises():
+    own = threading.get_ident()
+    frames = {own: chain(("/me.py", "sampling")),
+              5: chain(("/w.py", "work"))}
+    p, _ = _profiler(frames, {own: "main", 5: "w"})
+    assert p.sample_once() == 1
+    assert "me.py:sampling" not in p.folded()
+    p.frames_fn = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    assert p.sample_once() == 0  # swallowed, never raised
+
+
+def test_top_sites_quantized_for_the_damped_digest():
+    frames = {1: chain(("/a.py", "hot")), 2: chain(("/b.py", "cold"))}
+    p, _ = _profiler(frames, {1: "t1", 2: "t2"})
+    for _ in range(10):
+        p.sample_once()
+    top = p.top_sites(2)
+    assert [r["site"] for r in top] == ["a.py:hot", "b.py:cold"]
+    # 10/20 samples each -> 0.5 exactly on the 0.05 grid
+    assert all(r["selfFraction"] == 0.5 for r in top)
+
+
+def test_overhead_stays_under_two_percent_on_a_busy_scheduler():
+    """The acceptance bound: sampling a genuinely busy scheduler loop
+    (real frames, real perf_counter) every ~50 iterations must keep
+    the profiler's self-metered overhead ratio below 0.02."""
+    cfg = serve.ServeConfig(slots=4, kv_blocks=64, kv_block_size=16,
+                            queue_limit=1024, ttft_bound_s=10.0)
+    sched = serve.Scheduler(cfg)
+    for i in range(60):
+        sched.submit(serve.Request(rid=f"busy{i}", prompt_len=16,
+                                   output_len=48,
+                                   slo_class=serve.BATCH,
+                                   arrival_s=0.0))
+    p = profiler.SamplingProfiler()  # real clock + frames
+    worker = threading.Thread(target=sched.run, name="serve-busy",
+                              daemon=True)
+    worker.start()
+    last = 0
+    while worker.is_alive():
+        it = sched.iterations
+        if it - last >= 50:
+            last = it
+            p.sample_once()
+    worker.join(timeout=30)
+    assert not worker.is_alive()
+    snap = p.snapshot()
+    assert snap["overheadRatio"] < 0.02, snap
+    # the busy thread attributed by NAME, not ident
+    assert any(name == "serve-busy" for name in snap["threads"])
+
+
+def test_debug_profile_handler_merges_jax_counters():
+    payload = profiler.debug_handler()
+    assert {"running", "samples", "folded", "overheadRatio",
+            "jax"} <= set(payload)
+    assert {"armed", "compiles", "retraces", "perFn"} \
+        <= set(payload["jax"])
+
+
+# -- jaxwatch: compile detection, warmth, retrace -----------------------------
+
+
+class FakeArray:
+    def __init__(self, shape, dtype="float32"):
+        self.shape = shape
+        self.dtype = dtype
+
+
+class FakeJit:
+    """Stand-in for a jitted fn: a new (shape, dtype) signature grows
+    the trace cache and costs *compile_cost* on the shared clock —
+    exactly the observable surface CompiledFnWatch probes."""
+
+    def __init__(self, clock, compile_cost):
+        self.clock = clock
+        self.compile_cost = compile_cost
+        self.seen = set()
+
+    def _cache_size(self):
+        return len(self.seen)
+
+    def __call__(self, x):
+        sig = (tuple(x.shape), str(x.dtype))
+        if sig not in self.seen:
+            self.clock.advance(self.compile_cost)
+            self.seen.add(sig)
+        return x
+
+
+def test_compile_watch_cache_delta_warmth_and_retrace(kube):
+    clock = Clock()
+    jaxwatch.reset(clock=clock)
+    name = "unit_watch_fn"
+    w = jaxwatch.watch(name, FakeJit(clock, 0.25))
+    compiles0 = metrics.JAX_COMPILES.value(fn=name)
+    retraces0 = metrics.JAX_RETRACES.value(fn=name)
+
+    w(FakeArray((2, 4)))  # first shape: compile, disarmed
+    assert (w.compiles, w.retraces, w.warmed) == (1, 0, False)
+    assert jaxwatch.drain_compile_seconds() == pytest.approx(0.25)
+    assert jaxwatch.drain_compile_seconds() == 0.0  # drained
+
+    w(FakeArray((2, 4)))  # cache hit proves steady state
+    assert w.warmed and w.compiles == 1
+
+    jaxwatch.arm()
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("tpu-vm-0"))
+    try:
+        w(FakeArray((2, 8)))  # armed + warm: THE retrace
+        events.flush()
+    finally:
+        events.reset()
+    assert (w.compiles, w.retraces) == (2, 1)
+    assert metrics.JAX_COMPILES.value(fn=name) - compiles0 == 2
+    assert metrics.JAX_RETRACES.value(fn=name) - retraces0 == 1
+    evs = [e for e in kube.list("v1", "Event")
+           if e.get("reason") == "RetraceDetected"]
+    assert len(evs) == 1
+    assert name in evs[0]["message"]
+    assert "float32[2,8]" in evs[0]["message"]
+    # compile flight entries carry the abstract signature
+    ours = [e for e in flight.RECORDER.events(kind="compile")
+            if (e.get("attributes") or {}).get("fn") == name]
+    assert [e["attributes"]["retrace"] for e in ours] \
+        == ["false", "true"]
+    assert ours[0]["attributes"]["signature"] == "(float32[2,4])"
+    assert ours[1]["duration_s"] == pytest.approx(0.25)
+
+
+def test_watch_is_transparent_and_signature_truncates():
+    clock = Clock()
+    jaxwatch.reset(clock=clock)
+    w = jaxwatch.watch("unit_proxy_fn", FakeJit(clock, 0.1))
+    assert w._cache_size() == 0
+    assert w.seen == set()  # attribute proxy into the wrapped fn
+    sig = jaxwatch.abstract_signature(
+        (FakeArray((2, 4)), 3), {"flag": True})
+    assert sig == "(float32[2,4], int:3, bool:True)"
+    many = jaxwatch.abstract_signature(
+        tuple(FakeArray((1, i + 1)) for i in range(15)), {})
+    assert many.endswith(",+3)")
+
+
+def _watched_run(kube, name, flip_at):
+    """The seeded e2e body: a real-clock Scheduler whose executor
+    drives a watched fake-jit fn each decode step; *flip_at* switches
+    the input shape once mid-run (None = steady state)."""
+    clock = Clock()
+    jaxwatch.reset(clock=clock)
+    w = jaxwatch.watch(name, FakeJit(clock, 0.05))
+
+    class ShapeUnstableExecutor(serve.SimExecutor):
+        def __init__(self):
+            self.calls = 0
+
+        def step(self, active):
+            self.calls += 1
+            width = 16 if self.calls == flip_at else 8
+            w(FakeArray((1, width)))
+            return super().step(active)
+
+    # the serving shell's startup sequence: warm on the working shape,
+    # arm the sentinel, drain warmup compile cost out of the pot
+    w(FakeArray((1, 8)))
+    w(FakeArray((1, 8)))
+    assert w.warmed
+    jaxwatch.arm()
+    jaxwatch.drain_compile_seconds()
+
+    cfg = serve.ServeConfig(slots=2, kv_blocks=32, kv_block_size=16,
+                            queue_limit=64, ttft_bound_s=10.0)
+    sched = serve.Scheduler(cfg, executor=ShapeUnstableExecutor(),
+                            clock=clock)
+    events.configure(events.EventRecorder(kube, "tpu-daemon"),
+                     events.node_reference("tpu-vm-0"))
+    try:
+        for i in range(2):
+            sched.submit(serve.Request(rid=f"r{i}", prompt_len=8,
+                                       output_len=6, arrival_s=0.0))
+        assert sched.run(max_steps=10_000) < 10_000
+        events.flush()
+    finally:
+        events.reset()
+    assert len(sched.completed) == 2
+    return sched, w
+
+
+def test_e2e_shape_unstable_executor_fires_exactly_one_retrace(kube):
+    retraces0 = metrics.JAX_RETRACES.value(fn="e2e_unstable")
+    sched, w = _watched_run(kube, "e2e_unstable", flip_at=3)
+    assert w.retraces == 1
+    assert metrics.JAX_RETRACES.value(fn="e2e_unstable") \
+        - retraces0 == 1
+    evs = [e for e in kube.list("v1", "Event")
+           if e.get("reason") == "RetraceDetected"]
+    assert len(evs) == 1 and "e2e_unstable" in evs[0]["message"]
+    ours = [e for e in flight.RECORDER.events(kind="compile")
+            if (e.get("attributes") or {}).get("fn") == "e2e_unstable"]
+    # the warmup compile plus the mid-run retrace, nothing else
+    assert [e["attributes"]["retrace"] for e in ours] \
+        == ["false", "true"]
+    compile_s = sum((e["phases"] or {}).get("compile", 0.0)
+                    for e in sched.ledger.entries())
+    assert compile_s == pytest.approx(0.05)
+    # re-billing kept the ledger exact: phase sums still reconcile
+    verdict = sched.ledger.reconcile()
+    assert verdict["ok"], verdict
+
+
+def test_e2e_steady_state_run_produces_zero_retrace_signals(kube):
+    retraces0 = metrics.JAX_RETRACES.value(fn="e2e_steady")
+    sched, w = _watched_run(kube, "e2e_steady", flip_at=None)
+    assert w.retraces == 0
+    assert metrics.JAX_RETRACES.value(fn="e2e_steady") == retraces0
+    assert not [e for e in kube.list("v1", "Event")
+                if e.get("reason") == "RetraceDetected"]
+    ours = [e for e in flight.RECORDER.events(kind="compile")
+            if (e.get("attributes") or {}).get("fn") == "e2e_steady"]
+    assert [e["attributes"]["retrace"] for e in ours] == ["false"]
+    assert sum((e["phases"] or {}).get("compile", 0.0)
+               for e in sched.ledger.entries()) == 0.0
+    assert sched.ledger.reconcile()["ok"]
+
+
+# -- tpuctl: serve why + profile rendering ------------------------------------
+
+
+def _span(name, rid, start, dur):
+    return {"kind": "serve", "name": name, "duration_s": dur,
+            "attributes": {"rid": rid, "start_s": start}}
+
+
+def _mark(name, rid, **attrs):
+    return {"kind": "serve", "name": name,
+            "attributes": {"rid": rid, **attrs}}
+
+
+def test_serve_why_not_found():
+    out = tpuctl.render_serve_why([], "ghost")
+    assert out["found"] is False and out["verdict"] == "unknown"
+
+
+@pytest.mark.parametrize("events_fn,expected", [
+    (lambda: [_span("serve.decode", "r", 0.0, 1.0),
+              _mark("DeadlineExceeded", "r")], "deadline"),
+    (lambda: [_span("serve.decode", "r", 0.0, 1.0),
+              _mark("RetryScheduled", "r"), _mark("RetryScheduled", "r")],
+     "executor-faults"),
+    (lambda: [_span("serve.decode", "r", 0.0, 1.0),
+              _mark("Preempted", "r"), _mark("Preempted", "r")],
+     "preempt-thrash"),
+    (lambda: [_span("serve.cow", "r", 0.0, 0.4),
+              _span("serve.decode", "r", 0.4, 0.6)], "cow-stall"),
+    (lambda: [_span("serve.queued", "r", 0.0, 0.7),
+              _span("serve.decode", "r", 0.7, 0.3)], "queue-bound"),
+    (lambda: [_span("serve.prefill_chunk", "r", 0.0, 0.6),
+              _span("serve.decode", "r", 0.6, 0.4)], "prefill-bound"),
+    (lambda: [_span("serve.decode", "r", 0.0, 1.0)], "decode-bound"),
+])
+def test_serve_why_verdict_ladder(events_fn, expected):
+    out = tpuctl.render_serve_why(events_fn(), "r")
+    assert out["verdict"] == expected, out["line"]
+    assert out["line"].startswith(f"r: {expected}")
+
+
+def test_serve_why_retrace_coincident_and_rung():
+    evs = [_span("serve.decode", "r", 0.0, 1.0),
+           {"kind": "compile", "name": "decode_step",
+            "attributes": {"fn": "decode_step", "retrace": "true"}}]
+    ledger = {"entries": [{"phases": {"compile": 0.31, "decode": 0.7}}]}
+    snap = {"degraded": {"rung": 2, "name": "no_spec"}}
+    out = tpuctl.render_serve_why(evs, "r", ledger=ledger,
+                                  snapshot=snap)
+    assert out["verdict"] == "retrace-coincident"
+    assert out["retraceCompiles"] == 1
+    assert out["compileLedgerSeconds"] == pytest.approx(0.31)
+    assert out["degradedRung"] == "no_spec"
+    assert "rung no_spec" in out["line"]
+    # without the ledger compile evidence the same events fall through
+    no_ledger = tpuctl.render_serve_why(evs, "r")
+    assert no_ledger["verdict"] == "decode-bound"
+
+
+def test_render_profile_summary_and_folded():
+    snap = {"running": True, "samples": 9, "dropped": 0,
+            "overheadRatio": 0.004, "trackedSites": 3,
+            "threads": {"w": [{"site": f"s{i}", "self": i, "total": i}
+                              for i in range(8)]},
+            "folded": "w;a.py:f 9",
+            "jax": {"armed": True, "compiles": 1, "retraces": 0,
+                    "perFn": {}}}
+    out = tpuctl.render_profile(snap)
+    assert out["reachable"] and out["samples"] == 9
+    assert len(out["threads"]["w"]) == 5  # summary caps rows
+    assert out["jax"]["compiles"] == 1
+    folded = tpuctl.render_profile(snap, folded=True)
+    assert folded == {"format": "folded", "folded": "w;a.py:f 9"}
+
+
+def test_fleet_top_carries_serving_and_perf():
+    out = tpuctl.render_fleet_top({
+        "nodes": {"total": 1, "fresh": 1, "stale": 0},
+        "serving": {"degradedRungs": {"no_spec": 1}},
+        "perf": {"jaxRetraces": 2, "retraceNodes": ["n0"]}})
+    assert out["serving"]["degradedRungs"] == {"no_spec": 1}
+    assert out["perf"]["retraceNodes"] == ["n0"]
+
+
+# -- telemetry digest: damped serving/perf dims -------------------------------
+
+
+def test_digest_serving_and_perf_dims_are_damped(kube):
+    state = {"acc": 0.62, "retraces": 0, "samples": 100}
+    clock = Clock()
+    pub = TelemetryPublisher(
+        kube, "tpu-vm-0",
+        serving_fn=lambda: {"degradedRung": 0,
+                            "degradedRungName": "healthy",
+                            "specKMax": 4,
+                            "specAcceptanceRate": state["acc"]},
+        perf_fn=lambda: {"topSites": [], "samples": state["samples"],
+                         "overheadRatio": 0.001, "jaxCompiles": 3,
+                         "jaxRetraces": state["retraces"]},
+        clock=clock, wall=clock)
+    digest = pub.build_digest()
+    assert digest["serving"]["specAcceptanceRate"] == 0.62
+    assert digest["perf"]["jaxRetraces"] == 0
+
+    assert pub.tick() is True  # first publish always lands
+    clock.advance(6.0)
+    state["acc"] = 0.64        # inside the 0.05 deadband
+    state["samples"] += 500    # infinite band: never material
+    assert pub.tick() is False
+    clock.advance(6.0)
+    state["retraces"] = 1      # a retrace IS material
+    assert pub.tick() is True
+
+
+# -- fleet aggregator: rollup + gauges ----------------------------------------
+
+
+def _digest_obj(node, seq, rung, acc, compiles, retraces):
+    return {"metadata": {"name": node},
+            "status": {"schemaVersion": TELEMETRY_SCHEMA_VERSION,
+                       "node": node, "sequence": seq,
+                       "serving": {"degradedRung": 0,
+                                   "degradedRungName": rung,
+                                   "specAcceptanceRate": acc},
+                       "perf": {"jaxCompiles": compiles,
+                                "jaxRetraces": retraces}}}
+
+
+def test_fleet_rollup_serving_perf_and_zero_on_vanish(kube):
+    clock = Clock()
+    agg = FleetAggregator(kube, factory=None, clock=clock)
+    assert agg.ingest(_digest_obj("n0", 1, "no_spec", 0.5, 10, 2))
+    assert agg.ingest(_digest_obj("n1", 1, "healthy", 0.7, 4, 0))
+    roll = agg.rollup()
+    assert roll["serving"]["degradedRungs"] \
+        == {"no_spec": 1, "healthy": 1}
+    assert roll["serving"]["specAcceptanceRate"] \
+        == pytest.approx(0.6)
+    assert roll["perf"] == {"jaxCompiles": 14, "jaxRetraces": 2,
+                            "retraceNodes": ["n0"]}
+    assert roll["perNode"]["n0"]["degradedRung"] == "no_spec"
+    assert roll["perNode"]["n0"]["jaxRetraces"] == 2
+    with agg._lock:
+        agg._export_locked()
+    assert metrics.FLEET_JAX_COMPILES.value() == 14.0
+    assert metrics.FLEET_JAX_RETRACES.value() == 2.0
+    assert metrics.FLEET_SPEC_ACCEPTANCE.value() \
+        == pytest.approx(0.6)
+    assert metrics.FLEET_DEGRADED_NODES.value(rung="no_spec") == 1.0
+    # n0 climbs back to healthy: the vacated rung must read 0
+    assert agg.ingest(_digest_obj("n0", 2, "healthy", 0.5, 10, 2))
+    with agg._lock:
+        agg._export_locked()
+    assert metrics.FLEET_DEGRADED_NODES.value(rung="no_spec") == 0.0
+    assert metrics.FLEET_DEGRADED_NODES.value(rung="healthy") == 2.0
+
+
+# -- bench trend --------------------------------------------------------------
+
+
+def _bench_trend():
+    path = Path(__file__).resolve().parent.parent / "tools" \
+        / "bench_trend.py"
+    spec = importlib.util.spec_from_file_location("bench_trend", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_trend_directions_and_judgment():
+    bt = _bench_trend()
+    assert bt.direction("tokens_per_s") == 1
+    assert bt.direction("decode_tok_s_b1") == 1
+    assert bt.direction("serve_ttft_p99_improvement_0.8") == 1
+    assert bt.direction("ttft_p99_s") == -1
+    assert bt.direction("train_step_ms") == -1
+    assert bt.direction("serve.loads.1.1.preemptions") == -1
+    assert bt.direction("kv_occupancy_mean") == 0
+    flat = bt.flatten_numeric({"a": {"b": 1, "ok": True}, "c": 2.5,
+                               "name": "cpu"})
+    assert flat == {"a.b": 1.0, "c": 2.5}
+    # last vs median-of-prior, direction-aware, noise-banded
+    assert bt.judge([100.0, 102.0, 50.0], 1, 0.10)[0] == "regressed"
+    assert bt.judge([1.0, 1.0, 0.5], -1, 0.10)[0] == "improved"
+    assert bt.judge([1.0, 1.04], -1, 0.10)[0] == "steady"
+    assert bt.judge([5.0, 50.0], 0, 0.10)[0] == "changed"
+    assert bt.judge([5.0], 1, 0.10)[0] == "single"
+
+
+def test_bench_trend_end_to_end_strict_exit(tmp_path, capsys):
+    bt = _bench_trend()
+    rounds = [
+        (1, 0, {"tokens_per_s": 100.0, "ttft_p99_s": 1.0}),
+        (2, 0, {"tokens_per_s": 101.0, "ttft_p99_s": 1.01}),
+        (3, 1, {"tokens_per_s": 1.0}),  # rc!=0: skipped, not counted
+        (4, 0, {"tokens_per_s": 50.0, "ttft_p99_s": 0.5}),
+    ]
+    for n, rc, parsed in rounds:
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(
+            {"n": n, "cmd": "bench", "rc": rc, "tail": "",
+             "parsed": parsed}))
+    assert bt.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "3 rounds" in out
+    assert "regressed (1):" in out and "tokens_per_s" in out
+    assert bt.main(["--dir", str(tmp_path), "--strict"]) == 1
+    assert bt.main(["--dir", str(tmp_path / "empty")]) == 2

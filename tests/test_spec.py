@@ -516,7 +516,7 @@ def test_spec_verify_phase_lands_in_ledger():
     sched.submit_all(serve.open_loop_arrivals(SEED, 6.0, 6.0))
     sched.run()
     assert set(serve.LEDGER_PHASES) == {"prefill", "decode", "verify",
-                                        "cow", "sched"}
+                                        "cow", "sched", "compile"}
     verify_s = sum(e["phases"]["verify"]
                    for e in sched.ledger.entries())
     assert verify_s > 0.0

@@ -1,0 +1,208 @@
+#!/usr/bin/env python3
+"""Bench trajectory: parse the checked-in BENCH_r*.json rounds into a
+per-metric trend table with direction-aware regression flags.
+
+Each PR's bench run leaves a ``BENCH_rNN.json`` behind (``n``, ``cmd``,
+``rc``, ``tail``, ``parsed``). Individually they answer "how fast is it
+now"; this tool lines them up so `make bench-trend` answers "which
+metrics drifted, and which way". The parsed payload is flattened
+(nested dicts join with '.'), every numeric leaf becomes a series over
+rounds, and the LAST round is judged against the median of the earlier
+rounds it appeared in:
+
+- a metric whose name says which way is good (tokens_per_s up,
+  ttft_p99_s down) gets a verdict — ``regressed`` / ``improved`` when
+  the relative delta clears the noise band, ``steady`` inside it;
+- a metric with no recognizable direction is reported neutrally
+  (``changed``/``steady``) and never fails ``--strict``.
+
+The band defaults to 10% because these are single-shot CI-box runs,
+not pinned-hardware benchmarks; tune with ``--band``. Output ordering
+is fully deterministic (sorted metric names, fixed column widths) so
+diffs of the table itself are meaningful.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import statistics
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+BENCH_GLOB = "BENCH_r*.json"
+
+#: tokens that settle the direction outright (a ttft IMPROVEMENT is
+#: higher-better even though ttft itself is a latency)
+_STRONG_HIGHER = {"improvement", "speedup", "acceptance", "accepted",
+                  "mfu", "throughput"}
+#: name tokens that mark a metric as lower-is-better (latencies and
+#: loss/waste counters)
+_LOWER_TOKENS = {
+    "ms", "s", "p50", "p95", "p99", "ttft", "itl", "latency", "rtt",
+    "leaked", "discarded", "rejected", "preemptions", "copies",
+    "opened", "stalls", "dropped", "retraces",
+}
+#: name tokens that mark a metric as higher-is-better
+_HIGHER_TOKENS = {
+    "rate", "tokens", "tflops", "peak", "completed", "hits", "shared",
+    "reconciles", "cut", "ratio",
+}
+
+
+def _tokens(metric: str) -> List[str]:
+    # throughput suffixes (tok_s, tokens_per_s, reconciles_per_s) are
+    # rates, not durations — collapse them BEFORE 's' can read as a
+    # seconds suffix
+    name = re.sub(r"tok(ens)?_s|per_s", "rate", metric.lower())
+    return [t for t in re.split(r"[^a-z0-9]+", name) if t]
+
+
+def direction(metric: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown."""
+    toks = _tokens(metric)
+    if any(t in _STRONG_HIGHER for t in toks):
+        return +1
+    if any(t in _LOWER_TOKENS for t in toks):
+        return -1
+    if any(t in _HIGHER_TOKENS for t in toks):
+        return +1
+    return 0
+
+
+def flatten_numeric(value: object, prefix: str = "",
+                    out: Optional[Dict[str, float]] = None,
+                    ) -> Dict[str, float]:
+    """Numeric leaves of a nested dict, '.'-joined paths; bools and
+    strings are skipped (device names, flags are not trajectories)."""
+    if out is None:
+        out = {}
+    if isinstance(value, dict):
+        for key in sorted(value):
+            path = f"{prefix}.{key}" if prefix else str(key)
+            flatten_numeric(value[key], path, out)
+    elif isinstance(value, bool):
+        pass
+    elif isinstance(value, (int, float)):
+        out[prefix] = float(value)
+    return out
+
+
+def load_rounds(root: Path) -> List[Tuple[int, Dict[str, float]]]:
+    """(round, flat-metrics) per bench file, ordered by round number.
+    A file that fails to parse or whose run failed (rc != 0) is
+    reported on stderr and skipped — a broken round must not poison
+    the trend math for the rounds that did run."""
+    rounds: List[Tuple[int, Dict[str, float]]] = []
+    for path in sorted(root.glob(BENCH_GLOB)):
+        match = re.search(r"BENCH_r(\d+)\.json$", path.name)
+        if not match:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            print(f"bench-trend: skipping {path.name}: {e}",
+                  file=sys.stderr)
+            continue
+        if doc.get("rc", 0) != 0:
+            print(f"bench-trend: skipping {path.name}: bench rc="
+                  f"{doc.get('rc')}", file=sys.stderr)
+            continue
+        n = int(doc.get("n") or match.group(1))
+        rounds.append((n, flatten_numeric(doc.get("parsed") or {})))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def build_series(rounds: List[Tuple[int, Dict[str, float]]],
+                 ) -> Dict[str, List[Tuple[int, float]]]:
+    series: Dict[str, List[Tuple[int, float]]] = {}
+    for n, flat in rounds:
+        for metric, value in flat.items():
+            series.setdefault(metric, []).append((n, value))
+    return series
+
+
+def judge(values: List[float], sign: int, band: float) -> Tuple[str, float]:
+    """(verdict, relative delta of last vs median-of-prior)."""
+    if len(values) < 2:
+        return "single", 0.0
+    ref = statistics.median(values[:-1])
+    last = values[-1]
+    if ref == 0.0:
+        delta = 0.0 if last == 0.0 else float("inf")
+    else:
+        delta = (last - ref) / abs(ref)
+    if abs(delta) < band:
+        return "steady", delta
+    if sign == 0:
+        return "changed", delta
+    good = delta * sign > 0
+    return ("improved" if good else "regressed"), delta
+
+
+def render(series: Dict[str, List[Tuple[int, float]]], band: float,
+           ) -> Tuple[List[str], List[str]]:
+    """(table lines, regressed metric names), both sorted."""
+    lines = [f"{'metric':<56} {'dir':>4} {'rounds':>6} "
+             f"{'first':>12} {'last':>12} {'delta':>8}  verdict"]
+    regressed: List[str] = []
+    for metric in sorted(series):
+        points = series[metric]
+        values = [v for _, v in points]
+        sign = direction(metric)
+        verdict, delta = judge(values, sign, band)
+        if verdict == "regressed":
+            regressed.append(metric)
+        arrow = {1: "up", -1: "down", 0: "?"}[sign]
+        delta_s = ("-" if verdict == "single"
+                   else f"{delta:+.1%}" if abs(delta) != float("inf")
+                   else "inf")
+        lines.append(
+            f"{metric:<56} {arrow:>4} {len(points):>6} "
+            f"{values[0]:>12.6g} {values[-1]:>12.6g} {delta_s:>8}  "
+            f"{verdict}")
+    return lines, regressed
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_trend",
+        description="per-metric trajectory over the checked-in "
+                    "BENCH_r*.json rounds, with noise-banded "
+                    "regression flags")
+    parser.add_argument("--dir", default=str(Path(__file__)
+                                             .resolve().parent.parent),
+                        help="directory holding BENCH_r*.json "
+                             "(default: repo root)")
+    parser.add_argument("--band", type=float, default=0.10,
+                        help="relative noise band (default 0.10)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 when any direction-known metric "
+                             "regressed beyond the band")
+    args = parser.parse_args(argv)
+    rounds = load_rounds(Path(args.dir))
+    if not rounds:
+        print("bench-trend: no BENCH_r*.json rounds found",
+              file=sys.stderr)
+        return 2
+    series = build_series(rounds)
+    lines, regressed = render(series, args.band)
+    print(f"bench-trend: {len(rounds)} rounds "
+          f"(r{rounds[0][0]:02d}..r{rounds[-1][0]:02d}), "
+          f"{len(series)} metrics, band {args.band:.0%}")
+    for line in lines:
+        print(line)
+    if regressed:
+        print(f"\nregressed ({len(regressed)}):")
+        for metric in regressed:
+            print(f"  {metric}")
+    if args.strict and regressed:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
