@@ -5,7 +5,7 @@ TAG ?= latest
 
 .PHONY: test fast-test collect-check chaos-check obs-check health-check \
         upgrade-check fault-check scale-check serve-check \
-        serve-chaos-check profile-check lint-check \
+        serve-chaos-check profile-check history-check lint-check \
         fuzz-check fleet-obs-check bench-trend \
         race-check type-check bench native traffic-flow images \
         smoke-images deploy undeploy graft-check clean
@@ -155,6 +155,25 @@ serve-chaos-check:
 # run produces zero retrace signals. Injected clocks, no wall sleeps.
 profile-check:
 	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m profile \
+	  -p no:randomly -p no:cacheprovider
+
+# metrics history plane gate (doc/observability.md "Metrics history
+# plane"): the bounded in-process TSDB and the trend engine on top of
+# it — rings stay inside their hard caps under a 10k-sample storm with
+# evictions counted; raw->10s->2m downsampling is EXACT on a seeded
+# series; two seeded runs serialize byte-identical /debug/history
+# snapshots; counter families store exact windowed rates and histogram
+# families exact interpolated quantiles; the shared metric-direction
+# vocabulary judges identically in bench-trend and the live engine; a
+# seeded chunk-backlog-growth scenario fires EXACTLY one TrendAnomaly
+# (Event + kind=trend flight entry + gauge) that clears through
+# hold-down hysteresis while a steady twin fires none; the digest's
+# trends block damps (verdict changes publish immediately, slope
+# jitter rides heartbeats, counted apiserver writes); and the fleet
+# rollup reflects a node's verdict end-to-end through a real digest
+# publish. Injected clocks, no wall sleeps.
+history-check:
+	env PYTHONHASHSEED=0 $(PYTHON) -m pytest tests/ -q -m history \
 	  -p no:randomly -p no:cacheprovider
 
 # fleet telemetry plane gate (doc/observability.md "Fleet telemetry

@@ -667,13 +667,16 @@ _ALLOWED_RANDOM = {"random.Random"}  # seedable constructor — the idiom
 #: markers whose tests promise bit-identical replay from a seed: the
 #: scripted-fault matrix (chaos), the hardware fault-domain storms
 #: (fault), the serve scheduler harness (serve — its open-loop
-#: arrival process must never silently use unseeded entropy) and the
+#: arrival process must never silently use unseeded entropy), the
 #: runtime performance plane gate (profile — folded profiler output
-#: is asserted byte-for-byte) share the invariant
+#: is asserted byte-for-byte) and the metrics history plane gate
+#: (history — /debug/history snapshots are asserted byte-identical
+#: across seeded runs) share the invariant
 _DETERMINISTIC_MARKS = ("pytest.mark.chaos", "pytest.mark.fault",
                         "pytest.mark.serve",
                         "pytest.mark.serve_chaos",
-                        "pytest.mark.profile")
+                        "pytest.mark.profile",
+                        "pytest.mark.history")
 
 
 def _is_deterministic_mark(target: Any) -> bool:

@@ -98,6 +98,7 @@ class FleetAggregator:
         self._exported_kinds: set = set()
         self._exported_slos: set = set()
         self._exported_rungs: set = set()
+        self._exported_trends: set = set()
         #: gauge-export debounce: a full rollup recompute per watch
         #: event would be O(nodes) work per event — O(nodes²) per
         #: convergence wave — under the lock; the gauges are a mirror,
@@ -351,11 +352,18 @@ class FleetAggregator:
         acc_rates: list[float] = []
         jax_compiles = jax_retraces = 0
         retrace_nodes: list[str] = []
+        trend_nodes = 0
+        trend_census: dict[str, int] = {}
+        backlog_slopes: list[float] = []
+        burn_slopes: list[float] = []
         for name, state in sorted(self._nodes.items()):
             digest = state.digest
             headroom = digest.get("headroom") or {}
             serving = digest.get("serving") or {}
             perf = digest.get("perf") or {}
+            trends = digest.get("trends") or {}
+            node_anoms = [str(a) for a in
+                          (trends.get("anomalies") or [])]
             adv = int(headroom.get("advertisableSlots") or 0)
             row = {
                 "sequence": state.sequence,
@@ -368,6 +376,7 @@ class FleetAggregator:
                 "degradedRung": str(
                     serving.get("degradedRungName") or ""),
                 "jaxRetraces": int(perf.get("jaxRetraces") or 0),
+                "trendAnomalies": node_anoms,
             }
             per_node[name] = row
             if state.stale:
@@ -392,6 +401,26 @@ class FleetAggregator:
             jax_retraces += node_retraces
             if node_retraces:
                 retrace_nodes.append(name)
+            # trend verdicts: census of anomalous series across fresh
+            # nodes, plus the fleet-mean relative slopes for the two
+            # series the autoscaler/router read (chunk backlog, burn)
+            if trends:
+                trend_nodes += 1
+                for series in node_anoms:
+                    key = metrics.bounded_label(series)
+                    trend_census[key] = trend_census.get(key, 0) + 1
+                for series, info in (trends.get("series")
+                                     or {}).items():
+                    try:
+                        slope = float(
+                            (info or {}).get("slope") or 0.0)
+                    except (TypeError, ValueError):
+                        continue
+                    if series == ("tpu_serve_prefill_"
+                                  "chunk_backlog_tokens"):
+                        backlog_slopes.append(slope)
+                    elif str(series).startswith("tpu_slo_burn_rate"):
+                        burn_slopes.append(slope)
             slots_total += int(headroom.get("slots") or 0)
             slots_free += int(headroom.get("freeSlots") or 0)
             slots_adv += adv
@@ -440,6 +469,17 @@ class FleetAggregator:
                 "jaxCompiles": jax_compiles,
                 "jaxRetraces": jax_retraces,
                 "retraceNodes": sorted(retrace_nodes),
+            },
+            "trends": {
+                "nodesReporting": trend_nodes,
+                "anomalies": {k: trend_census[k]
+                              for k in sorted(trend_census)},
+                "chunkBacklogSlope": round(
+                    sum(backlog_slopes) / len(backlog_slopes), 4)
+                if backlog_slopes else 0.0,
+                "burnRateSlope": round(
+                    sum(burn_slopes) / len(burn_slopes), 4)
+                if burn_slopes else 0.0,
             },
             "perNode": per_node,
         }
@@ -529,6 +569,18 @@ class FleetAggregator:
         for rung, count in degraded.items():
             metrics.FLEET_DEGRADED_NODES.set(float(count), rung=rung)
         self._exported_rungs = set(degraded)
+        trends = roll["trends"]
+        anomalies = trends["anomalies"]
+        for series in self._exported_trends - set(anomalies):
+            metrics.FLEET_TREND_ANOMALIES.set(0.0, series=series)
+        for series, count in anomalies.items():
+            metrics.FLEET_TREND_ANOMALIES.set(float(count),
+                                              series=series)
+        self._exported_trends = set(anomalies)
+        metrics.FLEET_TREND_BACKLOG_SLOPE.set(
+            float(trends["chunkBacklogSlope"]))
+        metrics.FLEET_TREND_BURN_SLOPE.set(
+            float(trends["burnRateSlope"]))
 
     # -- TpuOperatorConfig condition seam -------------------------------------
     def conditions(self) -> list[dict]:

@@ -71,6 +71,10 @@ DEFAULT_DEADBANDS: dict[str, float] = {
     "jaxCompiles": float("inf"),
     "overheadRatio": float("inf"),
     "specAcceptanceRate": 0.05,
+    # trend slopes jitter every evaluation; small wiggles ride the
+    # heartbeat. A VERDICT change (steady -> anomaly, the anomalies
+    # list) has no band and publishes immediately
+    "slope": 0.05,
 }
 
 
@@ -113,6 +117,8 @@ class TelemetryPublisher:
     - *serving_fn* — Scheduler.serving_summary() (degradation rung,
       speculative acceptance rate)
     - *perf_fn* — profiler top sites + jaxwatch compile/retrace counts
+    - *trends_fn* — TrendEngine.digest() (anomaly list + per-series
+      verdict/slope); None until something has been judged
     """
 
     def __init__(self, client: Any, node_name: str, *,
@@ -129,6 +135,8 @@ class TelemetryPublisher:
                  serving_fn: Optional[Callable[[], Optional[dict]]]
                  = None,
                  perf_fn: Optional[Callable[[], Optional[dict]]]
+                 = None,
+                 trends_fn: Optional[Callable[[], Optional[dict]]]
                  = None,
                  clock: Callable[[], float] = time.monotonic,
                  wall: Callable[[], float] = time.time,
@@ -149,6 +157,7 @@ class TelemetryPublisher:
         self.stalls_fn = stalls_fn
         self.serving_fn = serving_fn
         self.perf_fn = perf_fn
+        self.trends_fn = trends_fn
         self.clock = clock
         self.wall = wall
         self.heartbeat_interval = heartbeat_interval
@@ -188,7 +197,8 @@ class TelemetryPublisher:
                         ("health", self.health_fn),
                         ("sloCounters", self.counters_fn),
                         ("serving", self.serving_fn),
-                        ("perf", self.perf_fn)):
+                        ("perf", self.perf_fn),
+                        ("trends", self.trends_fn)):
             if fn is None:
                 continue
             try:
@@ -375,9 +385,10 @@ def default_publisher(client: Any, node_name: str, *,
     """Production wiring over the process-global health engine: the
     watchdog's degraded components, the global SLO evaluator's alerts
     and counters, and health_snapshot — plus whatever headroom/fault/
-    serving sources THIS process hosts. The perf source is always
-    wired: the sampling profiler and jaxwatch are process globals."""
-    from ..utils import profiler, slo
+    serving sources THIS process hosts. The perf and trend sources are
+    always wired: the sampling profiler, jaxwatch and the trend engine
+    are process globals."""
+    from ..utils import profiler, slo, trend
     from ..workloads import jaxwatch
 
     def perf() -> dict:
@@ -414,4 +425,5 @@ def default_publisher(client: Any, node_name: str, *,
         stalls_fn=watchdog.WATCHDOG.degraded_components,
         serving_fn=serving_fn,
         perf_fn=perf,
+        trends_fn=trend.TREND.digest,
     )

@@ -365,6 +365,9 @@ class _NodeSources:
         self.slo: dict = {"serve-ttft": {
             "total": float(rng.randrange(100, 1000)), "bad": 0.0,
             "objective": 0.99}}
+        #: TrendEngine.digest()-shaped block; empty = section omitted
+        #: from the digest (the old-snapshot graceful path)
+        self.trends: dict = {}
         self._hseq = 0
 
     def headroom(self) -> dict:
@@ -423,6 +426,8 @@ class TelemetryFleetHarness:
                 counters_fn=(lambda s=src: dict(s.slo)),
                 alerts_fn=(lambda s=src: list(s.alerts)),
                 stalls_fn=(lambda s=src: list(s.stalls)),
+                trends_fn=(lambda s=src: (dict(s.trends)
+                                          if s.trends else None)),
                 clock=clock, wall=clock,
                 heartbeat_interval=heartbeat_interval,
                 damp_interval=damp_interval)

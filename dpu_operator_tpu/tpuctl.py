@@ -149,6 +149,22 @@ def _vsp_cmds(sub):
                    help="bearer token when /debug/profile is "
                         "auth-filtered")
     p = sub.add_parser(
+        "history",
+        help="metrics history plane: render one family's bounded "
+             "time-series rings from /debug/history on --metrics-addr "
+             "as terminal sparklines (raw/10s/2m resolutions, trend "
+             "verdict per series); with no family, list the sampled "
+             "series and their judgments")
+    p.add_argument("family", nargs="?", default="",
+                   help="metric family or series name (prefix match "
+                        "picks up labeled/quantile sub-series)")
+    p.add_argument("--resolution", choices=["raw", "10s", "2m"],
+                   default="raw",
+                   help="which downsampling ring to render")
+    p.add_argument("--token", default="",
+                   help="bearer token when /debug/history is "
+                        "auth-filtered")
+    p = sub.add_parser(
         "fleet",
         help="fleet telemetry plane: 'top' renders the operator's "
              "cluster rollup from /debug/fleet on --operator-addr "
@@ -396,6 +412,16 @@ def render_serve_top(snapshot: dict, ledger: dict,
         "preemptionsPerIteration": round(preempt_rate, 4),
         "cowCopiesPerIteration": round(cow_rate, 4),
         "reconciliation": ledger.get("reconciliation"),
+        # ▲/▼/steady over the window, bench-trend judgment (last vs
+        # median of prior); short or absent windows read steady
+        "trendArrows": {
+            "chunkBacklog": _series_arrow(
+                [e.get("chunkBacklogTokens") for e in entries]),
+            "activeSlots": _series_arrow(
+                [e.get("activeSlots") for e in entries]),
+            "queuedRequests": _series_arrow(
+                [e.get("queuedRequests") for e in entries]),
+        },
         "entries": entries,
     }
     capacity = (snapshot.get("capacity") or {}) if snapshot else {}
@@ -550,11 +576,124 @@ def render_profile(snapshot: dict, folded: bool = False) -> dict:
     }
 
 
+#: eight-level sparkline alphabet, min-max scaled per series
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list) -> str:
+    """Terminal sparkline over *values*: min-max scaled into eight
+    block levels; a flat series renders all-low (no range to show)."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _BLOCKS[0] * len(values)
+    span = hi - lo
+    return "".join(
+        _BLOCKS[min(len(_BLOCKS) - 1,
+                    int((v - lo) / span * len(_BLOCKS)))]
+        for v in values)
+
+
+def _slope_arrow(slope: object, band: float = 0.01) -> str:
+    """▲ rising / ▼ falling / steady, over a relative slope; non-
+    numeric (old snapshots missing the trends block) reads steady."""
+    try:
+        s = float(slope)  # type: ignore[arg-type]
+    except (TypeError, ValueError):
+        return "steady"
+    if s > band:
+        return "▲"
+    if s < -band:
+        return "▼"
+    return "steady"
+
+
+def _series_arrow(values: list, band: float = 0.05) -> str:
+    """Arrow from raw values (bench-trend judgment: last vs median of
+    prior, relative to the prior's magnitude)."""
+    nums = []
+    for v in values:
+        try:
+            nums.append(float(v))
+        except (TypeError, ValueError):
+            continue
+    if len(nums) < 2:
+        return "steady"
+    import statistics
+    ref = statistics.median(nums[:-1])
+    if ref == 0.0:
+        return "▲" if nums[-1] > 0 else "steady"
+    return _slope_arrow((nums[-1] - ref) / abs(ref), band)
+
+
+def render_history(snapshot: dict, family: str = "",
+                   resolution: str = "raw") -> dict:
+    """The `tpuctl history` view over /debug/history: with no family,
+    the series listing (kind, point counts, trend verdict); with one,
+    every matching series (exact name or prefix — labeled/quantile
+    sub-series ride along) as a sparkline plus last/min/max at the
+    chosen resolution. Pure over the fetched payload."""
+    series = snapshot.get("series") or {}
+    trend_state = ((snapshot.get("trend") or {}).get("series")
+                   or {})
+    if not family:
+        listing = {}
+        for name in sorted(series):
+            info = series[name]
+            judged = trend_state.get(name) or {}
+            listing[name] = {
+                "kind": info.get("kind", ""),
+                "points": {res: len(info.get(res) or [])
+                           for res in ("raw", "10s", "2m")},
+                "verdict": judged.get("verdict", ""),
+            }
+        return {
+            "reachable": True,
+            "samples": snapshot.get("samples", 0),
+            "resolutions": snapshot.get("resolutions", {}),
+            "evicted": snapshot.get("evicted", {}),
+            "series": listing,
+            "anomalies": (snapshot.get("trend")
+                          or {}).get("anomalies", []),
+        }
+    matched = sorted(n for n in series
+                     if n == family or n.startswith(family + "."))
+    out_series = {}
+    for name in matched:
+        points = series[name].get(resolution) or []
+        values = [float(p[1]) for p in points]
+        judged = trend_state.get(name) or {}
+        row = {
+            "kind": series[name].get("kind", ""),
+            "points": len(values),
+            "sparkline": sparkline(values),
+            "trend": _series_arrow(values),
+            "verdict": judged.get("verdict", ""),
+            "relSlope": judged.get("relSlope"),
+        }
+        if values:
+            row["last"] = round(values[-1], 6)
+            row["min"] = round(min(values), 6)
+            row["max"] = round(max(values), 6)
+        out_series[name] = row
+    return {
+        "reachable": True,
+        "family": family,
+        "resolution": resolution,
+        "matched": len(matched),
+        "series": out_series,
+    }
+
+
 def render_fleet_top(rollup: dict) -> dict:
     """The `tpuctl fleet top` view over the operator's /debug/fleet
     rollup: the cluster capacity/health summary an operator of N nodes
-    reads first, with the per-node table kept for drill-down."""
+    reads first, with the per-node table kept for drill-down. Trend
+    arrows come from the rollup's trends block; an old operator
+    snapshot without one renders steady arrows, never an error."""
     nodes = rollup.get("nodes") or {}
+    trends = rollup.get("trends") or {}
     return {
         "reachable": True,
         "nodes": nodes,
@@ -567,6 +706,12 @@ def render_fleet_top(rollup: dict) -> dict:
         "watchdogStalls": rollup.get("watchdogStalls", []),
         "serving": rollup.get("serving", {}),
         "perf": rollup.get("perf", {}),
+        "trends": trends,
+        "trendArrows": {
+            "chunkBacklog": _slope_arrow(
+                trends.get("chunkBacklogSlope")),
+            "burnRate": _slope_arrow(trends.get("burnRateSlope")),
+        },
         "perNode": rollup.get("perNode", {}),
     }
 
@@ -794,6 +939,19 @@ def run(args) -> dict:
                   f"{args.metrics_addr}: {e}", file=sys.stderr)
             return {"reachable": False, "error": str(e)}
         return render_profile(snap, folded=args.folded)
+
+    if args.cmd == "history":
+        from .utils.flight import fetch
+        try:
+            snap = fetch(args.metrics_addr, token=args.token,
+                         path="/debug/history")
+        except Exception as e:  # noqa: BLE001 — graceful: the history
+            # sampler may simply not run on this node
+            print(f"tpuctl: history endpoint unreachable at "
+                  f"{args.metrics_addr}: {e}", file=sys.stderr)
+            return {"reachable": False, "error": str(e)}
+        return render_history(snap, family=args.family,
+                              resolution=args.resolution)
 
     if args.cmd == "serve" and args.action == "top":
         from .utils.flight import fetch

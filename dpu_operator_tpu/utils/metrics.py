@@ -48,6 +48,16 @@ class Counter:
         return sum(v for key, v in items
                    if where({str(k): str(val) for k, val in key}))
 
+    def samples(self) -> list:
+        """Sorted ``(label-dict, value)`` rows across every label set —
+        the read the metrics-history sampler expands labeled families
+        with (one history sub-series per label set, e.g. one burn-rate
+        trend per SLO/window)."""
+        with self._lock:
+            items = sorted(self._values.items())
+        return [({str(k): str(v) for k, v in key}, val)
+                for key, val in items]
+
     def _render(self, openmetrics: bool = False) -> list:
         # OpenMetrics names counter FAMILIES without the _total suffix
         # (samples keep it); emitting `# TYPE x_total counter` makes
@@ -754,6 +764,62 @@ FLEET_SPEC_ACCEPTANCE = REGISTRY.gauge(
     "tpu_fleet_spec_acceptance_rate",
     "Mean speculative-draft acceptance rate over fresh nodes "
     "reporting one (0 when no fresh node serves speculatively)")
+# -- metrics history plane (utils/history.py + utils/trend.py) ---------------
+HISTORY_SAMPLES = REGISTRY.counter(
+    "tpu_history_samples_total",
+    "Sampling passes taken by the in-process metrics history (one per "
+    "cadence tick, each reading every registered family into the "
+    "multi-resolution rings served at /debug/history)")
+HISTORY_SERIES = REGISTRY.gauge(
+    "tpu_history_series",
+    "Distinct time series currently tracked by the metrics history "
+    "(families expand per label set / quantile, bounded by the "
+    "series cap)")
+HISTORY_POINTS = REGISTRY.gauge(
+    "tpu_history_points",
+    "Total points currently held across every history ring at every "
+    "resolution — the memory-bound readout the history gate asserts "
+    "against under a 10k-sample storm")
+HISTORY_EVICTED = REGISTRY.counter(
+    "tpu_history_evicted_total",
+    "History points/series not kept, by reason (ring = oldest point "
+    "evicted by a full ring; series_cap = a new label set refused "
+    "because the series table was full) — bounded by construction, "
+    "never grown")
+TREND_EVALUATIONS = REGISTRY.counter(
+    "tpu_trend_evaluations_total",
+    "Trend-engine evaluation passes over the watched history series")
+TREND_SLOPE = REGISTRY.gauge(
+    "tpu_trend_slope",
+    "Per-series relative drift over the judgment window (signed: "
+    "positive = rising), by series — the raw signal the hysteresis "
+    "judges before any anomaly fires")
+TREND_ANOMALY = REGISTRY.gauge(
+    "tpu_trend_anomaly",
+    "1 while a watched series is in the anomalous state (drift past "
+    "the threshold in its bad direction for escalate_after "
+    "consecutive evaluations, not yet cleared through hold-down), "
+    "by series")
+TREND_TRANSITIONS = REGISTRY.counter(
+    "tpu_trend_transitions_total",
+    "Committed trend state transitions by series and target state "
+    "(anomaly / cleared) — each one also emits a TrendAnomaly / "
+    "TrendCleared Event and a kind=trend flight entry")
+FLEET_TREND_ANOMALIES = REGISTRY.gauge(
+    "tpu_fleet_trend_anomalies",
+    "Fresh nodes currently reporting a trend anomaly, by series — "
+    "the fleet census of the per-node trend verdicts carried in the "
+    "telemetry digests")
+FLEET_TREND_BACKLOG_SLOPE = REGISTRY.gauge(
+    "tpu_fleet_trend_chunk_backlog_slope",
+    "Mean chunk-backlog relative drift over fresh nodes reporting a "
+    "trends block — the fleet-wide prefill-pressure trend the item-5 "
+    "autoscaler consumes")
+FLEET_TREND_BURN_SLOPE = REGISTRY.gauge(
+    "tpu_fleet_trend_burn_rate_slope",
+    "Mean SLO burn-rate relative drift over fresh nodes reporting "
+    "burn-rate trend series — the fleet-wide burn trajectory the "
+    "item-1 router scores by")
 BUILD_INFO = REGISTRY.gauge(
     "tpu_build_info",
     "Always-1 info-style gauge carrying build identity as labels: "

@@ -2208,11 +2208,13 @@ class DecodeService:
                    for name, _ in ev.active_alerts())
 
     def debug_handlers(self) -> dict:
+        from ..utils import history as _history
         from ..utils import profiler as _profiler
         return {"/debug/serve": self.scheduler.snapshot,
                 "/debug/serve/ledger": self.scheduler.ledger.snapshot,
                 "/debug/serve/headroom": self.headroom,
-                "/debug/profile": _profiler.debug_handler}
+                "/debug/profile": _profiler.debug_handler,
+                "/debug/history": _history.debug_handler}
 
     def headroom(self) -> dict:
         """The full replica headroom digest: the scheduler's snapshot
@@ -2232,10 +2234,15 @@ class DecodeService:
                           if self.fault_capacity_fn is not None
                           else None)
         digest["faultGateCapacity"] = fault_capacity
+        from ..utils import trend as _trend
+        anomalies = _trend.TREND.anomalies()
+        digest["trendAnomalies"] = anomalies
         metrics.SERVE_HEADROOM.set(float(len(alerts)),
                                    dimension="slo_alerts_firing")
         metrics.SERVE_HEADROOM.set(float(fault_capacity or 0),
                                    dimension="fault_gate_capacity")
+        metrics.SERVE_HEADROOM.set(float(len(anomalies)),
+                                   dimension="trend_anomalies")
         return digest
 
     # -- streaming ingress ----------------------------------------------------
@@ -2443,6 +2450,13 @@ class DecodeService:
         # are warmup, compiles after steady state are regressions
         from ..utils import profiler as _profiler
         _profiler.PROFILER.start()
+        # the metrics history plane rides here too: serving families
+        # sampled into the bounded rings, trend engine judging them
+        from ..utils import history as _history
+        from ..utils import trend as _trend
+        _history.register_serving_families()
+        _trend.register_serving_watches()
+        _history.HISTORY.start()
         jaxwatch.arm()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="serve-scheduler")
@@ -2474,6 +2488,8 @@ class DecodeService:
             self._http_thread.join(timeout=5)
             self._http_thread = None
         self._stop.set()
+        from ..utils import history as _history
+        _history.HISTORY.stop()
         thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout=5)

@@ -278,7 +278,7 @@ def test_leases_are_namespace_scoped(rbac_clients):
 DEBUG_PATHS = ("/debug", "/debug/flight", "/debug/health",
                "/debug/serve", "/debug/serve/ledger",
                "/debug/serve/headroom", "/debug/fleet",
-               "/debug/profile")
+               "/debug/profile", "/debug/history")
 
 
 @pytest.fixture
@@ -296,6 +296,7 @@ def debug_server():
             "/debug/serve/headroom": lambda: {"ok": "headroom"},
             "/debug/fleet": lambda: {"ok": "fleet"},
             "/debug/profile": lambda: {"ok": "profile"},
+            "/debug/history": lambda: {"ok": "history"},
         })
     ms.start()
     yield ms

@@ -507,15 +507,23 @@ def test_file_cache_invalidated_by_rule_set_change(tmp_path):
 def test_lint_gate_19_rules_under_8_seconds():
     """The tightened bound the v4 pass must respect: the whole-tree
     gate (19 rules, ONE index build, four trace rules sharing one
-    model) stays interactive."""
-    t0 = time.monotonic()
-    proc = subprocess.run(
-        [sys.executable, "-m", "dpu_operator_tpu.analysis"],
-        cwd=REPO, capture_output=True, text=True, timeout=60)
-    elapsed = time.monotonic() - t0
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "(19 rules)" in proc.stdout
-    assert elapsed < 8.0, f"lint gate took {elapsed:.1f}s"
+    model) stays interactive. Best-of-two, because the tripwire is for
+    algorithmic blowup (a second index build roughly doubles EVERY
+    run) — a single subprocess timing on a loaded box jitters by
+    seconds and must not fail the gate on scheduler noise."""
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "dpu_operator_tpu.analysis"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "(19 rules)" in proc.stdout
+        best = min(best, elapsed)
+        if best < 8.0:
+            break
+    assert best < 8.0, f"lint gate took {best:.1f}s (best of two)"
 
 
 def test_v4_rules_registered_and_live_tree_green():

@@ -32,44 +32,16 @@ import sys
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+# the direction vocabulary lives in the package (the live trend engine
+# shares it — utils/metric_direction.py); running this file standalone
+# from tools/ needs the repo root on sys.path first
+try:
+    from dpu_operator_tpu.utils.metric_direction import direction
+except ImportError:  # pragma: no cover — standalone invocation
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from dpu_operator_tpu.utils.metric_direction import direction
+
 BENCH_GLOB = "BENCH_r*.json"
-
-#: tokens that settle the direction outright (a ttft IMPROVEMENT is
-#: higher-better even though ttft itself is a latency)
-_STRONG_HIGHER = {"improvement", "speedup", "acceptance", "accepted",
-                  "mfu", "throughput"}
-#: name tokens that mark a metric as lower-is-better (latencies and
-#: loss/waste counters)
-_LOWER_TOKENS = {
-    "ms", "s", "p50", "p95", "p99", "ttft", "itl", "latency", "rtt",
-    "leaked", "discarded", "rejected", "preemptions", "copies",
-    "opened", "stalls", "dropped", "retraces",
-}
-#: name tokens that mark a metric as higher-is-better
-_HIGHER_TOKENS = {
-    "rate", "tokens", "tflops", "peak", "completed", "hits", "shared",
-    "reconciles", "cut", "ratio",
-}
-
-
-def _tokens(metric: str) -> List[str]:
-    # throughput suffixes (tok_s, tokens_per_s, reconciles_per_s) are
-    # rates, not durations — collapse them BEFORE 's' can read as a
-    # seconds suffix
-    name = re.sub(r"tok(ens)?_s|per_s", "rate", metric.lower())
-    return [t for t in re.split(r"[^a-z0-9]+", name) if t]
-
-
-def direction(metric: str) -> int:
-    """+1 higher-is-better, -1 lower-is-better, 0 unknown."""
-    toks = _tokens(metric)
-    if any(t in _STRONG_HIGHER for t in toks):
-        return +1
-    if any(t in _LOWER_TOKENS for t in toks):
-        return -1
-    if any(t in _HIGHER_TOKENS for t in toks):
-        return +1
-    return 0
 
 
 def flatten_numeric(value: object, prefix: str = "",
